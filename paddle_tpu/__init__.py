@@ -1,0 +1,63 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's capabilities.
+
+Built from scratch on jax/XLA/Pallas (see SURVEY.md). The public surface mirrors
+the reference's ``paddle.*`` so users can switch with an import change:
+eager Tensors with ``.backward()``, ``nn.Layer``, optimizers, AMP, DataLoader,
+``vision`` models, a Fleet-equivalent hybrid-parallel stack, and jit-to-XLA
+compilation — all running SPMD over TPU meshes.
+"""
+from __future__ import annotations
+
+from .framework import (  # noqa: F401
+    CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
+    set_device, get_device, device_count, get_flags, set_flags, seed,
+    get_rng_state, set_rng_state, set_default_dtype, get_default_dtype,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .framework.dtype import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2, DType,
+)
+from .tensor import *  # noqa: F401,F403
+from .tensor import Tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+
+# Subsystems land incrementally during the build; import what exists.
+import importlib as _importlib
+
+for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
+             "incubate", "ops", "profiler", "device", "hapi", "static"):
+    try:
+        globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
+    except ImportError:
+        pass
+
+if "hapi" in globals():
+    from .hapi.model import Model  # noqa: F401
+if "nn" in globals():
+    from .nn.layer.layers import ParamAttr  # noqa: F401
+
+# dygraph-mode shims: this framework is always "dygraph" (eager over XLA)
+def in_dynamic_mode():
+    return True
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for compiled graphs")
+
+
+def is_grad_enabled_():
+    from .autograd import engine
+    return engine.is_grad_enabled()
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,6 @@
+"""Automatic mixed precision (ref: python/paddle/amp/)."""
+from .auto_cast import auto_cast, amp_guard, decorate
+from .grad_scaler import GradScaler
+from . import state
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler"]

@@ -1,0 +1,52 @@
+"""Autocast context + model decoration (ref: python/paddle/amp/auto_cast.py).
+
+O1: matmul/conv cast to low precision at op level (see amp/state.py hooks in
+linalg.matmul and nn.functional.conv). O2: parameters themselves are cast; the
+optimizer keeps fp32 master weights (optimizer/optimizer.py multi_precision).
+bfloat16 is the TPU default — no loss scaling required.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from . import state
+from ..framework import dtype as dtype_mod
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (state._enabled, state._dtype, state._level)
+    state.set_autocast(enable, dtype_mod.convert_dtype(dtype), level)
+    try:
+        yield
+    finally:
+        state._enabled, state._dtype, state._level = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """Cast model parameters to the AMP dtype (O2); enable optimizer master weights."""
+    nd = dtype_mod.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(nd)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            if master_weight is not False:
+                opt._multi_precision = True
+        if single_model:
+            return models, optimizers
+        return model_list, opt_list
+    return models if single_model else model_list

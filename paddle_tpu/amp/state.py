@@ -1,0 +1,47 @@
+"""AMP autocast state, consulted by hot ops (matmul/conv) at trace time.
+
+Ref: python/paddle/amp/auto_cast.py. O1 = cast MXU-bound ops (matmul, conv) to
+the low-precision dtype; O2 = whole-model low precision with fp32 master
+weights (handled in amp/decorate). bfloat16 is the TPU-native choice: no loss
+scaling needed (same exponent range as fp32).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_enabled = False
+_dtype = jnp.bfloat16
+_level = "O1"
+
+
+def set_autocast(enabled: bool, dtype=None, level: str = "O1"):
+    global _enabled, _dtype, _level
+    _enabled = enabled
+    if dtype is not None:
+        _dtype = jnp.dtype(dtype)
+    _level = level
+
+
+def autocast_enabled() -> bool:
+    return _enabled
+
+
+def autocast_dtype():
+    return _dtype
+
+
+def autocast_level() -> str:
+    return _level
+
+
+def maybe_autocast(x):
+    """Cast a float array to the autocast dtype when autocast is active."""
+    if _enabled and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != _dtype:
+        return x.astype(_dtype)
+    return x
+
+
+def maybe_autocast_pair(a, b):
+    if _enabled:
+        return maybe_autocast(a), maybe_autocast(b)
+    return a, b

@@ -1,0 +1,7 @@
+"""Autograd public API (ref: python/paddle/autograd/)."""
+from .engine import (backward, grad, no_grad, enable_grad, is_grad_enabled,
+                     set_grad_enabled, GradNode)
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext"]

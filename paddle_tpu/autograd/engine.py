@@ -1,0 +1,249 @@
+"""Define-by-run autograd engine (ref: paddle/fluid/eager/backward.cc, grad_node_info.h).
+
+TPU-native design: instead of per-op hand-written GradNodes codegen'd from
+backward.yaml, every eager op records ONE GradNode holding the ``jax.vjp``
+closure of its traced forward. Backward is a reverse-topological sweep over
+nodes, accumulating cotangents per producer output slot, exactly like the
+reference's ``egr::Backward`` queue — but each node's grad kernel is the
+XLA-compiled vjp instead of a CUDA kernel.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator disabling autograd taping (paddle.no_grad parity)."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    # allow use as plain decorator: @no_grad
+    def __call__(self, func=None):
+        if func is None:
+            return self
+        @functools.wraps(func)
+        def wrapper(*a, **k):
+            with no_grad():
+                return func(*a, **k)
+        return wrapper
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+def _zero_cotangent(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _is_float0(g) -> bool:
+    return getattr(g, "dtype", None) == jax.dtypes.float0
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    Holds the vjp closure, strong refs to input Tensors (keeps the graph alive
+    until backward, like the reference's GradNode input metas), the output tree
+    structure, and accumulated pending cotangents per output slot.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_treedef", "out_avals",
+                 "pending", "out_hooks", "__weakref__")
+
+    def __init__(self, name, vjp_fn, inputs, out_treedef, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs           # list[Tensor], positional wrt vjp primals
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals     # list[(shape, dtype)] per flat output
+        self.pending: Dict[int, Any] = {}
+        self.out_hooks: Dict[int, List] = {}
+
+    def producers(self):
+        seen = []
+        ids = set()
+        for t in self.inputs:
+            p = t._grad_node
+            if p is not None and id(p) not in ids:
+                ids.add(id(p))
+                seen.append(p)
+        return seen
+
+    def accumulate(self, idx: int, g):
+        cur = self.pending.get(idx)
+        self.pending[idx] = g if cur is None else cur + g
+
+    def run_vjp(self):
+        cts = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            g = self.pending.get(i)
+            if g is None:
+                g = _zero_cotangent(shape, dtype)
+            else:
+                for hook in self.out_hooks.get(i, ()):
+                    res = hook_call(hook, g)
+                    if res is not None:
+                        g = res
+            cts.append(g)
+        ct_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
+        return self.vjp_fn(ct_tree)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = ()
+        self.pending.clear()
+
+
+def hook_call(hook, g):
+    from ..tensor.tensor import Tensor
+    res = hook(Tensor._from_data(g, stop_gradient=True))
+    if res is None:
+        return None
+    return res._data if isinstance(res, Tensor) else res
+
+
+def _accumulate_leaf(tensor, g):
+    from ..tensor.tensor import Tensor
+    for hook in tensor._hooks:
+        res = hook_call(hook, g)
+        if res is not None:
+            g = res
+    if tensor.grad is None:
+        tensor.grad = Tensor._from_data(g, stop_gradient=True)
+    else:
+        tensor.grad._data = tensor.grad._data + g
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False):
+    """Run backward from ``tensor``, accumulating into leaf ``.grad``s."""
+    from ..tensor.tensor import Tensor
+
+    data = tensor._data
+    if grad_tensor is None:
+        if data.size != 1:
+            raise RuntimeError(
+                "grad_tensor can only be None for scalar outputs "
+                f"(got shape {tuple(data.shape)})")
+        seed = jnp.ones_like(data)
+    else:
+        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+        seed = jnp.broadcast_to(seed, data.shape).astype(data.dtype)
+
+    root = tensor._grad_node
+    if root is None:
+        if not tensor.stop_gradient:
+            _accumulate_leaf(tensor, seed)
+        return
+
+    # Count reachable consumer edges per node (Kahn over the reverse graph).
+    indeg: Dict[int, int] = {id(root): 0}
+    nodes: Dict[int, GradNode] = {id(root): root}
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        for p in n.producers():
+            pid = id(p)
+            indeg[pid] = indeg.get(pid, 0) + 1
+            if pid not in nodes:
+                nodes[pid] = p
+                stack.append(p)
+
+    root.accumulate(tensor._out_index, seed)
+    queue: List[GradNode] = [root]
+    while queue:
+        n = queue.pop()
+        in_grads = n.run_vjp()
+        consumed_inputs = n.inputs
+        for t, g in zip(consumed_inputs, in_grads):
+            if g is None or _is_float0(g):
+                continue
+            if t.stop_gradient:
+                continue
+            p = t._grad_node
+            if p is None:
+                _accumulate_leaf(t, g)
+            else:
+                p.accumulate(t._out_index, g)
+        for p in n.producers():
+            pid = id(p)
+            indeg[pid] -= 1
+            if indeg[pid] == 0:
+                queue.append(p)
+        if not retain_graph:
+            n.release()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """paddle.grad parity: return grads of outputs w.r.t. inputs without
+    touching ``.grad`` fields. Implemented via a scoped backward that records
+    leaf grads into a side table."""
+    from ..tensor.tensor import Tensor
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+
+    saved = [(t.grad, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        for o, go in zip(outputs, grad_outputs):
+            backward(o, go, retain_graph=retain_graph or create_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError("an input tensor received no gradient; "
+                                       "pass allow_unused=True to permit this")
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, (g, sg) in zip(inputs, saved):
+            t.grad = g
+            t.stop_gradient = sg

@@ -1,0 +1,92 @@
+"""Custom autograd functions (ref: python/paddle/autograd/py_layer.py).
+
+PyLayer lets users define forward/backward in Python; the recorded GradNode
+calls the user's ``backward`` instead of a jax.vjp closure. This is the
+mechanism `recompute` (activation checkpointing) builds on, like the reference.
+"""
+from __future__ import annotations
+
+import jax
+
+from . import engine
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class _PyLayerNode(engine.GradNode):
+    """GradNode whose vjp is the user's backward()."""
+
+    __slots__ = ("ctx", "layer_cls", "n_inputs")
+
+    def __init__(self, layer_cls, ctx, inputs, out_treedef, out_avals):
+        super().__init__(layer_cls.__name__, None, inputs, out_treedef, out_avals)
+        self.ctx = ctx
+        self.layer_cls = layer_cls
+
+    def run_vjp(self):
+        from ..tensor.tensor import Tensor
+        cts = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            g = self.pending.get(i)
+            if g is None:
+                g = engine._zero_cotangent(shape, dtype)
+            else:
+                for hook in self.out_hooks.get(i, ()):
+                    res = engine.hook_call(hook, g)
+                    if res is not None:
+                        g = res
+            cts.append(Tensor._from_data(g, stop_gradient=True))
+        with engine.no_grad():
+            grads = self.layer_cls.backward(self.ctx, *cts)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        out = []
+        for g in grads:
+            out.append(None if g is None else (g._data if isinstance(g, Tensor) else g))
+        return tuple(out)
+
+    def release(self):
+        self.ctx = None
+        self.inputs = ()
+        self.pending.clear()
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor.tensor import Tensor
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = (engine.is_grad_enabled()
+                      and any(not t.stop_gradient for t in in_tensors))
+        with engine.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        if not needs_grad:
+            return outs
+        out_leaves = [o._data for o in out_list]
+        _, out_treedef = jax.tree_util.tree_flatten(out_leaves)
+        avals = [(tuple(o.shape), o.dtype) for o in out_leaves]
+        node = _PyLayerNode(cls, ctx, in_tensors, out_treedef, avals)
+        wrapped = [Tensor._from_data(o, node=node, out_index=i, stop_gradient=False)
+                   for i, o in enumerate(out_leaves)]
+        return wrapped[0] if single else tuple(wrapped)

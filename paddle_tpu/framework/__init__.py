@@ -1,0 +1,9 @@
+"""Framework core: dtypes, places, flags, rng (ref: paddle/phi/common + paddle/common)."""
+from . import dtype as _dtype_mod
+from .dtype import (DType, convert_dtype, to_framework_dtype, get_default_dtype,
+                    set_default_dtype)
+from .place import (Place, CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
+                    set_device, get_device, device_count,
+                    is_compiled_with_cuda, is_compiled_with_tpu)
+from .flags import define_flag, get_flags, get_flag, set_flags
+from .random import seed, get_rng_state, set_rng_state, get_rng_state_tracker

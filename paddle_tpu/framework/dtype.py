@@ -1,0 +1,108 @@
+"""Data types for paddle_tpu.
+
+TPU-native rebuild of the reference's dtype surface (ref: paddle/phi/common/data_type.h).
+Dtypes are thin named wrappers over numpy/jax dtypes so that ``paddle_tpu.float32`` etc.
+work as drop-in dtype arguments everywhere, while the underlying arrays are jax arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+
+class DType:
+    """A framework dtype. Compares equal to its string name and numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return np.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", ml_dtypes.float8_e5m2)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+        float64, complex64, complex128, float8_e4m3fn, float8_e5m2]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+
+_DEFAULT_DTYPE = float32
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (DType, str, np/jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype.np_dtype
+    if isinstance(dtype, str):
+        d = _BY_NAME.get(dtype)
+        if d is not None:
+            return d.np_dtype
+        return np.dtype(dtype)
+    return np.dtype(dtype)
+
+
+def to_framework_dtype(np_like) -> DType:
+    """Map a numpy/jax dtype back to the framework DType object."""
+    nd = np.dtype(np_like)
+    for d in _ALL:
+        if d.np_dtype == nd:
+            return d
+    raise TypeError(f"unsupported dtype: {np_like}")
+
+
+def get_default_dtype() -> DType:
+    return _DEFAULT_DTYPE
+
+
+def set_default_dtype(dtype):
+    global _DEFAULT_DTYPE
+    nd = convert_dtype(dtype)
+    _DEFAULT_DTYPE = to_framework_dtype(nd)
+
+
+def is_floating(dtype) -> bool:
+    nd = convert_dtype(dtype)
+    return jnp.issubdtype(nd, np.floating)
+
+
+def is_integer(dtype) -> bool:
+    nd = convert_dtype(dtype)
+    return jnp.issubdtype(nd, np.integer)
+
+
+def is_complex(dtype) -> bool:
+    nd = convert_dtype(dtype)
+    return jnp.issubdtype(nd, np.complexfloating)

@@ -1,0 +1,66 @@
+"""Typed flag registry with environment override (ref: paddle/common/flags.cc).
+
+The reference has gflags-style ``FLAGS_*`` definitions settable via env or
+``paddle.set_flags``. Here: a single registry; env vars named ``FLAGS_<name>``
+override defaults at first read; ``set_flags`` overrides at runtime.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, dict] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return s.lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name: str, default, help_str: str = "", parser: Callable | None = None):
+    if parser is None:
+        if isinstance(default, bool):
+            parser = _parse_bool
+        elif isinstance(default, int):
+            parser = int
+        elif isinstance(default, float):
+            parser = float
+        else:
+            parser = str
+    _REGISTRY[name] = {"default": default, "help": help_str,
+                       "parser": parser, "value": None}
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for n in names:
+        ent = _REGISTRY.get(n)
+        if ent is None:
+            raise KeyError(f"unknown flag: {n}")
+        if ent["value"] is not None:
+            out[n] = ent["value"]
+        else:
+            env = os.environ.get(f"FLAGS_{n}")
+            out[n] = ent["parser"](env) if env is not None else ent["default"]
+    return out
+
+
+def get_flag(name: str):
+    return get_flags([name])[name]
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag: {k}")
+        _REGISTRY[k]["value"] = v
+
+
+# Core flags (TPU-relevant subset of the reference's surface).
+define_flag("allocator_strategy", "auto_growth", "kept for API parity; XLA/PJRT owns device memory")
+define_flag("check_nan_inf", False, "check outputs for nan/inf after each eager op")
+define_flag("cudnn_deterministic", True, "parity alias: deterministic op selection")
+define_flag("use_pallas_kernels", True, "use Pallas custom kernels when on TPU")
+define_flag("eager_op_jit", False, "wrap each eager op in jax.jit (per-op cache)")
+define_flag("log_level", 0, "framework VLOG level")

@@ -1,0 +1,73 @@
+"""Serialization: paddle.save / paddle.load parity (ref: python/paddle/framework/io.py).
+
+State dicts (nested dict/list of Tensors) are saved as pickle with per-tensor
+numpy payloads, like the reference. Sharded/async distributed checkpointing
+lives in distributed/checkpoint (orbax/TensorStore-style).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper for a tensor's ndarray + metadata."""
+
+    def __init__(self, array: np.ndarray, stop_gradient: bool = True):
+        # bfloat16 has no portable numpy repr; store as uint16 view + tag
+        self.dtype_name = str(array.dtype)
+        if self.dtype_name == "bfloat16":
+            self.buf = array.view(np.uint16)
+        else:
+            self.buf = array
+        self.stop_gradient = stop_gradient
+
+    def to_array(self) -> np.ndarray:
+        if self.dtype_name == "bfloat16":
+            import ml_dtypes
+            return self.buf.view(ml_dtypes.bfloat16)
+        return self.buf
+
+
+def _pack(obj):
+    from ..tensor.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    from ..tensor.tensor import Tensor
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_array()
+        if return_numpy:
+            return arr
+        t = Tensor._from_data(jnp.asarray(arr))
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

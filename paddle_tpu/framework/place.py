@@ -1,0 +1,115 @@
+"""Device placement (ref: paddle/phi/common/place.h).
+
+The reference keys kernels and allocations by ``phi::Place`` (CPUPlace/GPUPlace/...).
+On TPU the device runtime is PJRT behind jax; a Place here names a jax device and
+``set_device`` steers where eager ops place their outputs via jax's default-device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    """Base place. Identifies a device type and an index."""
+
+    device_type: str = "undefined"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _dev_kind(d) == self.device_type]
+        if not devs:
+            # fall back to host CPU devices (always present)
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+# GPU alias for API parity: scripts that say "gpu" run on the accelerator present.
+class GPUPlace(Place):
+    device_type = "tpu"
+
+
+CUDAPlace = GPUPlace
+
+_current_place: Place | None = None
+
+
+def _dev_kind(d) -> str:
+    p = d.platform.lower()
+    # treat any accelerator platform (tpu / experimental bridges) as "tpu"
+    return "cpu" if p == "cpu" else "tpu"
+
+
+def _default_place() -> Place:
+    for d in jax.devices():
+        if _dev_kind(d) == "tpu":
+            return TPUPlace(0)
+    return CPUPlace(0)
+
+
+def get_device() -> str:
+    p = _current_expected_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def set_device(device: str) -> Place:
+    """Set the global default device, e.g. 'tpu', 'tpu:0', 'cpu', 'gpu:0'."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    name = name.lower()
+    if name in ("tpu", "gpu", "cuda", "xpu", "npu"):
+        _current_place = TPUPlace(idx)
+    elif name == "cpu":
+        _current_place = CPUPlace(idx)
+    else:
+        _current_place = CustomPlace(name, idx)
+    return _current_place
+
+
+def _current_expected_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = _default_place()
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:  # parity shim
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(_dev_kind(d) == "tpu" for d in jax.devices())
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,98 @@
+"""Global RNG state (ref: paddle/fluid/framework/generator.cc).
+
+The reference has stateful per-device Generators. jax is functional (explicit keys);
+we keep a global counter-based state: every random op folds a fresh subkey out of
+the global key. ``get_rng_state``/``set_rng_state`` capture (key, counter) so
+training runs are reproducible and resumable.
+
+A named-state tracker (``RNGStatesTracker``) mirrors the reference's
+fleet/meta_parallel/parallel_layers/random.py for tensor-parallel-deterministic
+dropout: "global" state is identical across mp ranks, "local" state is folded with
+the mp rank so dropout masks differ where they must.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+
+class _GeneratorState:
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self.counter = 0
+
+    def key(self):
+        k = jax.random.fold_in(jax.random.PRNGKey(self.seed), self.counter)
+        self.counter += 1
+        return k
+
+    def state(self):
+        return (self.seed, self.counter)
+
+    def set_state(self, state):
+        self.seed, self.counter = state
+
+
+_GLOBAL = _GeneratorState(seed=np.random.randint(0, 2**31 - 1))
+
+
+def seed(s: int):
+    """Set the global RNG seed (paddle.seed parity)."""
+    _GLOBAL.seed = int(s)
+    _GLOBAL.counter = 0
+    np.random.seed(int(s) % (2**32))
+    return _GLOBAL
+
+
+def next_key():
+    """Draw a fresh PRNG key from the global stateful generator."""
+    return _GLOBAL.key()
+
+
+def get_rng_state():
+    return _GLOBAL.state()
+
+
+def set_rng_state(state):
+    _GLOBAL.set_state(state)
+
+
+class RNGStatesTracker:
+    """Named RNG states for hybrid parallel (ref: fleet parallel_layers/random.py)."""
+
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name: str, seed: int):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = _GeneratorState(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self.states:
+            raise ValueError(f"rng state {name} not registered")
+        global _GLOBAL
+        orig = _GLOBAL
+        _GLOBAL = self.states[name]
+        try:
+            yield
+        finally:
+            _GLOBAL = orig
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed_: int, mp_rank: int = 0):
+    """Register global/local states for TP-deterministic dropout."""
+    global _tracker
+    _tracker = RNGStatesTracker()
+    _tracker.add("global_seed", seed_)
+    _tracker.add("local_seed", seed_ + 1024 + mp_rank)

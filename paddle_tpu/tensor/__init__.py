@@ -1,0 +1,65 @@
+"""Tensor API assembly: ops + method attachment.
+
+The reference monkey-patches ~300 methods onto its eager Tensor
+(python/paddle/base/dygraph/math_op_patch.py); we do the same so
+``x.sum()``, ``x + y``, ``x.reshape(...)`` all work.
+"""
+from __future__ import annotations
+
+from .tensor import Tensor, apply_op, unwrap, wrap, _run_op
+from . import creation, linalg, manipulation, math, search
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+
+def _attach(name, fn):
+    setattr(Tensor, name, fn)
+
+
+# attach every public op as a method (paddle parity: tensor.add(y) etc.)
+_METHOD_SOURCES = [math, manipulation, linalg, search]
+_SKIP = {"where"}  # tensor.where has cond-first signature confusion; keep functional
+for _mod in _METHOD_SOURCES:
+    for _name in dir(_mod):
+        if _name.startswith("_") or _name in _SKIP:
+            continue
+        _fn = getattr(_mod, _name)
+        if callable(_fn) and not isinstance(_fn, type):
+            if not hasattr(Tensor, _name):
+                _attach(_name, _fn)
+
+# creation-like methods that take self
+_attach("zeros_like_", None) if False else None
+Tensor.astype = math.cast
+Tensor.cast = math.cast
+
+# -- dunder operators --------------------------------------------------------
+Tensor.__add__ = lambda s, o: math.add(s, o)
+Tensor.__radd__ = lambda s, o: math.add(o, s)
+Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+Tensor.__mod__ = lambda s, o: math.mod(s, o)
+Tensor.__pow__ = lambda s, o: math.pow(s, o)
+Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+Tensor.__neg__ = lambda s: math.neg(s)
+Tensor.__abs__ = lambda s: math.abs(s)
+Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+Tensor.__eq__ = lambda s, o: math.equal(s, o)
+Tensor.__ne__ = lambda s, o: math.not_equal(s, o)
+Tensor.__lt__ = lambda s, o: math.less_than(s, o)
+Tensor.__le__ = lambda s, o: math.less_equal(s, o)
+Tensor.__gt__ = lambda s, o: math.greater_than(s, o)
+Tensor.__ge__ = lambda s, o: math.greater_equal(s, o)
+Tensor.__invert__ = lambda s: math.logical_not(s)
+Tensor.__and__ = lambda s, o: math.bitwise_and(s, o)
+Tensor.__or__ = lambda s, o: math.bitwise_or(s, o)
+Tensor.__xor__ = lambda s, o: math.bitwise_xor(s, o)

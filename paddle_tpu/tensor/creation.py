@@ -1,0 +1,197 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+from .tensor import Tensor, _run_op, _device_put
+
+
+def _dt(dtype, default=None):
+    nd = dtype_mod.convert_dtype(dtype)
+    if nd is None:
+        nd = default or dtype_mod.get_default_dtype().np_dtype
+    return nd
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = data.astype(dtype) if dtype is not None else Tensor._from_data(data._data)
+        t.stop_gradient = stop_gradient
+        return t
+    t = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    return t
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor._from_data(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor._from_data(jnp.ones(_shape_tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor._from_data(jnp.full(_shape_tuple(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _run_op("zeros_like", lambda a: jnp.zeros_like(a, dtype=dtype_mod.convert_dtype(dtype)), (x,), {})
+
+
+def ones_like(x, dtype=None, name=None):
+    return _run_op("ones_like", lambda a: jnp.ones_like(a, dtype=dtype_mod.convert_dtype(dtype)), (x,), {})
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _run_op("full_like", lambda a: jnp.full_like(a, fill_value, dtype=dtype_mod.convert_dtype(dtype)), (x,), {})
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    start, end, step = v(start), v(end), v(step)
+    if end is None:
+        start, end = 0, start
+    nd = dtype_mod.convert_dtype(dtype)
+    if nd is None:
+        nd = (np.int64 if all(isinstance(a, (int, np.integer)) for a in (start, end, step))
+              else dtype_mod.get_default_dtype().np_dtype)
+    return Tensor._from_data(jnp.arange(start, end, step, dtype=nd))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def v(x):
+        return x.item() if isinstance(x, Tensor) else x
+    return Tensor._from_data(jnp.linspace(v(start), v(stop), int(v(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor._from_data(jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._from_data(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, offset)
+            mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else jnp.diag(jnp.ones_like(a, dtype=bool), offset)
+            return jnp.where(mask, d, padding_value).astype(a.dtype)
+        return jnp.diag(a, offset)
+    return _run_op("diag", f, (x,), {})
+
+
+def diagflat(x, offset=0, name=None):
+    return _run_op("diagflat", lambda a: jnp.diagflat(a, offset), (x,), {})
+
+
+def tril(x, diagonal=0, name=None):
+    return _run_op("tril", lambda a: jnp.tril(a, diagonal), (x,), {})
+
+
+def triu(x, diagonal=0, name=None):
+    return _run_op("triu", lambda a: jnp.triu(a, diagonal), (x,), {})
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[t._data for t in tensors], indexing="ij")
+    return [Tensor._from_data(o) for o in outs]
+
+
+def assign(x, output=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._data = data.astype(output._data.dtype) if output._data.dtype != data.dtype else data
+        return output
+    return Tensor._from_data(data)
+
+
+def clone(x, name=None):
+    return _run_op("clone", lambda a: a + jnp.zeros((), a.dtype), (x,), {})
+
+
+def complex(real, imag, name=None):
+    return _run_op("complex", lambda r, i: jax.lax.complex(r, i), (real, imag), {})
+
+
+# -- random ------------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = random_mod.next_key() if not seed else jax.random.PRNGKey(seed)
+    d = jax.random.uniform(key, _shape_tuple(shape), dtype=_dt(dtype),
+                           minval=min, maxval=max)
+    return Tensor._from_data(d)
+
+
+def randn(shape, dtype=None, name=None):
+    return normal(mean=0.0, std=1.0, shape=shape, dtype=dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None, name=None):
+    key = random_mod.next_key()
+    if shape is None:
+        shape = ()
+    d = jax.random.normal(key, _shape_tuple(shape), dtype=_dt(dtype)) * std + mean
+    return Tensor._from_data(d)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    nd = dtype_mod.convert_dtype(dtype) or np.int64
+    return Tensor._from_data(jax.random.randint(key, _shape_tuple(shape), low, high, dtype=nd))
+
+
+def randperm(n, dtype=None, name=None):
+    key = random_mod.next_key()
+    nd = dtype_mod.convert_dtype(dtype) or np.int64
+    return Tensor._from_data(jax.random.permutation(key, n).astype(nd))
+
+
+def bernoulli(x, name=None):
+    key = random_mod.next_key()
+    d = (jax.random.uniform(key, tuple(x._data.shape), dtype=jnp.float32)
+         < x._data.astype(jnp.float32)).astype(x._data.dtype)
+    return Tensor._from_data(d)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_mod.next_key()
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if x._data.ndim == 1:
+        out = jax.random.choice(key, x._data.shape[0], (num_samples,),
+                                replace=replacement, p=x._data / x._data.sum())
+    else:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(x._data.shape[0], num_samples))
+    return Tensor._from_data(out.astype(np.int64))

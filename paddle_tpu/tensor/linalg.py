@@ -1,0 +1,183 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py).
+
+``matmul`` is the MXU hot path: bf16 inputs stay bf16 with fp32 accumulation
+(jax's default ``preferred_element_type`` handling) so XLA tiles it onto the
+systolic array.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, _run_op
+from ..amp import state as amp_state
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        a, b = amp_state.maybe_autocast_pair(a, b)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return _run_op("matmul", f, (x, y), {})
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return _run_op("mv", lambda a, b: jnp.matmul(a, b), (x, vec), {})
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _run_op("addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                   (input, x, y), {})
+
+
+def einsum(equation, *operands):
+    ops = operands[0] if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else operands
+    return _run_op("einsum", lambda *ts: jnp.einsum(equation, *ts), tuple(ops), {})
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+    return _run_op("norm", f, (x,), {})
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y, p=float(p))
+
+
+def t(x, name=None):
+    return _run_op("t", lambda a: a.T if a.ndim <= 2 else jnp.swapaxes(a, -1, -2), (x,), {})
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else -1
+    return _run_op("cross", lambda a, b: jnp.cross(a, b, axis=ax), (x, y), {})
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return _run_op("cholesky", f, (x,), {})
+
+
+def inverse(x, name=None):
+    return _run_op("inverse", lambda a: jnp.linalg.inv(a), (x,), {})
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _run_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), (x,), {})
+
+
+def det(x, name=None):
+    return _run_op("det", lambda a: jnp.linalg.det(a), (x,), {})
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+    return _run_op("slogdet", f, (x,), {})
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return _run_op("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol=tol), (x,), {})
+
+
+def matrix_power(x, n, name=None):
+    return _run_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), (x,), {})
+
+
+def qr(x, mode="reduced", name=None):
+    out = _run_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), (x,), {})
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    return _run_op("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), (x,), {})
+
+
+def eig(x, name=None):
+    return _run_op("eig", lambda a: tuple(jnp.linalg.eig(a)), (x,), {})
+
+
+def eigh(x, UPLO="L", name=None):
+    return _run_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,), {})
+
+
+def eigvals(x, name=None):
+    return _run_op("eigvals", lambda a: jnp.linalg.eigvals(a), (x,), {})
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _run_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,), {})
+
+
+def solve(x, y, name=None):
+    return _run_op("solve", lambda a, b: jnp.linalg.solve(a, b), (x, y), {})
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return _run_op("triangular_solve", f, (x, y), {})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return _run_op("lstsq", lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), (x, y), {})
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(np.int32)
+    return _run_op("lu", f, (x,), {})
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _run_op("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), (x,), {})
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _run_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,), {})
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+        return h.astype(np.int64)
+    return _run_op("histogram", f, (x,), {})
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._data if isinstance(weights, Tensor) else weights
+    def f(a):
+        return jnp.bincount(a.astype(jnp.int32), weights=w, minlength=minlength)
+    return _run_op("bincount", f, (x,), {})
+
+
+def multi_dot(x, name=None):
+    return _run_op("multi_dot", lambda *ts: jnp.linalg.multi_dot(ts), tuple(x), {})

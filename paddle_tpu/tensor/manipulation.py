@@ -1,0 +1,345 @@
+"""Shape / layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, _run_op
+
+
+def _shape(s):
+    if isinstance(s, Tensor):
+        s = s.tolist()
+    if isinstance(s, (int, np.integer)):
+        return (int(s),)
+    return tuple(int(v.item()) if isinstance(v, Tensor) else int(v) for v in s)
+
+
+def reshape(x, shape, name=None):
+    return _run_op("reshape", lambda a: jnp.reshape(a, _shape(shape)), (x,), {})
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _shape(shape))
+    return x
+
+
+def transpose(x, perm, name=None):
+    return _run_op("transpose", lambda a: jnp.transpose(a, perm), (x,), {})
+
+
+def moveaxis(x, source, destination, name=None):
+    return _run_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), (x,), {})
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return _run_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), (x,), {})
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _run_op("concat", lambda *ts: jnp.concatenate(ts, axis=axis), tuple(tensors), {})
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return _run_op("stack", lambda *ts: jnp.stack(ts, axis=axis), tuple(tensors), {})
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    def f(a):
+        return tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis))
+    return list(_run_op("unstack", f, (x,), {}))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=axis))
+        sections = [int(s) for s in num_or_sections]
+        # paddle allows one -1 section
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = a.shape[axis] - known
+        idx = np.cumsum(sections)[:-1]
+        return tuple(jnp.split(a, idx, axis=axis))
+    return list(_run_op("split", f, (x,), {}))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axes) if axes else a
+    return _run_op("squeeze", f, (x,), {})
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+    def f(a):
+        for ax in sorted(axes):
+            a = jnp.expand_dims(a, ax)
+        return a
+    return _run_op("unsqueeze", f, (x,), {})
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return _run_op("flatten", f, (x,), {})
+
+
+def expand(x, shape, name=None):
+    target = _shape(shape)
+    def f(a):
+        # paddle semantics: -1 keeps the original dim
+        res = []
+        off = len(target) - a.ndim
+        for i, t in enumerate(target):
+            if t == -1:
+                res.append(a.shape[i - off] if i >= off else 1)
+            else:
+                res.append(t)
+        return jnp.broadcast_to(a, tuple(res))
+    return _run_op("expand", f, (x,), {})
+
+
+def expand_as(x, y, name=None):
+    return _run_op("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), (x, y), {})
+
+
+def broadcast_to(x, shape, name=None):
+    return _run_op("broadcast_to", lambda a: jnp.broadcast_to(a, _shape(shape)), (x,), {})
+
+
+def broadcast_tensors(inputs, name=None):
+    datas = jnp.broadcast_arrays(*[t._data for t in inputs])
+    shape = datas[0].shape
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape(repeat_times)
+    return _run_op("tile", lambda a: jnp.tile(a, reps), (x,), {})
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return _run_op("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), (x,), {})
+
+
+def roll(x, shifts, axis=None, name=None):
+    return _run_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), (x,), {})
+
+
+def flip(x, axis, name=None):
+    return _run_op("flip", lambda a: jnp.flip(a, axis=axis), (x,), {})
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _run_op("rot90", lambda a: jnp.rot90(a, k, axes), (x,), {})
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _run_op("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), (x, index), {})
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return _run_op("gather_nd", f, (x, index), {})
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return _run_op("take_along_axis",
+                   lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                   (arr, indices), {})
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        if reduce in ("add", "sum"):
+            dims = list(range(a.ndim))
+            onehot = None
+            # scatter-add via at[]
+            idx_full = [jnp.arange(s).reshape([-1 if d == k else 1 for k in dims])
+                        for d, s in enumerate(i.shape)]
+            idx_full[axis] = i
+            return a.at[tuple(idx_full)].add(v)
+        raise ValueError(f"unsupported reduce: {reduce}")
+    return _run_op("put_along_axis", f, (arr, indices, values), {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+    return _run_op("scatter", f, (x, index, updates), {})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        i = i.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return _run_op("scatter_nd_add", f, (x, index, updates), {})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        zeros = jnp.zeros(_shape(shape), u.dtype)
+        return zeros.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+    return _run_op("scatter_nd", f, (index, updates), {})
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    return _run_op("index_sample",
+                   lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+                   (x, index), {})
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return _run_op("index_add", f, (x, index, value), {})
+
+
+def masked_select(x, mask, name=None):
+    # data-dependent output shape: executes on host values (eager only)
+    data = x._data
+    m = mask._data if isinstance(mask, Tensor) else mask
+    return Tensor._from_data(data[jnp.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._data if isinstance(value, Tensor) else value
+    return _run_op("masked_fill", lambda a, m: jnp.where(m, v, a), (x, mask), {})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # paddle order: per-axis (before, after) starting from first axis
+            width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # NCHW-style: pad applies to last len(pad)//2 spatial dims, reversed pairs
+            n_spatial = len(pad) // 2
+            width = [(0, 0)] * (nd - n_spatial)
+            for i in range(n_spatial):
+                width.append((pad[2 * i], pad[2 * i + 1]))
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return _run_op("pad", f, (x,), {})
+
+
+def tensordot(x, y, axes=2, name=None):
+    return _run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y), {})
+
+
+def as_real(x, name=None):
+    return _run_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), (x,), {})
+
+
+def as_complex(x, name=None):
+    return _run_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,), {})
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # data-dependent shape: host-side eager op
+    arr = np.asarray(jax.device_get(x._data))
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor._from_data(jnp.asarray(res))
+    return tuple(Tensor._from_data(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, name=None):
+    arr = np.asarray(jax.device_get(x._data)).ravel() if axis is None else np.asarray(jax.device_get(x._data))
+    mask = np.ones(arr.shape[0] if axis is None else arr.shape[axis or 0], dtype=bool)
+    flat = arr
+    mask[1:] = flat[1:] != flat[:-1] if flat.ndim == 1 else np.any(flat[1:] != flat[:-1], axis=tuple(range(1, flat.ndim)))
+    return Tensor._from_data(jnp.asarray(flat[mask]))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    off = offsets or [0] * x.ndim
+    shp = _shape(shape)
+    def f(a):
+        sl = tuple(slice(o, o + s if s != -1 else None) for o, s in zip(off, shp))
+        return a[sl]
+    return _run_op("crop", f, (x,), {})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        sl = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = slice(s, e, st)
+        return a[tuple(sl)]
+    return _run_op("strided_slice", f, (x,), {})
+
+
+def slice(x, axes, starts, ends, name=None):
+    return strided_slice(x, axes, starts, ends, [1] * len(axes))
+
+
+def view(x, shape_or_dtype, name=None):
+    return reshape(x, shape_or_dtype)
+
+
+def numel(x, name=None):
+    return Tensor._from_data(jnp.asarray(x.size, dtype=np.int64))
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        per = index_num // nshards
+        lo = shard_id * per
+        inside = (a >= lo) & (a < lo + per)
+        return jnp.where(inside, a - lo, ignore_value)
+    return _run_op("shard_index", f, (x,), {})

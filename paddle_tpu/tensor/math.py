@@ -1,0 +1,331 @@
+"""Math / reduction / logic ops (ref: python/paddle/tensor/{math,logic,stat}.py).
+
+Every op funnels through ``_run_op`` so forward runs as XLA-dispatched jnp and
+backward is the recorded vjp — no per-op backward code needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from .tensor import Tensor, _run_op
+
+
+def _coerce(x):
+    """Allow python scalars / numpy arrays as op operands."""
+    if isinstance(x, Tensor):
+        return x
+    return x
+
+
+def _unary(name, jfn):
+    def op(x, name=None):
+        return _run_op(name, jfn, (x,), {})
+    op.__name__ = name
+    return op
+
+
+def _binary(name, jfn):
+    def op(x, y, name=None):
+        return _run_op(name, jfn, (_coerce(x), _coerce(y)), {})
+    op.__name__ = name
+    return op
+
+
+# -- elementwise -------------------------------------------------------------
+add = _binary("add", lambda a, b: jnp.add(a, b))
+subtract = _binary("subtract", lambda a, b: jnp.subtract(a, b))
+multiply = _binary("multiply", lambda a, b: jnp.multiply(a, b))
+divide = _binary("divide", lambda a, b: jnp.true_divide(a, b))
+floor_divide = _binary("floor_divide", lambda a, b: jnp.floor_divide(a, b))
+mod = _binary("mod", lambda a, b: jnp.mod(a, b))
+remainder = mod
+pow = _binary("pow", lambda a, b: jnp.power(a, b))
+maximum = _binary("maximum", lambda a, b: jnp.maximum(a, b))
+minimum = _binary("minimum", lambda a, b: jnp.minimum(a, b))
+fmax = _binary("fmax", lambda a, b: jnp.fmax(a, b))
+fmin = _binary("fmin", lambda a, b: jnp.fmin(a, b))
+atan2 = _binary("atan2", lambda a, b: jnp.arctan2(a, b))
+hypot = _binary("hypot", lambda a, b: jnp.hypot(a, b))
+logaddexp = _binary("logaddexp", lambda a, b: jnp.logaddexp(a, b))
+heaviside = _binary("heaviside", lambda a, b: jnp.heaviside(a, b))
+nextafter = _binary("nextafter", lambda a, b: jnp.nextafter(a, b))
+copysign = _binary("copysign", lambda a, b: jnp.copysign(a, b))
+gcd = _binary("gcd", lambda a, b: jnp.gcd(a, b))
+lcm = _binary("lcm", lambda a, b: jnp.lcm(a, b))
+
+neg = _unary("neg", lambda a: jnp.negative(a))
+abs = _unary("abs", lambda a: jnp.abs(a))
+sign = _unary("sign", lambda a: jnp.sign(a))
+exp = _unary("exp", lambda a: jnp.exp(a))
+expm1 = _unary("expm1", lambda a: jnp.expm1(a))
+log = _unary("log", lambda a: jnp.log(a))
+log2 = _unary("log2", lambda a: jnp.log2(a))
+log10 = _unary("log10", lambda a: jnp.log10(a))
+log1p = _unary("log1p", lambda a: jnp.log1p(a))
+sqrt = _unary("sqrt", lambda a: jnp.sqrt(a))
+rsqrt = _unary("rsqrt", lambda a: jax.lax.rsqrt(a))
+square = _unary("square", lambda a: jnp.square(a))
+reciprocal = _unary("reciprocal", lambda a: jnp.reciprocal(a))
+sin = _unary("sin", lambda a: jnp.sin(a))
+cos = _unary("cos", lambda a: jnp.cos(a))
+tan = _unary("tan", lambda a: jnp.tan(a))
+asin = _unary("asin", lambda a: jnp.arcsin(a))
+acos = _unary("acos", lambda a: jnp.arccos(a))
+atan = _unary("atan", lambda a: jnp.arctan(a))
+sinh = _unary("sinh", lambda a: jnp.sinh(a))
+cosh = _unary("cosh", lambda a: jnp.cosh(a))
+tanh = _unary("tanh", lambda a: jnp.tanh(a))
+asinh = _unary("asinh", lambda a: jnp.arcsinh(a))
+acosh = _unary("acosh", lambda a: jnp.arccosh(a))
+atanh = _unary("atanh", lambda a: jnp.arctanh(a))
+floor = _unary("floor", lambda a: jnp.floor(a))
+ceil = _unary("ceil", lambda a: jnp.ceil(a))
+round = _unary("round", lambda a: jnp.round(a))
+trunc = _unary("trunc", lambda a: jnp.trunc(a))
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sigmoid = _unary("sigmoid", lambda a: jax.nn.sigmoid(a))
+erf = _unary("erf", lambda a: jax.scipy.special.erf(a))
+erfinv = _unary("erfinv", lambda a: jax.scipy.special.erfinv(a))
+lgamma = _unary("lgamma", lambda a: jax.scipy.special.gammaln(a))
+digamma = _unary("digamma", lambda a: jax.scipy.special.digamma(a))
+exponential_ = None  # in-place RNG not supported; use creation ops
+angle = _unary("angle", lambda a: jnp.angle(a))
+conj = _unary("conj", lambda a: jnp.conj(a))
+real = _unary("real", lambda a: jnp.real(a))
+imag = _unary("imag", lambda a: jnp.imag(a))
+deg2rad = _unary("deg2rad", lambda a: jnp.deg2rad(a))
+rad2deg = _unary("rad2deg", lambda a: jnp.rad2deg(a))
+
+
+def clip(x, min=None, max=None, name=None):
+    def v(b):
+        return b._data if isinstance(b, Tensor) else b
+    return _run_op("clip", lambda a: jnp.clip(a, v(min), v(max)), (x,), {})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+    return _run_op("scale", f, (x,), {})
+
+
+def lerp(x, y, weight, name=None):
+    w = weight if isinstance(weight, Tensor) else weight
+    return _run_op("lerp", lambda a, b: a + (b - a) * (w._data if isinstance(w, Tensor) else w), (x, y), {})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _run_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), (x,), {})
+
+
+def multiply_(x, y):
+    x._data = x._data * (y._data if isinstance(y, Tensor) else y)
+    x._grad_node = None
+    return x
+
+
+def add_(x, y):
+    x._data = x._data + (y._data if isinstance(y, Tensor) else y)
+    x._grad_node = None
+    return x
+
+
+def subtract_(x, y):
+    x._data = x._data - (y._data if isinstance(y, Tensor) else y)
+    x._grad_node = None
+    return x
+
+
+def scale_(x, scale=1.0, bias=0.0):
+    x._data = x._data * scale + bias
+    x._grad_node = None
+    return x
+
+
+# -- reductions --------------------------------------------------------------
+
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _reduce(name, jfn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _norm_axis(axis)
+        nd = dtype_mod.convert_dtype(dtype)
+        def f(a):
+            out = jfn(a, axis=ax, keepdims=keepdim)
+            return out.astype(nd) if nd is not None else out
+        return _run_op(name, f, (x,), {})
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _run_op("max", lambda a: jnp.max(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _run_op("min", lambda a: jnp.min(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _run_op("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return _run_op("std", lambda a: jnp.std(a, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdim), (x,), {})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ddof = 1 if unbiased else 0
+    return _run_op("var", lambda a: jnp.var(a, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdim), (x,), {})
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _run_op("median", lambda a: jnp.median(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _run_op("quantile", lambda a: jnp.quantile(a, q, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _run_op("nanmean", lambda a: jnp.nanmean(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def nansum(x, axis=None, keepdim=False, name=None, dtype=None):
+    return _run_op("nansum", lambda a: jnp.nansum(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1))
+        return jnp.cumsum(a, axis=int(axis))
+    return _run_op("cumsum", f, (x,), {})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return _run_op("cumprod", lambda a: jnp.cumprod(a, axis=dim), (x,), {})
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis or 0)
+        return vals
+    return _run_op("cummax", f, (x,), {})
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _run_op("count_nonzero",
+                   lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis), keepdims=keepdim).astype(np.int64),
+                   (x,), {})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _run_op("trace", lambda a: jnp.trace(a, offset, axis1, axis2), (x,), {})
+
+
+def kron(x, y, name=None):
+    return _run_op("kron", lambda a, b: jnp.kron(a, b), (x, y), {})
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return _run_op("diff", lambda a: jnp.diff(a, n=n, axis=axis), (x,), {})
+
+
+def inner(x, y, name=None):
+    return _run_op("inner", lambda a, b: jnp.inner(a, b), (x, y), {})
+
+
+def outer(x, y, name=None):
+    return _run_op("outer", lambda a, b: jnp.outer(a, b), (x, y), {})
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return _run_op("dot", f, (x, y), {})
+
+
+# -- logic -------------------------------------------------------------------
+equal = _binary("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _binary("not_equal", lambda a, b: jnp.not_equal(a, b))
+greater_than = _binary("greater_than", lambda a, b: jnp.greater(a, b))
+greater_equal = _binary("greater_equal", lambda a, b: jnp.greater_equal(a, b))
+less_than = _binary("less_than", lambda a, b: jnp.less(a, b))
+less_equal = _binary("less_equal", lambda a, b: jnp.less_equal(a, b))
+logical_and = _binary("logical_and", lambda a, b: jnp.logical_and(a, b))
+logical_or = _binary("logical_or", lambda a, b: jnp.logical_or(a, b))
+logical_xor = _binary("logical_xor", lambda a, b: jnp.logical_xor(a, b))
+logical_not = _unary("logical_not", lambda a: jnp.logical_not(a))
+bitwise_and = _binary("bitwise_and", lambda a, b: jnp.bitwise_and(a, b))
+bitwise_or = _binary("bitwise_or", lambda a, b: jnp.bitwise_or(a, b))
+bitwise_xor = _binary("bitwise_xor", lambda a, b: jnp.bitwise_xor(a, b))
+bitwise_not = _unary("bitwise_not", lambda a: jnp.bitwise_not(a))
+isnan = _unary("isnan", lambda a: jnp.isnan(a))
+isinf = _unary("isinf", lambda a: jnp.isinf(a))
+isfinite = _unary("isfinite", lambda a: jnp.isfinite(a))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _run_op("all", lambda a: jnp.all(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _run_op("any", lambda a: jnp.any(a, axis=_norm_axis(axis), keepdims=keepdim), (x,), {})
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _run_op("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), (x, y), {})
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _run_op("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), (x, y), {})
+
+
+def equal_all(x, y, name=None):
+    return _run_op("equal_all", lambda a, b: jnp.array_equal(a, b), (x, y), {})
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return _run_op("where", lambda c, a, b: jnp.where(c, a, b),
+                   (condition, _coerce(x), _coerce(y)), {})
+
+
+def cast(x, dtype):
+    nd = dtype_mod.convert_dtype(dtype)
+    return _run_op("cast", lambda a: a.astype(nd), (x,), {})
+
+
+astype = cast
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _run_op("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), (x,), {})
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x

@@ -1,0 +1,101 @@
+"""Search / sort ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, _run_op
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(np.dtype(str(dtype)) if not isinstance(dtype, str) else np.int64)
+    return _run_op("argmax", f, (x,), {})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(a):
+        out = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(np.int64)
+    return _run_op("argmin", f, (x,), {})
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable, descending=descending)
+        return idx.astype(np.int64)
+    return _run_op("argsort", f, (x,), {})
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+    return _run_op("sort", f, (x,), {})
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    def f(a):
+        ax = axis if axis is not None else a.ndim - 1
+        moved = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(moved if largest else -moved, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(np.int64), -1, ax))
+    return _run_op("topk", f, (x,), {})
+
+
+def nonzero(x, as_tuple=False):
+    # data-dependent output shape: host-side eager only
+    arr = np.asarray(jax.device_get(x._data))
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor._from_data(jnp.asarray(i.astype(np.int64))) for i in idx)
+    return Tensor._from_data(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(s, v):
+        side = "right" if right else "left"
+        out = jnp.searchsorted(s, v, side=side) if s.ndim == 1 else \
+            jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(s, v)
+        return out.astype(np.int32 if out_int32 else np.int64)
+    return _run_op("searchsorted", f, (sorted_sequence, values), {})
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        sorted_vals = jnp.sort(a, axis=axis)
+        idx_sorted = jnp.argsort(a, axis=axis)
+        vals = jnp.take(sorted_vals, k - 1, axis=axis)
+        idx = jnp.take(idx_sorted, k - 1, axis=axis).astype(np.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    return _run_op("kthvalue", f, (x,), {})
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(jax.device_get(x._data))
+    from scipy import stats  # available in the image via jax deps? fall back
+    raise NotImplementedError("mode: not yet implemented")
+
+
+def index_of_max(x):  # convenience
+    return argmax(x)
+
+
+def masked_argmax(x, mask, axis=None, keepdim=False):
+    def f(a, m):
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return jnp.argmax(jnp.where(m, a, neg), axis=axis).astype(np.int64)
+    return _run_op("masked_argmax", f, (x, mask), {})

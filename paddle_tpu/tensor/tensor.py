@@ -1,0 +1,378 @@
+"""The eager Tensor (ref: paddle/phi/core/dense_tensor.h + python/paddle/base/dygraph).
+
+A Tensor wraps a jax.Array (device buffer managed by PJRT). Eager ops run the
+underlying jnp computation immediately; when autograd is enabled and any input
+requires grad, the op's forward is executed under ``jax.vjp`` and a GradNode is
+recorded (see autograd/engine.py). Under ``paddle_tpu.jit`` tracing the same
+Tensor code runs with jax tracers inside ``_data`` — one implementation serves
+both the eager path and the compiled path.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import engine
+from ..framework import dtype as dtype_mod
+from ..framework import place as place_mod
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_index",
+                 "name", "persistable", "_hooks", "__weakref__", "__dict__")
+
+    _counter = 0
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        nd = dtype_mod.convert_dtype(dtype)
+        if data is None:
+            data = jnp.zeros((), nd or np.float32)
+        elif isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
+            if nd is not None and data.dtype != nd:
+                data = data.astype(nd)
+        else:
+            arr = np.asarray(data)
+            if nd is None and arr.dtype == np.float64:
+                arr = arr.astype(dtype_mod.get_default_dtype().np_dtype)
+            elif nd is not None:
+                arr = arr.astype(nd)
+            data = _device_put(arr, place)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_index = 0
+        self.persistable = False
+        self._hooks = []
+        if name is None:
+            Tensor._counter += 1
+            name = f"generated_tensor_{Tensor._counter}"
+        self.name = name
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def _from_data(cls, data, node=None, out_index=0, stop_gradient=True):
+        t = cls.__new__(cls)
+        t._data = data
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t._grad_node = node
+        t._out_index = out_index
+        t.persistable = False
+        t._hooks = []
+        Tensor._counter += 1
+        t.name = f"generated_tensor_{Tensor._counter}"
+        return t
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def dtype(self):
+        return dtype_mod.to_framework_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            dev = self._data.devices()
+            d = next(iter(dev))
+            kind = place_mod._dev_kind(d)
+            return (place_mod.CPUPlace if kind == "cpu" else place_mod.TPUPlace)(d.id)
+        except Exception:
+            return place_mod._current_expected_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def T(self):
+        from . import linalg
+        return transpose(self, list(range(self.ndim))[::-1])
+
+    # -- host interop ------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self._data))
+
+    def item(self, *idx):
+        arr = self.numpy()
+        return arr.item(*idx) if idx else arr.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        engine.backward(self, grad_tensor, retain_graph)
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad._data = jnp.zeros_like(self.grad._data)
+        else:
+            self.grad = None
+
+    clear_grad = clear_gradient
+
+    def detach(self):
+        return Tensor._from_data(self._data, stop_gradient=True)
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def register_hook(self, hook):
+        if self._grad_node is not None:
+            self._grad_node.out_hooks.setdefault(self._out_index, []).append(hook)
+        else:
+            self._hooks.append(hook)
+        return _HookHandle(self, hook)
+
+    # -- mutation ----------------------------------------------------------
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        else:
+            value = jnp.asarray(np.asarray(value, dtype=self._data.dtype))
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        self._data = value.astype(self._data.dtype)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def _to(self, device=None, dtype=None, blocking=None):
+        data = self._data
+        if dtype is not None:
+            nd = dtype_mod.convert_dtype(dtype)
+            data = data.astype(nd)
+        if device is not None:
+            p = place_mod.set_device.__wrapped__(device) if False else None
+            if isinstance(device, str):
+                plc = _parse_place(device)
+            else:
+                plc = device
+            data = jax.device_put(data, plc.jax_device())
+        return data
+
+    def to(self, *args, **kwargs):
+        device = kwargs.pop("device", None)
+        dtype = kwargs.pop("dtype", None)
+        kwargs.pop("blocking", None)
+        for a in args:
+            if isinstance(a, str) and (a in dtype_mod._BY_NAME):
+                dtype = a
+            elif isinstance(a, dtype_mod.DType):
+                dtype = a
+            elif isinstance(a, (str, place_mod.Place)):
+                device = a
+        if dtype is not None and not self.stop_gradient:
+            return self.astype(dtype)
+        t = Tensor._from_data(self._to(device=device, dtype=dtype),
+                              stop_gradient=self.stop_gradient)
+        return t
+
+    def cpu(self):
+        return self.to(device="cpu")
+
+    def cuda(self, device_id=0):
+        return self.to(device=f"tpu:{device_id}")
+
+    def pin_memory(self):
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return _run_op("getitem", lambda a: a[idx], (self,), {})
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = self._data.at[idx].set(value)
+        # in-place write re-roots the tensor (reference bumps inplace_version)
+        self._grad_node = None
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}, stop_gradient={sg},\n"
+                f"       {np.array2string(self.numpy(), prefix='       ')})")
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+
+class _HookHandle:
+    def __init__(self, tensor, hook):
+        self._tensor = tensor
+        self._hook = hook
+
+    def remove(self):
+        t = self._tensor
+        if hook_list := t._hooks:
+            if self._hook in hook_list:
+                hook_list.remove(self._hook)
+        if t._grad_node is not None:
+            hooks = t._grad_node.out_hooks.get(t._out_index, [])
+            if self._hook in hooks:
+                hooks.remove(self._hook)
+
+
+def _parse_place(device: str) -> place_mod.Place:
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    if name.lower() in ("tpu", "gpu", "cuda", "xpu"):
+        return place_mod.TPUPlace(idx)
+    return place_mod.CPUPlace(idx)
+
+
+def _device_put(arr, place=None):
+    if place is None:
+        place = place_mod._current_expected_place()
+    elif isinstance(place, str):
+        place = _parse_place(place)
+    return jax.device_put(arr, place.jax_device())
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [i._data if isinstance(i, Tensor) else i for i in idx]
+    if isinstance(idx, slice):
+        def s(v):
+            return int(v.item()) if isinstance(v, Tensor) else v
+        return slice(s(idx.start), s(idx.stop), s(idx.step))
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Eager op execution: the L3/L4 boundary of the reference collapsed into one
+# generic dispatcher (forward = jnp trace, backward = recorded vjp).
+# ---------------------------------------------------------------------------
+
+def _run_op(name: str, fn, args: tuple, kwargs: dict):
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_idx = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    tensors = [leaves[i] for i in t_idx]
+    datas = [t._data for t in tensors]
+
+    def call(*ds):
+        lv = list(leaves)
+        for i, d in zip(t_idx, ds):
+            lv[i] = d
+        a, k = jax.tree_util.tree_unflatten(treedef, lv)
+        return fn(*a, **k)
+
+    needs_grad = (engine.is_grad_enabled()
+                  and any(not t.stop_gradient for t in tensors))
+    if needs_grad:
+        out, vjp_fn = jax.vjp(call, *datas)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+        avals = [(tuple(o.shape), o.dtype) for o in out_leaves]
+        node = engine.GradNode(name, vjp_fn, tensors, out_treedef, avals)
+        wrapped = [Tensor._from_data(o, node=node, out_index=i, stop_gradient=False)
+                   for i, o in enumerate(out_leaves)]
+    else:
+        out = call(*datas)
+        out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+        wrapped = [Tensor._from_data(o, stop_gradient=True) for o in out_leaves]
+    res = jax.tree_util.tree_unflatten(out_treedef, wrapped)
+    return res
+
+
+def apply_op(name: str, fn, *args, **kwargs):
+    """Public helper: run ``fn`` (a jnp-level function) as a taped eager op."""
+    return _run_op(name, fn, args, kwargs)
+
+
+def unwrap(x):
+    """Tensor -> jax array (identity on arrays); recursive on lists/tuples/dicts."""
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(unwrap(v) for v in x)
+    if isinstance(x, dict):
+        return {k: unwrap(v) for k, v in x.items()}
+    return x
+
+
+def wrap(x, stop_gradient=True):
+    """jax array -> Tensor; recursive on containers."""
+    if isinstance(x, (jax.Array,)) or hasattr(x, "aval"):
+        return Tensor._from_data(x, stop_gradient=stop_gradient)
+    if isinstance(x, (list, tuple)):
+        return type(x)(wrap(v, stop_gradient) for v in x)
+    if isinstance(x, dict):
+        return {k: wrap(v, stop_gradient) for k, v in x.items()}
+    return x
+
+
+# late imports for T property
+from .manipulation import transpose  # noqa: E402  (circular-safe: manipulation only needs _run_op)
