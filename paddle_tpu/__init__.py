@@ -8,6 +8,12 @@ compilation — all running SPMD over TPU meshes.
 """
 from __future__ import annotations
 
+import jax as _jax
+
+# Paddle's integer default is int64; without x64 jax silently downcasts to
+# int32. Float creation paths still default to float32 (see tensor/creation).
+_jax.config.update("jax_enable_x64", True)
+
 from .framework import (  # noqa: F401
     CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
     set_device, get_device, device_count, get_flags, set_flags, seed,

@@ -123,6 +123,7 @@ class GradNode:
                     if res is not None:
                         g = res
             cts.append(g)
+        self.pending.clear()  # consumed; a retained graph must start fresh
         ct_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
         return self.vjp_fn(ct_tree)
 

@@ -46,6 +46,7 @@ class _PyLayerNode(engine.GradNode):
                     if res is not None:
                         g = res
             cts.append(Tensor._from_data(g, stop_gradient=True))
+        self.pending.clear()
         with engine.no_grad():
             grads = self.layer_cls.backward(self.ctx, *cts)
         if not isinstance(grads, (tuple, list)):

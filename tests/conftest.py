@@ -1,0 +1,29 @@
+"""Test config: force an 8-device virtual CPU mesh before jax initializes.
+
+Mirrors the reference's strategy (SURVEY.md §4): distributed correctness is
+asserted as numerical equivalence to the serial model, on one host. XLA's
+host-platform device-count flag gives 8 fake devices for mesh/collective tests.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's axon TPU plugin registers itself regardless of JAX_PLATFORMS;
+# pin eager dispatch and tensor placement to the 8 virtual CPU devices so
+# tests are deterministic, fp32-exact, and can build 8-way meshes.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+    paddle.set_device("cpu")
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,144 @@
+"""Autograd engine tests: analytic grads vs jax.grad references (the reference's
+check_grad uses finite differences; jax.grad is exact and stricter)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_branching():
+    a = np.random.randn(4, 4).astype(np.float32)
+
+    def f(x):
+        y = jnp.tanh(x @ x.T)
+        return (y * y + jnp.exp(-y)).mean()
+
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.tanh(paddle.matmul(x, x.T))
+    loss = (y * y + paddle.exp(-y)).mean()
+    loss.backward()
+    ref = jax.grad(f)(a)
+    np.testing.assert_allclose(x.grad.numpy(), ref, atol=1e-5)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    (x * x).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4.0 + 3.0)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_input_used_twice():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x + x * 5  # dy/dx = 2x + 5 = 11
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 11.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = (y * 3).sum()
+    assert z.stop_gradient
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+    @paddle.no_grad()
+    def f(t):
+        return t * 3
+    assert f(x).stop_gradient
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # 3 * 2
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(4.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), 3 * 16.0)
+    assert x.grad is None  # .grad untouched
+
+
+def test_integer_inputs_no_grad_flow():
+    idx = paddle.to_tensor([0, 2])
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    out = paddle.gather(x, idx).sum()
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy().sum(), 6.0)
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, gy):
+            (x,) = ctx.saved_tensor()
+            return gy * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_multi_output_op_backward():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a.sum() * 2 + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [1, 1, 1]])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)

@@ -1,0 +1,120 @@
+"""Core Tensor op tests vs numpy (modeled on the reference's OpTest strategy:
+forward checked against a numpy reference, grads against numeric/jax grads)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_meta():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    assert x.numel() == 4
+    assert x.ndim == 2
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtypes():
+    assert paddle.to_tensor([1, 2]).dtype == paddle.int64
+    assert paddle.to_tensor([1.0]).dtype == paddle.float32
+    x = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert x.dtype == paddle.bfloat16
+    y = x.astype("float32")
+    assert y.dtype == paddle.float32
+
+
+def test_arithmetic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2], rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert x.sum().item() == 66
+    np.testing.assert_allclose(x.mean(axis=0).numpy(), np.arange(12).reshape(3, 4).mean(0))
+    np.testing.assert_allclose(x.max(axis=1).numpy(), [3, 7, 11])
+    np.testing.assert_allclose(paddle.logsumexp(x, axis=1).numpy(),
+                               np.log(np.exp(np.arange(12).reshape(3, 4)).sum(1)), rtol=1e-5)
+
+
+def test_manipulation():
+    x = paddle.arange(24).reshape([2, 3, 4])
+    assert x.transpose([2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.concat([x, x], axis=1).shape == [2, 6, 4]
+    assert paddle.stack([x, x]).shape == [2, 2, 3, 4]
+    parts = paddle.split(x, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(x, [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    assert paddle.squeeze(paddle.ones([1, 3, 1])).shape == [3]
+    assert paddle.unsqueeze(paddle.ones([3]), [0, 2]).shape == [1, 3, 1]
+    assert paddle.flatten(x, 1, 2).shape == [2, 12]
+    assert paddle.tile(paddle.ones([2]), [3]).shape == [6]
+    assert paddle.expand(paddle.ones([1, 3]), [5, 3]).shape == [5, 3]
+
+
+def test_matmul_and_linalg():
+    a = np.random.randn(3, 4).astype(np.float32)
+    b = np.random.randn(4, 5).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+    out_t = paddle.matmul(paddle.to_tensor(a.T), paddle.to_tensor(b), transpose_x=True)
+    np.testing.assert_allclose(out_t.numpy(), a @ b, rtol=1e-5)
+    e = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(e.numpy(), a @ b, rtol=1e-5)
+
+
+def test_indexing_and_gather():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    idx = paddle.to_tensor([2, 0])
+    np.testing.assert_allclose(paddle.gather(x, idx).numpy(),
+                               np.arange(12).reshape(3, 4)[[2, 0]])
+    x[0, 0] = 99.0
+    assert x[0, 0].item() == 99.0
+
+
+def test_search_ops():
+    x = paddle.to_tensor([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    np.testing.assert_array_equal(paddle.argmax(x, axis=1).numpy(), [0, 1])
+    vals, idx = paddle.topk(x, k=2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[3, 2], [5, 4]])
+    s = paddle.sort(x, axis=1)
+    np.testing.assert_allclose(s.numpy(), [[1, 2, 3], [0, 4, 5]])
+    nz = paddle.nonzero(paddle.to_tensor([0, 3, 0, 5]))
+    np.testing.assert_array_equal(nz.numpy().ravel(), [1, 3])
+
+
+def test_logic_and_where():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    w = paddle.where(a < b, a, b)
+    np.testing.assert_allclose(w.numpy(), [1, 2, 1])
+    assert paddle.allclose(a, a).item()
+
+
+def test_random_reproducible():
+    paddle.seed(7)
+    a = paddle.rand([4])
+    paddle.seed(7)
+    b = paddle.rand([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    assert paddle.randint(0, 10, [100]).numpy().max() < 10
+
+
+def test_inplace_and_setvalue():
+    x = paddle.zeros([3])
+    x.set_value(np.array([1.0, 2.0, 3.0], dtype=np.float32))
+    np.testing.assert_allclose(x.numpy(), [1, 2, 3])
+    x.fill_(7.0)
+    np.testing.assert_allclose(x.numpy(), [7, 7, 7])
