@@ -1,0 +1,19 @@
+"""Distributed API (ref: python/paddle/distributed/).
+
+TPU-native stack: single-controller SPMD over a jax Mesh. See
+fleet/topology.py for the axis layout and communication.py for collective
+semantics.
+"""
+from . import fleet
+from . import sharding_utils
+from .communication import (Group, ReduceOp, all_gather, all_reduce,
+                            all_to_all_single, alltoall, barrier, broadcast,
+                            get_group, irecv, isend, new_group, ppermute,
+                            recv, reduce, reduce_scatter, scatter, send)
+from .env import (get_rank, get_world_size, init_parallel_env, is_initialized,
+                  parallel_device_count)
+from .parallel import DataParallel, spawn
+from . import checkpoint
+from . import auto_parallel
+from .auto_parallel.api import (shard_tensor, Shard, Replicate, Partial,
+                                ProcessMesh)

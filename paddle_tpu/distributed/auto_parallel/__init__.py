@@ -1,0 +1,2 @@
+from .api import (Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+                  get_mesh, reshard, shard_layer, shard_tensor)

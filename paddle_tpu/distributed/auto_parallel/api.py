@@ -1,0 +1,132 @@
+"""Semi-auto parallel API (ref: python/paddle/distributed/auto_parallel/api.py).
+
+shard_tensor + placements map 1:1 onto jax NamedSharding: Shard(i) -> axis
+name at dim i, Replicate -> None, Partial -> pending-psum (represented as
+replicated data with a marker; XLA resolves partials inside compiled code).
+ProcessMesh wraps jax.sharding.Mesh.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._mesh = mesh
+            self.dim_names = list(mesh.axis_names)
+            return
+        arr = np.asarray(mesh)
+        self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devs = jax.devices()
+        if len(devs) < arr.size:
+            devs = jax.devices("cpu")
+        flat = arr.reshape(-1)
+        dev_arr = np.array([devs[i] for i in flat]).reshape(arr.shape)
+        self._mesh = Mesh(dev_arr, axis_names=tuple(self.dim_names))
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._mesh.devices.shape)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _placements_to_spec(placements: List[Placement], ndim: int,
+                        mesh: ProcessMesh):
+    spec = [None] * ndim
+    for axis_idx, p in enumerate(placements):
+        if isinstance(p, Shard):
+            spec[p.dim] = mesh.dim_names[axis_idx]
+        elif isinstance(p, (Replicate, Partial)):
+            continue
+    return P(*spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """Place a tensor on the mesh with the given placements; returns a Tensor
+    whose underlying array is a sharded jax.Array (a true DistTensor)."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = _placements_to_spec(list(placements), t._data.ndim, mesh)
+    sharded = jax.device_put(t._data, NamedSharding(mesh.mesh, spec))
+    out = Tensor._from_data(sharded, stop_gradient=t.stop_gradient
+                            if stop_gradient is None else stop_gradient)
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(tensor, mesh: ProcessMesh, placements):
+    spec = _placements_to_spec(list(placements), tensor._data.ndim, mesh)
+    data = jax.device_put(tensor._data, NamedSharding(mesh.mesh, spec))
+    out = Tensor._from_data(data, stop_gradient=tensor.stop_gradient)
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def get_mesh():
+    from ..fleet.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return ProcessMesh(hcg.mesh) if hcg else None

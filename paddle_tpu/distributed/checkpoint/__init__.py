@@ -1,0 +1,8 @@
+"""Distributed checkpoint (ref: python/paddle/distributed/checkpoint/).
+
+Sharded, metadata-carrying save/load with reshard-on-load, built on orbax
+(TensorStore): each host writes its shards; load redistributes to the current
+mesh/shardings — the TPU-native equivalent of the reference's per-rank shard
+files + reshard logic.
+"""
+from .save_load import save_state_dict, load_state_dict

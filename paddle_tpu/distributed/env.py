@@ -1,0 +1,51 @@
+"""Distributed environment (ref: python/paddle/distributed/parallel.py env vars).
+
+TPU-native model: single-controller SPMD. One python process per HOST (not per
+device); jax.distributed coordinates hosts, the mesh spans all devices.
+``get_rank``/``get_world_size`` are therefore process-level (what you need for
+data loading / logging); device-level parallelism lives in the mesh
+(fleet/topology.py).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Bootstrap multi-host jax (TCPStore-equivalent rendezvous is handled by
+    jax.distributed's coordination service). Single-host: no-op."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(f"{coord}:{port}", num_processes=nprocs,
+                                   process_id=proc_id)
+    _initialized = True
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()

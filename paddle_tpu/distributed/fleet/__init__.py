@@ -1,0 +1,21 @@
+"""Fleet: hybrid-parallel training (ref: python/paddle/distributed/fleet/)."""
+from . import utils
+from .distributed_strategy import DistributedStrategy
+from .fleet import (Fleet, distributed_model, distributed_optimizer, fleet,
+                    init)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group)
+from . import meta_parallel
+from .meta_parallel.parallel_layers.mp_layers import (ColumnParallelLinear,
+                                                      ParallelCrossEntropy,
+                                                      RowParallelLinear,
+                                                      VocabParallelEmbedding)
+from .meta_parallel.parallel_layers.pp_layers import (LayerDesc, PipelineLayer,
+                                                      SharedLayerDesc)
+from .recompute.recompute import (recompute, recompute_hybrid,
+                                  recompute_sequential)
+
+
+def get_hybrid_communicate_group_global():
+    return get_hybrid_communicate_group()

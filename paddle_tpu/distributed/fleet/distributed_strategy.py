@@ -1,0 +1,49 @@
+"""DistributedStrategy (ref: python/paddle/distributed/fleet/base/distributed_strategy.py).
+
+The reference backs this with a protobuf; a typed python object with the same
+field names is sufficient (and validates degrees against the device count at
+fleet.init time via the topology)."""
+from __future__ import annotations
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "ep_degree": 1,
+        }
+        self.pipeline_configs = {
+            "micro_batch_size": 1,
+            "accumulate_steps": 1,
+        }
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "degree": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+
+    @property
+    def sharding_degree(self):
+        return self.sharding_configs.get("degree", 1)
+
+    def __repr__(self):
+        return (f"DistributedStrategy(hybrid={self.hybrid_configs}, "
+                f"pipeline={self.pipeline_configs})")

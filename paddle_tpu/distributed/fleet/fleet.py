@@ -1,0 +1,100 @@
+"""Fleet facade (ref: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init builds the hybrid mesh topology; distributed_model wraps the model
+per the strategy's degrees (TensorParallel / PipelineParallel); and
+distributed_optimizer wraps with HybridParallelOptimizer — the same three
+calls as the reference, now producing mesh-aware objects whose compiled steps
+run SPMD over ICI.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer.layers import Layer
+from ..env import init_parallel_env
+from .distributed_strategy import DistributedStrategy
+from .meta_optimizers.dygraph_optimizer.hybrid_parallel_optimizer import (
+    HybridParallelOptimizer)
+from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+from .meta_parallel.pipeline_parallel import PipelineParallel
+from .meta_parallel.tensor_parallel import TensorParallel
+from .topology import (HybridCommunicateGroup, set_hybrid_communicate_group,
+                       get_hybrid_communicate_group)
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        cfg = self._strategy.hybrid_configs
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=cfg.get("dp_degree", 1),
+            mp_degree=cfg.get("mp_degree", 1),
+            pp_degree=cfg.get("pp_degree", 1),
+            sharding_degree=cfg.get("sharding_degree", 1),
+            sep_degree=cfg.get("sep_degree", 1),
+            ep_degree=cfg.get("ep_degree", 1))
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def distributed_model(self, model: Layer):
+        assert self._is_initialized, "call fleet.init first"
+        hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            if not isinstance(model, PipelineLayer):
+                raise TypeError("pp_degree > 1 requires a PipelineLayer model")
+            return PipelineParallel(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1 or \
+                hcg.get_sep_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        # pure dp/sharding: model unchanged (mesh handles it in compiled steps)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        assert self._is_initialized, "call fleet.init first"
+        return HybridParallelOptimizer(optimizer, self._hcg,
+                                       strategy or self._strategy)
+
+    # -- worker info (reference API surface) ------------------------------
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from ..communication import barrier
+        barrier()
+
+
+fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    return fleet.init(role_maker, is_collective, strategy, log_level)
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def get_hybrid_communicate_group_():
+    return fleet.get_hybrid_communicate_group()

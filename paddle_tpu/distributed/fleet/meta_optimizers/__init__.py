@@ -1,0 +1,2 @@
+from .dygraph_optimizer.hybrid_parallel_optimizer import (
+    DygraphShardingOptimizer, HybridParallelClipGrad, HybridParallelOptimizer)

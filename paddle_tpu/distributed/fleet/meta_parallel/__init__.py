@@ -1,0 +1,11 @@
+from .meta_parallel_base import MetaParallelBase
+from .parallel_layers.mp_layers import (ColumnParallelLinear,
+                                        ParallelCrossEntropy,
+                                        RowParallelLinear,
+                                        VocabParallelEmbedding)
+from .parallel_layers.pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .pipeline_parallel import PipelineParallel, PipelineParallelWithInterleave
+from .tensor_parallel import TensorParallel
+from .sharding.group_sharded import (GroupShardedOptimizerStage2,
+                                     GroupShardedStage2, GroupShardedStage3,
+                                     group_sharded_parallel)

@@ -1,0 +1,4 @@
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .random_ctrl import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed

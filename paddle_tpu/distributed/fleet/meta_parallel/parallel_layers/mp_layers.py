@@ -1,0 +1,156 @@
+"""Megatron-style tensor-parallel layers
+(ref: python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py).
+
+TPU-native: instead of per-rank weight shards + hand-issued NCCL collectives
+(_c_identity/_mp_allreduce), each layer holds the FULL logical parameter with a
+PartitionSpec over the 'mp' mesh axis; GSPMD partitions the matmuls and emits
+the identical collective pattern (allreduce after row-parallel, none after
+column-parallel) over ICI. Eager single-device execution is dense, matching
+the reference's mp_degree=1 path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from jax.sharding import PartitionSpec as P
+
+from .....nn import functional as F
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+from .....tensor.tensor import _run_op
+from ....sharding_utils import hint, hint_tensor
+from ...topology import get_hybrid_communicate_group
+
+
+def _mp_degree():
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_model_parallel_world_size() if hcg else 1
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight.pspec = P("mp", None)
+        self.weight.is_distributed = _mp_degree() > 1
+        self.weight.split_axis = 0
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return hint_tensor(out, None, None, None)  # replicated activations
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over 'mp' (ref: fused QKV / MLP-up)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P(None, "mp")
+        self.weight.is_distributed = _mp_degree() > 1
+        self.weight.split_axis = 1
+        if has_bias is None:
+            has_bias = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.pspec = P("mp")
+            self.bias.is_distributed = self.weight.is_distributed
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return hint_tensor(out, *([None] * out.ndim))
+        # keep last dim sharded over mp
+        spec = [None] * (out.ndim - 1) + ["mp"]
+        return hint_tensor(out, *spec)
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over 'mp'; output is allreduced
+    (GSPMD inserts the psum from the contraction)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.pspec = P("mp", None)
+        self.weight.is_distributed = _mp_degree() > 1
+        self.weight.split_axis = 0
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.pspec = P()
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = [None] * (x.ndim - 1) + ["mp"]
+            x = hint_tensor(x, *spec)
+        out = F.linear(x, self.weight, self.bias)
+        # replicate output -> forces the partial-sum allreduce over mp
+        return hint_tensor(out, *([None] * out.ndim))
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over mp-sharded logits
+    (ref: mp_ops._c_softmax_with_cross_entropy). The fp32 logsumexp reduction
+    over the sharded vocab axis becomes an ICI psum under GSPMD."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        def f(logits, lbl):
+            spec = [None] * (logits.ndim - 1) + ["mp"]
+            logits = hint(logits, *spec)
+            l32 = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(l32, axis=-1, keepdims=True)
+            idx = lbl.astype(jnp.int32)
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, -1)
+            picked = jnp.take_along_axis(l32, idx[..., None], axis=-1)
+            loss = (lse - picked).squeeze(-1)[..., None]
+            if self.ignore_index >= 0:
+                loss = jnp.where((idx == self.ignore_index)[..., None], 0.0, loss)
+            return loss
+        return _run_op("parallel_cross_entropy", f, (input, label), {})
+
+
+# reference's low-level mp_ops surface, as sharding-constraint equivalents
+def _c_identity(tensor, group=None):
+    return tensor
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True, use_model_parallel=True):
+    return hint_tensor(tensor, *([None] * tensor.ndim))
+
+
+def _c_split(tensor, group=None):
+    spec = [None] * (tensor.ndim - 1) + ["mp"]
+    return hint_tensor(tensor, *spec)
+
+
+def _c_concat(tensor, group=None):
+    return hint_tensor(tensor, *([None] * tensor.ndim))

@@ -1,0 +1,132 @@
+"""Pipeline layer description (ref:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py).
+
+Same API: a PipelineLayer is a list of LayerDescs segmented into stages.
+TPU-native difference: a single controller owns ALL stages (no per-rank
+construction), so forward() works dense, and the compiled pipeline engine
+(paddle_tpu.parallel.pipeline) consumes the per-stage segmentation to build
+the shard_map/ppermute schedule with stage params stacked over the 'pp' axis.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List
+
+from .....nn.layer.layers import Layer
+from .....nn.layer.container import LayerList
+from ...topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_class, *inputs, **kwargs):
+        self.layer_class = layer_class
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_class, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_class(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_class.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied layers (e.g. embedding shared with the LM head across first/last
+    stage). The single-controller design makes weight tying literal object
+    sharing — no cross-stage grad allreduce needed (the tape accumulates both
+    uses), unlike the reference's _broadcast_shared_weights."""
+
+    def __init__(self, key, layer_class, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_class, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layer_descs = list(layers)
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None:
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = num_stages
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._shared_layers = {}
+
+        built = []
+        for desc in self._layer_descs:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared_layers:
+                    layer = self._shared_layers[desc.layer_name]
+                else:
+                    layer = desc.build_layer()
+                    self._shared_layers[desc.layer_name] = layer
+                built.append((layer, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), None))
+            elif isinstance(desc, Layer):
+                built.append((desc, None))
+            elif callable(desc):
+                built.append((desc, None))
+            else:
+                raise TypeError(f"invalid pipeline layer desc: {desc!r}")
+        self._built = built
+        self.run_function = LayerList(
+            [l for l, _ in built if isinstance(l, Layer)])
+        self._segment(seg_method)
+
+    def _segment(self, seg_method):
+        n = len(self._built)
+        s = self._num_stages
+        if seg_method.startswith("layer:"):
+            # segment at layers whose class name matches
+            pat = seg_method.split(":", 1)[1]
+            marks = [0] + [i for i, (l, _) in enumerate(self._built)
+                           if type(l).__name__ == pat]
+            # choose s boundaries as evenly as possible among marks
+            if len(marks) >= s:
+                chosen = [marks[int(i * len(marks) / s)] for i in range(s)]
+            else:
+                chosen = marks + [n] * (s - len(marks))
+            bounds = sorted(set(chosen)) + [n]
+            while len(bounds) < s + 1:
+                bounds.insert(-1, bounds[-2])
+        else:  # uniform
+            per = n / s
+            bounds = [int(round(i * per)) for i in range(s + 1)]
+        self.segment_parts = bounds
+        self._stage_layers = [
+            self._built[bounds[i]:bounds[i + 1]] for i in range(s)]
+
+    # -- dense (non-pipelined) execution: numerically the ground truth ------
+    def forward(self, x):
+        for layer, fwd in self._built:
+            if fwd is not None:
+                x = fwd(layer, x)
+            elif isinstance(layer, Layer):
+                x = layer(x)
+            else:
+                x = layer(x)
+        return x
+
+    def get_stage_layers(self, stage_id):
+        return self._stage_layers[stage_id]
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def loss_fn(self, *args):
+        return self._loss_fn(*args)
+
+    def allreduce_shared_weight_gradients(self):
+        # literal weight sharing on a single controller: tape already
+        # accumulated both contributions; kept for API parity
+        return None

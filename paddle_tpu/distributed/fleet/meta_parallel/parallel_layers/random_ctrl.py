@@ -1,0 +1,4 @@
+"""TP RNG control (ref: fleet/meta_parallel/parallel_layers/random.py):
+re-exported from the framework generator, which implements the tracker."""
+from .....framework.random import (RNGStatesTracker, get_rng_state_tracker,
+                                   model_parallel_random_seed)

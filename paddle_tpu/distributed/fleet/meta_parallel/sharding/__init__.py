@@ -1,0 +1,3 @@
+from .group_sharded import (GroupShardedOptimizerStage2, GroupShardedStage2,
+                            GroupShardedStage3, group_sharded_parallel,
+                            save_group_sharded_model)

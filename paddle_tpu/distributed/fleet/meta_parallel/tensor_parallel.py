@@ -1,0 +1,17 @@
+"""TensorParallel model wrapper (ref:
+python/paddle/distributed/fleet/meta_parallel/tensor_parallel.py).
+
+The reference broadcasts non-distributed params across mp ranks and seeds the
+TP RNG tracker. Single-controller: params are shared by construction; this
+wrapper seeds the tracker and records which params carry mp shardings."""
+from __future__ import annotations
+
+from ....framework import random as random_mod
+from .meta_parallel_base import MetaParallelBase
+
+
+class TensorParallel(MetaParallelBase):
+    def _prepare_for_model(self):
+        mp_rank = self._hcg.get_model_parallel_rank() if self._hcg else 0
+        random_mod.model_parallel_random_seed(
+            seed_=random_mod._GLOBAL.seed, mp_rank=mp_rank)

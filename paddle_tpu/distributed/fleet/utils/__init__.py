@@ -1,0 +1,1 @@
+from . import hybrid_parallel_util, sequence_parallel_utils

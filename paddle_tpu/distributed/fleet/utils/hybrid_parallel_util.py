@@ -1,0 +1,41 @@
+"""Hybrid-parallel grad utilities (ref:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py).
+
+The reference fuses DP/sharding grad allreduces into buckets overlapping
+backward. Single-controller SPMD: grads of replicated params are already
+globally correct inside a compiled step (XLA inserts the psum); these helpers
+keep the eager API surface working (identity on one controller, with the mp
+partial-grad allreduce expressed as a sharding hint)."""
+from __future__ import annotations
+
+from ....tensor.tensor import Tensor
+from ...communication import all_reduce
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    group = hcg.get_data_parallel_group() if hcg else None
+    if group is None or group.nranks <= 1:
+        return
+    for p in parameter_list:
+        if p.grad is not None:
+            p.grad = all_reduce(p.grad, group=group)
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    return inputs, kwargs
+
+
+def broadcast_mp_parameters(model, hcg):
+    return None  # single controller: one copy of every parameter
+
+
+def broadcast_dp_parameters(model, hcg):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return None
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    return None

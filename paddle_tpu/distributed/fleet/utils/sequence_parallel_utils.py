@@ -1,0 +1,85 @@
+"""Megatron-style sequence parallelism
+(ref: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py).
+
+In the TP region, activations are sharded along the sequence dim over 'mp'
+(saving activation memory ∝ mp_degree): allgather before attention/MLP
+matmuls, reduce-scatter after. Under GSPMD these are sharding constraints —
+ScatterOp/GatherOp below pin the seq dim sharding and XLA emits the
+all-gather / reduce-scatter pair over ICI.
+"""
+from __future__ import annotations
+
+from ....tensor.tensor import Tensor
+from ...sharding_utils import hint_tensor
+from ..topology import get_hybrid_communicate_group
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """Params of seq-parallel layers (LayerNorm in the SP region): their grads
+    are partial over mp and need an allreduce. Under GSPMD the replicated
+    param spec forces that psum automatically; the marker is kept so
+    register_sequence_parallel_allreduce_hooks remains API-compatible."""
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=True):
+    return None  # GSPMD emits the allreduce from the sharding specs
+
+
+class ScatterOp:
+    """Scatter activation along seq dim over 'mp' (enter the SP region)."""
+
+    @staticmethod
+    def apply(x):
+        # layout [B, S, H]: shard S over mp
+        spec = [None, "mp"] + [None] * (x.ndim - 2)
+        return hint_tensor(x, *spec)
+
+
+class GatherOp:
+    """Gather activation along seq dim (leave the SP region)."""
+
+    @staticmethod
+    def apply(x):
+        return hint_tensor(x, *([None] * x.ndim))
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def scatter(x):
+    return ScatterOp.apply(x)
+
+
+def all_gather(x):
+    return GatherOp.apply(x)
+
+
+class ColumnSequenceParallelLinear:
+    """Column-parallel linear consuming seq-sharded input (allgather happens
+    at the matmul via GSPMD when the weight is mp-column-sharded)."""
+
+    def __new__(cls, *args, **kwargs):
+        from ..meta_parallel.parallel_layers.mp_layers import ColumnParallelLinear
+        layer = ColumnParallelLinear(*args, **kwargs)
+        return layer
+
+
+class RowSequenceParallelLinear:
+    def __new__(cls, *args, **kwargs):
+        from ..meta_parallel.parallel_layers.mp_layers import RowParallelLinear
+        layer = RowParallelLinear(*args, **kwargs)
+        orig_forward = layer.forward
+
+        def forward(x):
+            out = orig_forward(x)
+            return ScatterOp.apply(out)
+
+        layer.forward = forward
+        return layer

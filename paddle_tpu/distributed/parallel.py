@@ -1,0 +1,57 @@
+"""init_parallel_env + DataParallel (ref: python/paddle/distributed/parallel.py).
+
+DataParallel on TPU: the reference broadcasts params then bucket-allreduces
+grads during backward (EagerReducer over NCCL). Single-controller SPMD holds
+ONE copy of the params for all devices, so the eager wrapper is numerically
+the identity; the dp communication pattern materializes when the step is
+compiled over a mesh with the batch sharded on 'dp' (TrainStep(batch_spec=
+P('dp')) — XLA inserts the grad psum that the reducer used to issue).
+no_sync is honored in compiled mode by skipping the step's optimizer update.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer.layers import Layer
+from .env import get_rank, get_world_size, init_parallel_env  # noqa: F401
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        return None
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """The reference forks one process per GPU. TPU SPMD needs one process
+    per HOST; on a single host run the function directly."""
+    func(*args)

@@ -1,0 +1,3 @@
+"""paddle.distributed.sharding parity (ref: python/paddle/distributed/sharding/)."""
+from ..fleet.meta_parallel.sharding.group_sharded import (
+    group_sharded_parallel, save_group_sharded_model)

@@ -1,0 +1,61 @@
+"""Sharding annotation plumbing shared by the fleet layers.
+
+GSPMD design: parallel layers attach ``PartitionSpec``s to parameters
+(``param.pspec``) and drop ``with_sharding_constraint`` hints on activations.
+Eagerly (no mesh active) the hints are no-ops and every layer computes dense —
+exactly the reference's single-card fallback. Inside a jitted step under
+``use_mesh(mesh)`` XLA partitions the graph and inserts the ICI collectives
+the reference issued manually through NCCL.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+_active_mesh: Optional[Mesh] = None
+
+
+@contextlib.contextmanager
+def auto_shard(mesh: Mesh):
+    """Activate sharding hints for code traced inside this context.
+
+    Hints are explicit NamedShardings, so no jax-level mesh context is needed;
+    this just tells the hint() calls which mesh to target.
+    """
+    global _active_mesh
+    prev = _active_mesh
+    _active_mesh = mesh
+    try:
+        yield
+    finally:
+        _active_mesh = prev
+
+
+def active_mesh() -> Optional[Mesh]:
+    return _active_mesh
+
+
+def hint(data, *spec):
+    """with_sharding_constraint when a mesh is active, identity otherwise."""
+    if _active_mesh is None:
+        return data
+    return jax.lax.with_sharding_constraint(
+        data, NamedSharding(_active_mesh, P(*spec)))
+
+
+def hint_tensor(tensor, *spec):
+    from ..tensor.tensor import _run_op
+    if _active_mesh is None:
+        return tensor
+    return _run_op("shard_hint", lambda a: hint(a, *spec), (tensor,), {})
+
+
+def param_sharding(param, mesh: Mesh) -> NamedSharding:
+    """The NamedSharding for a parameter, from its attached pspec."""
+    spec = getattr(param, "pspec", None) or P()
+    return NamedSharding(mesh, spec)

@@ -36,6 +36,24 @@ class _GeneratorState:
 
 
 _GLOBAL = _GeneratorState(seed=np.random.randint(0, 2**31 - 1))
+_TRACE_KEY = None  # when set, next_key derives from this traced base key
+
+
+@contextlib.contextmanager
+def trace_rng(base_key):
+    """Derive keys from a traced base key during jit tracing.
+
+    Host-side stateful keys would bake into the compiled graph as constants
+    (same dropout mask every step). Under this context, ``next_key`` folds a
+    per-call counter into ``base_key`` — a traced array that varies per step.
+    """
+    global _TRACE_KEY
+    prev = _TRACE_KEY
+    _TRACE_KEY = [base_key, 0]
+    try:
+        yield
+    finally:
+        _TRACE_KEY = prev
 
 
 def seed(s: int):
@@ -48,6 +66,10 @@ def seed(s: int):
 
 def next_key():
     """Draw a fresh PRNG key from the global stateful generator."""
+    if _TRACE_KEY is not None:
+        base, n = _TRACE_KEY
+        _TRACE_KEY[1] = n + 1
+        return jax.random.fold_in(base, n)
     return _GLOBAL.key()
 
 

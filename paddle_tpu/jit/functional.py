@@ -1,0 +1,148 @@
+"""Functional tracing of Layers (the to_static substrate).
+
+Ref: python/paddle/jit/ (dy2static + SOT). The reference translates Python
+AST/bytecode to ProgramDesc. TPU-native: a Layer's forward is ALREADY jax-
+traceable — Tensors wrap tracers transparently — so "to static" is just:
+swap parameter/buffer arrays for tracer arrays, run forward under no_grad
+(the tape is unnecessary inside a compiled graph; jax.grad differentiates the
+traced function), collect buffer mutations (BatchNorm running stats) as
+explicit outputs, and jax.jit the result.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..framework import random as random_mod
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+def state_arrays(layer: Layer):
+    """(params, buffers): name -> jax array."""
+    params = {k: p._data for k, p in layer.named_parameters()}
+    buffers = {k: b._data for k, b in layer.named_buffers() if b is not None}
+    return params, buffers
+
+
+def functional_call(layer: Layer, params: Dict[str, Any], args, kwargs=None,
+                    buffers: Dict[str, Any] = None, rng_key=None,
+                    training: bool = None):
+    """Run layer.forward with the given arrays bound as parameters/buffers.
+
+    Returns (outputs, new_buffers) where outputs have Tensors replaced by raw
+    arrays. Safe under jax tracing (params may be tracers).
+    """
+    kwargs = kwargs or {}
+    param_objs = dict(layer.named_parameters())
+    buffer_objs = {k: b for k, b in layer.named_buffers() if b is not None}
+    saved_p = {k: p._data for k, p in param_objs.items()}
+    saved_b = {k: b._data for k, b in buffer_objs.items()}
+    saved_train = layer.training
+    if training is not None:
+        layer.train() if training else layer.eval()
+    for k, v in params.items():
+        if k in param_objs:
+            param_objs[k]._data = v
+    if buffers:
+        for k, v in buffers.items():
+            if k in buffer_objs:
+                buffer_objs[k]._data = v
+
+    def run():
+        t_args = [Tensor._from_data(a) if _is_array(a) else a for a in args]
+        t_kwargs = {k: Tensor._from_data(v) if _is_array(v) else v
+                    for k, v in kwargs.items()}
+        with engine.no_grad():
+            out = layer(*t_args, **t_kwargs)
+        return out
+
+    try:
+        if rng_key is not None:
+            with random_mod.trace_rng(rng_key):
+                out = run()
+        else:
+            out = run()
+        new_buffers = {k: b._data for k, b in buffer_objs.items()}
+    finally:
+        for k, p in param_objs.items():
+            p._data = saved_p[k]
+        for k, b in buffer_objs.items():
+            b._data = saved_b[k]
+        layer.training = saved_train
+        for sub in layer.sublayers():
+            sub.training = saved_train
+    out_arrays = jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, Tensor) else x, out,
+        is_leaf=lambda x: isinstance(x, Tensor))
+    return out_arrays, new_buffers
+
+
+def _is_array(a):
+    return isinstance(a, jax.Array) or hasattr(a, "aval")
+
+
+class TracedLayer:
+    """jit-compiled callable over a Layer (paddle.jit.to_static on a Layer)."""
+
+    def __init__(self, layer: Layer, training=False):
+        self.layer = layer
+        self.training = training
+
+        @functools.partial(jax.jit, static_argnums=())
+        def _fn(params, buffers, arg_arrays):
+            out, new_buf = functional_call(layer, params, arg_arrays,
+                                           buffers=buffers,
+                                           training=self.training)
+            return out, new_buf
+
+        self._fn = _fn
+
+    def __call__(self, *args):
+        params, buffers = state_arrays(self.layer)
+        arg_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                           for a in args)
+        out, new_buf = self._fn(params, buffers, arg_arrays)
+        # propagate buffer updates (running stats) back to the layer
+        for k, b in self.layer.named_buffers():
+            if b is not None and k in new_buf:
+                b._data = new_buf[k]
+        return jax.tree_util.tree_map(
+            lambda x: Tensor._from_data(x) if _is_array(x) else x, out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              full_graph=True, backend=None):
+    """paddle.jit.to_static parity: Layer -> TracedLayer; function -> jitted."""
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            return TracedLayer(obj)
+
+        @functools.wraps(obj)
+        def wrapper(*args, **kwargs):
+            arrs = tuple(a._data if isinstance(a, Tensor) else a for a in args)
+
+            @functools.cache
+            def get_jitted():
+                def fn(arg_arrays):
+                    t_args = [Tensor._from_data(a) if _is_array(a) else a
+                              for a in arg_arrays]
+                    with engine.no_grad():
+                        out = obj(*t_args, **kwargs)
+                    return jax.tree_util.tree_map(
+                        lambda x: x._data if isinstance(x, Tensor) else x, out,
+                        is_leaf=lambda x: isinstance(x, Tensor))
+                return jax.jit(fn)
+
+            out = get_jitted()(arrs)
+            return jax.tree_util.tree_map(
+                lambda x: Tensor._from_data(x) if _is_array(x) else x, out)
+        return wrapper
+
+    if function is not None:
+        return decorate(function)
+    return decorate

@@ -1,0 +1,179 @@
+"""Compiled SPMD training step (the performance path).
+
+Ref: the reference's fleet static-graph path (SURVEY.md §3.5) — one compiled
+program per step. Here: jax.value_and_grad over the Layer's functional form +
+the optimizer's pure update rule, jitted once with donated state. When a mesh
++ sharding specs are given, parameters/optimizer states are placed with
+NamedShardings (TP from param.pspec, ZeRO from group_sharded), the batch is
+dp-sharded, and XLA emits all collectives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..distributed import sharding_utils
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from .functional import functional_call, state_arrays
+
+
+class TrainStep:
+    """Owns the (possibly sharded) param/opt-state arrays; callable per batch.
+
+    train_step = TrainStep(model, loss_fn, optimizer, mesh=hcg.mesh,
+                           batch_spec=P('dp'))
+    loss = train_step(x, y)
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 mesh: Optional[Mesh] = None, batch_spec=None,
+                 grad_accum: int = 1, donate: bool = True, rng_seed: int = 0):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.batch_spec = batch_spec
+        self._step_count = 0
+        self._rng = jax.random.PRNGKey(rng_seed)
+
+        params, buffers = state_arrays(model)
+        self.param_objs = dict(model.named_parameters())
+        trainable = {k: p for k, p in self.param_objs.items()
+                     if not p.stop_gradient}
+        self.trainable_keys = list(trainable)
+
+        opt_states = {}
+        for k in self.trainable_keys:
+            opt_states[k] = optimizer._create_accumulators(self.param_objs[k])
+        self.wd_map = {k: optimizer._weight_decay for k in self.trainable_keys}
+
+        if mesh is not None:
+            self.param_shardings = {
+                k: sharding_utils.param_sharding(p, mesh)
+                for k, p in self.param_objs.items()}
+            params = {k: jax.device_put(v, self.param_shardings[k])
+                      for k, v in params.items()}
+            opt_states = {
+                k: jax.tree_util.tree_map(
+                    lambda a, s=self.param_shardings[k]: jax.device_put(
+                        a, s if a.ndim == params[k].ndim else
+                        NamedSharding(mesh, P())),
+                    opt_states[k])
+                for k in self.trainable_keys}
+            buffers = {k: jax.device_put(v, NamedSharding(mesh, P()))
+                       for k, v in buffers.items()}
+        self.params = params
+        self.buffers = buffers
+        self.opt_states = opt_states
+
+        clip = optimizer._grad_clip
+        clip_norm = getattr(clip, "clip_norm", None) if clip is not None else None
+        update_rule = optimizer._update
+        wd_map = dict(self.wd_map)
+        trainable_keys = list(self.trainable_keys)
+        model_ref = model
+        loss_ref = loss_fn
+        mesh_ref = mesh
+        bspec = batch_spec
+
+        def compute_loss(train_params, frozen_params, buffers, batch, rng):
+            all_params = {**frozen_params, **train_params}
+            def run():
+                out, new_buf = functional_call(model_ref, all_params,
+                                               batch["inputs"], buffers=buffers,
+                                               rng_key=rng, training=True)
+                t_out = Tensor._from_data(out) if not isinstance(out, tuple) \
+                    else tuple(Tensor._from_data(o) for o in out)
+                labels = [Tensor._from_data(l) for l in batch["labels"]]
+                loss = loss_ref(t_out, *labels)
+                return loss._data.astype(jnp.float32), new_buf
+            if mesh_ref is not None:
+                with _mesh_hints(mesh_ref):
+                    return run()
+            return run()
+
+        def step_fn(train_params, opt_states, buffers, frozen_params, batch,
+                    rng, lr):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(train_params, frozen_params,
+                                            buffers, batch, rng)
+            if clip_norm is not None:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
+                scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g * scale).astype(g.dtype), grads)
+            new_params = dict(train_params)
+            new_states = dict(opt_states)
+            for k in trainable_keys:
+                p32 = train_params[k]
+                new_p, new_s = update_rule(
+                    p32.astype(jnp.float32) if p32.dtype != jnp.float32 else p32,
+                    grads[k], opt_states[k], lr, wd_map[k], {})
+                new_params[k] = new_p.astype(train_params[k].dtype)
+                new_states[k] = new_s
+            return new_params, new_states, new_buffers, loss
+
+        donate_args = (0, 1, 2) if donate else ()
+        self._compiled = jax.jit(step_fn, donate_argnums=donate_args)
+
+    def __call__(self, *inputs, labels=None):
+        if labels is None:
+            *inputs, labels = inputs
+            labels = [labels]
+        elif not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        batch = {
+            "inputs": tuple(self._place_batch(x) for x in inputs),
+            "labels": [self._place_batch(l) for l in labels],
+        }
+        train_params = {k: self.params[k] for k in self.trainable_keys}
+        frozen = {k: v for k, v in self.params.items()
+                  if k not in set(self.trainable_keys)}
+        self._rng, sub = jax.random.split(self._rng)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        new_p, new_s, new_b, loss = self._compiled(
+            train_params, self.opt_states, self.buffers, frozen, batch, sub, lr)
+        self.params.update(new_p)
+        self.opt_states = new_s
+        self.buffers = new_b
+        self._step_count += 1
+        return Tensor._from_data(loss)
+
+    def _place_batch(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+        if self.mesh is not None and self.batch_spec is not None:
+            spec = list(self.batch_spec) + [None] * (arr.ndim - len(self.batch_spec))
+            arr = jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
+        return arr
+
+    def sync_to_model(self):
+        """Copy the (device, possibly sharded) params back into the Layer."""
+        for k, p in self.param_objs.items():
+            if k in self.params:
+                p._data = self.params[k]
+        for k, b in self.model.named_buffers():
+            if b is not None and k in self.buffers:
+                b._data = self.buffers[k]
+
+
+class _mesh_hints:
+    """Context activating sharding hints for the functional trace."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = sharding_utils.auto_shard(self.mesh)
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)

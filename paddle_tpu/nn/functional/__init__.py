@@ -1,0 +1,31 @@
+"""Functional ops (ref: python/paddle/nn/functional/).
+
+All compute lowers to jnp/lax so XLA fuses elementwise chains into the
+surrounding matmuls/convs; scaled_dot_product_attention routes to the Pallas
+flash-attention kernel on TPU (ops/flash_attention.py).
+"""
+from .activation import (relu, relu6, relu_, gelu, silu, swish, sigmoid,
+                         log_sigmoid, tanh, softmax, log_softmax, softplus,
+                         softsign, leaky_relu, elu, selu, celu, hardshrink,
+                         hardsigmoid, hardswish, hardtanh, mish, prelu,
+                         rrelu, tanhshrink, softshrink, thresholded_relu,
+                         maxout, glu, gumbel_softmax)
+from .common import (linear, dropout, dropout2d, embedding, one_hot, pad,
+                     interpolate, upsample, unfold, fold, pixel_shuffle,
+                     cosine_similarity, pairwise_distance, label_smooth,
+                     bilinear, alpha_dropout)
+from .conv import conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose
+from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
+                      max_pool2d, max_pool3d, adaptive_avg_pool1d,
+                      adaptive_avg_pool2d, adaptive_avg_pool3d,
+                      adaptive_max_pool2d, global_avg_pool2d)
+from .norm import (layer_norm, batch_norm, instance_norm, group_norm,
+                   rms_norm, local_response_norm, normalize)
+from .loss import (cross_entropy, softmax_with_cross_entropy, mse_loss,
+                   l1_loss, nll_loss, binary_cross_entropy,
+                   binary_cross_entropy_with_logits, smooth_l1_loss,
+                   kl_div, margin_ranking_loss, cosine_embedding_loss,
+                   hinge_embedding_loss, square_error_cost, log_loss,
+                   sigmoid_focal_loss, ctc_loss, triplet_margin_loss,
+                   poisson_nll_loss)
+from .attention import scaled_dot_product_attention, sdp_kernel

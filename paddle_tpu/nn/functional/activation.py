@@ -1,0 +1,154 @@
+"""Activation functions (ref: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, _run_op
+from ...framework import random as random_mod
+
+
+def _act(name, jfn):
+    def op(x, name=None):
+        return _run_op(name, jfn, (x,), {})
+    op.__name__ = name
+    return op
+
+
+relu = _act("relu", lambda a: jax.nn.relu(a))
+relu6 = _act("relu6", lambda a: jnp.clip(a, 0, 6))
+sigmoid = _act("sigmoid", lambda a: jax.nn.sigmoid(a))
+log_sigmoid = _act("log_sigmoid", lambda a: jax.nn.log_sigmoid(a))
+tanh = _act("tanh", lambda a: jnp.tanh(a))
+silu = _act("silu", lambda a: jax.nn.silu(a))
+swish = silu
+softplus_ = None
+softsign = _act("softsign", lambda a: jax.nn.soft_sign(a))
+mish = _act("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+tanhshrink = _act("tanhshrink", lambda a: a - jnp.tanh(a))
+
+
+def relu_(x):
+    x._data = jax.nn.relu(x._data)
+    x._grad_node = None
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    return _run_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), (x,), {})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework import dtype as dm
+            a = a.astype(dm.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return _run_op("softmax", f, (x,), {})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _run_op("log_softmax", lambda a: jax.nn.log_softmax(a, axis=axis), (x,), {})
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    def f(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jnp.log1p(jnp.exp(scaled)) / beta)
+    return _run_op("softplus", f, (x,), {})
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _run_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), (x,), {})
+
+
+def elu(x, alpha=1.0, name=None):
+    return _run_op("elu", lambda a: jax.nn.elu(a, alpha), (x,), {})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _run_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), (x,), {})
+
+
+def celu(x, alpha=1.0, name=None):
+    return _run_op("celu", lambda a: jax.nn.celu(a, alpha), (x,), {})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _run_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,), {})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    def f(a):
+        return jnp.where(a > threshold, a - threshold,
+                         jnp.where(a < -threshold, a + threshold, 0.0))
+    return _run_op("softshrink", f, (x,), {})
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _run_op("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (x,), {})
+
+
+def hardswish(x, name=None):
+    return _run_op("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6, (x,), {})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _run_op("hardtanh", lambda a: jnp.clip(a, min, max), (x,), {})
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+    return _run_op("prelu", f, (x, weight), {})
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        k = random_mod.next_key()
+        def f(a):
+            slope = jax.random.uniform(k, a.shape, jnp.float32, lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return _run_op("rrelu", f, (x,), {})
+    mid = (lower + upper) / 2
+    return leaky_relu(x, mid)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _run_op("thresholded_relu", lambda a: jnp.where(a > threshold, a, 0.0), (x,), {})
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        c = a.shape[axis]
+        new_shape = list(a.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(a.reshape(new_shape), axis=axis + 1)
+    return _run_op("maxout", f, (x,), {})
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return _run_op("glu", f, (x,), {})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    k = random_mod.next_key()
+    def f(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(k, a.shape, jnp.float32) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g.astype(a.dtype)) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return _run_op("gumbel_softmax", f, (x,), {})

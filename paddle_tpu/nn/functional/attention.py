@@ -1,0 +1,90 @@
+"""Scaled dot-product attention (ref: paddle/phi/kernels/gpu/flash_attn_kernel.cu
++ python/paddle/nn/functional/flash_attention.py).
+
+Layout matches the reference: [batch, seq, num_heads, head_dim]. On TPU the op
+routes to the Pallas flash-attention kernel (ops/flash_attention.py); elsewhere
+(or when FLAGS_use_pallas_kernels=0) it falls back to the XLA softmax path with
+fp32 accumulation.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import flags
+from ...tensor.tensor import Tensor, _run_op
+
+
+def _xla_sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=None):
+    # [B, S, H, D] -> compute in [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 1, 2)
+    hq, hk = qh.shape[1], kh.shape[1]
+    if hk != hq:  # GQA: repeat kv heads
+        rep = hq // hk
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / jnp.sqrt(jnp.float32(d))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -1e30)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vh.dtype), vh)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def _use_pallas(query) -> bool:
+    if not flags.get_flag("use_pallas_kernels"):
+        return False
+    data = query._data if isinstance(query, Tensor) else query
+    try:
+        dev = next(iter(data.devices()))
+        return dev.platform != "cpu"
+    except Exception:
+        # tracer: no concrete device — trust the default backend
+        return jax.default_backend() == "tpu"
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    if _use_pallas(query) and attn_mask is None and dropout_p == 0.0:
+        from ...ops.flash_attention import flash_attention_bshd
+        def f(q, k, v):
+            return flash_attention_bshd(q, k, v, causal=is_causal, scale=scale)
+        return _run_op("flash_attention", f, (query, key, value), {})
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    def f(q, k, v, *m):
+        return _xla_sdpa(q, k, v, m[0] if m else None, dropout_p, is_causal, scale)
+    return _run_op("sdpa", f, args, {})
+
+
+@contextlib.contextmanager
+def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
+    prev = flags.get_flag("use_pallas_kernels")
+    flags.set_flags({"use_pallas_kernels": enable_flash})
+    try:
+        yield
+    finally:
+        flags.set_flags({"use_pallas_kernels": prev})
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, name=None):
+    """paddle.nn.functional.flash_attention parity wrapper."""
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None

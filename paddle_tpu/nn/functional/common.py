@@ -1,0 +1,194 @@
+"""Common functional ops: linear, dropout, embedding, padding, etc.
+(ref: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...amp import state as amp_state
+from ...framework import random as random_mod
+from ...tensor.tensor import Tensor, _run_op
+from ...tensor import manipulation as manip
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. W layout [in, out] like the reference; bf16 under AMP
+    so XLA maps it onto the MXU."""
+    if bias is None:
+        def f(a, w):
+            a, w = amp_state.maybe_autocast_pair(a, w)
+            return jnp.matmul(a, w)
+        return _run_op("linear", f, (x, weight), {})
+    def f(a, w, b):
+        a, w = amp_state.maybe_autocast_pair(a, w)
+        return jnp.matmul(a, w) + b.astype(a.dtype if amp_state.autocast_enabled() else b.dtype)
+    return _run_op("linear", f, (x, weight, bias), {})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = random_mod.next_key()
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return _run_op("dropout", f, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = random_mod.next_key()
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        b_coef = -a_coef * p * alpha_p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return _run_op("alpha_dropout", f, (x,), {})
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return _run_op("embedding", f, (x, weight), {})
+
+
+def one_hot(x, num_classes, name=None):
+    return _run_op("one_hot",
+                   lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes, dtype=jnp.float32),
+                   (x,), {})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    return manip.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        nchw = data_format == "NCHW"
+        spatial = a.shape[2:] if nchw else a.shape[1:-1]
+        if size is not None:
+            tgt = tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in
+                        (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            tgt = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+        if nchw:
+            out_shape = a.shape[:2] + tgt
+        else:
+            out_shape = (a.shape[0],) + tgt + (a.shape[-1],)
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "bicubic": "cubic", "trilinear": "linear", "area": "linear"}[mode]
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+    return _run_op("interpolate", f, (x,), {})
+
+
+upsample = interpolate
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return _run_op("unfold", f, (x,), {})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]: pd[0] + os_[0], pd[1]: pd[1] + os_[1]]
+    return _run_op("fold", f, (x,), {})
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return _run_op("pixel_shuffle", f, (x,), {})
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+    return _run_op("cosine_similarity", f, (x1, x2), {})
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return _run_op("pairwise_distance", f, (x, y), {})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return _run_op("label_smooth", f, (label,), {})
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bias_arg):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias_arg:
+            out = out + bias_arg[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return _run_op("bilinear", f, args, {})

@@ -1,0 +1,165 @@
+"""Convolutions via lax.conv_general_dilated (ref: python/paddle/nn/functional/conv.py
++ paddle/phi/kernels/gpu/conv_kernel.cu — here XLA owns algorithm selection and
+layout assignment on TPU instead of cuDNN).
+
+Weight layout matches the reference: [out_c, in_c/groups, *kernel]. AMP casts
+inputs to bf16 so convs hit the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...amp import state as amp_state
+from ...tensor.tensor import Tensor, _run_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format,
+          name="conv"):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    chan_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n:] if n <= 3 else None
+    if chan_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    out_spec = lhs_spec
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (lhs_spec, rhs_spec, out_spec))
+
+    def f(a, w, *b):
+        a, w = amp_state.maybe_autocast_pair(a, w)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[out_spec.index("C")] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape).astype(out.dtype)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return _run_op(name, f, args, {})
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NLC" if data_format == "NLC" else "NCL"
+    # map 1d onto the generic path with spatial dim "W"
+    stride = _tuple(stride, 1)
+    dilation = _tuple(dilation, 1)
+    pad = _padding(padding, 1)
+    chan_last = df == "NLC"
+    lhs = "NWC" if chan_last else "NCW"
+    dn = jax.lax.conv_dimension_numbers((1, 1, 1), (1, 1, 1), (lhs, "OIW", lhs))
+
+    def f(a, w, *b):
+        a, w = amp_state.maybe_autocast_pair(a, w)
+        out = jax.lax.conv_general_dilated(a, w, stride, pad,
+                                           rhs_dilation=dilation,
+                                           dimension_numbers=dn,
+                                           feature_group_count=groups)
+        if b:
+            shape = [1, 1, 1]
+            shape[lhs.index("C")] = b[0].shape[0]
+            out = out + b[0].reshape(shape).astype(out.dtype)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return _run_op("conv1d", f, args, {})
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, name):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    spatial = "DHW"[3 - n:]
+    chan_last = data_format in ("NHWC", "NLC", "NDHWC")
+    lhs = ("N" + spatial + "C") if chan_last else ("NC" + spatial)
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (lhs, "IO" + spatial, lhs))
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _padding(padding, n)
+        # transposed conv padding: lax uses (k-1)*d - p on each side
+        pad = []
+        for i, (lo, hi) in enumerate(p):
+            k_eff = dilation[i] * (weight.shape[2 + i] - 1)
+            pad.append((k_eff - lo, k_eff - hi + opad[i]))
+
+    def f(a, w, *b):
+        a, w = amp_state.maybe_autocast_pair(a, w)
+        # weight layout in reference: [in_c, out_c/groups, *k] for transpose
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=pad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+            transpose_kernel=True)
+        if b:
+            shape = [1] * out.ndim
+            shape[lhs.index("C")] = b[0].shape[0]
+            out = out + b[0].reshape(shape).astype(out.dtype)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return _run_op(name, f, args, {})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NLC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, "NCHW"[:2] + "W" if df == "NCW" else df,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, "conv3d_transpose")

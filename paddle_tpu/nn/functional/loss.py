@@ -1,0 +1,282 @@
+"""Loss functions (ref: python/paddle/nn/functional/loss.py).
+
+cross_entropy computes log-softmax + gather in fp32 regardless of input dtype
+(bf16-safe), matching the reference's softmax_with_cross_entropy numerics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, _run_op
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lbl, *w):
+        l32 = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(l32, axis=axis) if use_softmax else jnp.log(l32)
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            tgt = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -(tgt * logp).sum(axis=axis)
+        else:
+            idx = lbl.astype(jnp.int32)
+            squeeze = False
+            if idx.ndim == logits.ndim:  # trailing [..., 1] label
+                idx = jnp.squeeze(idx, axis=axis)
+                squeeze = True
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(logp, safe_idx[..., None], axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                smooth = logp.mean(axis=axis)
+                loss = -((1 - label_smoothing) * picked + label_smoothing * smooth)
+            else:
+                loss = -picked
+            mask = (idx != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if w:
+                loss = loss * jnp.take(w[0].astype(jnp.float32), safe_idx)
+            if reduction == "mean":
+                denom = jnp.maximum(mask.sum(), 1)
+                if w:
+                    denom = jnp.maximum((jnp.take(w[0].astype(jnp.float32), safe_idx) * mask).sum(), 1e-12)
+                return loss.sum() / denom
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _run_op("cross_entropy", f, args, {})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # reference returns loss with a trailing singleton dim
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _run_op("mse_loss",
+                   lambda a, b: _reduce(jnp.square(a - b), reduction),
+                   (input, label), {})
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _run_op("l1_loss",
+                   lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                   (input, label), {})
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lbl, *w):
+        idx = lbl.astype(jnp.int32)
+        safe = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
+        loss = -picked
+        mask = idx != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], safe)
+            loss = loss * wt
+            if reduction == "mean":
+                return loss.sum() / jnp.maximum((wt * mask).sum(), 1e-12)
+        if reduction == "mean":
+            return loss.sum() / jnp.maximum(mask.sum(), 1)
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _run_op("nll_loss", f, args, {})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        p32 = jnp.clip(p.astype(jnp.float32), 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p32) + (1 - y) * jnp.log1p(-p32))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _run_op("bce", f, args, {})
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        z32 = z.astype(jnp.float32)
+        y32 = y.astype(jnp.float32)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight on the y term
+        if pw is None:
+            loss = jnp.maximum(z32, 0) - z32 * y32 + jnp.log1p(jnp.exp(-jnp.abs(z32)))
+        else:
+            log_sig = jax.nn.log_sigmoid(z32)
+            log_sig_neg = jax.nn.log_sigmoid(-z32)
+            loss = -(pw * y32 * log_sig + (1 - y32) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return _run_op("bce_with_logits", f, args, {})
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta) * delta
+        return _reduce(loss, reduction)
+    return _run_op("smooth_l1", f, (input, label), {})
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, q):
+        if log_target:
+            loss = jnp.exp(q) * (q - logp)
+        else:
+            q32 = jnp.maximum(q.astype(jnp.float32), 1e-12)
+            loss = q32 * (jnp.log(q32) - logp)
+        if reduction == "batchmean":
+            return loss.sum() / logp.shape[0]
+        return _reduce(loss, reduction)
+    return _run_op("kl_div", f, (input, label), {})
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return _run_op("margin_ranking", f, (input, other, label), {})
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = (a * b).sum(-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return _run_op("cosine_embedding", f, (input1, input2, label), {})
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return _run_op("hinge_embedding", f, (input, label), {})
+
+
+def square_error_cost(input, label):
+    return _run_op("square_error_cost", lambda a, b: jnp.square(a - b), (input, label), {})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return _run_op("log_loss", f, (input, label), {})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z.astype(jnp.float32))
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return _run_op("sigmoid_focal", f, args, {})
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        d_pos = jnp.sum(jnp.abs(a - pos + epsilon) ** p, -1) ** (1 / p)
+        d_neg = jnp.sum(jnp.abs(a - neg + epsilon) ** p, -1) ** (1 / p)
+        if swap:
+            d_pn = jnp.sum(jnp.abs(pos - neg + epsilon) ** p, -1) ** (1 / p)
+            d_neg = jnp.minimum(d_neg, d_pn)
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+    return _run_op("triplet_margin", f, (input, positive, negative), {})
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, y):
+        if log_input:
+            loss = jnp.exp(z) - y * z
+        else:
+            loss = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return _run_op("poisson_nll", f, (input, label), {})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    def f(lp, lbl, il, ll):
+        # lp: [T, B, C] log-probs (reference layout)
+        T, B, C = lp.shape
+        lp32 = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        S = 2 * lbl.shape[1] + 1
+        # extended label sequence with blanks
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl.astype(jnp.int32))
+        neg_inf = jnp.float32(-1e30)
+        alpha = jnp.full((B, S), neg_inf)
+        alpha = alpha.at[:, 0].set(lp32[0, :, blank])
+        alpha = alpha.at[:, 1].set(jnp.take_along_axis(lp32[0], ext[:, 1:2], axis=1)[:, 0])
+
+        def step(alpha, lp_t):
+            prev1 = alpha
+            prev2 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            prev3 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            ext_shift = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], 1)
+            allow3 = (ext != blank) & (ext != ext_shift)
+            m = jnp.maximum(prev1, prev2)
+            m = jnp.where(allow3, jnp.maximum(m, prev3), m)
+            m_safe = jnp.maximum(m, neg_inf)
+            summed = (jnp.exp(prev1 - m_safe) + jnp.exp(prev2 - m_safe)
+                      + jnp.where(allow3, jnp.exp(prev3 - m_safe), 0.0))
+            new_alpha = m_safe + jnp.log(summed)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new_alpha + emit, None
+
+        alpha_final, _ = jax.lax.scan(step, alpha, lp32[1:])
+        # pick final positions based on label_lengths
+        last = 2 * ll.astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha_final, last[:, None], 1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha_final, jnp.maximum(last - 1, 0)[:, None], 1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll_total = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+        loss = -ll_total
+        if reduction == "mean":
+            return (loss / jnp.maximum(ll.astype(jnp.float32), 1)).mean()
+        return _reduce(loss, reduction)
+    return _run_op("ctc_loss", f, (log_probs, labels, input_lengths, label_lengths), {})

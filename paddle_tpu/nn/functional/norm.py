@@ -1,0 +1,161 @@
+"""Normalization functional ops (ref: python/paddle/nn/functional/norm.py).
+
+rms_norm is a first-class op (the reference implements it as a fused CUDA
+kernel, paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu); here the default
+path is jnp (XLA fuses it) with a Pallas kernel override on TPU for long rows
+(ops/rms_norm.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, _run_op
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(normalized_shape)
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return _run_op("layer_norm", f, args, {})
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm: x * w / sqrt(mean(x^2)). fp32 accumulation, compute dtype out."""
+    def f(a, *w):
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(a32), axis=-1, keepdims=True)
+        out = a32 * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = (x,) + ((weight,) if weight is not None else ())
+    return _run_op("rms_norm", f, args, {})
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Batch norm with reference semantics: running stats are updated in place
+    on the mean/var tensors during training (the eager path); the jit path
+    captures buffer updates via jit/functional.py's buffer swap."""
+    ch_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        def stats(a):
+            a32 = a.astype(jnp.float32)
+            m = jnp.mean(a32, axis=reduce_axes)
+            v = jnp.var(a32, axis=reduce_axes)
+            return m, v
+        mean_t, var_t = _run_op("bn_stats", stats, (x,), {})
+        # update running stats in place (stop-gradient side channel)
+        rm = running_mean._data.astype(jnp.float32)
+        rv = running_var._data.astype(jnp.float32)
+        running_mean._data = (momentum * rm
+                              + (1 - momentum) * jax.lax.stop_gradient(mean_t._data)
+                              ).astype(running_mean._data.dtype)
+        running_var._data = (momentum * rv
+                             + (1 - momentum) * jax.lax.stop_gradient(var_t._data)
+                             ).astype(running_var._data.dtype)
+        use_mean, use_var = mean_t, var_t
+    else:
+        use_mean, use_var = running_mean, running_var
+
+    def f(a, m, v, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        a32 = a.astype(jnp.float32)
+        out = (a32 - m.astype(jnp.float32).reshape(shape)) * jax.lax.rsqrt(
+            v.astype(jnp.float32).reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = (x, use_mean, use_var) + tuple(t for t in (weight, bias) if t is not None)
+    return _run_op("batch_norm", f, args, {})
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        a32 = a.astype(jnp.float32)
+        m = jnp.mean(a32, axis=axes, keepdims=True)
+        v = jnp.var(a32, axis=axes, keepdims=True)
+        out = (a32 - m) * jax.lax.rsqrt(v + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return _run_op("instance_norm", f, args, {})
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        rest = a.shape[2:]
+        a32 = a.astype(jnp.float32).reshape((n, g, c // g) + rest)
+        axes = tuple(range(2, a32.ndim))
+        m = jnp.mean(a32, axis=axes, keepdims=True)
+        v = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return _run_op("group_norm", f, args, {})
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pads)
+        acc = sum(sq_p[:, i:i + c] for i in range(size))
+        return a / (k + alpha * acc) ** beta
+    return _run_op("lrn", f, (x,), {})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return _run_op("normalize", f, (x,), {})

@@ -1,0 +1,166 @@
+"""Pooling via lax.reduce_window (ref: python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor, _run_op
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pad_spec(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if len(p) == n:
+        return [(int(x), int(x)) for x in p]
+    return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+
+
+def _pool(x, kernel, stride, padding, n, reducer, init, name,
+          ceil_mode=False, count_include_pad=True, data_format="NCHW"):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_spec(padding, n)
+    chan_last = data_format in ("NHWC", "NLC", "NDHWC")
+
+    def f(a):
+        if chan_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = ([(0, 0)] + list(pad) + [(0, 0)]) if not isinstance(pad, str) else pad
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+        if reducer == "max":
+            neg = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, neg, jax.lax.max, dims, strides, pads)
+        # avg pool: sum then divide
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pads)
+        if count_include_pad or isinstance(pads, str):
+            return (s / np.prod(kernel)).astype(a.dtype)
+        ones = jnp.ones_like(a)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pads)
+        return (s / counts).astype(a.dtype)
+
+    return _run_op(name, f, (x,), {})
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NLC" if data_format == "NLC" else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, "max", None, "max_pool1d",
+                 data_format="NLC" if df == "NLC" else "NCHW")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", None, "max_pool2d",
+                 ceil_mode=ceil_mode, data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", None, "max_pool3d",
+                 data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", None, "avg_pool1d",
+                 count_include_pad=not exclusive,
+                 data_format="NLC" if data_format == "NLC" else "NCHW")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", None, "avg_pool2d",
+                 count_include_pad=not exclusive, data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", None, "avg_pool3d",
+                 count_include_pad=not exclusive, data_format=data_format)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def f(a):
+        l = a.shape[-1]
+        out = int(output_size)
+        a4 = a[..., None]
+        res = jax.image.resize(a4.mean(-1, keepdims=True) if False else a4,
+                               a4.shape, method="linear")
+        # exact adaptive: split into equal bins
+        bins = np.linspace(0, l, out + 1).astype(int)
+        return jnp.stack([a[..., s:e].mean(-1) for s, e in zip(bins[:-1], bins[1:])], axis=-1)
+    return _run_op("adaptive_avg_pool1d", f, (x,), {})
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out = _tuple(output_size, 2)
+    def f(a):
+        h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
+        hb = np.linspace(0, h, out[0] + 1).astype(int)
+        wb = np.linspace(0, w, out[1] + 1).astype(int)
+        rows = []
+        for hs, he in zip(hb[:-1], hb[1:]):
+            cols = []
+            for ws, we in zip(wb[:-1], wb[1:]):
+                if data_format == "NCHW":
+                    cols.append(a[:, :, hs:he, ws:we].mean((2, 3)))
+                else:
+                    cols.append(a[:, hs:he, ws:we, :].mean((1, 2)))
+            rows.append(jnp.stack(cols, axis=-1))
+        res = jnp.stack(rows, axis=-2)
+        return res
+    return _run_op("adaptive_avg_pool2d", f, (x,), {})
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    out = _tuple(output_size, 3)
+    def f(a):
+        d, h, w = a.shape[2:]
+        db = np.linspace(0, d, out[0] + 1).astype(int)
+        hb = np.linspace(0, h, out[1] + 1).astype(int)
+        wb = np.linspace(0, w, out[2] + 1).astype(int)
+        vol = []
+        for ds_, de in zip(db[:-1], db[1:]):
+            rows = []
+            for hs, he in zip(hb[:-1], hb[1:]):
+                cols = []
+                for ws, we in zip(wb[:-1], wb[1:]):
+                    cols.append(a[:, :, ds_:de, hs:he, ws:we].mean((2, 3, 4)))
+                rows.append(jnp.stack(cols, -1))
+            vol.append(jnp.stack(rows, -2))
+        return jnp.stack(vol, -3)
+    return _run_op("adaptive_avg_pool3d", f, (x,), {})
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _tuple(output_size, 2)
+    def f(a):
+        h, w = a.shape[2], a.shape[3]
+        hb = np.linspace(0, h, out[0] + 1).astype(int)
+        wb = np.linspace(0, w, out[1] + 1).astype(int)
+        rows = []
+        for hs, he in zip(hb[:-1], hb[1:]):
+            cols = [a[:, :, hs:he, ws:we].max((2, 3))
+                    for ws, we in zip(wb[:-1], wb[1:])]
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+    return _run_op("adaptive_max_pool2d", f, (x,), {})
+
+
+def global_avg_pool2d(x, data_format="NCHW", name=None):
+    axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    return _run_op("global_avg_pool2d", lambda a: a.mean(axes, keepdims=True), (x,), {})

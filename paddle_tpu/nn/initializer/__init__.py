@@ -1,0 +1,192 @@
+"""Weight initializers (ref: python/paddle/nn/initializer/).
+
+Each initializer is a callable that fills a Parameter's data in place, drawing
+randomness from the framework's global stateful RNG (so paddle.seed makes
+initialization reproducible, TP layers re-seed per rank via the RNG tracker).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as random_mod
+
+
+class Initializer:
+    def __call__(self, param, block=None):
+        raise NotImplementedError
+
+    def _set(self, param, data):
+        param._data = data.astype(param._data.dtype)
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        self._set(param, jnp.full(param._data.shape, self.value, jnp.float32))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        k = random_mod.next_key()
+        self._set(param, jax.random.normal(k, param._data.shape, jnp.float32)
+                  * self.std + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, param, block=None):
+        k = random_mod.next_key()
+        data = jax.random.truncated_normal(k, self.a, self.b,
+                                           param._data.shape, jnp.float32)
+        self._set(param, data * self.std + self.mean)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        k = random_mod.next_key()
+        self._set(param, jax.random.uniform(k, param._data.shape, jnp.float32,
+                                            self.low, self.high))
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: [out_c, in_c, *spatial] (reference layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param._data.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = random_mod.next_key()
+        self._set(param, jax.random.normal(k, param._data.shape, jnp.float32) * std)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param._data.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = random_mod.next_key()
+        self._set(param, jax.random.uniform(k, param._data.shape, jnp.float32,
+                                            -limit, limit))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param._data.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        k = random_mod.next_key()
+        self._set(param, jax.random.normal(k, param._data.shape, jnp.float32) * std)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param._data.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        k = random_mod.next_key()
+        self._set(param, jax.random.uniform(k, param._data.shape, jnp.float32,
+                                            -limit, limit))
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        from ...tensor.tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        else:
+            v = jnp.asarray(np.asarray(v))
+        self._set(param, v)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = param._data.shape
+        data = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic * self.groups)):
+            idx = (i, i % ic) + tuple(centers)
+            data[idx] = 1.0
+        self._set(param, jnp.asarray(data))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = param._data.shape
+        rows = shape[0]
+        cols = int(np.prod(shape)) // rows
+        k = random_mod.next_key()
+        a = jax.random.normal(k, (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diag(r))
+        q = q.T if rows < cols else q
+        self._set(param, self.gain * q[:rows, :cols].reshape(shape))
+
+
+# functional-style aliases matching paddle.nn.initializer names
+constant_ = Constant
+normal_ = Normal
+uniform_ = Uniform
+xavier_normal_ = XavierNormal
+xavier_uniform_ = XavierUniform
+kaiming_normal_ = KaimingNormal
+kaiming_uniform_ = KaimingUniform
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    # reference stores globals consulted by create_parameter; simple version:
+    from ..layer import layers as _layers
+    raise NotImplementedError("set_global_initializer: pass initializers via ParamAttr")

@@ -1,0 +1,70 @@
+"""Activation layers (ref: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _simple(name, fn, **defaults):
+    def forward(self, x):
+        kwargs = {k: getattr(self, k) for k in defaults}
+        return fn(x, **kwargs)
+
+    def __init__(self, name=None, **kwargs):
+        Layer.__init__(self)
+        for k, v in defaults.items():
+            setattr(self, k, kwargs.get(k, v))
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _simple("ReLU", F.relu)
+ReLU6 = _simple("ReLU6", F.relu6)
+Sigmoid = _simple("Sigmoid", F.sigmoid)
+LogSigmoid = _simple("LogSigmoid", F.log_sigmoid)
+Tanh = _simple("Tanh", F.tanh)
+Silu = _simple("Silu", F.silu)
+Swish = Silu
+Mish = _simple("Mish", F.mish)
+Softsign = _simple("Softsign", F.softsign)
+Tanhshrink = _simple("Tanhshrink", F.tanhshrink)
+GELU = _simple("GELU", F.gelu, approximate=False)
+Softmax = _simple("Softmax", F.softmax, axis=-1)
+LogSoftmax = _simple("LogSoftmax", F.log_softmax, axis=-1)
+Softplus = _simple("Softplus", F.softplus, beta=1, threshold=20)
+LeakyReLU = _simple("LeakyReLU", F.leaky_relu, negative_slope=0.01)
+ELU = _simple("ELU", F.elu, alpha=1.0)
+SELU = _simple("SELU", F.selu)
+CELU = _simple("CELU", F.celu, alpha=1.0)
+Hardshrink = _simple("Hardshrink", F.hardshrink, threshold=0.5)
+Softshrink = _simple("Softshrink", F.softshrink, threshold=0.5)
+Hardsigmoid = _simple("Hardsigmoid", F.hardsigmoid)
+Hardswish = _simple("Hardswish", F.hardswish)
+Hardtanh = _simple("Hardtanh", F.hardtanh, min=-1.0, max=1.0)
+ThresholdedReLU = _simple("ThresholdedReLU", F.thresholded_relu, threshold=1.0)
+Maxout = _simple("Maxout", F.maxout, groups=2, axis=1)
+GLU = _simple("GLU", F.glu, axis=-1)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

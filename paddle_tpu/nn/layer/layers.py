@@ -1,0 +1,360 @@
+"""Layer: the module system (ref: python/paddle/nn/layer/layers.py).
+
+Same contract as the reference: parameter/buffer/sublayer registration via
+``__setattr__``, ``state_dict``/``set_state_dict``, train/eval mode, forward
+hooks, ``to``/dtype casting. Parameters are Tensors with
+``stop_gradient=False``; everything composes with the eager autograd tape and
+with ``paddle_tpu.jit`` functional tracing (parameters are swapped for tracers
+during compilation — see jit/functional.py).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import dtype as dtype_mod
+from ...tensor.tensor import Tensor
+
+
+class ParamAttr:
+    """Parameter attribute bundle (ref: python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if callable(attr):  # bare initializer
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"invalid ParamAttr: {attr!r}")
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (ref: EagerParamBase)."""
+
+    def __init__(self, data, trainable=True, name=None, learning_rate=1.0,
+                 need_clip=True):
+        if isinstance(data, Tensor):
+            data = data._data
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": learning_rate}
+        self.need_clip = need_clip
+        self.is_distributed = False
+        self.split_axis = None  # set by TP layers: which axis is mp-sharded
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_by_pure_fp16 = False
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            if params is not None:
+                params.pop(name, None)
+            object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params and value is None:
+                params.pop(name)
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor) or value is None:
+                    buffers[name] = value
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        object.__setattr__(self, str(name), sublayer)
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from .. import initializer as init_mod
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype or "float32"
+        nd = dtype_mod.convert_dtype(dtype)
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = init_mod.Constant(0.0) if is_bias else init_mod.XavierNormal()
+        shape = [int(s) for s in shape]
+        data = jnp.zeros(shape, nd)
+        p = Parameter(data, trainable=attr.trainable, name=attr.name,
+                      learning_rate=attr.learning_rate, need_clip=attr.need_clip)
+        init(p)
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        nd = dtype_mod.convert_dtype(dtype or "float32")
+        return Tensor(jnp.zeros((), nd), name=name)
+
+    # -- traversal ---------------------------------------------------------
+    def _named_members(self, get_members_fn, prefix="", include_sublayers=True):
+        memo = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for layer_prefix, layer in layers:
+            for k, v in get_members_fn(layer):
+                if v is None or id(v) in memo:
+                    continue
+                memo.add(id(v))
+                name = (layer_prefix + "." if layer_prefix else "") + k
+                yield name, v
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        yield from self._named_members(lambda l: l._parameters.items(),
+                                       prefix, include_sublayers)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        yield from self._named_members(lambda l: l._buffers.items(),
+                                       prefix, include_sublayers)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        memo = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in memo:
+                memo.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            p = (prefix + "." if prefix else "") + name
+            yield from l.named_sublayers(prefix=p, include_self=True,
+                                         layers_set=layers_set)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        if destination is None:
+            destination = collections.OrderedDict()
+        for name, p in self._parameters.items():
+            if p is not None:
+                destination[structured_name_prefix + name] = p
+        for name, b in self._buffers.items():
+            if b is not None and name not in self._non_persistable_buffer_names:
+                destination[structured_name_prefix + name] = b
+        if include_sublayers:
+            for name, l in self._sub_layers.items():
+                if l is not None:
+                    l.state_dict(destination, include_sublayers,
+                                 structured_name_prefix + name + ".")
+        return destination
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k not in own:
+                unexpected.append(k)
+                continue
+            target = own[k]
+            data = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(data.shape) != tuple(target._data.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {data.shape} vs {target._data.shape}")
+            target._data = data.astype(target._data.dtype)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- modes & casting ---------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        nd = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+        def _cast(t):
+            if t is None:
+                return
+            if nd is not None and jnp.issubdtype(t._data.dtype, jnp.floating):
+                t._data = t._data.astype(nd)
+            if device is not None:
+                t._data = t._to(device=device)
+        for l in self.sublayers(include_self=True):
+            for p in l._parameters.values():
+                _cast(p)
+            for b in l._buffers.values():
+                _cast(b)
+        if nd is not None:
+            self._dtype = dtype
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _LayerHookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _LayerHookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, inputs)
+            if res is not None:
+                inputs = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, out)
+            if res is not None:
+                out = res
+        return out
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self._sub_layers.items():
+            sub = repr(l).split("\n")
+            sub = [sub[0]] + ["  " + s for s in sub[1:]]
+            lines.append(f"({name}): " + "\n".join(sub))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+
+class _LayerHookHandle:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        _LayerHookHandle._next_id += 1
+        self.id = _LayerHookHandle._next_id
+
+    def remove(self):
+        self._hooks.pop(self.id, None)

@@ -1,0 +1,276 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a real recurrence, so it runs as ``lax.scan``
+inside the taped op — XLA compiles one fused step and iterates it, instead of
+the reference's cuDNN RNN descriptors. Layout: batch-first [B, T, ...] by
+default with time_major option, matching the reference.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, _run_op
+from .. import initializer as I
+from .layers import Layer
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([gates * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([gates * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([gates * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([gates * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        if states is None:
+            states = paddle.zeros([inputs.shape[0], self.hidden_size],
+                                  dtype=inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = _run_op("rnn_cell", f, (inputs, states, self.weight_ih,
+                                    self.weight_hh, self.bias_ih, self.bias_hh), {})
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        if states is None:
+            z = paddle.zeros([inputs.shape[0], self.hidden_size], dtype=inputs.dtype)
+            states = (z, z)
+        h_prev, c_prev = states
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = _run_op("lstm_cell", f, (inputs, h_prev, c_prev, self.weight_ih,
+                                        self.weight_hh, self.bias_ih, self.bias_hh), {})
+        return h, (h, c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        if states is None:
+            states = paddle.zeros([inputs.shape[0], self.hidden_size],
+                                  dtype=inputs.dtype)
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, -1)
+            hr, hz, hc = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        h = _run_op("gru_cell", f, (inputs, states, self.weight_ih,
+                                    self.weight_hh, self.bias_ih, self.bias_hh), {})
+        return h, h
+
+
+def _scan_rnn(mode, x, init, weights, time_major, reverse=False):
+    """One direction of one layer, as lax.scan over time."""
+    wi, wh, bi, bh = weights
+
+    def lstm_step(carry, xt):
+        h, c = carry
+        gates = xt @ wi.T + bi + h @ wh.T + bh
+        i, fg, g, o = jnp.split(gates, 4, axis=-1)
+        i, fg, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fg), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = fg * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    def gru_step(h, xt):
+        gi = xt @ wi.T + bi
+        gh = h @ wh.T + bh
+        ir, iz, ic = jnp.split(gi, 3, -1)
+        hr, hz, hc = jnp.split(gh, 3, -1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        c = jnp.tanh(ic + r * hc)
+        h = (1 - z) * c + z * h
+        return h, h
+
+    def rnn_step(h, xt):
+        h = jnp.tanh(xt @ wi.T + bi + h @ wh.T + bh)
+        return h, h
+
+    step = {"LSTM": lstm_step, "GRU": gru_step, "RNN_TANH": rnn_step}[mode]
+    xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, F]
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    final, ys = jax.lax.scan(step, init, xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return final, ys
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        gates = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                sfx = f"l{layer}" + ("_reverse" if d else "")
+                wi = self.create_parameter([gates * hidden_size, in_sz],
+                                           weight_ih_attr, default_initializer=u)
+                wh = self.create_parameter([gates * hidden_size, hidden_size],
+                                           weight_hh_attr, default_initializer=u)
+                bi = self.create_parameter([gates * hidden_size], bias_ih_attr,
+                                           is_bias=True, default_initializer=u)
+                bh = self.create_parameter([gates * hidden_size], bias_hh_attr,
+                                           is_bias=True, default_initializer=u)
+                self.add_parameter(f"weight_ih_{sfx}", wi)
+                self.add_parameter(f"weight_hh_{sfx}", wh)
+                self.add_parameter(f"bias_ih_{sfx}", bi)
+                self.add_parameter(f"bias_hh_{sfx}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        b = inputs.shape[0] if not self.time_major else inputs.shape[1]
+        n_state = self.num_layers * self.bidirect
+        is_lstm = self.mode == "LSTM"
+        if initial_states is None:
+            z = paddle.zeros([n_state, b, self.hidden_size], dtype=inputs.dtype)
+            initial_states = (z, paddle.zeros([n_state, b, self.hidden_size],
+                                              dtype=inputs.dtype)) if is_lstm else z
+
+        flat_ws = [w for tup in self._all_weights for w in tup]
+
+        def f(x, *arrs):
+            if is_lstm:
+                h0, c0 = arrs[0], arrs[1]
+                ws = arrs[2:]
+            else:
+                h0 = arrs[0]
+                ws = arrs[1:]
+            out = x
+            hs, cs = [], []
+            for layer in range(self.num_layers):
+                outs_dir = []
+                for d in range(self.bidirect):
+                    i = layer * self.bidirect + d
+                    weights = ws[4 * i: 4 * i + 4]
+                    if is_lstm:
+                        init = (h0[i], c0[i])
+                    else:
+                        init = h0[i]
+                    final, ys = _scan_rnn(self.mode, out, init, weights,
+                                          self.time_major, reverse=(d == 1))
+                    outs_dir.append(ys)
+                    if is_lstm:
+                        hs.append(final[0]); cs.append(final[1])
+                    else:
+                        hs.append(final)
+                out = outs_dir[0] if self.bidirect == 1 else \
+                    jnp.concatenate(outs_dir, axis=-1)
+            h_n = jnp.stack(hs)
+            if is_lstm:
+                return out, h_n, jnp.stack(cs)
+            return out, h_n
+
+        if is_lstm:
+            args = (inputs, initial_states[0], initial_states[1]) + tuple(flat_ws)
+            out, h, c = _run_op("lstm", f, args, {})
+            return out, (h, c)
+        args = (inputs, initial_states) + tuple(flat_ws)
+        out, h = _run_op(self.mode.lower(), f, args, {})
+        return out, h
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class RNN(Layer):
+    """Wraps a cell into a recurrent layer (paddle.nn.RNN parity)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # host loop over the cell (eager); acceptable for small T
+        T_axis = 0 if self.time_major else 1
+        T = inputs.shape[T_axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = []
+        states = initial_states
+        from ...tensor import stack
+        for t in steps:
+            xt = inputs[:, t] if T_axis == 1 else inputs[t]
+            y, states = self.cell(xt, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=T_axis), states

@@ -1,0 +1,5 @@
+"""Optimizers and LR schedulers (ref: python/paddle/optimizer/)."""
+from . import lr
+from .optimizer import Optimizer
+from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                         Momentum, RMSProp)

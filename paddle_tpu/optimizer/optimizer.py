@@ -1,0 +1,155 @@
+"""Optimizer base (ref: python/paddle/optimizer/optimizer.py).
+
+Each optimizer defines a pure functional update ``_update(param, grad, state,
+lr) -> (new_param, new_state)``; the eager ``step()`` maps it over parameters
+(jit-compiled per shape/dtype so the hot loop is all XLA), and the same
+functional core drives compiled training steps (jit/train_step.py) — one
+implementation for both paths, unlike the reference's separate dygraph/static
+optimizer ops.
+
+multi_precision mirrors the reference: bf16/fp16 params keep an fp32 master
+copy in the optimizer state; updates apply to the master and cast down.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..nn.clip import ClipGradBase
+from ..nn.layer.layers import Parameter
+from ..tensor.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode)")
+        self._param_groups = []
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            for g in params:
+                group = dict(g)
+                group["params"] = list(group["params"])
+                self._param_groups.append(group)
+        else:
+            self._param_groups.append({"params": params})
+        self._parameter_list = [p for g in self._param_groups for p in g["params"]]
+        self._learning_rate = learning_rate
+        self._weight_decay = self._wd_value(weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._master_weights: Dict[str, jnp.ndarray] = {}
+        self._step_count = 0
+
+    @staticmethod
+    def _wd_value(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (int, float)):
+            return float(weight_decay)
+        # L2Decay-style objects expose .coeff in the reference
+        return float(getattr(weight_decay, "_coeff", getattr(weight_decay, "coeff", 0.0)))
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def _create_accumulators(self, param: Parameter) -> Dict[str, jnp.ndarray]:
+        raise NotImplementedError
+
+    def _update(self, p, g, state, lr, wd, group):
+        """Pure update rule on arrays. Returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    # -- main entry points -------------------------------------------------
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        clipped = {id(p): g for p, g in params_grads}
+        self._step_count += 1
+        for group in self._param_groups:
+            lr_scale = group.get("learning_rate", 1.0)
+            wd = self._wd_value(group.get("weight_decay", None)) \
+                if "weight_decay" in group else self._weight_decay
+            lr = self.get_lr() * lr_scale
+            for p in group["params"]:
+                g = clipped.get(id(p))
+                if g is None:
+                    continue
+                self._apply_one(p, g._data if isinstance(g, Tensor) else g,
+                                lr, wd, group)
+
+    def _apply_one(self, p: Parameter, g, lr, wd, group):
+        key = p.name
+        state = self._accumulators.get(key)
+        if state is None:
+            state = self._create_accumulators(p)
+            self._accumulators[key] = state
+        compute_p = p._data
+        master = None
+        if self._multi_precision and p._data.dtype in (jnp.bfloat16, jnp.float16):
+            master = self._master_weights.get(key)
+            if master is None:
+                master = p._data.astype(jnp.float32)
+            compute_p = master
+        g = g.astype(compute_p.dtype)
+        # per-parameter learning rate from ParamAttr
+        lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+        new_p, new_state = self._update(compute_p, g, state, lr, wd, group)
+        if master is not None:
+            self._master_weights[key] = new_p
+            p._data = new_p.astype(p._data.dtype)
+        else:
+            p._data = new_p
+        self._accumulators[key] = new_state
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        sd = {"step_count": self._step_count, "accumulators": {}, "master_weights": {}}
+        for k, st in self._accumulators.items():
+            sd["accumulators"][k] = {n: Tensor._from_data(v) if hasattr(v, "shape") else v
+                                     for n, v in st.items()}
+        for k, v in self._master_weights.items():
+            sd["master_weights"][k] = Tensor._from_data(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step_count", 0)
+        for k, st in state.get("accumulators", {}).items():
+            self._accumulators[k] = {
+                n: (v._data if isinstance(v, Tensor) else v) for n, v in st.items()}
+        for k, v in state.get("master_weights", {}).items():
+            self._master_weights[k] = v._data if isinstance(v, Tensor) else v
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+    load_state_dict = set_state_dict

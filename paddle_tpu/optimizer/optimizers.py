@@ -1,0 +1,263 @@
+"""Concrete optimizers (ref: python/paddle/optimizer/{sgd,momentum,adam,adamw,...}.py).
+
+Update rules are pure jnp functions jit-cached per parameter shape; states are
+fp32 regardless of param dtype (bf16-safe, like the reference's
+multi-precision kernels).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _create_accumulators(self, p):
+        return {}
+
+    def _update(self, p, g, state, lr, wd, group):
+        if wd:
+            g = g + wd * p
+        return (p - lr * g).astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_accumulators(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * state["velocity"] + _f32(g)
+        if self._nesterov:
+            upd = _f32(g) + self._momentum * v
+        else:
+            upd = v
+        return (p - lr * upd.astype(p.dtype)).astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None,
+                 multi_precision=False, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+        self._decoupled_wd = False  # Adam applies wd as L2 into grad
+
+    def _create_accumulators(self, p):
+        st = {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+              "moment2": jnp.zeros(p._data.shape, jnp.float32),
+              "beta1_pow": jnp.ones((), jnp.float32),
+              "beta2_pow": jnp.ones((), jnp.float32)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p._data.shape, jnp.float32)
+        return st
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        p32 = _f32(p)
+        if wd and not self._decoupled_wd:
+            g32 = g32 + wd * p32
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        m_hat = m / (1 - b1p)
+        if self._amsgrad:
+            v_max = jnp.maximum(state["moment2_max"], v)
+            v_hat = v_max / (1 - b2p)
+            new_state = {"moment1": m, "moment2": v, "moment2_max": v_max,
+                         "beta1_pow": b1p, "beta2_pow": b2p}
+        else:
+            v_hat = v / (1 - b2p)
+            new_state = {"moment1": m, "moment2": v,
+                         "beta1_pow": b1p, "beta2_pow": b2p}
+        upd = m_hat / (jnp.sqrt(v_hat) + self._eps)
+        if wd and self._decoupled_wd:
+            upd = upd + wd * p32
+        return (p32 - lr * upd).astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, name,
+                         multi_precision, amsgrad)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_one(self, p, g, lr, wd, group):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            wd = 0.0
+        super()._apply_one(p, g, lr, wd, group)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.full(p._data.shape, self._init_acc, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        acc = state["moment"] + g32 * g32
+        new_p = _f32(p) - lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return new_p.astype(p.dtype), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, p):
+        return {"mean_square": jnp.zeros(p._data.shape, jnp.float32),
+                "mean_grad": jnp.zeros(p._data.shape, jnp.float32),
+                "momentum": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g32 * g32
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        return (_f32(p) - mom).astype(p.dtype), \
+            {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _create_accumulators(self, p):
+        return {"moment": jnp.zeros(p._data.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._data.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        b1p = state["beta1_pow"] * self._beta1
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        new_p = _f32(p) - (lr / (1 - b1p)) * m / (u + self._eps)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (ref: python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_accumulators(self, p):
+        return {"moment1": jnp.zeros(p._data.shape, jnp.float32),
+                "moment2": jnp.zeros(p._data.shape, jnp.float32),
+                "beta1_pow": jnp.ones((), jnp.float32),
+                "beta2_pow": jnp.ones((), jnp.float32)}
+
+    def _apply_one(self, p, g, lr, wd, group):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        super()._apply_one(p, g, lr, wd, group)
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32, p32 = _f32(g), _f32(p)
+        b1, b2 = self._beta1, self._beta2
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        m = b1 * state["moment1"] + (1 - b1) * g32
+        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v,
+                                       "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps, self._rho = epsilon, rho
+
+    def _create_accumulators(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._data.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = g32 * jnp.sqrt(state["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return (_f32(p) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}

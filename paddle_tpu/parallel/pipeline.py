@@ -1,0 +1,115 @@
+"""SPMD pipeline parallelism over the 'pp' mesh axis.
+
+Ref: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py +
+pp_utils/p2p_communication.py. The reference runs 1F1B as a host-driven
+schedule of send/recv between per-stage processes. On TPU there is no
+send/recv — the TPU-native design is COLLECTIVE pipelining inside one SPMD
+program: stage parameters are stacked on a leading axis sharded over 'pp',
+activations rotate between neighbor stages with ``lax.ppermute`` over ICI, and
+the microbatch schedule is a ``lax.scan`` over ticks with bubble masking.
+
+Because the whole schedule is one differentiable jax program, backward is
+jax.grad through the scan: XLA generates the reverse rotation automatically
+(the cooldown phase of 1F1B), and per-tick rematerialisation
+(``jax.checkpoint`` on the stage body) keeps activation memory at
+O(stages + microbatches·checkpoint), the same asymptotics as 1F1B.
+Utilization is M/(M+S-1), identical to the reference's schedules.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage param pytrees on a new leading 'pp' axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
+                   axis_name: str = "pp", remat: bool = True):
+    """Build f(stacked_params_local, x_microbatches) -> outputs, to be called
+    INSIDE shard_map over ``axis_name``.
+
+    stage_fn(stage_params, h) -> h  : one pipeline stage, hidden -> hidden.
+    x_microbatches: [M, ...] hidden inputs (replicated across stages).
+    Returns [M, ...] outputs, valid on the LAST stage (garbage elsewhere);
+    callers mask/psum-select (see last_stage_value).
+    """
+    S, M = num_stages, num_microbatches
+    T = M + S - 1
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def run(params_local, x_mb):
+        # shard_map gives params_local a leading axis of size 1 (this stage)
+        params_here = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage = lax.axis_index(axis_name)
+        h0 = jnp.zeros_like(x_mb[0])
+        out0 = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            h, outputs = carry
+            mb = t - stage
+            active = (mb >= 0) & (mb < M)
+            fresh = x_mb[jnp.clip(t, 0, M - 1)]
+            x_in = jnp.where(stage == 0, fresh, h)
+            out = body(params_here, x_in)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            idx = jnp.clip(mb, 0, M - 1)
+            write = active & (stage == S - 1)
+            outputs = outputs.at[idx].set(
+                jnp.where(write, out, outputs[idx]))
+            perm = [(i, i + 1) for i in range(S - 1)]
+            h_next = lax.ppermute(out, axis_name, perm) if S > 1 else out
+            return (h_next, outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (h0, out0), jnp.arange(T))
+        return outputs
+
+    return run
+
+
+def last_stage_value(value, num_stages: int, axis_name: str = "pp"):
+    """Broadcast a value computed on the last stage to all stages (call inside
+    shard_map): zero elsewhere + psum."""
+    if num_stages == 1:
+        return value
+    stage = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(stage == num_stages - 1, value, jnp.zeros_like(value)),
+                    axis_name)
+
+
+def build_pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, num_stages,
+                           num_microbatches, axis_name="pp", remat=True):
+    """Compose a full pipelined loss suitable for jax.value_and_grad.
+
+    embed_fn(embed_params, batch) -> [M, ...] microbatched hidden states
+    stage_fn(stage_params, h) -> h
+    head_loss_fn(head_params, h_microbatches, batch) -> scalar loss
+    Called INSIDE shard_map over 'pp'; embed/head params live on first/last
+    stage logically but are computed replicated (cheap vs the stage stack).
+    """
+    pipe = pipeline_apply(stage_fn, num_stages, num_microbatches, axis_name,
+                          remat)
+
+    def loss_fn(params, batch):
+        embed_params, stacked_stage_params, head_params = params
+        h_mb = embed_fn(embed_params, batch)
+        out_mb = pipe(stacked_stage_params, h_mb)
+        loss = head_loss_fn(head_params, out_mb, batch)
+        return last_stage_value(loss, num_stages, axis_name)
+
+    return loss_fn
+
+
+def microbatch(x, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...]"""
+    b = x.shape[0]
+    assert b % num_microbatches == 0, (b, num_microbatches)
+    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])

@@ -1,0 +1,118 @@
+"""Context parallelism over the 'sep' mesh axis: ring attention and Ulysses.
+
+Ref: SURVEY.md §5.7 — the reference provides sep-axis process groups
+(fleet/base/topology.py) and varlen flash-attn; ring/Ulysses live downstream
+(PaddleNLP RingFlashAttention). Here both are first-class, TPU-native:
+
+- ring_attention: Q stays local to its sequence shard; K/V blocks rotate
+  around the 'sep' ring via lax.ppermute (ICI neighbor exchange), with online
+  softmax (flash-style running max/sum) so the full [S, S] score matrix never
+  materializes. Communication overlaps compute across ring steps.
+- ulysses_attention: all-to-all over 'sep' redistributes heads<->sequence so
+  each device runs full-sequence attention on a head slice, then a reverse
+  all-to-all. Cheaper at moderate S, ring wins at very long S.
+
+Both are called INSIDE shard_map with q/k/v already sequence-sharded:
+q, k, v: [B, S_local, H, D].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, scale, causal_mask):
+    """Scores for one (Q_local, K_block) pair in fp32.
+    q: [B, Sq, H, D], k/v: [B, Sk, H, D]. Returns (scores [B,H,Sq,Sk], v)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -1e30)
+    return s
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   scale=None):
+    """Flash-style ring attention. Block layout: device i holds sequence chunk
+    i of Q, K, V. Returns attention output [B, S_local, H, D]."""
+    B, Sq, H, D = q.shape
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    # GQA: repeat kv heads to match q heads
+    if k.shape[2] != H:
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    o = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)  # running max
+    l = jnp.zeros((B, H, Sq), jnp.float32)           # running denom
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    pos_q = my * Sq + jnp.arange(Sq)
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # which chunk is this k block from? it started at (my - i) mod n
+        src = (my - i) % n
+        if causal:
+            pos_k = src * Sq + jnp.arange(k_blk.shape[1])
+            mask = pos_q[:, None] >= pos_k[None, :]
+            mask = mask[None, None]  # [1,1,Sq,Sk]
+        else:
+            mask = None
+        s = _block_attn(q, k_blk, v_blk, scale, mask)
+        blk_max = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # renormalize running stats
+        alpha = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m[..., None])
+        new_l = l * alpha + p.sum(-1)
+        new_o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (new_o, new_m, new_l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = lax.scan(step, (o, m, l, k, v), jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                      scale=None, attn_fn=None):
+    """DeepSpeed-Ulysses style: all_to_all heads<->sequence over 'sep'.
+    Requires num_heads % sep_degree == 0."""
+    n = lax.axis_size(axis_name)
+    B, S_local, H, D = q.shape
+    assert H % n == 0, f"heads {H} not divisible by sep degree {n}"
+
+    def scatter_heads(x):
+        # [B, S/n, H, D] -> all_to_all -> [B, S, H/n, D]
+        xs = x.reshape(B, S_local, n, H // n, D)
+        xs = jnp.moveaxis(xs, 2, 0)                      # [n, B, S/n, H/n, D]
+        xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+        # now leading axis enumerates seq chunks of the full sequence
+        return jnp.moveaxis(xs, 0, 1).reshape(B, n * S_local, H // n, D)
+
+    def gather_heads(x):
+        xs = x.reshape(B, n, S_local, H // n, D)
+        xs = jnp.moveaxis(xs, 1, 0)
+        xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+        xs = jnp.moveaxis(xs, 0, 2)                      # [B, S/n, n, H/n, D]
+        return xs.reshape(B, S_local, H, D)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if attn_fn is None:
+        from ..nn.functional.attention import _xla_sdpa
+        out = _xla_sdpa(qg, kg, vg, is_causal=causal, scale=scale)
+    else:
+        out = attn_fn(qg, kg, vg)
+    return gather_heads(out)
