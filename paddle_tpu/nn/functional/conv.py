@@ -113,7 +113,7 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
     chan_last = data_format in ("NHWC", "NLC", "NDHWC")
     lhs = ("N" + spatial + "C") if chan_last else ("NC" + spatial)
     dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
-                                        (lhs, "IO" + spatial, lhs))
+                                        (lhs, "OI" + spatial, lhs))
     if isinstance(padding, str):
         pad = padding.upper()
     else:
@@ -126,12 +126,20 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
 
     def f(a, w, *b):
         a, w = amp_state.maybe_autocast_pair(a, w)
-        # weight layout in reference: [in_c, out_c/groups, *k] for transpose
+        # weight layout in reference: [in_c, out_c/groups, *k] for transpose.
+        # Build the equivalent forward kernel: swap I/O (per group) and flip
+        # the spatial dims (what the removed transpose_kernel flag did).
+        in_c = w.shape[0]
+        out_per_g = w.shape[1]
+        k_dims = w.shape[2:]
+        wg = w.reshape((groups, in_c // groups, out_per_g) + k_dims)
+        wg = jnp.swapaxes(wg, 1, 2)  # [g, out/g, in/g, *k]
+        w_t = wg.reshape((groups * out_per_g, in_c // groups) + k_dims)
+        w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + n)))
         out = jax.lax.conv_general_dilated(
-            a, w, window_strides=(1,) * n, padding=pad,
+            a, w_t, window_strides=(1,) * n, padding=pad,
             lhs_dilation=stride, rhs_dilation=dilation,
-            dimension_numbers=dn, feature_group_count=groups,
-            transpose_kernel=True)
+            dimension_numbers=dn, feature_group_count=groups)
         if b:
             shape = [1] * out.ndim
             shape[lhs.index("C")] = b[0].shape[0]

@@ -1,0 +1,160 @@
+"""Optimizer update-rule numerics vs closed-form references + schedulers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _one_param_model(value):
+    m = nn.Linear(1, 1, bias_attr=False)
+    m.weight.set_value(np.array([[value]], np.float32))
+    return m
+
+
+def _step(m, o, grad_val):
+    m.weight.grad = paddle.to_tensor(np.array([[grad_val]], np.float32))
+    o.step()
+    o.clear_grad()
+    return float(m.weight.numpy()[0, 0])
+
+
+def test_sgd():
+    m = _one_param_model(1.0)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    assert abs(_step(m, o, 0.5) - (1.0 - 0.1 * 0.5)) < 1e-6
+
+
+def test_momentum_nesterov():
+    m = _one_param_model(1.0)
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=m.parameters())
+    w1 = _step(m, o, 1.0)          # v=1, w=1-0.1
+    assert abs(w1 - 0.9) < 1e-6
+    w2 = _step(m, o, 1.0)          # v=1.9, w=0.9-0.19
+    assert abs(w2 - 0.71) < 1e-6
+
+
+def test_adam_bias_correction():
+    m = _one_param_model(1.0)
+    o = opt.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                 parameters=m.parameters())
+    w1 = _step(m, o, 2.0)
+    # first step of adam moves by ~lr regardless of grad scale
+    assert abs(w1 - (1.0 - 0.1)) < 1e-4
+
+
+def test_adamw_decoupled_decay():
+    m = _one_param_model(1.0)
+    o = opt.AdamW(learning_rate=0.1, weight_decay=0.1,
+                  parameters=m.parameters())
+    w1 = _step(m, o, 0.0)
+    # zero grad: only the decoupled decay applies (moments stay 0)
+    assert abs(w1 - (1.0 - 0.1 * 0.1 * 1.0)) < 1e-5
+
+
+def test_multi_precision_master_weights():
+    m = nn.Linear(2, 2, bias_attr=False)
+    m.bfloat16()
+    o = opt.AdamW(learning_rate=1e-4, parameters=m.parameters(),
+                  multi_precision=True)
+    x = paddle.randn([4, 2]).astype("bfloat16")
+    for _ in range(3):
+        m(x).sum().backward()
+        o.step()
+        o.clear_grad()
+    assert m.weight.dtype == paddle.bfloat16
+    assert len(o._master_weights) == 1  # fp32 master kept
+
+
+def test_param_groups():
+    a, b = nn.Linear(2, 2), nn.Linear(2, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=[
+        {"params": a.parameters()},
+        {"params": b.parameters(), "learning_rate": 0.1},  # scale => lr*0.1
+    ])
+    wa0, wb0 = a.weight.numpy().copy(), b.weight.numpy().copy()
+    g = np.ones((2, 2), np.float32)
+    a.weight.grad = paddle.to_tensor(g)
+    b.weight.grad = paddle.to_tensor(g)
+    o.step()
+    np.testing.assert_allclose(wa0 - a.weight.numpy(), 0.1 * g, atol=1e-6)
+    np.testing.assert_allclose(wb0 - b.weight.numpy(), 0.01 * g, atol=1e-6)
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    assert lrs == [1.0, 1.0, 0.5, 0.5, 0.25]
+
+    warm = opt.lr.LinearWarmup(learning_rate=1.0, warmup_steps=4,
+                               start_lr=0.0, end_lr=1.0)
+    vals = []
+    for _ in range(5):
+        vals.append(warm())
+        warm.step()
+    np.testing.assert_allclose(vals, [0.0, 0.25, 0.5, 0.75, 1.0])
+
+    cos = opt.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    assert abs(cos() - 1.0) < 1e-6
+
+    noam = opt.lr.NoamDecay(d_model=64, warmup_steps=10, learning_rate=1.0)
+    v = [noam() or 0]
+    for _ in range(20):
+        noam.step()
+        v.append(noam())
+    assert np.argmax(v) in (9, 10, 11)
+
+
+def test_scheduler_with_optimizer_and_state():
+    m = nn.Linear(2, 2)
+    sched = opt.lr.ExponentialDecay(learning_rate=0.1, gamma=0.9)
+    o = opt.Adam(learning_rate=sched, parameters=m.parameters())
+    assert abs(o.get_lr() - 0.1) < 1e-9
+    sched.step()
+    assert abs(o.get_lr() - 0.09) < 1e-9
+    sd = o.state_dict()
+    assert "LR_Scheduler" in sd
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    m = nn.Linear(2, 2)
+    o = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    m(paddle.randn([2, 2])).sum().backward()
+    o.step()
+    path = str(tmp_path / "opt.pdopt")
+    paddle.save(o.state_dict(), path)
+    o2 = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    o2.set_state_dict(paddle.load(path))
+    k = list(o._accumulators)[0]
+    np.testing.assert_allclose(
+        np.asarray(o._accumulators[k]["moment1"]),
+        np.asarray(o2._accumulators[k]["moment1"]))
+
+
+def test_grad_clip_in_optimizer():
+    m = _one_param_model(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=m.parameters(),
+                grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    w = _step(m, o, 10.0)
+    assert abs(w - (1.0 - 0.5)) < 1e-5
+
+
+def test_amp_gradscaler_flow():
+    from paddle_tpu.amp import GradScaler, auto_cast
+    m = nn.Linear(4, 4)
+    o = opt.SGD(learning_rate=0.01, parameters=m.parameters())
+    scaler = GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([2, 4])
+    with auto_cast(True, dtype="bfloat16"):
+        out = m(x)
+        assert out.dtype == paddle.bfloat16
+        loss = out.astype("float32").sum()
+    scaler.scale(loss).backward()
+    scaler.step(o)
+    scaler.update()
+    assert scaler.state_dict()["scale"] == 1024.0
