@@ -1,0 +1,107 @@
+"""Device API (ref: python/paddle/device/).
+
+Streams/events do not exist at the jax level on TPU — XLA orders execution by
+data dependence. The stream API is kept for source compatibility as ordered
+no-ops, with synchronize() mapping to blocking on all pending device work.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (CPUPlace, CustomPlace, TPUPlace, get_device,
+                               set_device)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu"):
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def get_available_device():
+    return [f"{'tpu' if d.platform != 'cpu' else 'cpu'}:{d.id}"
+            for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device() if not d.startswith("cpu")]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work completes."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        return None
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class cuda:  # namespace shim: paddle.device.cuda
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        return None

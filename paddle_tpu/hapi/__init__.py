@@ -1,0 +1,3 @@
+"""High-level API (ref: python/paddle/hapi/)."""
+from . import callbacks
+from .model import Model

@@ -1,0 +1,196 @@
+"""High-level Model API (ref: python/paddle/hapi/model.py)."""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..autograd import no_grad
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..tensor.tensor import Tensor
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+
+    # -- single-batch ops --------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [float(loss.item())] + metrics
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        labels = self._to_list(labels)
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels) if self._loss else None
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.item())] if loss is not None else []) + metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = self._to_list(inputs)
+        out = self.network(*inputs)
+        return out
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self._metrics:
+            res = m.compute(outputs, *labels)
+            v = m.update(res)
+            vals.append(v)
+        return vals
+
+    @staticmethod
+    def _to_list(x):
+        if x is None:
+            return []
+        if isinstance(x, (list, tuple)):
+            return list(x)
+        return [x]
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._make_loader(train_data, batch_size, shuffle, drop_last,
+                                   num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False, False,
+                                        num_workers) if eval_data is not None else None
+        cbks = CallbackList(callbacks or ([ProgBarLogger(log_freq, verbose)]
+                                          if verbose else []))
+        cbks.set_model(self)
+        cbks.on_train_begin()
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch, {"steps": steps})
+            for m in self._metrics:
+                m.reset()
+            it = 0
+            for batch in loader:
+                cbks.on_train_batch_begin(it)
+                x, y = self._split_batch(batch)
+                outs = self.train_batch(x, y)
+                logs = {"loss": outs[0]}
+                for m, v in zip(self._metrics, outs[1:]):
+                    logs[m.name()] = v
+                cbks.on_train_batch_end(it, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            epoch_logs = dict(logs) if it else {}
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_res = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=0)
+                epoch_logs.update({f"eval_{k}": v for k, v in eval_res.items()})
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+        cbks.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for i, batch in enumerate(loader):
+            x, y = self._split_batch(batch)
+            outs = self.eval_batch(x, y)
+            if self._loss:
+                losses.append(outs[0])
+            if num_iters is not None and i + 1 >= num_iters:
+                break
+        res = {}
+        if losses:
+            res["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            res[m.name()] = m.accumulate()
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=True,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, False,
+                                   num_workers)
+        outs = []
+        for batch in loader:
+            x, _ = self._split_batch(batch, has_label=False)
+            outs.append(self.predict_batch(x))
+        return outs
+
+    def _make_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _split_batch(batch, has_label=True):
+        if isinstance(batch, (list, tuple)):
+            if has_label and len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return list(batch), []
+        return [batch], []
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if os.path.exists(opt_path) and self._optimizer is not None \
+                and not reset_optimizer:
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters()
+                        if not p.stop_gradient)
+        s = (f"Total params: {total:,}\nTrainable params: {trainable:,}\n"
+             f"Non-trainable params: {total - trainable:,}")
+        print(s)
+        return {"total_params": total, "trainable_params": trainable}

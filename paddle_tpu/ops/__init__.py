@@ -1,0 +1,5 @@
+"""Pallas custom kernels replacing the reference's fused CUDA kernels
+(ref: paddle/fluid/operators/fused/, paddle/phi/kernels/fusion/)."""
+from .flash_attention import flash_attention_bshd
+from .rms_norm import fused_rms_norm
+from .rope import apply_rope, build_rope_cache, fused_rotary_position_embedding

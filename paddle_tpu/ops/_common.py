@@ -1,0 +1,24 @@
+"""Shared kernel plumbing."""
+from __future__ import annotations
+
+import jax
+
+_FORCE_INTERPRET = None
+
+
+def set_interpret(value: bool | None):
+    """Override interpret-mode detection (None = auto)."""
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = value
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels must run interpreted off-TPU. The axon TPU plugin stays
+    the default backend even when work is pinned to host CPU devices (tests,
+    dryruns), so honor jax_default_device first."""
+    if _FORCE_INTERPRET is not None:
+        return _FORCE_INTERPRET
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        return dd.platform == "cpu"
+    return jax.default_backend() != "tpu"

@@ -1,0 +1,179 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Ref: paddle/phi/kernels/gpu/flash_attn_kernel.cu (the reference dlopens its
+FlashAttention-2 fork). TPU-native rewrite, not a translation:
+
+- forward: Pallas kernel, online-softmax over KV tiles held in VMEM, fp32
+  accumulators, MXU matmuls via jnp.dot(preferred_element_type=f32). The
+  [S, S] score matrix never exists in HBM. Also emits the per-row logsumexp.
+- backward: blockwise lax.scan in jnp using the saved logsumexp (the standard
+  FA2 recomputation identities: dV = PᵀdO, dS = P∘(dP − rowsum(dO∘O)),
+  dQ/dK from dS) — O(S·Bk) working set, fused by XLA. A hand-written Pallas
+  backward is a further optimization, not a correctness need.
+
+Layout [B, S, H, D] (the reference's), GQA via KV-head repeat.
+interpret=True under CPU so the same code runs in tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
+                scale, seq_k):
+    import numpy as np
+    bk_i = np.int32(block_k)  # keep ALL index math i32 (x64 mode is on)
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    bq, d = q.shape
+    bq_i = np.int32(bq)
+    m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    nblocks = np.int32(pl.cdiv(seq_k, block_k))
+    if causal:
+        # only blocks whose start <= last query position of this tile
+        last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
+        nblocks = jnp.minimum(nblocks, last_q // bk_i + np.int32(1))
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * bk_i, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk_i, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
+        if causal:
+            rows = qi * bq_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            s = jnp.where(rows >= cols, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(np.int32(0), nblocks, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    """q, k, v: [BH, S, D] (same head count). Returns (o, lse)."""
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    grid = (bh, pl.cdiv(s, block_q))
+    kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                               scale=scale, seq_k=sk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse.reshape(bh, s)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_k):
+    """Blockwise FA2 backward in jnp. All [BH, S, D]."""
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    block_k = min(block_k, sk)
+    nblocks = sk // block_k
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [BH, S]
+
+    kb = k.reshape(bh, nblocks, block_k, d).swapaxes(0, 1)
+    vb = v.reshape(bh, nblocks, block_k, d).swapaxes(0, 1)
+    pos_q = jnp.arange(s)
+
+    def block_grads(carry, inp):
+        dq_acc = carry
+        j, k_j, v_j = inp
+        s_j = jnp.einsum("bqd,bkd->bqk", q32, k_j.astype(jnp.float32)) * scale
+        if causal:
+            cols = j * block_k + jnp.arange(block_k)
+            mask = pos_q[:, None] >= cols[None, :]
+            s_j = jnp.where(mask[None], s_j, -1e30)
+        p_j = jnp.exp(s_j - lse[:, :, None])                    # [BH, S, BK]
+        dv_j = jnp.einsum("bqk,bqd->bkd", p_j, do32)
+        dp_j = jnp.einsum("bqd,bkd->bqk", do32, v_j.astype(jnp.float32))
+        ds_j = p_j * (dp_j - delta[:, :, None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds_j,
+                                     k_j.astype(jnp.float32))
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds_j, q32)
+        return dq_acc, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((bh, s, d), jnp.float32)
+    dq, (dk_b, dv_b) = lax.scan(block_grads, dq0,
+                                (jnp.arange(nblocks), kb, vb))
+    dk = dk_b.swapaxes(0, 1).reshape(bh, sk, d)
+    dv = dv_b.swapaxes(0, 1).reshape(bh, sk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attention_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, causal, scale, block_k)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention_bshd(q, k, v, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Public entry. q: [B, S, H, D]; k/v: [B, S, Hkv, D] (GQA repeats kv)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+
+    def to_bh(x, seq):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, seq, d)
+
+    o = _flash_attention(to_bh(q, s), to_bh(k, sk), to_bh(v, sk),
+                         causal, float(scale), block_q, block_k)
+    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,76 @@
+"""Fused RMSNorm Pallas kernel (ref: paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu).
+
+One VMEM pass per row tile: mean-of-squares, rsqrt, scale — fp32 accumulation,
+compute-dtype output. Backward via custom_vjp with the closed-form gradient
+(one fused jnp expression; XLA fuses it into surrounding ops).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * inv * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _rms_fwd_impl(x2d, w, eps, block_rows):
+    n, h = x2d.shape
+    grid = (pl.cdiv(n, block_rows),)
+    return pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+        interpret=_interpret(),
+    )(x2d, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm(x2d, w, eps):
+    return _rms_fwd_impl(x2d, w, eps, block_rows=min(256, x2d.shape[0]))
+
+
+def _rms_fwd(x2d, w, eps):
+    out = _rms_norm(x2d, w, eps)
+    return out, (x2d, w)
+
+
+def _rms_bwd(eps, res, g):
+    x2d, w = res
+    x = x2d.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    h = x.shape[-1]
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    xhat = x * inv
+    gw = g32 * w32
+    # d/dx [x * inv]: inv * (gw - xhat * mean(gw * xhat))
+    dx = inv * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum(g32 * xhat, axis=0)
+    return dx.astype(x2d.dtype), dw.astype(w.dtype)
+
+
+_rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+def fused_rms_norm(x, weight, epsilon=1e-6):
+    """x: [..., H] array; weight: [H]. Returns same shape/dtype as x."""
+    shape = x.shape
+    out = _rms_norm(x.reshape(-1, shape[-1]), weight, float(epsilon))
+    return out.reshape(shape)

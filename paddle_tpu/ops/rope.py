@@ -1,0 +1,70 @@
+"""Fused rotary position embedding (ref: paddle/phi/kernels/fusion/gpu/
+fused_rope_kernel.cu; python API paddle.incubate.nn.functional.
+fused_rotary_position_embedding).
+
+The rotation is pure VPU work that XLA fuses into the surrounding attention
+projections, so the "kernel" here is the fused jnp expression (a Pallas
+version adds nothing: no reuse, no reduction). Neox-style half-rotation and
+GPT-J-style interleaved pairs both supported, [B, S, H, D] layout.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def build_rope_cache(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                     position_ids=None):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                               / head_dim))
+    pos = (jnp.arange(seq_len, dtype=jnp.float32) if position_ids is None
+           else position_ids.astype(jnp.float32))
+    freqs = jnp.einsum("...s,d->...sd", pos, inv_freq)    # [S, D/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, cos, sin, interleaved=False):
+    """x: [B, S, H, D]; cos/sin: [S, D/2] (or broadcastable)."""
+    d = x.shape[-1]
+    x32 = x.astype(jnp.float32)
+    if cos.ndim == 2:
+        cos_b = cos[None, :, None, :]
+        sin_b = sin[None, :, None, :]
+    else:
+        cos_b = cos[:, :, None, :]
+        sin_b = sin[:, :, None, :]
+    if interleaved:
+        x1 = x32[..., 0::2]
+        x2 = x32[..., 1::2]
+        r1 = x1 * cos_b - x2 * sin_b
+        r2 = x2 * cos_b + x1 * sin_b
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    else:  # neox half-rotation
+        x1 = x32[..., : d // 2]
+        x2 = x32[..., d // 2:]
+        r1 = x1 * cos_b - x2 * sin_b
+        r2 = x2 * cos_b + x1 * sin_b
+        out = jnp.concatenate([r1, r2], axis=-1)
+    return out.astype(x.dtype)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """paddle.incubate parity signature, on raw arrays [B, S, H, D]."""
+    if cos is None or sin is None:
+        cos_h, sin_h = build_rope_cache(q.shape[1], q.shape[-1],
+                                        position_ids=position_ids)
+    else:
+        # reference passes [1, S, 1, D] duplicated tables; reduce to [S, D/2]
+        cos_h = jnp.squeeze(cos)
+        sin_h = jnp.squeeze(sin)
+        if cos_h.shape[-1] == q.shape[-1]:
+            cos_h = cos_h[..., : q.shape[-1] // 2]
+            sin_h = sin_h[..., : q.shape[-1] // 2]
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+        else:
+            outs.append(apply_rope(t, cos_h, sin_h,
+                                   interleaved=not use_neox_rotary_style))
+    return tuple(outs)

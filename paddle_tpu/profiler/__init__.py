@@ -1,0 +1,193 @@
+"""Profiler (ref: python/paddle/profiler/ + paddle/fluid/platform/profiler/).
+
+Wraps jax.profiler (XLA's xplane tracing → TensorBoard/Perfetto) under the
+reference's API shape: Profiler with scheduler states, RecordEvent spans,
+export_chrome_tracing. Host-side RecordEvent spans are also collected into a
+chrome-trace JSON by the native runtime (csrc/trace) so host code is visible
+alongside device timelines.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: str = None) -> Callable:
+    os.makedirs(dir_name, exist_ok=True)
+
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+_host_events = []
+_host_lock = threading.Lock()
+
+
+class RecordEvent:
+    """Host span (ref: paddle.profiler.RecordEvent / platform RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._jx = jax.profiler.TraceAnnotation(self.name)
+            self._jx.__enter__()
+        except Exception:
+            self._jx = None
+
+    def end(self):
+        t1 = time.perf_counter_ns()
+        if self._jx is not None:
+            self._jx.__exit__(None, None, None)
+        with _host_lock:
+            _host_events.append((self.name, self._t0, t1,
+                                 threading.get_ident()))
+
+
+class Profiler:
+    def __init__(self, *, targets: Iterable = None, scheduler=None,
+                 on_trace_ready: Callable = None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 with_flops: bool = False):
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._active = False
+        self._export_dir = None
+        self._logdir = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self):
+        self._state = (self._scheduler(self._step) if self._scheduler
+                       else ProfilerState.RECORD)
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and not self._timer_only:
+            self._start_jax()
+
+    def _start_jax(self):
+        if self._active:
+            return
+        self._logdir = self._export_dir or "/tmp/paddle_tpu_profile"
+        os.makedirs(self._logdir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+        except Exception:
+            self._active = False
+
+    def _stop_jax(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+
+    def step(self, num_samples: Optional[int] = None):
+        self._step += 1
+        if self._scheduler is None:
+            return
+        new_state = self._scheduler(self._step)
+        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if not self._active and not self._timer_only:
+                self._start_jax()
+        else:
+            if self._active:
+                self._stop_jax()
+                if self._on_trace_ready:
+                    self._on_trace_ready(self)
+        self._state = new_state
+
+    def stop(self):
+        self._stop_jax()
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def export(self, path: str, format: str = "json"):
+        """Export collected host spans as chrome trace JSON (device timeline
+        lives in the jax trace dir for TensorBoard/Perfetto)."""
+        events = []
+        with _host_lock:
+            for name, t0, t1, tid in _host_events:
+                events.append({"name": name, "ph": "X", "ts": t0 / 1000.0,
+                               "dur": (t1 - t0) / 1000.0, "pid": 0, "tid": tid,
+                               "cat": "host"})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        agg = {}
+        with _host_lock:
+            for name, t0, t1, _ in _host_events:
+                d = agg.setdefault(name, [0, 0.0])
+                d[0] += 1
+                d[1] += (t1 - t0) / 1e6
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"]
+        for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40}{calls:>8}{total:>12.3f}")
+        return "\n".join(lines)
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

@@ -1,0 +1,50 @@
+"""Static-graph shims (ref: python/paddle/static/).
+
+This framework is eager-first over XLA; `Program` exists for source
+compatibility and `save/load_inference_model` persist params + an input spec
+(the compiled artifact is re-traced on load; XLA has no stable cross-version
+serialized executable).
+"""
+from __future__ import annotations
+
+import os
+
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..jit import to_static
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    layer = kwargs.get("layer")
+    if layer is not None:
+        _save(layer.state_dict(), path_prefix + ".pdparams")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return _load(path_prefix + ".pdparams")

@@ -1,0 +1,2 @@
+"""Vision: models/datasets/transforms (ref: python/paddle/vision/)."""
+from . import datasets, models, transforms

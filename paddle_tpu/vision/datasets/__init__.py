@@ -1,0 +1,97 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/).
+
+No network in this environment: MNIST/Cifar load from a local `data_file`
+when given; FakeData generates deterministic synthetic samples for tests and
+benchmarks (the reference tests do the same via numpy fixtures).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randn(*self.image_shape).astype(np.float32)
+        label = np.array(rng.randint(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        if image_path is None or not os.path.exists(image_path):
+            raise FileNotFoundError(
+                "MNIST requires local idx files (no network in this "
+                "environment); pass image_path/label_path, or use "
+                "paddle_tpu.vision.datasets.FakeData for synthetic data")
+        with gzip.open(image_path, "rb") if image_path.endswith(".gz") \
+                else open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") if label_path.endswith(".gz") \
+                else open(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "Cifar10 requires a local data file (no network); use "
+                "FakeData for synthetic data")
+        import tarfile
+        imgs, labels = [], []
+        with tarfile.open(data_file) as tf:
+            names = [n for n in tf.getnames()
+                     if ("data_batch" in n if mode == "train" else "test_batch" in n)]
+            for n in sorted(names):
+                d = pickle.load(tf.extractfile(n), encoding="bytes")
+                imgs.append(d[b"data"])
+                labels.extend(d[b"labels"])
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,140 @@
+"""Vision transforms (ref: python/paddle/vision/transforms/) — numpy-based
+(HWC uint8/float arrays), no PIL dependency."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] -> CHW float32 [0,1] Tensor."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            arr = img.numpy()
+        else:
+            arr = np.asarray(img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        out = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(out) if isinstance(img, Tensor) else out
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        import jax
+        import jax.numpy as jnp
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+        h_axis = 1 if chw else 0
+        tgt = list(arr.shape)
+        tgt[h_axis] = self.size[0]
+        tgt[h_axis + 1] = self.size[1]
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32), tgt, "linear")
+        return np.asarray(out).astype(arr.dtype)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            axis = 2 if (arr.ndim == 3 and arr.shape[0] in (1, 3)) else 1
+            return np.flip(arr, axis=axis).copy()
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h_ax = 1 if chw else 0
+        if self.padding:
+            pads = [(0, 0)] * arr.ndim
+            pads[h_ax] = (self.padding, self.padding)
+            pads[h_ax + 1] = (self.padding, self.padding)
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[h_ax], arr.shape[h_ax + 1]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[h_ax + 1] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h_ax = 1 if chw else 0
+        h, w = arr.shape[h_ax], arr.shape[h_ax + 1]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(i, i + th)
+        sl[h_ax + 1] = slice(j, j + tw)
+        return arr[tuple(sl)]
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
