@@ -1,0 +1,97 @@
+"""Benchmark: Llama causal-LM training throughput on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric is model FLOPs utilization (MFU) for a bf16 Llama training step
+(fwd+bwd+AdamW) at seq 2048 — the BASELINE.json north-star metric shape
+(target >= 0.45 on v5p-128; vs_baseline = mfu / 0.45).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal, so the script still reports off-TPU
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_BF16_FLOPS["cpu"]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import (LlamaConfig, ParallelConfig,
+                                         build_train_step,
+                                         train_flops_per_token)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    seq = 2048 if on_tpu else 128
+    batch = 4 if on_tpu else 2
+    if on_tpu:
+        config = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                             intermediate_size=4096, num_hidden_layers=24,
+                             num_attention_heads=16, num_key_value_heads=16,
+                             max_position_embeddings=seq, dtype=jnp.bfloat16)
+    else:
+        from paddle_tpu.models.llama import llama_tiny
+        config = llama_tiny(seq=seq)
+
+    parallel = ParallelConfig(remat=True, use_flash=on_tpu)
+    step, params, opt = build_train_step(config, parallel, lr=1e-4)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    # warmup (compile) + 2 steps
+    for _ in range(3):
+        params, opt, loss = step(params, opt, ids, labels)
+    jax.block_until_ready(loss)
+
+    n_steps = 10 if on_tpu else 2
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt, loss = step(params, opt, ids, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / n_steps
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step / dt
+    flops_per_token = train_flops_per_token(config, seq)
+    mfu = tok_s * flops_per_token / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_train_mfu",
+        "value": round(float(mfu), 4),
+        "unit": "MFU",
+        "vs_baseline": round(float(mfu) / 0.45, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tok_s, 1),
+            "step_time_s": round(dt, 4),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+            "seq_len": seq, "batch": batch,
+            "loss": round(float(jax.device_get(loss)), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

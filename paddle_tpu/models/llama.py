@@ -1,0 +1,548 @@
+"""Llama model family — the flagship (BASELINE configs 3 & 4).
+
+Ref: the reference trains Llama-2 via paddle.distributed.fleet HybridParallel
+(ColumnParallelLinear/RowParallelLinear TP, PipelineLayer 1F1B, GroupSharded
+ZeRO) + fused CUDA kernels (fused_rope, flash_attn, fused_rms_norm).
+
+TPU-native architecture (not a translation):
+- a PURE functional core: params are a pytree with every decoder layer
+  STACKED on a leading axis, the depth loop is lax.scan (one compiled layer
+  body), attention is the Pallas flash kernel, norms the fused RMSNorm,
+  RoPE the fused rotary op. Remat per layer.
+- parallelism is declarative: ParallelConfig(dp, mp, pp, sharding/fsdp, sep)
+  maps to PartitionSpecs over the fleet mesh. TP/FSDP/DP via GSPMD param and
+  activation specs; sep>1 switches attention to ring attention (KV rotation
+  over ICI inside shard_map); pp>1 wraps the stage scan in the collective
+  pipeline (shard_map over 'pp' + ppermute, see parallel/pipeline.py).
+- the Layer-based eager API (LlamaForCausalLM) wraps the same functional
+  core for dygraph-style use and weight interchange.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.flash_attention import flash_attention_bshd
+from ..ops.rms_norm import fused_rms_norm
+from ..ops.rope import apply_rope, build_rope_cache
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama_7b():
+    return LlamaConfig()
+
+
+def llama_13b():
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                       num_hidden_layers=40, num_attention_heads=40,
+                       num_key_value_heads=40)
+
+
+def llama_tiny(vocab=256, hidden=64, layers=4, heads=4, kv_heads=2, inter=128,
+               seq=128):
+    return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                       intermediate_size=inter, num_hidden_layers=layers,
+                       num_attention_heads=heads, num_key_value_heads=kv_heads,
+                       max_position_embeddings=seq, dtype=jnp.float32)
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1   # ZeRO/FSDP degree over the 'sharding' axis
+    sep: int = 1        # context parallel (ring attention)
+    microbatches: int = 1
+    remat: bool = True
+    zero_stage: int = 3  # what 'sharding' shards: 1=os, 2=os+g, 3=os+g+p
+    use_flash: Optional[bool] = None  # None = auto (TPU yes, CPU no)
+
+    @property
+    def total(self):
+        return self.dp * self.mp * self.pp * self.sharding * self.sep
+
+
+# ---------------------------------------------------------------------------
+# parameter init
+# ---------------------------------------------------------------------------
+
+def init_llama_params(config: LlamaConfig, seed: int = 0) -> Dict[str, Any]:
+    """Params with per-layer leaves stacked on axis 0 (length = num layers)."""
+    c = config
+    k = jax.random.PRNGKey(seed)
+    keys = jax.random.split(k, 10)
+    d = c.dtype
+    h, kv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.head_dim
+    std = 0.02
+
+    def norm_init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(d)
+
+    L = c.num_hidden_layers
+    layers = {
+        "input_norm": jnp.ones((L, c.hidden_size), d),
+        "q_proj": norm_init(keys[1], (L, c.hidden_size, h * hd)),
+        "k_proj": norm_init(keys[2], (L, c.hidden_size, kv * hd)),
+        "v_proj": norm_init(keys[3], (L, c.hidden_size, kv * hd)),
+        "o_proj": norm_init(keys[4], (L, h * hd, c.hidden_size)),
+        "post_norm": jnp.ones((L, c.hidden_size), d),
+        "gate_proj": norm_init(keys[5], (L, c.hidden_size, c.intermediate_size)),
+        "up_proj": norm_init(keys[6], (L, c.hidden_size, c.intermediate_size)),
+        "down_proj": norm_init(keys[7], (L, c.intermediate_size, c.hidden_size)),
+    }
+    params = {
+        "embed": norm_init(keys[0], (c.vocab_size, c.hidden_size)),
+        "layers": layers,
+        "final_norm": jnp.ones((c.hidden_size,), d),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = norm_init(keys[8], (c.hidden_size, c.vocab_size))
+    return params
+
+
+def param_pspecs(config: LlamaConfig, parallel: ParallelConfig) -> Dict[str, Any]:
+    """PartitionSpecs mirroring the reference's fleet sharding:
+    column-parallel out-dim over 'mp', row-parallel in-dim over 'mp',
+    FSDP shards a remaining big dim over 'sharding' (ZeRO-3)."""
+    fs = "sharding" if (parallel.sharding > 1 and parallel.zero_stage >= 3) else None
+    mp = "mp" if parallel.mp > 1 else None
+
+    layers = {
+        "input_norm": P(None, None),
+        "q_proj": P(None, fs, mp),
+        "k_proj": P(None, fs, mp),
+        "v_proj": P(None, fs, mp),
+        "o_proj": P(None, mp, fs),
+        "post_norm": P(None, None),
+        "gate_proj": P(None, fs, mp),
+        "up_proj": P(None, fs, mp),
+        "down_proj": P(None, mp, fs),
+    }
+    specs = {
+        "embed": P(mp, fs),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not config.tie_word_embeddings:
+        specs["lm_head"] = P(fs, mp)
+    return specs
+
+
+def opt_state_pspecs(config, parallel, pspec_tree):
+    """ZeRO stage 1/2: optimizer states shard over 'sharding' even when the
+    params don't. Stage >=3 states follow the (already sharded) param specs."""
+    if parallel.sharding > 1 and parallel.zero_stage < 3:
+        def shard_state(spec):
+            parts = list(spec) if len(spec) else []
+            for i, p_ in enumerate(parts):
+                if p_ is None:
+                    parts[i] = "sharding"
+                    return P(*parts)
+            return spec
+        return jax.tree_util.tree_map(shard_state, pspec_tree,
+                                      is_leaf=lambda x: isinstance(x, P))
+    return pspec_tree
+
+
+# ---------------------------------------------------------------------------
+# functional forward
+# ---------------------------------------------------------------------------
+
+def _act_spec(parallel):
+    # activations [B, S, H]: batch over dp(+sharding for ZeRO grads), seq over sep
+    batch_axes = ("dp",) if parallel.sharding == 1 else ("dp", "sharding")
+    seq_axis = "sep" if parallel.sep > 1 else None
+    return P(batch_axes, seq_axis, None)
+
+
+def _maybe_hint(x, mesh, spec):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
+                  parallel: ParallelConfig, mesh=None, use_flash=True,
+                  in_shard_map=False, tp_axis=None):
+    """One decoder block. h_in: [B, S, H].
+
+    tp_axis: when set (inside a manual shard_map region) weights arrive
+    mp-SLICED and this runs the explicit Megatron pattern — local head slice
+    compute + lax.psum after the row-parallel matmuls (o_proj, down_proj);
+    when None, GSPMD derives the same collectives from param shardings.
+    """
+    c = config
+    b, s, _ = h_in.shape
+    hd = c.head_dim
+    nh = p["q_proj"].shape[-1] // hd      # local head count (sliced under TP)
+    nkv = p["k_proj"].shape[-1] // hd
+
+    x = fused_rms_norm(h_in, p["input_norm"], c.rms_norm_eps)
+    q = (x @ p["q_proj"]).reshape(b, s, nh, hd)
+    k = (x @ p["k_proj"]).reshape(b, s, nkv, hd)
+    v = (x @ p["v_proj"]).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if parallel.sep > 1 and in_shard_map:
+        from ..parallel.ring_attention import ring_attention
+        attn = ring_attention(q, k, v, axis_name="sep", causal=True)
+    elif use_flash:
+        attn = flash_attention_bshd(q, k, v, causal=True)
+    else:
+        from ..nn.functional.attention import _xla_sdpa
+        attn = _xla_sdpa(q, k, v, is_causal=True)
+    attn = attn.reshape(b, s, nh * hd)
+    attn_out = attn @ p["o_proj"]
+    if tp_axis is not None:
+        attn_out = lax.psum(attn_out, tp_axis)
+    h = h_in + _maybe_hint(attn_out, mesh, _act_spec(parallel))
+
+    x = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
+    gated = jax.nn.silu(x @ p["gate_proj"]) * (x @ p["up_proj"])
+    mlp_out = gated @ p["down_proj"]
+    if tp_axis is not None:
+        mlp_out = lax.psum(mlp_out, tp_axis)
+    out = h + _maybe_hint(mlp_out, mesh, _act_spec(parallel))
+    return out
+
+
+def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
+                 layer_slice=None, in_shard_map=False):
+    """Embed + scan decoder stack. Returns final hidden (pre-norm)."""
+    c = config
+    h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)
+    h = _maybe_hint(h, mesh, _act_spec(parallel))
+    s_total = ids.shape[1] * (parallel.sep if in_shard_map else 1)
+    cos, sin = build_rope_cache(s_total, c.head_dim, base=c.rope_theta)
+    if parallel.sep > 1 and in_shard_map:
+        # each sep shard sees its slice of positions
+        idx = lax.axis_index("sep") * ids.shape[1]
+        cos = lax.dynamic_slice_in_dim(cos, idx, ids.shape[1], 0)
+        sin = lax.dynamic_slice_in_dim(sin, idx, ids.shape[1], 0)
+
+    body = functools.partial(decoder_layer, config=c, parallel=parallel,
+                             mesh=mesh, use_flash=use_flash,
+                             in_shard_map=in_shard_map)
+    scan_body = (jax.checkpoint(lambda h, p: (body(p, h, cos, sin), None))
+                 if parallel.remat else
+                 (lambda h, p: (body(p, h, cos, sin), None)))
+    layer_params = params["layers"]
+    if layer_slice is not None:
+        layer_params = jax.tree_util.tree_map(lambda a: a[layer_slice],
+                                              layer_params)
+    h, _ = lax.scan(scan_body, h, layer_params)
+    return h
+
+
+def llama_logits(params, h, config):
+    x = fused_rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    head = (params["embed"].T if config.tie_word_embeddings
+            else params["lm_head"])
+    return x @ head
+
+
+def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
+               mesh=None, use_flash=True, in_shard_map=False):
+    """Causal LM loss, fp32 softmax. labels: [B, S] with -100 = ignore."""
+    h = llama_hidden(params, ids, config, parallel, mesh, use_flash,
+                     in_shard_map=in_shard_map)
+    logits = llama_logits(params, h, config).astype(jnp.float32)
+    mask = labels != -100
+    safe = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
+    count = jnp.maximum(jnp.sum(mask), 1)
+    if in_shard_map and parallel.sep > 1:
+        # only 'sep' is manual; dp/sharding stay auto (GSPMD reduces them)
+        loss_sum = lax.psum(loss_sum, "sep")
+        count = lax.psum(count, "sep")
+    return loss_sum / count
+
+
+# ---------------------------------------------------------------------------
+# compiled SPMD train step
+# ---------------------------------------------------------------------------
+
+def make_mesh(parallel: ParallelConfig, devices=None) -> Mesh:
+    from ..distributed.fleet.topology import _pick_devices
+    n = parallel.total
+    devs = list(devices) if devices is not None else _pick_devices(n)
+    arr = np.array(devs[:n]).reshape(parallel.dp, parallel.pp,
+                                     parallel.sharding, parallel.sep,
+                                     parallel.mp)
+    return Mesh(arr, axis_names=("dp", "pp", "sharding", "sep", "mp"))
+
+
+def _adamw_init(params):
+    return {
+        "m": jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        "v": jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+        "t": jnp.zeros((), jnp.float32),
+    }
+
+
+def _adamw_update(params, grads, state, lr, b1=0.9, b2=0.95, eps=1e-8, wd=0.1):
+    t = state["t"] + 1
+
+    def upd(p, g, m, v):
+        g32 = g.astype(jnp.float32)
+        m_new = b1 * m + (1 - b1) * g32
+        v_new = b2 * v + (1 - b2) * g32 * g32
+        m_hat = m_new / (1 - b1 ** t)
+        v_hat = v_new / (1 - b2 ** t)
+        p32 = p.astype(jnp.float32)
+        p_new = p32 - lr * (m_hat / (jnp.sqrt(v_hat) + eps) + wd * p32)
+        return p_new.astype(p.dtype), m_new, v_new
+
+    flat_p, tree = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_leaves(grads)
+    flat_m = jax.tree_util.tree_leaves(state["m"])
+    flat_v = jax.tree_util.tree_leaves(state["v"])
+    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    new_p = jax.tree_util.tree_unflatten(tree, [o[0] for o in out])
+    new_m = jax.tree_util.tree_unflatten(tree, [o[1] for o in out])
+    new_v = jax.tree_util.tree_unflatten(tree, [o[2] for o in out])
+    return new_p, {"m": new_m, "v": new_v, "t": t}
+
+
+def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
+                     mesh: Optional[Mesh] = None, lr: float = 3e-4,
+                     seed: int = 0):
+    """Returns (step_fn, params, opt_state). step_fn(params, opt, ids, labels)
+    -> (params, opt, loss), jit-compiled over the mesh with full dp/mp/
+    sharding/sep/pp shardings. ids/labels: [B, S] int32 host arrays.
+    """
+    if mesh is None and parallel.total > 1:
+        mesh = make_mesh(parallel)
+    use_flash = parallel.use_flash
+    if use_flash is None:
+        from ..ops._common import interpret_mode
+        use_flash = not interpret_mode()
+
+    params = init_llama_params(config, seed)
+    pspecs = param_pspecs(config, parallel)
+
+    if parallel.pp > 1:
+        return _build_pp_train_step(config, parallel, mesh, params, pspecs,
+                                    lr, use_flash)
+
+    opt_specs = opt_state_pspecs(config, parallel, pspecs)
+    if mesh is not None:
+        params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, pspecs, is_leaf=lambda x: not isinstance(x, dict))
+    opt_state = _adamw_init(params)
+    if mesh is not None:
+        opt_state["m"] = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            opt_state["m"], opt_specs, is_leaf=lambda x: not isinstance(x, dict))
+        opt_state["v"] = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            opt_state["v"], opt_specs, is_leaf=lambda x: not isinstance(x, dict))
+
+    needs_shard_map = parallel.sep > 1
+
+    def loss_fn(p, ids, labels):
+        if needs_shard_map:
+            from jax import shard_map
+            # manual ONLY over 'sep' (ring attention does explicit ppermute);
+            # dp/mp/sharding remain auto -> GSPMD partitions them as usual.
+            sep_only = jax.tree_util.tree_map(
+                lambda _: P(), pspecs, is_leaf=lambda x: isinstance(x, P))
+            smap = shard_map(
+                functools.partial(llama_loss, config=config, parallel=parallel,
+                                  mesh=None, use_flash=use_flash,
+                                  in_shard_map=True),
+                mesh=mesh,
+                in_specs=(sep_only, P(None, "sep"), P(None, "sep")),
+                out_specs=P(),
+                axis_names={"sep"},
+                check_vma=False)
+            return smap(p, ids, labels)
+        return llama_loss(p, ids, labels, config, parallel, mesh,
+                          use_flash=use_flash)
+
+    def step(p, opt, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        new_p, new_opt = _adamw_update(p, grads, opt, lr)
+        return new_p, new_opt, loss
+
+    batch_sharding = (NamedSharding(mesh, P(_act_spec(parallel)[0], None))
+                      if mesh is not None else None)
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+
+    def step_fn(p, opt, ids, labels):
+        ids = jnp.asarray(ids, jnp.int32)
+        labels = jnp.asarray(labels, jnp.int32)
+        if batch_sharding is not None:
+            ids = jax.device_put(ids, batch_sharding)
+            labels = jax.device_put(labels, batch_sharding)
+        return jit_step(p, opt, ids, labels)
+
+    return step_fn, params, opt_state
+
+
+def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
+    """Pipeline path: stage-stacked params sharded over 'pp', collective
+    schedule via shard_map + ppermute (parallel/pipeline.py design) with the
+    other axes left to GSPMD (auto)."""
+    from jax import shard_map
+    c = config
+    S = parallel.pp
+    L = c.num_hidden_layers
+    assert L % S == 0, (L, S)
+    per = L // S
+    M = max(parallel.microbatches, S)
+
+    # reshape stacked layers [L, ...] -> [S, per, ...] and shard axis0 on 'pp'
+    def restage(a):
+        return a.reshape((S, per) + a.shape[1:])
+
+    params = dict(params)
+    params["layers"] = jax.tree_util.tree_map(restage, params["layers"])
+    layer_specs = jax.tree_util.tree_map(
+        lambda s: P(*(("pp",) + tuple(s))), pspecs["layers"],
+        is_leaf=lambda x: isinstance(x, P))
+    pspecs = dict(pspecs)
+    pspecs["layers"] = layer_specs
+
+    if mesh is not None:
+        params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, pspecs, is_leaf=lambda x: not isinstance(x, dict))
+    opt_state = _adamw_init(params)
+    if mesh is not None:
+        for key in ("m", "v"):
+            opt_state[key] = jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+                opt_state[key], pspecs, is_leaf=lambda x: not isinstance(x, dict))
+
+    act = _act_spec(parallel)
+    batch_axes = act[0]
+    tp_axis = "mp" if parallel.mp > 1 else None
+
+    def stage_fn(stage_params, h, cos, sin):
+        body = functools.partial(decoder_layer, config=c, parallel=parallel,
+                                 mesh=None, use_flash=use_flash,
+                                 tp_axis=tp_axis)
+        def scan_body(hh, p):
+            return body(p, hh, cos, sin), None
+        if parallel.remat:
+            scan_body = jax.checkpoint(scan_body)
+        h, _ = lax.scan(scan_body, h, stage_params)
+        return h
+
+    def pipelined_loss(p, ids, labels):
+        # inside shard_map: manual over 'pp' (and batch axes for psums)
+        b, s = ids.shape
+        cos, sin = build_rope_cache(s, c.head_dim, base=c.rope_theta)
+        h = jnp.take(p["embed"], ids, axis=0).astype(c.dtype)
+        from ..parallel.pipeline import microbatch, pipeline_apply, last_stage_value
+        h_mb = microbatch(h, M)
+
+        pipe = pipeline_apply(
+            lambda sp, hh: stage_fn(sp, hh, cos, sin), S, M, "pp",
+            remat=False)  # remat already inside stage scan
+        out_mb = pipe(p["layers"], h_mb)
+        h_out = out_mb.reshape(b, s, c.hidden_size)
+        logits = llama_logits(p, h_out, c).astype(jnp.float32)
+        mask = labels != -100
+        safe = jnp.where(mask, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        loss = jnp.sum(jnp.where(mask, -picked, 0.0)) / jnp.maximum(mask.sum(), 1)
+        return last_stage_value(loss, S, "pp")
+
+    # Manual over 'pp' (+ 'mp' when TP is on: the explicit Megatron psum
+    # pattern — mixing manual pp with auto mp collectives crashes XLA's SPMD
+    # group expansion). dp/sharding stay auto/GSPMD.
+    manual_axes = {"pp"} | ({"mp"} if tp_axis else set())
+
+    def manual_spec(full_spec, lead_pp: bool):
+        parts = ["pp"] if lead_pp else []
+        for ax in (tuple(full_spec)[1:] if lead_pp else tuple(full_spec)):
+            parts.append(ax if (ax == "mp" and tp_axis) else None)
+        return P(*parts)
+
+    pp_manual = jax.tree_util.tree_map(
+        lambda s: manual_spec(s, lead_pp=False), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    pp_manual["layers"] = jax.tree_util.tree_map(
+        lambda s: manual_spec(s, lead_pp=True), pspecs["layers"],
+        is_leaf=lambda x: isinstance(x, P))
+    # embed/final_norm/lm_head compute replicated across mp in the manual
+    # region (their heavy math is outside the layer stack)
+    pp_manual["embed"] = P()
+    pp_manual["final_norm"] = P()
+    if "lm_head" in pp_manual:
+        pp_manual["lm_head"] = P()
+    in_specs = (pp_manual, P(), P())
+    smap_loss = shard_map(pipelined_loss, mesh=mesh, in_specs=in_specs,
+                          out_specs=P(), axis_names=manual_axes,
+                          check_vma=False)
+
+    def step(p, opt, ids, labels):
+        loss, grads = jax.value_and_grad(
+            lambda pp_, i, l: smap_loss(pp_, i, l))(p, ids, labels)
+        new_p, new_opt = _adamw_update(p, grads, opt, lr)
+        return new_p, new_opt, loss
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    batch_sharding = NamedSharding(mesh, P(batch_axes, None))
+
+    def step_fn(p, opt, ids, labels):
+        ids = jax.device_put(jnp.asarray(ids, jnp.int32), batch_sharding)
+        labels = jax.device_put(jnp.asarray(labels, jnp.int32), batch_sharding)
+        return jit_step(p, opt, ids, labels)
+
+    return step_fn, params, opt_state
+
+
+def count_params(config: LlamaConfig) -> int:
+    c = config
+    per_layer = (c.hidden_size * (c.num_attention_heads +
+                                  2 * c.num_key_value_heads) * c.head_dim
+                 + c.num_attention_heads * c.head_dim * c.hidden_size
+                 + 3 * c.hidden_size * c.intermediate_size
+                 + 2 * c.hidden_size)
+    total = c.num_hidden_layers * per_layer + c.vocab_size * c.hidden_size \
+        + c.hidden_size
+    if not c.tie_word_embeddings:
+        total += c.hidden_size * c.vocab_size
+    return total
+
+
+def train_flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """~6N + attention flops per token (fwd+bwd), for MFU accounting."""
+    n = count_params(config)
+    attn = 12 * config.num_hidden_layers * config.hidden_size * seq_len
+    return 6.0 * n + attn
