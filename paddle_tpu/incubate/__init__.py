@@ -1,0 +1,3 @@
+"""Incubate: experimental API surface (ref: python/paddle/incubate/)."""
+from . import nn
+from . import distributed

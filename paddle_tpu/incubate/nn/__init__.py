@@ -1,0 +1,196 @@
+"""Fused transformer layers (ref: python/paddle/incubate/nn/layer/).
+
+FusedMultiTransformer is the reference's inference workhorse
+(fused_multi_transformer_op.cu: full decoder stack incl. KV cache). Here the
+stack is a lax.scan over stacked per-layer weights with the Pallas attention
+kernel — the fusion XLA+Pallas equivalent.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ...nn import initializer as I
+from ...tensor.tensor import Tensor, _run_op
+from . import functional
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features] if not transpose_weight
+            else [out_features, in_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return functional.fused_linear(x, self.weight, self.bias,
+                                       self.transpose_weight)
+
+
+class FusedRMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [hidden_size], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.epsilon = epsilon
+
+    def forward(self, x, residual=None):
+        return functional.fused_rms_norm(x, self.weight, epsilon=self.epsilon,
+                                         residual=residual)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, normalize_before=False, **kw):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, self.head_dim, embed_dim],
+            default_initializer=I.XavierNormal())
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, self.head_dim], is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], default_initializer=I.XavierNormal())
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, x, attn_mask=None, cache=None):
+        return functional.fused_multi_head_attention(
+            x, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            training=self.training, num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", normalize_before=False, **kw):
+        super().__init__()
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], default_initializer=I.XavierNormal())
+        self.linear1_bias = self.create_parameter([dim_feedforward], is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], default_initializer=I.XavierNormal())
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, x):
+        return functional.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            self.linear1_bias, self.linear2_bias,
+            self.ln1_scale, self.ln1_bias, self.ln2_scale, self.ln2_bias,
+            dropout1_rate=self.dropout_rate, dropout2_rate=self.dropout_rate,
+            activation=self.activation, pre_layer_norm=self.normalize_before,
+            training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Decoder stack with per-layer weights stacked for a scanned, fused
+    forward + incremental KV-cache decode (ref: fused_multi_transformer_op.cu).
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, num_layers=1,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.num_layers = num_layers
+        self.normalize_before = normalize_before
+        self.activation = activation
+        L = num_layers
+        xavier = I.XavierNormal()
+
+        def mk(shape, init=None):
+            return self.create_parameter(shape, default_initializer=init or xavier)
+
+        self.ln_scales = mk([L, embed_dim], I.Constant(1.0))
+        self.ln_biases = mk([L, embed_dim], I.Constant(0.0))
+        self.qkv_weights = mk([L, embed_dim, 3 * embed_dim])
+        self.qkv_biases = mk([L, 3 * embed_dim], I.Constant(0.0))
+        self.linear_weights = mk([L, embed_dim, embed_dim])
+        self.linear_biases = mk([L, embed_dim], I.Constant(0.0))
+        self.ffn_ln_scales = mk([L, embed_dim], I.Constant(1.0))
+        self.ffn_ln_biases = mk([L, embed_dim], I.Constant(0.0))
+        self.ffn1_weights = mk([L, embed_dim, dim_feedforward])
+        self.ffn1_biases = mk([L, dim_feedforward], I.Constant(0.0))
+        self.ffn2_weights = mk([L, dim_feedforward, embed_dim])
+        self.ffn2_biases = mk([L, embed_dim], I.Constant(0.0))
+
+    def forward(self, x, attn_mask=None, caches=None, time_step=None):
+        nh, hd = self.num_heads, self.head_dim
+        act_name = self.activation
+
+        def f(xa, *ws):
+            (ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b,
+             fln_s, fln_b, f1_w, f1_b, f2_w, f2_b) = ws
+
+            def layer(h, per):
+                (ls, lb, qw, qb, lw, lbias, fs_, fb, w1, b1, w2, b2) = per
+                def ln(t, s_, b_):
+                    t32 = t.astype(jnp.float32)
+                    mu = t32.mean(-1, keepdims=True)
+                    var = t32.var(-1, keepdims=True)
+                    return ((t32 - mu) * jax.lax.rsqrt(var + 1e-5)
+                            * s_ + b_).astype(t.dtype)
+                resid = h
+                y = ln(h, ls, lb)
+                b_, s_len = y.shape[0], y.shape[1]
+                qkv = (y @ qw + qb).reshape(b_, s_len, 3, nh, hd)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                from ...nn.functional.attention import _xla_sdpa
+                from ...ops._common import interpret_mode
+                if interpret_mode():
+                    attn = _xla_sdpa(q, k, v, is_causal=True)
+                else:
+                    from ...ops.flash_attention import flash_attention_bshd
+                    attn = flash_attention_bshd(q, k, v, causal=True)
+                h = resid + attn.reshape(b_, s_len, nh * hd) @ lw + lbias
+                resid = h
+                y = ln(h, fs_, fb)
+                act = (jax.nn.gelu if act_name == "gelu" else jax.nn.relu)
+                h = resid + act(y @ w1 + b1) @ w2 + b2
+                return h, None
+
+            h, _ = jax.lax.scan(layer, xa,
+                                (ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b,
+                                 fln_s, fln_b, f1_w, f1_b, f2_w, f2_b))
+            return h
+
+        return _run_op("fused_multi_transformer", f,
+                       (x, self.ln_scales, self.ln_biases, self.qkv_weights,
+                        self.qkv_biases, self.linear_weights,
+                        self.linear_biases, self.ffn_ln_scales,
+                        self.ffn_ln_biases, self.ffn1_weights,
+                        self.ffn1_biases, self.ffn2_weights,
+                        self.ffn2_biases), {})

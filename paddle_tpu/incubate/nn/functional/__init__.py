@@ -1,0 +1,190 @@
+"""Fused-op functional API (ref: python/paddle/incubate/nn/functional/).
+
+Tensor-level wrappers over the Pallas kernels in paddle_tpu.ops — the same
+surface the reference exposes for its fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops import flash_attention as _fa
+from ....ops import rms_norm as _rms
+from ....ops import rope as _rope
+from ....tensor.tensor import Tensor, _run_op
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """q/k/v: [B, S, H, D] Tensors (ref: fused_rope_kernel.cu wrapper)."""
+    tensors = [t for t in (q, k, v) if t is not None]
+    arrays = [None if t is None else t for t in (q, k, v)]
+
+    def f(*args):
+        it = iter(args)
+        qa = next(it) if q is not None else None
+        ka = next(it) if k is not None else None
+        va = next(it) if v is not None else None
+        extra = {}
+        sa = sin._data if isinstance(sin, Tensor) else sin
+        ca = cos._data if isinstance(cos, Tensor) else cos
+        pid = position_ids._data if isinstance(position_ids, Tensor) else position_ids
+        outs = _rope.fused_rotary_position_embedding(
+            qa, ka, va, sin=sa, cos=ca, position_ids=pid,
+            use_neox_rotary_style=use_neox_rotary_style)
+        return tuple(o for o in outs if o is not None)
+
+    outs = _run_op("fused_rope", f, tuple(tensors), {})
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    it = iter(outs)
+    return tuple(next(it) if t is not None else None for t in (q, k, v))
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None):
+    """(ref: phi/kernels/fusion/gpu/rms_norm_kernel.cu wrapper).
+    Supports the residual-add fusion variant."""
+    args = [x, norm_weight]
+    has_res = residual is not None
+
+    def f(xa, wa, *rest):
+        i = 0
+        res = None
+        if has_res:
+            res = rest[i]; i += 1
+        if res is not None:
+            xa = xa + res
+        out = _rms.fused_rms_norm(xa, wa, epsilon)
+        if norm_bias is not None:
+            out = out + (norm_bias._data if isinstance(norm_bias, Tensor)
+                         else norm_bias)
+        return out
+
+    if has_res:
+        args.append(residual)
+    return _run_op("fused_rms_norm", f, tuple(args), {})
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, bias=None, residual=None):
+    def f(xa, wa, ba, *rest):
+        if rest:
+            xa = xa + rest[0]
+        x32 = xa.astype(jnp.float32)
+        mean = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        out = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+        return (out * wa.astype(jnp.float32)
+                + ba.astype(jnp.float32)).astype(xa.dtype)
+    args = (x, norm_weight, norm_bias) + ((residual,) if residual is not None else ())
+    return _run_op("fused_layer_norm", f, args, {})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ....nn.functional.common import linear
+    if transpose_weight:
+        from ....tensor.linalg import t as _t
+        weight = _t(weight)
+    return linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ....tensor.linalg import matmul
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    if bias is not None:
+        out = out + bias
+    from ....nn import functional as F
+    act = {"gelu": lambda t: F.gelu(t, approximate=True),
+           "relu": F.relu, "none": lambda t: t}[activation]
+    return act(out)
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; single-arg form splits last dim in half (ref: swiglu op)."""
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+        return _run_op("swiglu", f, (x,), {})
+    return _run_op("swiglu", lambda a, b: jax.nn.silu(a) * b, (x, y), {})
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn.functional.common import dropout
+    return dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, ln_epsilon=1e-5,
+                                           training=True):
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    out = x if bias is None else x + bias
+    out = dropout(out, p=dropout_rate, training=training) + residual
+    return layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, num_heads=None, name=None):
+    """Fused MHA (ref: fused_attention_op.cu). qkv_weight: [3, H, D, hidden]."""
+    from ....nn.functional.attention import scaled_dot_product_attention
+    from ....nn.functional.norm import layer_norm
+    from ....nn.functional.common import dropout
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+
+    def qkv_f(xa, wa, *b):
+        out = jnp.einsum("bsh,tndh->bstnd", xa, wa)
+        if b:
+            out = out + b[0]
+        return out
+    args = (x, qkv_weight) + ((qkv_bias,) if qkv_bias is not None else ())
+    qkv = _run_op("fused_qkv", qkv_f, args, {})
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    attn = scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                        dropout_p=attn_dropout_rate,
+                                        training=training)
+    b_, s_ = attn.shape[0], attn.shape[1]
+    from ....tensor.manipulation import reshape
+    attn = reshape(attn, [b_, s_, -1])
+    from ....nn.functional.common import linear
+    out = linear(attn, linear_weight, linear_bias)
+    out = dropout(out, p=dropout_rate, training=training) + residual
+    if not pre_layer_norm:
+        out = layer_norm(out, out.shape[-1], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    """(ref: fused_feedforward_op.cu)."""
+    from ....nn import functional as F
+    from ....nn.functional.norm import layer_norm
+    from ....nn.functional.common import dropout, linear
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1], ln1_scale, ln1_bias, ln1_epsilon)
+    act = getattr(F, activation)
+    h = dropout(act(linear(x, linear1_weight, linear1_bias)),
+                p=dropout1_rate, training=training)
+    h = dropout(linear(h, linear2_weight, linear2_bias),
+                p=dropout2_rate, training=training) + residual
+    if not pre_layer_norm:
+        h = layer_norm(h, h.shape[-1], ln2_scale, ln2_bias, ln2_epsilon)
+    return h

@@ -1,5 +1,6 @@
 """Model zoo: flagship configs from the BASELINE ladder."""
 from . import llama
+from . import ernie_moe, gpt2
 from .llama import (LlamaConfig, ParallelConfig, build_train_step,
                     init_llama_params, llama_loss, llama_7b, llama_13b,
                     llama_tiny, count_params)

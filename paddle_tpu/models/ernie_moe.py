@@ -1,0 +1,208 @@
+"""ERNIE-style MoE transformer with expert parallelism (BASELINE config 5:
+"ERNIE-MoE with Fleet expert-parallel + PipelineLayer").
+
+Ref: the reference composes incubate MoELayer (gshard gate +
+global_scatter/global_gather all-to-all) with fleet PP. TPU-native: the same
+functional-core design as models/llama.py, with every even layer's FFN
+replaced by a top-2 MoE block whose expert stack is sharded over the 'ep'
+submesh — the dispatch einsum becomes XLA all-to-all over ICI.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.moe import moe_dispatch_combine
+from ..ops.rms_norm import fused_rms_norm
+from .llama import _adamw_init, _adamw_update
+
+
+@dataclasses.dataclass
+class ErnieMoEConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_experts: int = 8
+    moe_topk: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2           # every k-th layer is MoE
+    aux_loss_weight: float = 0.01
+    max_position_embeddings: int = 512
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def ernie_moe_tiny():
+    return ErnieMoEConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=4, num_attention_heads=4,
+                          num_experts=4, max_position_embeddings=128,
+                          dtype=jnp.float32)
+
+
+def init_params(config: ErnieMoEConfig, seed: int = 0):
+    c = config
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 12)
+    d = c.dtype
+    std = 0.02
+    L = c.num_hidden_layers
+    E = c.num_experts
+
+    def rnd(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(d)
+
+    layers = {
+        "ln1": jnp.ones((L, c.hidden_size), d),
+        "qkv": rnd(ks[1], (L, c.hidden_size, 3 * c.hidden_size)),
+        "o": rnd(ks[2], (L, c.hidden_size, c.hidden_size)),
+        "ln2": jnp.ones((L, c.hidden_size), d),
+        # dense FFN (used on non-MoE layers)
+        "w1": rnd(ks[3], (L, c.hidden_size, c.intermediate_size)),
+        "w2": rnd(ks[4], (L, c.intermediate_size, c.hidden_size)),
+        # MoE experts (used on MoE layers)
+        "gate": rnd(ks[5], (L, c.hidden_size, E)).astype(jnp.float32),
+        "e_w1": rnd(ks[6], (L, E, c.hidden_size, c.intermediate_size)),
+        "e_w2": rnd(ks[7], (L, E, c.intermediate_size, c.hidden_size)),
+    }
+    return {
+        "embed": rnd(ks[0], (c.vocab_size, c.hidden_size)),
+        "pos": rnd(ks[8], (c.max_position_embeddings, c.hidden_size)),
+        "layers": layers,
+        "final_ln": jnp.ones((c.hidden_size,), d),
+    }
+
+
+def param_pspecs(config, ep_degree: int, dp_degree: int = 1):
+    ep = "ep" if ep_degree > 1 else None
+    layers = {
+        "ln1": P(None, None),
+        "qkv": P(None, None, None),
+        "o": P(None, None, None),
+        "ln2": P(None, None),
+        "w1": P(None, None, None),
+        "w2": P(None, None, None),
+        "gate": P(None, None, None),
+        "e_w1": P(None, ep, None, None),   # experts sharded over 'ep'
+        "e_w2": P(None, ep, None, None),
+    }
+    return {"embed": P(None, None), "pos": P(None, None), "layers": layers,
+            "final_ln": P(None)}
+
+
+def _layer(p, h, layer_idx, config: ErnieMoEConfig):
+    c = config
+    b, s, hid = h.shape
+    nh, hd = c.num_attention_heads, c.head_dim
+
+    x = fused_rms_norm(h, p["ln1"], c.layer_norm_eps)
+    qkv = (x @ p["qkv"]).reshape(b, s, 3, nh, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    from ..ops._common import interpret_mode
+    if interpret_mode():
+        from ..nn.functional.attention import _xla_sdpa
+        attn = _xla_sdpa(q, k, v, is_causal=True)
+    else:
+        from ..ops.flash_attention import flash_attention_bshd
+        attn = flash_attention_bshd(q, k, v, causal=True)
+    h = h + attn.reshape(b, s, hid) @ p["o"]
+
+    x = fused_rms_norm(h, p["ln2"], c.layer_norm_eps)
+    is_moe = (layer_idx % c.moe_every) == (c.moe_every - 1)
+
+    def moe_branch(x_):
+        tokens = x_.reshape(-1, hid)
+        logits = tokens.astype(jnp.float32) @ p["gate"]
+
+        def expert_fn(params, toks):
+            w1, w2 = params
+            return jax.nn.gelu(toks @ w1) @ w2
+
+        out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
+                                        (p["e_w1"], p["e_w2"]),
+                                        c.num_experts, k=c.moe_topk,
+                                        capacity_factor=c.capacity_factor)
+        return out.reshape(x_.shape).astype(x_.dtype), aux.astype(jnp.float32)
+
+    def dense_branch(x_):
+        return (jax.nn.gelu(x_ @ p["w1"]) @ p["w2"]).astype(x_.dtype), \
+            jnp.zeros((), jnp.float32)
+
+    # layer_idx is a traced scan counter: lax.cond keeps one compiled body
+    ffn_out, aux = lax.cond(is_moe, moe_branch, dense_branch, x)
+    return h + ffn_out, aux
+
+
+def moe_loss(params, ids, labels, config: ErnieMoEConfig):
+    c = config
+    b, s = ids.shape
+    h = (jnp.take(params["embed"], ids, axis=0)
+         + params["pos"][:s][None]).astype(c.dtype)
+
+    def body(carry, inp):
+        h = carry
+        idx, layer_params = inp
+        h, aux = _layer(layer_params, h, idx, c)
+        return h, aux
+
+    idxs = jnp.arange(c.num_hidden_layers)
+    h, auxes = lax.scan(body, h, (idxs, params["layers"]))
+    x = fused_rms_norm(h, params["final_ln"], c.layer_norm_eps)
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    mask = labels != -100
+    safe = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    lm_loss = jnp.sum(jnp.where(mask, -picked, 0.0)) / jnp.maximum(mask.sum(), 1)
+    return lm_loss + c.aux_loss_weight * auxes.sum(), lm_loss
+
+
+def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
+                     dp_degree: int = 1, mesh: Optional[Mesh] = None,
+                     lr: float = 3e-4, seed: int = 0):
+    """EP x DP training step; experts sharded over 'ep', batch over 'dp'."""
+    if mesh is None and ep_degree * dp_degree > 1:
+        from ..distributed.fleet.topology import _pick_devices
+        devs = _pick_devices(ep_degree * dp_degree)
+        mesh = Mesh(np.array(devs).reshape(dp_degree, ep_degree),
+                    axis_names=("dp", "ep"))
+
+    params = init_params(config, seed)
+    pspecs = param_pspecs(config, ep_degree, dp_degree)
+    if mesh is not None:
+        params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            params, pspecs, is_leaf=lambda x: not isinstance(x, dict))
+    opt = _adamw_init(params)
+
+    def step(p, o, ids, labels):
+        (loss, lm_loss), grads = jax.value_and_grad(
+            moe_loss, has_aux=True)(p, ids, labels, config)
+        new_p, new_o = _adamw_update(p, grads, o, lr)
+        return new_p, new_o, loss, lm_loss
+
+    jit_step = jax.jit(step, donate_argnums=(0, 1))
+    batch_sharding = (NamedSharding(mesh, P("dp", None))
+                      if mesh is not None else None)
+
+    def step_fn(p, o, ids, labels):
+        ids = jnp.asarray(ids, jnp.int32)
+        labels = jnp.asarray(labels, jnp.int32)
+        if batch_sharding is not None:
+            ids = jax.device_put(ids, batch_sharding)
+            labels = jax.device_put(labels, batch_sharding)
+        return jit_step(p, o, ids, labels)
+
+    return step_fn, params, opt

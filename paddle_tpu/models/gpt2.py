@@ -1,0 +1,154 @@
+"""GPT-2 built on the fleet TP layers (BASELINE config 2: GPT-2 345M TP=2).
+
+Ref: the reference exercises Column/RowParallelLinear with GPT-2 in
+test/collective/fleet. This is the Layer-based (dygraph) model — it runs
+eagerly dense, and compiled over a mesh the TP specs on its fleet layers
+partition it; build_gpt2_train_step wires it into the jit TrainStep.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+from ..nn import Dropout, Embedding, Layer, LayerList, LayerNorm
+from ..nn import functional as F
+from ..nn.layer.layers import ParamAttr
+from ..nn import initializer as I
+from ..tensor import arange, reshape
+from ..tensor.tensor import Tensor
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50304, hidden_size=1024, num_layers=24,
+                 num_heads=16, intermediate_size=None, max_position=1024,
+                 dropout=0.0, layer_norm_eps=1e-5):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position = max_position
+        self.dropout = dropout
+        self.layer_norm_eps = layer_norm_eps
+
+
+def gpt2_345m():
+    return GPT2Config(hidden_size=1024, num_layers=24, num_heads=16)
+
+
+def gpt2_tiny():
+    return GPT2Config(vocab_size=256, hidden_size=64, num_layers=2,
+                      num_heads=4, max_position=128)
+
+
+class GPT2Attention(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        init = ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.qkv_proj = ColumnParallelLinear(c.hidden_size, 3 * c.hidden_size,
+                                             weight_attr=init,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(c.hidden_size, c.hidden_size,
+                                          weight_attr=init,
+                                          input_is_parallel=True)
+        self.dropout = Dropout(c.dropout)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        attn = reshape(attn, [b, s, self.num_heads * self.head_dim])
+        return self.dropout(self.out_proj(attn))
+
+
+class GPT2MLP(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        init = ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.fc_in = ColumnParallelLinear(config.hidden_size,
+                                          config.intermediate_size,
+                                          weight_attr=init,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(config.intermediate_size,
+                                        config.hidden_size,
+                                        weight_attr=init,
+                                        input_is_parallel=True)
+        self.dropout = Dropout(config.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x), approximate=True)))
+
+
+class GPT2Block(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = GPT2Attention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlp = GPT2MLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPT2Model(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.config = config
+        self.wte = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        self.wpe = Embedding(config.max_position, config.hidden_size)
+        self.drop = Dropout(config.dropout)
+        self.h = LayerList([GPT2Block(config) for _ in range(config.num_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = arange(s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPT2ForCausalLM(Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.transformer = GPT2Model(config)
+        self.config = config
+
+    def forward(self, input_ids):
+        h = self.transformer(input_ids)
+        # tied lm head: project onto the (possibly vocab-sharded) embedding
+        from ..tensor.linalg import matmul
+        logits = matmul(h, self.transformer.wte.weight.T)
+        return logits
+
+
+def gpt2_loss(logits, labels):
+    return F.cross_entropy(reshape(logits, [-1, logits.shape[-1]]),
+                           reshape(labels, [-1]))
+
+
+def build_gpt2_train_step(config: GPT2Config, mesh=None, lr=3e-4,
+                          weight_decay=0.01):
+    """Config-2 training: GPT-2 with TP=2 over the fleet mesh."""
+    from jax.sharding import PartitionSpec as P
+    from ..jit import TrainStep
+    from ..optimizer import AdamW
+    model = GPT2ForCausalLM(config)
+    opt = AdamW(learning_rate=lr, parameters=model.parameters(),
+                weight_decay=weight_decay)
+    step = TrainStep(model, lambda out, lbl: gpt2_loss(out, lbl), opt,
+                     mesh=mesh, batch_spec=P("dp") if mesh is not None else None)
+    return model, opt, step
