@@ -1,0 +1,209 @@
+// Best-fit caching host allocator
+// (ref: paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.cc).
+//
+// On TPU the device heap belongs to PJRT/XLA; what the framework still owns
+// is host staging memory — the buffers DataLoader workers collate batches
+// into before the host->HBM transfer (the pinned-pool analog).  Strategy
+// mirrors the reference's AutoGrowthBestFit: grab OS chunks of at least
+// `chunk_bytes`, carve blocks best-fit from a size-ordered free map, coalesce
+// with neighbors on free, keep everything cached until release_free().
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+#include "common.h"
+#include "pd_runtime.h"
+
+namespace pd {
+namespace {
+
+constexpr uint64_t kAlignment = 64;
+constexpr uint64_t kSplitThreshold = 256;
+
+inline uint64_t align_up(uint64_t n) {
+  return (n + kAlignment - 1) & ~(kAlignment - 1);
+}
+
+struct Chunk;
+
+struct Block {
+  char* ptr;
+  uint64_t size;
+  bool free;
+  Chunk* chunk;
+  Block* prev;  // address-adjacent neighbors within the chunk
+  Block* next;
+};
+
+struct Chunk {
+  char* base;
+  uint64_t size;
+};
+
+class Allocator {
+ public:
+  explicit Allocator(uint64_t chunk_bytes)
+      : chunk_bytes_(chunk_bytes ? chunk_bytes : (64ull << 20)) {}
+
+  ~Allocator() {
+    for (auto& kv : chunks_) std::free(kv.first);
+  }
+
+  void* Alloc(uint64_t nbytes) {
+    if (nbytes == 0) nbytes = kAlignment;
+    nbytes = align_up(nbytes);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = free_blocks_.lower_bound(nbytes);
+    Block* b;
+    if (it != free_blocks_.end()) {
+      b = it->second;
+      free_blocks_.erase(it);
+    } else {
+      b = NewChunkBlock(nbytes);
+      if (!b) return nullptr;
+    }
+    b->free = false;
+    if (b->size >= nbytes + kSplitThreshold) {
+      Block* rest = new Block{b->ptr + nbytes, b->size - nbytes, true,
+                              b->chunk, b, b->next};
+      if (b->next) b->next->prev = rest;
+      b->next = rest;
+      b->size = nbytes;
+      free_blocks_.emplace(rest->size, rest);
+    }
+    live_[b->ptr] = b;
+    allocated_ += b->size;
+    if (allocated_ > peak_) peak_ = allocated_;
+    return b->ptr;
+  }
+
+  bool Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = live_.find(static_cast<char*>(p));
+    if (it == live_.end()) return false;
+    Block* b = it->second;
+    live_.erase(it);
+    allocated_ -= b->size;
+    b->free = true;
+    // Coalesce with address-adjacent free neighbors.
+    if (b->next && b->next->free) {
+      Block* n = b->next;
+      EraseFree(n);
+      b->size += n->size;
+      b->next = n->next;
+      if (n->next) n->next->prev = b;
+      delete n;
+    }
+    if (b->prev && b->prev->free) {
+      Block* p2 = b->prev;
+      EraseFree(p2);
+      p2->size += b->size;
+      p2->next = b->next;
+      if (b->next) b->next->prev = p2;
+      delete b;
+      b = p2;
+    }
+    free_blocks_.emplace(b->size, b);
+    return true;
+  }
+
+  void Stats(uint64_t* allocated, uint64_t* reserved, uint64_t* peak) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (allocated) *allocated = allocated_;
+    if (reserved) *reserved = reserved_;
+    if (peak) *peak = peak_;
+  }
+
+  uint64_t ReleaseFree() {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint64_t released = 0;
+    for (auto it = chunks_.begin(); it != chunks_.end();) {
+      Block* b = it->second;
+      // A chunk is releasable iff it is one free block spanning the chunk.
+      if (b->free && !b->prev && !b->next) {
+        EraseFree(b);
+        released += b->size;
+        reserved_ -= b->size;
+        std::free(it->first);
+        delete b;
+        it = chunks_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    return released;
+  }
+
+ private:
+  Block* NewChunkBlock(uint64_t nbytes) {
+    uint64_t sz = nbytes > chunk_bytes_ ? nbytes : chunk_bytes_;
+    char* mem = static_cast<char*>(std::malloc(sz));
+    if (!mem) {
+      set_last_error("allocator: malloc(%llu) failed",
+                     static_cast<unsigned long long>(sz));
+      return nullptr;
+    }
+    reserved_ += sz;
+    Block* b = new Block{mem, sz, false, nullptr, nullptr, nullptr};
+    chunks_.emplace(mem, b);
+    return b;
+  }
+
+  void EraseFree(Block* b) {
+    auto range = free_blocks_.equal_range(b->size);
+    for (auto it = range.first; it != range.second; ++it) {
+      if (it->second == b) {
+        free_blocks_.erase(it);
+        return;
+      }
+    }
+  }
+
+  std::mutex mu_;
+  uint64_t chunk_bytes_;
+  std::multimap<uint64_t, Block*> free_blocks_;
+  std::unordered_map<char*, Block*> live_;
+  // chunk base pointer -> first block in chunk (for release bookkeeping).
+  std::unordered_map<char*, Block*> chunks_;
+  uint64_t allocated_ = 0;
+  uint64_t reserved_ = 0;
+  uint64_t peak_ = 0;
+};
+
+}  // namespace
+}  // namespace pd
+
+extern "C" {
+
+pd_allocator_t pd_allocator_create(uint64_t chunk_bytes) {
+  return new pd::Allocator(chunk_bytes);
+}
+
+void pd_allocator_destroy(pd_allocator_t a) {
+  delete static_cast<pd::Allocator*>(a);
+}
+
+void* pd_alloc(pd_allocator_t a, uint64_t nbytes) {
+  return static_cast<pd::Allocator*>(a)->Alloc(nbytes);
+}
+
+void pd_free(pd_allocator_t a, void* ptr) {
+  if (!ptr) return;
+  if (!static_cast<pd::Allocator*>(a)->Free(ptr)) {
+    pd::set_last_error("pd_free: pointer %p not owned by allocator", ptr);
+  }
+}
+
+void pd_allocator_stats(pd_allocator_t a, uint64_t* allocated,
+                        uint64_t* reserved, uint64_t* peak) {
+  static_cast<pd::Allocator*>(a)->Stats(allocated, reserved, peak);
+}
+
+uint64_t pd_allocator_release_free(pd_allocator_t a) {
+  return static_cast<pd::Allocator*>(a)->ReleaseFree();
+}
+
+}  // extern "C"

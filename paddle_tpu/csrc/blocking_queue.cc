@@ -1,0 +1,111 @@
+// Bounded MPMC blocking queue of opaque handles
+// (ref: the reader BlockingQueue behind paddle/fluid/operators/reader/ that
+// python/paddle/io's DataLoader feeds).  Handles are uint64 tokens the Python
+// side maps to staged batches; capacity gives prefetch backpressure.
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+
+#include "pd_runtime.h"
+
+namespace pd {
+namespace {
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(int capacity)
+      : capacity_(capacity > 0 ? capacity : 1) {}
+
+  int Push(uint64_t h, double timeout_s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return closed_ || (int)q_.size() < capacity_; };
+    if (!Wait(not_full_, lk, timeout_s, pred)) return -1;
+    if (closed_) return -2;
+    q_.push_back(h);
+    not_empty_.notify_one();
+    return 0;
+  }
+
+  int Pop(uint64_t* h, double timeout_s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [&] { return closed_ || !q_.empty(); };
+    if (!Wait(not_empty_, lk, timeout_s, pred)) return -1;
+    if (q_.empty()) return -2;  // closed and drained
+    *h = q_.front();
+    q_.pop_front();
+    not_full_.notify_one();
+    return 0;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  int Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int>(q_.size());
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+
+ private:
+  template <typename Pred>
+  bool Wait(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+            double timeout_s, Pred pred) {
+    if (timeout_s < 0) {
+      cv.wait(lk, pred);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::duration<double>(timeout_s), pred);
+  }
+
+  std::mutex mu_;
+  std::condition_variable not_empty_;
+  std::condition_variable not_full_;
+  int capacity_;
+  std::deque<uint64_t> q_;
+  bool closed_ = false;
+};
+
+}  // namespace
+}  // namespace pd
+
+extern "C" {
+
+pd_queue_t pd_queue_create(int capacity) {
+  return new pd::BlockingQueue(capacity);
+}
+
+void pd_queue_destroy(pd_queue_t q) {
+  delete static_cast<pd::BlockingQueue*>(q);
+}
+
+int pd_queue_push(pd_queue_t q, uint64_t handle, double timeout_s) {
+  return static_cast<pd::BlockingQueue*>(q)->Push(handle, timeout_s);
+}
+
+int pd_queue_pop(pd_queue_t q, uint64_t* handle, double timeout_s) {
+  return static_cast<pd::BlockingQueue*>(q)->Pop(handle, timeout_s);
+}
+
+void pd_queue_close(pd_queue_t q) {
+  static_cast<pd::BlockingQueue*>(q)->Close();
+}
+
+int pd_queue_size(pd_queue_t q) {
+  return static_cast<pd::BlockingQueue*>(q)->Size();
+}
+
+int pd_queue_is_closed(pd_queue_t q) {
+  return static_cast<pd::BlockingQueue*>(q)->Closed() ? 1 : 0;
+}
+
+}  // extern "C"

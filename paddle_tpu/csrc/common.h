@@ -1,0 +1,25 @@
+// Shared internals for the native runtime (ref: paddle/common/enforce.h).
+#ifndef PD_COMMON_H_
+#define PD_COMMON_H_
+
+#include <cstdarg>
+#include <cstdio>
+#include <string>
+
+namespace pd {
+
+// Per-thread last-error slot surfaced through pd_last_error().
+std::string& last_error_slot();
+
+inline void set_last_error(const char* fmt, ...) {
+  char buf[1024];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  last_error_slot() = buf;
+}
+
+}  // namespace pd
+
+#endif  // PD_COMMON_H_

@@ -1,0 +1,140 @@
+// Typed flag registry with env override (ref: paddle/common/flags.cc,
+// PHI_DEFINE_EXPORTED_*).  Values are stored as strings; typing lives in the
+// Python layer, which mirrors the reference where FLAGS parse from env text.
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "common.h"
+#include "pd_runtime.h"
+
+namespace pd {
+
+std::string& last_error_slot() {
+  static thread_local std::string slot;
+  return slot;
+}
+
+namespace {
+
+struct FlagEntry {
+  std::string def;
+  std::string help;
+  std::string value;  // runtime override; empty + !has_value means unset
+  bool has_value = false;
+};
+
+std::mutex g_mu;
+std::map<std::string, FlagEntry>& registry() {
+  static std::map<std::string, FlagEntry> r;
+  return r;
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char tmp[8];
+          snprintf(tmp, sizeof(tmp), "\\u%04x", c);
+          out += tmp;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+}  // namespace
+}  // namespace pd
+
+extern "C" {
+
+int pd_runtime_abi_version(void) { return PD_RUNTIME_ABI_VERSION; }
+
+const char* pd_last_error(void) { return pd::last_error_slot().c_str(); }
+
+int pd_flag_define(const char* name, const char* default_value,
+                   const char* help) {
+  if (!name || !default_value) {
+    pd::set_last_error("pd_flag_define: null name/default");
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(pd::g_mu);
+  auto& e = pd::registry()[name];
+  e.def = default_value;
+  e.help = help ? help : "";
+  return 0;
+}
+
+int pd_flag_set(const char* name, const char* value) {
+  if (!name || !value) {
+    pd::set_last_error("pd_flag_set: null name/value");
+    return -1;
+  }
+  std::lock_guard<std::mutex> lk(pd::g_mu);
+  auto it = pd::registry().find(name);
+  if (it == pd::registry().end()) {
+    pd::set_last_error("unknown flag: %s", name);
+    return -2;
+  }
+  it->second.value = value;
+  it->second.has_value = true;
+  return 0;
+}
+
+const char* pd_flag_get(const char* name) {
+  static thread_local std::string out;
+  if (!name) return nullptr;
+  std::lock_guard<std::mutex> lk(pd::g_mu);
+  auto it = pd::registry().find(name);
+  if (it == pd::registry().end()) return nullptr;
+  if (it->second.has_value) {
+    out = it->second.value;
+    return out.c_str();
+  }
+  std::string env_name = std::string("FLAGS_") + name;
+  if (const char* env = std::getenv(env_name.c_str())) {
+    out = env;
+    return out.c_str();
+  }
+  out = it->second.def;
+  return out.c_str();
+}
+
+int pd_flags_list(char* buf, int cap) {
+  std::string json = "{";
+  {
+    std::lock_guard<std::mutex> lk(pd::g_mu);
+    bool first = true;
+    for (auto& kv : pd::registry()) {
+      if (!first) json += ",";
+      first = false;
+      const std::string cur =
+          kv.second.has_value ? kv.second.value : kv.second.def;
+      json += "\"" + pd::json_escape(kv.first) + "\":{\"value\":\"" +
+              pd::json_escape(cur) + "\",\"default\":\"" +
+              pd::json_escape(kv.second.def) + "\",\"help\":\"" +
+              pd::json_escape(kv.second.help) + "\"}";
+    }
+  }
+  json += "}";
+  if (buf && cap > 0) {
+    int n = static_cast<int>(json.size());
+    int w = n < cap - 1 ? n : cap - 1;
+    memcpy(buf, json.data(), w);
+    buf[w] = '\0';
+  }
+  return static_cast<int>(json.size());
+}
+
+}  // extern "C"

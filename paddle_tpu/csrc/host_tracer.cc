@@ -1,0 +1,161 @@
+// Host-side span tracer with chrome-trace export
+// (ref: paddle/fluid/platform/profiler/host_tracer.cc, RecordEvent,
+//  chrometracing_logger.cc).  The device side on TPU comes from the XLA
+// profiler (xplane -> TensorBoard/Perfetto); this covers the host: Python-op
+// dispatch, DataLoader, checkpoint threads.  Export merges into one
+// chrome://tracing JSON the Python profiler can also hand to perfetto.
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "pd_runtime.h"
+
+namespace pd {
+namespace {
+
+enum class EventType : uint8_t { kSpan, kInstant, kCounter };
+
+struct Event {
+  EventType type;
+  std::string name;
+  uint64_t begin_ns;
+  uint64_t end_ns;   // spans only
+  double value;      // counters only
+  uint32_t tid;
+};
+
+uint64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::atomic<bool> g_recording{false};
+std::mutex g_mu;
+std::vector<Event> g_events;
+std::atomic<uint32_t> g_next_tid{0};
+
+struct ThreadState {
+  uint32_t tid;
+  // Stack of open spans (name, begin) so begin/end nest per-thread.
+  std::vector<std::pair<std::string, uint64_t>> open;
+  ThreadState() : tid(g_next_tid.fetch_add(1)) {}
+};
+
+ThreadState& tls() {
+  static thread_local ThreadState s;
+  return s;
+}
+
+void emit(Event e) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_events.size() < (1u << 22)) g_events.push_back(std::move(e));
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += c;
+    } else if (static_cast<unsigned char>(c) >= 0x20) {
+      out += c;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+}  // namespace pd
+
+extern "C" {
+
+void pd_tracer_start(void) { pd::g_recording.store(true); }
+
+void pd_tracer_stop(void) { pd::g_recording.store(false); }
+
+int pd_tracer_is_recording(void) { return pd::g_recording.load() ? 1 : 0; }
+
+void pd_tracer_clear(void) {
+  std::lock_guard<std::mutex> lk(pd::g_mu);
+  pd::g_events.clear();
+}
+
+void pd_trace_begin(const char* name) {
+  // Push unconditionally so begin/end stay paired even when spans straddle a
+  // tracer start/stop boundary; filtering happens at end time.
+  pd::tls().open.emplace_back(name ? name : "", pd::now_ns());
+}
+
+void pd_trace_end(void) {
+  auto& st = pd::tls();
+  if (st.open.empty()) return;
+  auto [name, begin] = st.open.back();
+  st.open.pop_back();
+  if (!pd::g_recording.load()) return;
+  pd::emit({pd::EventType::kSpan, std::move(name), begin, pd::now_ns(), 0.0,
+            st.tid});
+}
+
+void pd_trace_instant(const char* name) {
+  if (!pd::g_recording.load()) return;
+  pd::emit({pd::EventType::kInstant, name ? name : "", pd::now_ns(), 0, 0.0,
+            pd::tls().tid});
+}
+
+void pd_trace_counter(const char* name, double value) {
+  if (!pd::g_recording.load()) return;
+  pd::emit({pd::EventType::kCounter, name ? name : "", pd::now_ns(), 0, value,
+            pd::tls().tid});
+}
+
+int pd_tracer_export(char* buf, int cap) {
+  std::string json = "{\"traceEvents\":[";
+  {
+    std::lock_guard<std::mutex> lk(pd::g_mu);
+    bool first = true;
+    char num[128];
+    for (const auto& e : pd::g_events) {
+      if (!first) json += ",";
+      first = false;
+      double ts_us = e.begin_ns / 1000.0;
+      // Compose with std::string so arbitrarily long names can't truncate
+      // the JSON mid-object.
+      switch (e.type) {
+        case pd::EventType::kSpan:
+          snprintf(num, sizeof(num), "\"pid\":0,\"tid\":%u,\"ts\":%.3f,"
+                   "\"dur\":%.3f}", e.tid, ts_us,
+                   (e.end_ns - e.begin_ns) / 1000.0);
+          json += "{\"ph\":\"X\",\"name\":\"" + pd::json_escape(e.name) +
+                  "\"," + num;
+          break;
+        case pd::EventType::kInstant:
+          snprintf(num, sizeof(num), "\"pid\":0,\"tid\":%u,\"ts\":%.3f,"
+                   "\"s\":\"t\"}", e.tid, ts_us);
+          json += "{\"ph\":\"i\",\"name\":\"" + pd::json_escape(e.name) +
+                  "\"," + num;
+          break;
+        case pd::EventType::kCounter:
+          snprintf(num, sizeof(num), "\"pid\":0,\"tid\":%u,\"ts\":%.3f,"
+                   "\"args\":{\"value\":%g}}", e.tid, ts_us, e.value);
+          json += "{\"ph\":\"C\",\"name\":\"" + pd::json_escape(e.name) +
+                  "\"," + num;
+          break;
+      }
+    }
+  }
+  json += "]}";
+  if (buf && cap > 0) {
+    int n = static_cast<int>(json.size());
+    int w = n < cap - 1 ? n : cap - 1;
+    memcpy(buf, json.data(), w);
+    buf[w] = '\0';
+  }
+  return static_cast<int>(json.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+/* C API for the paddle_tpu native runtime (libpd_runtime.so).
+ *
+ * TPU-native counterpart of the reference's native runtime surface
+ * (ref: paddle/common/flags.cc, paddle/fluid/memory/allocation/,
+ *  paddle/phi/core/distributed/store/tcp_store.cc,
+ *  paddle/fluid/platform/profiler/).  Device memory itself is owned by
+ * PJRT/XLA on TPU; this runtime owns everything around it: host staging
+ * memory (the pinned-buffer-pool analog feeding host->HBM transfers),
+ * prefetch queues, the multi-host rendezvous store, flags, and host tracing.
+ *
+ * Exposed over a plain C ABI so Python binds via ctypes (no pybind11 in the
+ * image).  All functions are thread-safe unless noted.
+ */
+#ifndef PD_RUNTIME_H_
+#define PD_RUNTIME_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PD_RUNTIME_ABI_VERSION 1
+
+int pd_runtime_abi_version(void);
+
+/* ---------------- error reporting ----------------
+ * Functions returning int use 0 = OK, negative = error.  The last error
+ * message for the calling thread is retrievable here. */
+const char* pd_last_error(void);
+
+/* ---------------- flags (ref: paddle/common/flags.cc) ---------------- */
+int pd_flag_define(const char* name, const char* default_value,
+                   const char* help);
+int pd_flag_set(const char* name, const char* value);
+/* Returns value string (env FLAGS_<name> overrides default) or NULL if the
+ * flag is unknown.  Pointer valid until the next pd_flag_get on this thread. */
+const char* pd_flag_get(const char* name);
+/* Writes a JSON object {name: {value, default, help}} into buf.  Returns the
+ * number of bytes required (excluding NUL); if > cap, buf holds a truncated
+ * string. */
+int pd_flags_list(char* buf, int cap);
+
+/* ------------- host allocator (ref: AutoGrowthBestFitAllocator) -------------
+ * Best-fit caching allocator over malloc'd chunks; serves the host staging
+ * arena for DataLoader batches so buffers are reused instead of churned. */
+typedef void* pd_allocator_t;
+pd_allocator_t pd_allocator_create(uint64_t chunk_bytes);
+void pd_allocator_destroy(pd_allocator_t a);
+void* pd_alloc(pd_allocator_t a, uint64_t nbytes);
+void pd_free(pd_allocator_t a, void* ptr);
+/* allocated = live bytes handed out, reserved = bytes malloc'd from the OS,
+ * peak = high-water mark of allocated. */
+void pd_allocator_stats(pd_allocator_t a, uint64_t* allocated,
+                        uint64_t* reserved, uint64_t* peak);
+/* Release fully-free chunks back to the OS; returns bytes released. */
+uint64_t pd_allocator_release_free(pd_allocator_t a);
+
+/* ------------- blocking queue (ref: the reader blocking queue used by
+ * paddle/fluid/operators/reader + python/paddle/io prefetch) -------------
+ * Bounded MPMC queue of opaque uint64 handles. */
+typedef void* pd_queue_t;
+pd_queue_t pd_queue_create(int capacity);
+void pd_queue_destroy(pd_queue_t q);
+/* 0 = ok, -1 = timeout, -2 = closed.  timeout_s < 0 means block forever. */
+int pd_queue_push(pd_queue_t q, uint64_t handle, double timeout_s);
+int pd_queue_pop(pd_queue_t q, uint64_t* handle, double timeout_s);
+void pd_queue_close(pd_queue_t q);
+int pd_queue_size(pd_queue_t q);
+int pd_queue_is_closed(pd_queue_t q);
+
+/* ------------- TCP store (ref: phi/core/distributed/store/tcp_store.cc) ----
+ * Key/value rendezvous + barrier substrate for multi-host bootstrap, the
+ * launch CLI, and elastic heartbeats. */
+typedef void* pd_store_server_t;
+typedef void* pd_store_client_t;
+/* port 0 picks an ephemeral port (query with pd_store_server_port). */
+pd_store_server_t pd_store_server_start(int port);
+int pd_store_server_port(pd_store_server_t s);
+void pd_store_server_stop(pd_store_server_t s);
+
+pd_store_client_t pd_store_client_connect(const char* host, int port,
+                                          double timeout_s);
+void pd_store_client_close(pd_store_client_t c);
+int pd_store_set(pd_store_client_t c, const char* key, const uint8_t* val,
+                 int len);
+/* Returns value length (may exceed cap; bytes up to cap are written), or
+ * -1 on wait-timeout, -3 on connection error. timeout_s < 0 blocks forever
+ * until the key exists. */
+int pd_store_get(pd_store_client_t c, const char* key, uint8_t* buf, int cap,
+                 double timeout_s);
+/* Atomic add to an integer-valued key (created as 0); returns new value
+ * (INT64_MIN on error). */
+int64_t pd_store_add(pd_store_client_t c, const char* key, int64_t delta);
+/* 0 once key exists, -1 on timeout. */
+int pd_store_wait(pd_store_client_t c, const char* key, double timeout_s);
+int pd_store_delete(pd_store_client_t c, const char* key);
+int pd_store_num_keys(pd_store_client_t c);
+
+/* ------------- host tracer (ref: paddle/fluid/platform/profiler) ------- */
+void pd_tracer_start(void);
+void pd_tracer_stop(void);
+int pd_tracer_is_recording(void);
+void pd_tracer_clear(void);
+/* Begin/end nest per-thread; end closes the innermost open span. */
+void pd_trace_begin(const char* name);
+void pd_trace_end(void);
+void pd_trace_instant(const char* name);
+void pd_trace_counter(const char* name, double value);
+/* Chrome-trace JSON. Returns bytes required (excluding NUL); truncates at
+ * cap. Call with cap=0 to size the buffer. */
+int pd_tracer_export(char* buf, int cap);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* PD_RUNTIME_H_ */

@@ -1,0 +1,414 @@
+// TCP key/value store for multi-host rendezvous
+// (ref: paddle/phi/core/distributed/store/tcp_store.cc + tcp_utils.cc).
+//
+// The reference bootstraps ProcessGroupNCCL by exchanging NCCL uniqueIds
+// through this store.  The TPU build has no NCCL, but the same substrate
+// drives: launch-CLI rank rendezvous/barriers, elastic heartbeats (keys acting
+// as TTL-free liveness counters), and user-level Store APIs.
+//
+// Wire protocol (all little-endian):
+//   request : u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
+//   response: i64 status | u32 len | payload
+// Commands: 1=SET 2=GET 3=ADD(value=i64 delta) 4=WAIT(value=f64 timeout)
+//           5=DEL 6=NUMKEYS 7=GET_WAIT(value=f64 timeout)
+// GET_WAIT blocks server-side until the key exists (or timeout -> status -1).
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "pd_runtime.h"
+
+namespace pd {
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 1,
+  kGet = 2,
+  kAdd = 3,
+  kWait = 4,
+  kDel = 5,
+  kNumKeys = 6,
+  kGetWait = 7,
+};
+
+bool send_all(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) {
+      set_last_error("store server: socket() failed: %s", strerror(errno));
+      return false;
+    }
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      set_last_error("store server: bind(%d) failed: %s", port_,
+                     strerror(errno));
+      ::close(listen_fd_);
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    if (::listen(listen_fd_, 128) < 0) {
+      set_last_error("store server: listen failed: %s", strerror(errno));
+      ::close(listen_fd_);
+      return false;
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    if (stopping_.exchange(true)) return;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      cv_.notify_all();
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      workers.swap(workers_);
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping_.load()) return;
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(mu_);
+      conn_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopping_.load()) {
+      uint8_t cmd;
+      uint32_t klen, vlen;
+      if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &klen, 4)) break;
+      if (klen > (64u << 10)) break;
+      std::string key(klen, '\0');
+      if (klen && !recv_all(fd, &key[0], klen)) break;
+      if (!recv_all(fd, &vlen, 4)) break;
+      if (vlen > (256u << 20)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !recv_all(fd, &val[0], vlen)) break;
+
+      int64_t status = 0;
+      std::string payload;
+      switch (cmd) {
+        case kSet: {
+          std::lock_guard<std::mutex> lk(mu_);
+          data_[key] = val;
+          cv_.notify_all();
+          break;
+        }
+        case kGet: {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = data_.find(key);
+          if (it == data_.end())
+            status = -2;
+          else
+            payload = it->second;
+          break;
+        }
+        case kGetWait:
+        case kWait: {
+          double timeout_s;
+          memcpy(&timeout_s, val.data(), sizeof(double));
+          std::unique_lock<std::mutex> lk(mu_);
+          auto pred = [&] {
+            return stopping_.load() || data_.count(key) > 0;
+          };
+          bool ok;
+          if (timeout_s < 0) {
+            cv_.wait(lk, pred);
+            ok = data_.count(key) > 0;
+          } else {
+            ok = cv_.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                              pred) &&
+                 data_.count(key) > 0;
+          }
+          if (!ok)
+            status = -1;
+          else if (cmd == kGetWait)
+            payload = data_[key];
+          break;
+        }
+        case kAdd: {
+          int64_t delta;
+          memcpy(&delta, val.data(), sizeof(int64_t));
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = data_.find(key);
+          if (it != data_.end() && it->second.size() == sizeof(int64_t))
+            memcpy(&cur, it->second.data(), sizeof(int64_t));
+          cur += delta;
+          std::string enc(sizeof(int64_t), '\0');
+          memcpy(&enc[0], &cur, sizeof(int64_t));
+          data_[key] = enc;
+          cv_.notify_all();
+          payload = enc;
+          break;
+        }
+        case kDel: {
+          std::lock_guard<std::mutex> lk(mu_);
+          status = data_.erase(key) ? 0 : -2;
+          break;
+        }
+        case kNumKeys: {
+          std::lock_guard<std::mutex> lk(mu_);
+          status = static_cast<int64_t>(data_.size());
+          break;
+        }
+        default:
+          status = -3;
+      }
+      uint32_t plen = static_cast<uint32_t>(payload.size());
+      char hdr[12];
+      memcpy(hdr, &status, 8);
+      memcpy(hdr + 8, &plen, 4);
+      if (!send_all(fd, hdr, 12)) break;
+      if (plen && !send_all(fd, payload.data(), plen)) break;
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+  std::vector<int> conn_fds_;
+  std::vector<std::thread> workers_;
+};
+
+class StoreClient {
+ public:
+  bool Connect(const std::string& host, int port, double timeout_s) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration_cast<std::chrono::nanoseconds>(
+                        std::chrono::duration<double>(
+                            timeout_s < 0 ? 3600.0 : timeout_s));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      addrinfo hints{};
+      hints.ai_family = AF_INET;
+      hints.ai_socktype = SOCK_STREAM;
+      addrinfo* res = nullptr;
+      if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res) {
+        set_last_error("store client: cannot resolve host %s", host.c_str());
+        return false;
+      }
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    }
+    // Retry until the server is up (rendezvous races are normal).
+    while (true) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd_ >= 0 &&
+          ::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+              0) {
+        int one = 1;
+        setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      if (fd_ >= 0) ::close(fd_);
+      fd_ = -1;
+      if (std::chrono::steady_clock::now() > deadline) {
+        set_last_error("store client: connect %s:%d timed out", host.c_str(),
+                       port);
+        return false;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  // Returns status; payload (if any) in *out.
+  int64_t Request(uint8_t cmd, const std::string& key, const std::string& val,
+                  std::string* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    uint32_t vlen = static_cast<uint32_t>(val.size());
+    std::string msg;
+    msg.reserve(9 + klen + vlen);
+    msg.push_back(static_cast<char>(cmd));
+    msg.append(reinterpret_cast<char*>(&klen), 4);
+    msg.append(key);
+    msg.append(reinterpret_cast<char*>(&vlen), 4);
+    msg.append(val);
+    if (!send_all(fd_, msg.data(), msg.size())) return -3;
+    char hdr[12];
+    if (!recv_all(fd_, hdr, 12)) return -3;
+    int64_t status;
+    uint32_t plen;
+    memcpy(&status, hdr, 8);
+    memcpy(&plen, hdr + 8, 4);
+    std::string payload(plen, '\0');
+    if (plen && !recv_all(fd_, &payload[0], plen)) return -3;
+    if (out) *out = std::move(payload);
+    return status;
+  }
+
+ private:
+  std::mutex mu_;
+  int fd_ = -1;
+};
+
+std::string encode_f64(double v) {
+  std::string s(sizeof(double), '\0');
+  memcpy(&s[0], &v, sizeof(double));
+  return s;
+}
+
+}  // namespace
+}  // namespace pd
+
+extern "C" {
+
+pd_store_server_t pd_store_server_start(int port) {
+  auto* s = new pd::StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pd_store_server_port(pd_store_server_t s) {
+  return static_cast<pd::StoreServer*>(s)->port();
+}
+
+void pd_store_server_stop(pd_store_server_t s) {
+  auto* srv = static_cast<pd::StoreServer*>(s);
+  srv->Stop();
+  delete srv;
+}
+
+pd_store_client_t pd_store_client_connect(const char* host, int port,
+                                          double timeout_s) {
+  auto* c = new pd::StoreClient();
+  if (!c->Connect(host ? host : "127.0.0.1", port, timeout_s)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pd_store_client_close(pd_store_client_t c) {
+  delete static_cast<pd::StoreClient*>(c);
+}
+
+int pd_store_set(pd_store_client_t c, const char* key, const uint8_t* val,
+                 int len) {
+  std::string v(reinterpret_cast<const char*>(val), len);
+  return static_cast<int>(
+      static_cast<pd::StoreClient*>(c)->Request(pd::kSet, key, v, nullptr));
+}
+
+int pd_store_get(pd_store_client_t c, const char* key, uint8_t* buf, int cap,
+                 double timeout_s) {
+  std::string payload;
+  int64_t status = static_cast<pd::StoreClient*>(c)->Request(
+      pd::kGetWait, key, pd::encode_f64(timeout_s), &payload);
+  if (status < 0) return static_cast<int>(status);
+  int n = static_cast<int>(payload.size());
+  if (buf && cap > 0) memcpy(buf, payload.data(), n < cap ? n : cap);
+  return n;
+}
+
+int64_t pd_store_add(pd_store_client_t c, const char* key, int64_t delta) {
+  std::string enc(sizeof(int64_t), '\0');
+  memcpy(&enc[0], &delta, sizeof(int64_t));
+  std::string payload;
+  int64_t status =
+      static_cast<pd::StoreClient*>(c)->Request(pd::kAdd, key, enc, &payload);
+  if (status < 0 || payload.size() != sizeof(int64_t)) return INT64_MIN;
+  int64_t out;
+  memcpy(&out, payload.data(), sizeof(int64_t));
+  return out;
+}
+
+int pd_store_wait(pd_store_client_t c, const char* key, double timeout_s) {
+  return static_cast<int>(static_cast<pd::StoreClient*>(c)->Request(
+      pd::kWait, key, pd::encode_f64(timeout_s), nullptr));
+}
+
+int pd_store_delete(pd_store_client_t c, const char* key) {
+  return static_cast<int>(
+      static_cast<pd::StoreClient*>(c)->Request(pd::kDel, key, "", nullptr));
+}
+
+int pd_store_num_keys(pd_store_client_t c) {
+  return static_cast<int>(static_cast<pd::StoreClient*>(c)->Request(
+      pd::kNumKeys, "", "", nullptr));
+}
+
+}  // extern "C"

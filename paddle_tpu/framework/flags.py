@@ -28,6 +28,10 @@ def define_flag(name: str, default, help_str: str = "", parser: Callable | None 
             parser = str
     _REGISTRY[name] = {"default": default, "help": help_str,
                        "parser": parser, "value": None}
+    # Mirror into the native registry so C++ code reads the same flags
+    # (ref: the reference's FLAGS_* are visible on both sides of pybind).
+    from .. import runtime as _rt
+    _rt.mirror_flag_define(name, default, help_str)
 
 
 def get_flags(names) -> Dict[str, Any]:
@@ -51,10 +55,12 @@ def get_flag(name: str):
 
 
 def set_flags(flags: Dict[str, Any]):
+    from .. import runtime as _rt
     for k, v in flags.items():
         if k not in _REGISTRY:
             raise KeyError(f"unknown flag: {k}")
         _REGISTRY[k]["value"] = v
+        _rt.mirror_flag_set(k, v)
 
 
 # Core flags (TPU-relevant subset of the reference's surface).

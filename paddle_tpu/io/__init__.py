@@ -242,46 +242,71 @@ def default_collate_fn(batch):
     return batch
 
 
+def _prefetch_worker(q, idx_q, dataset, collate):
+    while True:
+        try:
+            idxs = idx_q.get_nowait()
+        except _queue.Empty:
+            return
+        samples = [dataset[i] for i in idxs]
+        try:
+            q.push(collate(samples), timeout=-1.0)
+        except RuntimeError:  # consumer closed the queue mid-epoch
+            return
+
+
 class _DataLoaderIter:
+    """Prefetching iterator.  With num_workers > 0, worker threads collate
+    batches and feed the native runtime's C++ blocking queue (backpressure and
+    blocking happen off-GIL; ref: the reader BlockingQueue the reference's
+    DataLoader feeds through paddle/fluid/operators/reader/)."""
+
     def __init__(self, loader):
+        from .. import runtime as _rt
         self.loader = loader
         self.batch_iter = iter(loader.batch_sampler)
         self.collate = loader.collate_fn or default_collate_fn
         self.dataset = loader.dataset
-        self._exhausted = False
         if loader.num_workers > 0:
-            self.q = _queue.Queue(maxsize=max(2, loader.prefetch_factor))
+            self.q = _rt.BlockingQueue(
+                capacity=max(2, loader.prefetch_factor * loader.num_workers))
             self.idx_q = _queue.Queue()
             for b in self.batch_iter:
                 self.idx_q.put(b)
             self.n_batches = self.idx_q.qsize()
             self.n_got = 0
-            self.workers = [threading.Thread(target=self._worker, daemon=True)
-                            for _ in range(loader.num_workers)]
+            # Workers capture only what they need — never `self` — so an
+            # abandoned iterator stays collectible; __del__ then closes the
+            # queue, which unblocks any worker stuck in push().
+            self.workers = [
+                threading.Thread(
+                    target=_prefetch_worker,
+                    args=(self.q, self.idx_q, self.dataset, self.collate),
+                    daemon=True)
+                for _ in range(loader.num_workers)]
             for w in self.workers:
                 w.start()
-
-    def _worker(self):
-        while True:
-            try:
-                idxs = self.idx_q.get_nowait()
-            except _queue.Empty:
-                return
-            samples = [self.dataset[i] for i in idxs]
-            self.q.put(self.collate(samples))
 
     def __next__(self):
         if self.loader.num_workers > 0:
             if self.n_got >= self.n_batches:
+                self.q.close()
                 raise StopIteration
             self.n_got += 1
-            return self.q.get()
+            return self.q.pop(timeout=-1.0)
         idxs = next(self.batch_iter)
         samples = [self.dataset[i] for i in idxs]
         return self.collate(samples)
 
     def __iter__(self):
         return self
+
+    def __del__(self):
+        if getattr(self, "q", None) is not None:
+            try:
+                self.q.close()
+            except Exception:
+                pass
 
 
 class _IterableLoaderIter:

@@ -18,6 +18,8 @@ from typing import Callable, Iterable, Optional
 
 import jax
 
+from .. import runtime as _rt
+
 
 class ProfilerState(Enum):
     CLOSED = 0
@@ -80,6 +82,7 @@ class RecordEvent:
 
     def begin(self):
         self._t0 = time.perf_counter_ns()
+        _rt.trace_begin(self.name)  # native host tracer (no-op if not recording)
         try:
             self._jx = jax.profiler.TraceAnnotation(self.name)
             self._jx.__enter__()
@@ -90,6 +93,7 @@ class RecordEvent:
         t1 = time.perf_counter_ns()
         if self._jx is not None:
             self._jx.__exit__(None, None, None)
+        _rt.trace_end()
         with _host_lock:
             _host_events.append((self.name, self._t0, t1,
                                  threading.get_ident()))
@@ -117,6 +121,9 @@ class Profiler:
         self.stop()
 
     def start(self):
+        # New profiling session: drop spans accumulated by earlier sessions
+        # (the native buffer is process-global).
+        _rt.tracer_clear()
         self._state = (self._scheduler(self._step) if self._scheduler
                        else ProfilerState.RECORD)
         if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
@@ -126,6 +133,7 @@ class Profiler:
     def _start_jax(self):
         if self._active:
             return
+        _rt.tracer_start()
         self._logdir = self._export_dir or "/tmp/paddle_tpu_profile"
         os.makedirs(self._logdir, exist_ok=True)
         try:
@@ -135,6 +143,7 @@ class Profiler:
             self._active = False
 
     def _stop_jax(self):
+        _rt.tracer_stop()
         if self._active:
             try:
                 jax.profiler.stop_trace()
@@ -165,12 +174,21 @@ class Profiler:
     def export(self, path: str, format: str = "json"):
         """Export collected host spans as chrome trace JSON (device timeline
         lives in the jax trace dir for TensorBoard/Perfetto)."""
+        # The native host tracer sees every RecordEvent span while recording;
+        # the Python-side _host_events list is the fallback for spans emitted
+        # while the tracer was off (timer_only mode). Prefer the native trace
+        # to avoid double-counting the same span.
         events = []
-        with _host_lock:
-            for name, t0, t1, tid in _host_events:
-                events.append({"name": name, "ph": "X", "ts": t0 / 1000.0,
-                               "dur": (t1 - t0) / 1000.0, "pid": 0, "tid": tid,
-                               "cat": "host"})
+        try:
+            events = json.loads(_rt.tracer_export())["traceEvents"]
+        except Exception:
+            pass
+        if not events:
+            with _host_lock:
+                for name, t0, t1, tid in _host_events:
+                    events.append({"name": name, "ph": "X", "ts": t0 / 1000.0,
+                                   "dur": (t1 - t0) / 1000.0, "pid": 0,
+                                   "tid": tid, "cat": "host"})
         with open(path, "w") as f:
             json.dump({"traceEvents": events}, f)
 

@@ -1,0 +1,208 @@
+"""Native runtime tests (csrc/ + paddle_tpu/runtime).
+
+Covers the C++ allocator / blocking queue / TCP store / tracer through the
+ctypes bindings, plus the pure-Python fallback store speaking the same wire
+protocol (interop both directions).
+"""
+import json
+import queue as pyqueue
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import runtime as rt
+
+
+_needs_native = pytest.mark.skipif(
+    not rt.available(), reason="native runtime disabled/unavailable")
+
+
+def test_native_lib_loads():
+    # The image has g++, so the native path must be live (fallbacks are for
+    # degraded environments only) — unless explicitly disabled.
+    import os
+    if os.environ.get("PD_DISABLE_NATIVE") == "1":
+        pytest.skip("native explicitly disabled")
+    assert rt.available(), rt.load_error()
+
+
+@_needs_native
+def test_allocator_roundtrip_and_stats():
+    a = rt.HostAllocator(chunk_bytes=1 << 20)
+    mv = a.alloc(1000)
+    assert len(mv) == 1000
+    mv[:4] = b"abcd"
+    arr = np.frombuffer(mv, dtype=np.uint8, count=4)
+    assert bytes(arr.tobytes()) == b"abcd"
+    st = a.stats()
+    assert st["allocated"] >= 1000
+    assert st["reserved"] >= 1 << 20
+    del arr
+    a.free(mv)
+    assert a.stats()["allocated"] == 0
+    assert a.stats()["peak"] >= 1000
+
+
+@_needs_native
+def test_allocator_reuses_freed_blocks():
+    a = rt.HostAllocator(chunk_bytes=1 << 20)
+    mvs = [a.alloc(4096) for _ in range(16)]
+    reserved_before = a.stats()["reserved"]
+    for mv in mvs:
+        a.free(mv)
+    # Second wave should come from the cache, not new chunks.
+    mvs = [a.alloc(4096) for _ in range(16)]
+    assert a.stats()["reserved"] == reserved_before
+    for mv in mvs:
+        a.free(mv)
+    assert a.release_free() >= 1 << 20
+    assert a.stats()["reserved"] == 0
+
+
+def test_blocking_queue_producer_consumer():
+    q = rt.BlockingQueue(capacity=4)
+    out = []
+
+    def consumer():
+        while True:
+            try:
+                out.append(q.pop(timeout=5.0))
+            except RuntimeError:
+                return
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(20):
+        assert q.push(("batch", i), timeout=5.0)
+    q.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert [x[1] for x in out] == list(range(20))
+
+
+def test_blocking_queue_backpressure_timeout():
+    q = rt.BlockingQueue(capacity=2)
+    assert q.push(1, timeout=1.0)
+    assert q.push(2, timeout=1.0)
+    t0 = time.monotonic()
+    assert not q.push(3, timeout=0.2)  # full -> timeout
+    assert time.monotonic() - t0 >= 0.15
+    with pytest.raises(pyqueue.Empty):
+        rt.BlockingQueue(capacity=1).pop(timeout=0.1)
+
+
+def test_tcp_store_basic():
+    srv = rt.TCPStoreServer()
+    c = rt.TCPStore("127.0.0.1", srv.port)
+    c.set("alpha", b"1")
+    assert c.get("alpha", timeout=5.0) == b"1"
+    assert c.add("ctr", 3) == 3
+    assert c.add("ctr", 4) == 7
+    assert c.num_keys() == 2
+    assert c.delete("alpha")
+    assert not c.delete("alpha")
+    with pytest.raises(TimeoutError):
+        c.get("missing", timeout=0.2)
+    c.close()
+    srv.stop()
+
+
+def test_tcp_store_wait_unblocks_on_set():
+    srv = rt.TCPStoreServer()
+    c1 = rt.TCPStore("127.0.0.1", srv.port)
+    c2 = rt.TCPStore("127.0.0.1", srv.port)
+    got = {}
+
+    def waiter():
+        got["v"] = c1.get("late", timeout=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    c2.set("late", b"payload")
+    t.join(timeout=10)
+    assert got["v"] == b"payload"
+    c1.close()
+    c2.close()
+    srv.stop()
+
+
+def test_tcp_store_large_value():
+    srv = rt.TCPStoreServer()
+    c = rt.TCPStore("127.0.0.1", srv.port)
+    big = bytes(np.random.RandomState(0).bytes(300_000))
+    c.set("big", big)
+    assert c.get("big", timeout=5.0) == big
+    c.close()
+    srv.stop()
+
+
+def test_tcp_store_cross_process():
+    srv = rt.TCPStoreServer()
+    code = (
+        "from paddle_tpu import runtime as rt;"
+        f"c = rt.TCPStore('127.0.0.1', {srv.port});"
+        "c.set('from_child', b'hello');"
+        "print(c.add('ranks', 1))"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    c = rt.TCPStore("127.0.0.1", srv.port)
+    assert c.get("from_child", timeout=5.0) == b"hello"
+    assert c.add("ranks", 1) == 2
+    c.close()
+    srv.stop()
+
+
+def test_python_fallback_store_interop():
+    """Pure-python client must speak to the native server (one wire format)."""
+    if not rt.available():
+        pytest.skip("needs the native server for the interop direction")
+    srv = rt.TCPStoreServer()  # native
+    code = (
+        "import os; os.environ['PD_DISABLE_NATIVE'] = '1';"
+        "from paddle_tpu import runtime as rt;"
+        "assert not rt.available();"
+        f"c = rt.TCPStore('127.0.0.1', {srv.port});"
+        "c.set('py', b'fallback');"
+        "assert c.get('py', timeout=5.0) == b'fallback';"
+        "assert c.add('n', 5) == 5"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    c = rt.TCPStore("127.0.0.1", srv.port)
+    assert c.get("py", timeout=5.0) == b"fallback"
+    srv.stop()
+
+
+def test_tracer_chrome_export():
+    rt.tracer_clear()
+    rt.tracer_start()
+    with rt.RecordSpan("outer"):
+        with rt.RecordSpan("inner"):
+            time.sleep(0.01)
+    rt.trace_instant("marker")
+    rt.trace_counter("loss", 1.25)
+    rt.tracer_stop()
+    trace = json.loads(rt.tracer_export())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "outer" in names and "inner" in names and "marker" in names
+    spans = {e["name"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert spans["inner"]["dur"] >= 10_000 * 0.5  # us
+    counter = [e for e in trace["traceEvents"] if e["ph"] == "C"][0]
+    assert counter["args"]["value"] == 1.25
+    rt.tracer_clear()
+
+
+def test_native_flags_mirror():
+    rt.mirror_flag_define("test_mirror_flag", "7", "test flag")
+    assert rt.native_flag_get("test_mirror_flag") in ("7", None)
+    rt.mirror_flag_set("test_mirror_flag", "9")
+    if rt.available():
+        assert rt.native_flag_get("test_mirror_flag") == "9"
