@@ -1,0 +1,10 @@
+"""Elastic / fault-tolerant training (ref: python/paddle/distributed/fleet/elastic/).
+
+The reference resizes jobs via etcd membership within [min_np, max_np].  A TPU
+slice cannot resize in place, so elasticity here means **failure detection +
+checkpoint-restart**: heartbeats through the rendezvous TCP store detect dead
+ranks; the launch controller (distributed/launch/) relaunches the node with
+``PADDLE_RESTART_ROUND`` bumped; training code resumes from the latest
+checkpoint (see distributed/checkpoint/).
+"""
+from .manager import ElasticManager, current_restart_round  # noqa: F401

@@ -1,0 +1,121 @@
+"""Heartbeat-based failure detection over the rendezvous store
+(ref: python/paddle/distributed/fleet/elastic/manager.py — etcd TTL leases
+there; TCP-store timestamps here).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from .... import runtime as rt
+
+
+def current_restart_round() -> int:
+    """Which elastic restart round this process is running in (0 = first
+    launch). Training scripts use this to decide whether to resume."""
+    return int(os.environ.get("PADDLE_RESTART_ROUND", "0"))
+
+
+class ElasticManager:
+    """Per-process heartbeat writer + peer watchdog.
+
+    Every ``interval`` seconds, writes ``{job}/hb/{rank}`` = monotonic-ish
+    wall time into the store.  The watchdog scans peers' heartbeats; a peer
+    stale by more than ``miss_threshold * interval`` triggers ``on_fault``
+    (default: ``os._exit(1)`` so the launch controller's restart loop takes
+    over — the whole-job restart is the TPU analog of an elastic scale event).
+    """
+
+    def __init__(self, rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 host: Optional[str] = None, port: Optional[int] = None,
+                 job_id: Optional[str] = None,
+                 interval: Optional[float] = None,
+                 miss_threshold: float = 3.0,
+                 on_fault: Optional[Callable[[int], None]] = None):
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = world_size if world_size is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        host = host or os.environ.get("PADDLE_MASTER", "127.0.0.1")
+        port = port if port is not None else int(
+            os.environ.get("MASTER_PORT", "0"))
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        self.interval = interval if interval is not None else float(
+            os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "5.0"))
+        self.miss_threshold = miss_threshold
+        self.on_fault = on_fault or self._default_fault
+        self._store = rt.TCPStore(host, port) if port else None
+        self._stop = threading.Event()
+        self._threads = []
+        self.dead_ranks: list[int] = []
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._store is None:
+            return
+        self._beat()  # register immediately so peers see us
+        t1 = threading.Thread(target=self._beat_loop, daemon=True)
+        t2 = threading.Thread(target=self._watch_loop, daemon=True)
+        self._threads = [t1, t2]
+        t1.start()
+        t2.start()
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- internals --------------------------------------------------------
+    def _key(self, rank: int) -> str:
+        return f"{self.job_id}/hb/{rank}"
+
+    def _beat(self):
+        try:
+            self._store.set(self._key(self.rank), repr(time.time()).encode())
+        except (ConnectionError, OSError):
+            pass  # store down: the controller is already tearing down
+
+    def _beat_loop(self):
+        while not self._stop.wait(self.interval):
+            self._beat()
+
+    def _watch_loop(self):
+        # Give peers one full interval to register before judging them.
+        if self._stop.wait(self.interval * 2):
+            return
+        while not self._stop.wait(self.interval):
+            now = time.time()
+            stale = []
+            for r in range(self.world_size):
+                if r == self.rank:
+                    continue
+                try:
+                    raw = self._store.get(self._key(r), timeout=1.0)
+                    last = float(raw.decode())
+                except TimeoutError:
+                    continue  # never registered yet
+                except (ConnectionError, OSError, ValueError):
+                    return
+                if now - last > self.miss_threshold * self.interval:
+                    stale.append(r)
+            if stale:
+                self.dead_ranks = stale
+                self.on_fault(stale[0])
+                return
+
+    def _default_fault(self, dead_rank: int):
+        import sys
+        print(f"[elastic] rank {self.rank}: peer rank {dead_rank} missed "
+              f"heartbeats; exiting for checkpoint-restart", file=sys.stderr)
+        os._exit(1)

@@ -1,0 +1,22 @@
+"""Distributed launcher (ref: python/paddle/distributed/launch/).
+
+``python -m paddle_tpu.distributed.launch [opts] train.py [args...]``
+
+TPU-native process model: ONE process per host joins the SPMD program (jax
+single-controller; devices on the host all belong to that process), unlike the
+reference's one-proc-per-GPU. ``--nproc_per_node`` therefore defaults to 1;
+values > 1 exist for CPU simulation (each proc gets its own virtual device
+count via XLA_FLAGS) and for tests.
+
+The node controller:
+  * rank-0 node starts the native TCPStore rendezvous server (runtime/,
+    csrc/tcp_store.cc) — the ProcessGroup bootstrap analog;
+  * every proc registers in the store and barriers before user code runs;
+  * children get ``PADDLE_TRAINER_ID`` / ``PADDLE_TRAINERS_NUM`` /
+    ``PADDLE_MASTER`` env (consumed by distributed/env.py init_parallel_env);
+  * the controller watches children, tears the job down on failure, and with
+    ``--max_restarts`` > 0 relaunches the whole node (checkpoint-restart
+    elasticity — a TPU slice cannot resize in place, so "elastic" means
+    restart + resume, see fleet/elastic/).
+"""
+from .controller import LaunchConfig, launch  # noqa: F401

@@ -1,0 +1,189 @@
+"""Node controller: spawn/monitor/restart per-rank processes
+(ref: python/paddle/distributed/launch/controllers/collective.py).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ... import runtime as rt
+
+
+@dataclass
+class LaunchConfig:
+    script: str = ""
+    script_args: List[str] = field(default_factory=list)
+    nproc_per_node: int = 1
+    nnodes: int = 1
+    node_rank: int = 0
+    master: Optional[str] = None      # "host:port"; None -> local ephemeral
+    job_id: str = "default"
+    log_dir: str = "log"
+    max_restarts: int = 0
+    devices: Optional[str] = None     # parity with --gpus/--devices
+    envs: dict = field(default_factory=dict)
+    # run module (python -m mod) instead of a script
+    run_module: bool = False
+    heartbeat_interval: float = 5.0
+
+
+class NodeController:
+    def __init__(self, cfg: LaunchConfig):
+        self.cfg = cfg
+        self.server = None
+        self.procs: List[subprocess.Popen] = []
+        self.log_files = []
+
+    # -- rendezvous bootstrap --------------------------------------------
+    def _start_master(self):
+        """Rank-0 node hosts the store. Its address is either fixed by
+        --master (multi-node) or an ephemeral local port (single node)."""
+        if self.cfg.master:
+            host, port = self.cfg.master.rsplit(":", 1)
+            if self.cfg.node_rank == 0:
+                self.server = rt.TCPStoreServer(int(port))
+            return host, int(port)
+        self.server = rt.TCPStoreServer()
+        return "127.0.0.1", self.server.port
+
+    # -- child env --------------------------------------------------------
+    def _child_env(self, local_rank: int, host: str, port: int,
+                   restart_round: int) -> dict:
+        world = self.cfg.nnodes * self.cfg.nproc_per_node
+        rank = self.cfg.node_rank * self.cfg.nproc_per_node + local_rank
+        env = dict(os.environ)
+        env.update(self.cfg.envs)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(self.cfg.nnodes),
+            "PADDLE_NODE_RANK": str(self.cfg.node_rank),
+            "PADDLE_MASTER": host,
+            "MASTER_ADDR": host,
+            "MASTER_PORT": str(port),
+            "PADDLE_JOB_ID": self.cfg.job_id,
+            "PADDLE_RESTART_ROUND": str(restart_round),
+            "PADDLE_ELASTIC_MAX_RESTARTS": str(self.cfg.max_restarts),
+            "PADDLE_HEARTBEAT_INTERVAL": str(self.cfg.heartbeat_interval),
+        })
+        if self.cfg.devices is not None:
+            env["PADDLE_SELECTED_DEVICES"] = self.cfg.devices
+        return env
+
+    # -- spawn ------------------------------------------------------------
+    def _spawn(self, host: str, port: int, restart_round: int):
+        os.makedirs(self.cfg.log_dir, exist_ok=True)
+        self.procs, self.log_files = [], []
+        for local_rank in range(self.cfg.nproc_per_node):
+            rank = (self.cfg.node_rank * self.cfg.nproc_per_node + local_rank)
+            cmd = [sys.executable]
+            if self.cfg.run_module:
+                cmd += ["-m", self.cfg.script]
+            else:
+                cmd += [self.cfg.script]
+            cmd += self.cfg.script_args
+            log_path = os.path.join(self.cfg.log_dir,
+                                    f"workerlog.{rank}")
+            # rank 0 tees to the controller's stdout like the reference.
+            if rank == 0:
+                lf = open(log_path, "wb")
+                p = subprocess.Popen(
+                    cmd, env=self._child_env(local_rank, host, port,
+                                             restart_round),
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            else:
+                lf = open(log_path, "wb")
+                p = subprocess.Popen(
+                    cmd, env=self._child_env(local_rank, host, port,
+                                             restart_round),
+                    stdout=lf, stderr=subprocess.STDOUT)
+            self.procs.append(p)
+            self.log_files.append(lf)
+
+    def _pump_rank0(self):
+        """Forward rank-0 output to our stdout AND its log file."""
+        p0 = self.procs[0]
+        if p0.stdout is None:
+            return
+        data = p0.stdout.read1(65536) if hasattr(p0.stdout, "read1") else b""
+        if data:
+            sys.stdout.buffer.write(data)
+            sys.stdout.buffer.flush()
+            self.log_files[0].write(data)
+            self.log_files[0].flush()
+
+    def _poll(self) -> Optional[int]:
+        """None while all alive; else the first nonzero exit code or 0."""
+        all_done = True
+        for p in self.procs:
+            rc = p.poll()
+            if rc is None:
+                all_done = False
+            elif rc != 0:
+                return rc
+        return 0 if all_done else None
+
+    def _terminate_all(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for lf in self.log_files:
+            try:
+                lf.close()
+            except Exception:
+                pass
+
+    # -- main loop --------------------------------------------------------
+    def run(self) -> int:
+        host, port = self._start_master()
+        restart_round = 0
+        try:
+            while True:
+                self._spawn(host, port, restart_round)
+                status = None
+                while status is None:
+                    self._pump_rank0()
+                    status = self._poll()
+                    if status is None:
+                        time.sleep(0.05)
+                self._pump_rank0()
+                self._terminate_all()
+                if status == 0:
+                    return 0
+                if restart_round >= self.cfg.max_restarts:
+                    print(f"[launch] job failed with exit code {status} "
+                          f"after {restart_round} restarts", file=sys.stderr)
+                    return status
+                restart_round += 1
+                print(f"[launch] worker failed (exit {status}); restart "
+                      f"{restart_round}/{self.cfg.max_restarts}",
+                      file=sys.stderr)
+                # Scrub job keys so the next round re-rendezvouses cleanly.
+                if self.server is not None:
+                    try:
+                        c = rt.TCPStore(host, port, timeout=5.0)
+                        c.set(f"{self.cfg.job_id}/restart_round",
+                              str(restart_round).encode())
+                        c.close()
+                    except Exception:
+                        pass
+        finally:
+            self._terminate_all()
+            if self.server is not None:
+                self.server.stop()
+
+
+def launch(cfg: LaunchConfig) -> int:
+    return NodeController(cfg).run()

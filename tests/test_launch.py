@@ -1,0 +1,111 @@
+"""Launch CLI + elastic manager tests (distributed/launch/, fleet/elastic/)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu import runtime as rt
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_launch(args, timeout=120):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch"] + args,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+
+
+def test_launch_two_procs_rendezvous(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "from paddle_tpu import runtime as rt\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "c = rt.TCPStore(os.environ['PADDLE_MASTER'],\n"
+        "                int(os.environ['MASTER_PORT']))\n"
+        "c.add('arrived', 1)\n"
+        "c.wait('arrived', timeout=30.0)\n"
+        "while c.add('arrived', 0) < world:\n"
+        "    import time; time.sleep(0.05)\n"
+        "print(f'rank {rank}/{world} ready')\n")
+    r = run_launch(["--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+                    str(script)])
+    assert r.returncode == 0, r.stderr
+    assert "rank 0/2 ready" in r.stdout
+    log1 = (tmp_path / "log" / "workerlog.1").read_text()
+    assert "rank 1/2 ready" in log1
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    r = run_launch(["--nproc_per_node=2", f"--log_dir={tmp_path}/log",
+                    str(script)])
+    assert r.returncode == 7
+
+
+def test_launch_elastic_restart_resumes(tmp_path):
+    """Round 0 fails after 'checkpointing'; round 1 resumes and succeeds."""
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "from paddle_tpu.distributed.fleet.elastic import current_restart_round\n"
+        f"ckpt = r'{tmp_path}/ckpt.txt'\n"
+        "rnd = current_restart_round()\n"
+        "if rnd == 0:\n"
+        "    open(ckpt, 'w').write('step=3')\n"
+        "    sys.exit(1)\n"
+        "state = open(ckpt).read()\n"
+        "print(f'resumed round={rnd} {state}')\n")
+    r = run_launch(["--nproc_per_node=1", "--max_restarts=2",
+                    f"--log_dir={tmp_path}/log", str(script)])
+    assert r.returncode == 0, r.stderr
+    assert "resumed round=1 step=3" in r.stdout
+    assert "restart 1/2" in r.stderr
+
+
+def test_launch_module_mode(tmp_path):
+    r = run_launch(["--nproc_per_node=1", f"--log_dir={tmp_path}/log",
+                    "-m", "json.tool", "--help"])
+    assert r.returncode == 0
+
+
+def test_elastic_manager_detects_dead_peer():
+    srv = rt.TCPStoreServer()
+    faults = []
+    m = ElasticManager(rank=0, world_size=2, host="127.0.0.1", port=srv.port,
+                       job_id="jtest", interval=0.2,
+                       on_fault=lambda dead: faults.append(dead))
+    # Fake rank 1: one heartbeat, then silence (simulates a crashed peer).
+    c = rt.TCPStore("127.0.0.1", srv.port)
+    c.set("jtest/hb/1", repr(time.time() - 100).encode())
+    m.start()
+    deadline = time.monotonic() + 10
+    while not faults and time.monotonic() < deadline:
+        time.sleep(0.05)
+    m.stop()
+    srv.stop()
+    assert faults == [1]
+
+
+def test_elastic_manager_healthy_peers_no_fault():
+    srv = rt.TCPStoreServer()
+    faults = []
+    managers = [
+        ElasticManager(rank=r, world_size=2, host="127.0.0.1", port=srv.port,
+                       job_id="jok", interval=0.2,
+                       on_fault=lambda dead: faults.append(dead))
+        for r in range(2)
+    ]
+    for m in managers:
+        m.start()
+    time.sleep(2.0)  # several watchdog cycles
+    for m in managers:
+        m.stop()
+    srv.stop()
+    assert faults == []
