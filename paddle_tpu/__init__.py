@@ -36,7 +36,8 @@ from . import autograd  # noqa: F401
 import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
-             "incubate", "ops", "profiler", "device", "hapi", "static"):
+             "incubate", "ops", "profiler", "device", "hapi", "static",
+             "inference", "runtime"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ImportError:
@@ -47,18 +48,22 @@ if "hapi" in globals():
 if "nn" in globals():
     from .nn.layer.layers import ParamAttr  # noqa: F401
 
-# dygraph-mode shims: this framework is always "dygraph" (eager over XLA)
+# dygraph/static mode switches (ref: paddle.enable_static / disable_static).
+# Eager is the default; static mode activates Program capture on the eager
+# dispatcher (see static/program.py).
 def in_dynamic_mode():
-    return True
+    from .static import program as _sp
+    return not _sp.in_static_mode()
 
 
 def disable_static(place=None):
-    return None
+    from .static import program as _sp
+    _sp.disable_static()
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is eager-first; use paddle_tpu.jit.to_static for compiled graphs")
+    from .static import program as _sp
+    _sp.enable_static()
 
 
 def is_grad_enabled_():

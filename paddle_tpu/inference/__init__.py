@@ -1,0 +1,152 @@
+"""Inference API (ref: paddle/fluid/inference/ AnalysisPredictor,
+ python/paddle/inference/).
+
+The reference's predictor runs analysis passes (op fusion, TensorRT subgraphs)
+over a saved program, then executes with zero-copy input/output handles.  The
+TPU-native analog: load the StableHLO artifact saved by ``jit.save`` /
+``static.save_inference_model`` — XLA performs the fusion/layout work the
+analysis passes did — and run it on the target device.  The handle-based API
+(get_input_handle / copy_from_cpu / run / get_output_handle) is preserved.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"      # parity alias; maps to the accelerator
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class Config:
+    """ref: paddle_infer.Config. Device/memory knobs that map to XLA are
+    honored; CUDA-specific ones are accepted and ignored."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_prefix = prog_file
+        self.params_file = params_file
+        self._device = "tpu" if any(
+            d.platform == "tpu" for d in jax.devices()) else "cpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+
+    # device selection
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device_id = device_id
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        pass  # XLA's buffer assignment already does this
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA fusion replaces IR passes
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # no TRT on TPU; XLA compiles the whole graph
+
+    def model_dir(self):
+        return os.path.dirname(self.model_prefix or "")
+
+
+class Tensor_:
+    """I/O handle (ref: paddle_infer.Tensor): name + staged host array."""
+
+    def __init__(self, name: str, shape=None, dtype=None):
+        self.name = name
+        self._shape = shape
+        self._dtype = dtype
+        self._value = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def shape(self):
+        v = self._value
+        return list(v.shape) if v is not None else list(self._shape or [])
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+        else:
+            self._shape = list(shape)
+
+
+class Predictor:
+    """ref: AnalysisPredictor via the handle API."""
+
+    def __init__(self, config: Config):
+        from ..static import load_inference_model
+        if config.model_prefix is None:
+            raise ValueError("Config needs a model path prefix")
+        self._model = load_inference_model(config.model_prefix)
+        self._inputs: Dict[str, Tensor_] = {
+            n: Tensor_(n) for n in self._model.feed_names}
+        self._outputs: List[np.ndarray] = []
+        self._out_names = [f"fetch_{i}"
+                           for i in range(self._model.meta["num_fetch"])]
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> Tensor_:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(a))
+        feeds = {n: h._value for n, h in self._inputs.items()}
+        missing = [n for n, v in feeds.items() if v is None]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        self._outputs = self._model.run(feeds)
+        if inputs is not None:
+            return [np.asarray(o) for o in self._outputs]
+        return None
+
+    def get_output_names(self) -> List[str]:
+        return list(self._out_names)
+
+    def get_output_handle(self, name: str) -> Tensor_:
+        idx = self._out_names.index(name)
+        h = Tensor_(name)
+        h._value = self._outputs[idx]
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]

@@ -2,23 +2,8 @@
 import os as _os
 
 from .functional import TracedLayer, functional_call, state_arrays, to_static
+from .save_load import TranslatedLayer, load, save
 from .train_step import TrainStep
-
-
-def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: persist params + a note that compilation is
-    trace-on-load (XLA has no stable serialized program format across
-    versions; params + code are the artifact)."""
-    from ..framework.io import save as _save
-    from ..nn.layer.layers import Layer
-    target = layer.layer if isinstance(layer, TracedLayer) else layer
-    _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
-    _save(target.state_dict(), path + ".pdparams")
-
-
-def load(path, **configs):
-    from ..framework.io import load as _load
-    return _load(path + ".pdparams")
 
 
 def not_to_static(fn):

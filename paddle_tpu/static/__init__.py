@@ -1,50 +1,163 @@
-"""Static-graph shims (ref: python/paddle/static/).
+"""Static-graph API (ref: python/paddle/static/).
 
-This framework is eager-first over XLA; `Program` exists for source
-compatibility and `save/load_inference_model` persist params + an input spec
-(the compiled artifact is re-traced on load; XLA has no stable cross-version
-serialized executable).
+TPU-native: ``Program`` captures the op stream flowing through the eager
+dispatcher while active (see program.py); ``Executor`` replays it under
+``jax.jit``.  ``save/load_inference_model`` persist a StableHLO artifact via
+``jax.export`` (plus params), the XLA-era analog of the reference's
+ProgramDesc+params files.
 """
 from __future__ import annotations
 
+import json
 import os
 
-from ..framework.io import load as _load
-from ..framework.io import save as _save
-from ..jit import to_static
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program import (Executor, Program, active_program,  # noqa: F401
+                      default_main_program, default_startup_program,
+                      disable_static, enable_static, in_static_mode,
+                      program_guard)
 
 
 class InputSpec:
     def __init__(self, shape=None, dtype="float32", name=None):
-        self.shape = shape
+        self.shape = list(shape) if shape is not None else None
         self.dtype = dtype
         self.name = name
 
     def __repr__(self):
-        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
-
-
-class Program:
-    def __init__(self):
-        self._ops = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """Declare a feed placeholder in the active program.
+
+    Eagerly materializes zeros (dynamic dims -> 1) so the build phase runs
+    shape-correctly once; Executor.run substitutes real feeds at replay.
+    """
+    from ..tensor.tensor import Tensor
+    from ..framework.dtype import convert_dtype
+    prog = active_program() or default_main_program()
+    concrete = [1 if (s is None or s < 0) else int(s) for s in shape]
+    t = Tensor(np.zeros(concrete, dtype=convert_dtype(dtype)))
+    t.name = name
+    t.stop_gradient = True
+    prog.add_feed(name, t)
+    return t
 
 
+def append_backward(loss, parameter_list=None):
+    """Static autodiff (ref: python/paddle/base/backward.py append_backward).
+
+    Returns [(param, grad_handle)] usable with
+    ``Executor.run(..., fetch_grads_of=[p for p, _ in pairs])`` — the grads are
+    computed by ``jax.grad`` over the replayed program instead of by appending
+    grad-op descs.
+    """
+    prog = active_program() or default_main_program()
+    if parameter_list is None:
+        parameter_list = [p for p in prog.param_tensors()
+                          if not p.stop_gradient]
+    return [(p, ("grad", id(p))) for p in parameter_list]
+
+
+# ---------------------------------------------------------------------------
+# Inference artifacts
+# ---------------------------------------------------------------------------
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    layer = kwargs.get("layer")
-    if layer is not None:
-        _save(layer.state_dict(), path_prefix + ".pdparams")
+    """Serialize program+params as a StableHLO artifact (jax.export) with a
+    JSON meta file. Layout: <prefix>.json + <prefix>.pdmodel (serialized
+    StableHLO) [+ <prefix>.pdiparams numpy params for retraining]."""
+    from ..tensor.tensor import Tensor
+    program = program or default_main_program()
+    if isinstance(feed_vars, Tensor):
+        feed_vars = [feed_vars]
+    if isinstance(fetch_vars, Tensor):
+        fetch_vars = [fetch_vars]
+    name_of = {id(t): n for n, t in program.feeds.items()}
+    feed_names = [name_of[id(t)] for t in feed_vars]
+    fn, params = program.compiled(sorted(feed_names), fetch_vars)
+
+    def export_fn(feed_arrays, param_arrays):
+        outs, _ = fn(feed_arrays, param_arrays)
+        return outs
+
+    feed_shapes = [jax.ShapeDtypeStruct(program.feeds[n]._data.shape,
+                                        program.feeds[n]._data.dtype)
+                   for n in sorted(feed_names)]
+    param_shapes = [jax.ShapeDtypeStruct(p._data.shape, p._data.dtype)
+                    for p in params]
+    exported = jax.export.export(
+        jax.jit(export_fn),
+        platforms=("cpu", "tpu"))(feed_shapes, param_shapes)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(path_prefix + ".pdiparams.npz",
+             **{f"p{i}": np.asarray(p._data) for i, p in enumerate(params)})
+    with open(path_prefix + ".json", "w") as f:
+        json.dump({
+            "feed_names": sorted(feed_names),
+            "num_fetch": len(fetch_vars),
+            "num_params": len(params),
+            "format": "stablehlo-exported",
+        }, f)
+
+
+class _LoadedInferenceModel:
+    def __init__(self, exported, params, meta):
+        self._exported = exported
+        self._params = params
+        self.meta = meta
+        self.feed_names = meta["feed_names"]
+
+    def run(self, feeds):
+        """feeds: dict name -> array (or positional list). Returns list."""
+        if isinstance(feeds, dict):
+            arrays = [jnp.asarray(np.asarray(feeds[n]))
+                      for n in self.feed_names]
+        else:
+            arrays = [jnp.asarray(np.asarray(a)) for a in feeds]
+        return [np.asarray(o)
+                for o in self._exported.call(arrays, self._params)]
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    return _load(path_prefix + ".pdparams")
+    with open(path_prefix + ".json") as f:
+        meta = json.load(f)
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    loaded = np.load(path_prefix + ".pdiparams.npz")
+    params = [jnp.asarray(loaded[f"p{i}"])
+              for i in range(meta["num_params"])]
+    return _LoadedInferenceModel(exported, params, meta)
+
+
+def save(program: Program, path_prefix: str):
+    """paddle.static.save parity: persist parameter values."""
+    params = program.param_tensors()
+    np.savez(path_prefix + ".pdparams.npz",
+             **{f"p{i}": np.asarray(p._data) for i, p in enumerate(params)})
+
+
+def load(program: Program, path_prefix: str, executor=None):
+    loaded = np.load(path_prefix + ".pdparams.npz")
+    for i, p in enumerate(program.param_tensors()):
+        p._data = jnp.asarray(loaded[f"p{i}"])
+
+
+# Parity aliases
+Variable = None
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    """Naming-only scope in the reference; no-op here."""
+    yield

@@ -1,0 +1,262 @@
+"""Static graph Program + replay executor
+(ref: paddle/fluid/framework/program_desc.h, new_executor/interpretercore.cc,
+ python/paddle/base/framework.py Program/Block).
+
+TPU-native design: the reference builds a ProgramDesc of OpDescs and runs it
+with InterpreterCore's instruction queue.  Here, graph *capture* rides the
+eager dispatcher — while a ``program_guard`` is active, every op that flows
+through ``tensor.tensor._run_op`` appends an ``OpRecord`` (the fn + arg tree +
+input/output tensor identities) to the active Program.  ``Executor.run``
+replays the instruction list as a pure function of (feeds, parameters) and
+hands it to ``jax.jit`` — XLA plays the role of the dependency-building,
+stream-scheduling InterpreterCore, and the replay is cached per feed-shape.
+
+Placeholders come from ``static.data`` (zero-filled eagerly so the build phase
+executes shape-correctly, exactly once, like the reference's startup pass).
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class OpRecord:
+    name: str
+    fn: Any                   # jnp-level callable
+    treedef: Any              # treedef of (args, kwargs) with Tensors as leaves
+    leaves: List[Any]         # leaf list; Tensor leaves kept as Tensor objects
+    out_tensors: List[Any]    # output Tensors (strong refs keep ids stable)
+
+
+class Program:
+    """Recorded op list + feed registry (ProgramDesc analog)."""
+
+    def __init__(self):
+        self.ops: List[OpRecord] = []
+        self.feeds: Dict[str, Any] = {}     # name -> placeholder Tensor
+        self._cache = {}
+
+    # - capture -
+    def add_feed(self, name: str, tensor):
+        if name in self.feeds:
+            raise ValueError(f"duplicate feed name: {name}")
+        self.feeds[name] = tensor
+
+    def record(self, rec: OpRecord):
+        self.ops.append(rec)
+        self._cache.clear()
+
+    # paddle API parity
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.ops = list(self.ops)
+        p.feeds = dict(self.feeds)
+        return p
+
+    def __repr__(self):
+        lines = [f"Program(feeds={list(self.feeds)}, ops={len(self.ops)}):"]
+        lines += [f"  {i}: {r.name}" for i, r in enumerate(self.ops)]
+        return "\n".join(lines)
+
+    # - replay -
+    def _replay(self, feed_ids: List[int], param_ids: List[int]):
+        """Build fn(feed_arrays, param_arrays) -> env executing the op list."""
+        ops = self.ops
+
+        def fn(feed_arrays, param_arrays):
+            env: Dict[int, Any] = {}
+            for tid, a in zip(feed_ids, feed_arrays):
+                env[tid] = a
+            for tid, a in zip(param_ids, param_arrays):
+                env[tid] = a
+
+            from ..tensor.tensor import Tensor
+            for rec in ops:
+                lv = []
+                for leaf in rec.leaves:
+                    if isinstance(leaf, Tensor):
+                        lv.append(env.get(id(leaf), leaf._data))
+                    else:
+                        lv.append(leaf)
+                a, k = jax.tree_util.tree_unflatten(rec.treedef, lv)
+                out = rec.fn(*a, **k)
+                out_leaves = jax.tree_util.tree_flatten(out)[0]
+                for t, val in zip(rec.out_tensors, out_leaves):
+                    env[id(t)] = val
+            return env
+        return fn
+
+    def param_tensors(self) -> List[Any]:
+        """All distinct non-placeholder Tensor inputs consumed by the program
+        but produced outside it (parameters / captured constants)."""
+        from ..tensor.tensor import Tensor
+        feed_ids = {id(t) for t in self.feeds.values()}
+        produced = set()
+        params, seen = [], set()
+        for rec in self.ops:
+            for leaf in rec.leaves:
+                if (isinstance(leaf, Tensor) and id(leaf) not in feed_ids
+                        and id(leaf) not in produced and id(leaf) not in seen):
+                    seen.add(id(leaf))
+                    params.append(leaf)
+            for t in rec.out_tensors:
+                produced.add(id(t))
+        return params
+
+    def compiled(self, feed_names, fetch_tensors, with_grads_of=None):
+        """jit-compiled (feeds, params) -> (fetch values, grads?)."""
+        key = (tuple(feed_names), tuple(id(t) for t in fetch_tensors),
+               tuple(id(t) for t in (with_grads_of or ())))
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        placeholders = [self.feeds[n] for n in feed_names]
+        feed_ids = [id(t) for t in placeholders]
+        params = self.param_tensors()
+        param_ids = [id(t) for t in params]
+        replay = self._replay(feed_ids, param_ids)
+        fetch_ids = [id(t) for t in fetch_tensors]
+        fetch_fallback = {id(t): t for t in fetch_tensors}
+
+        def run_fn(feed_arrays, param_arrays):
+            env = replay(feed_arrays, param_arrays)
+            return [env.get(fid, fetch_fallback[fid]._data)
+                    for fid in fetch_ids]
+
+        if with_grads_of:
+            grad_param_idx = [params.index(t) for t in with_grads_of]
+
+            def run_with_grads(feed_arrays, param_arrays):
+                def loss_fn(wrt):
+                    pa = list(param_arrays)
+                    for i, v in zip(grad_param_idx, wrt):
+                        pa[i] = v
+                    outs = run_fn(feed_arrays, pa)
+                    return outs[0].sum(), outs
+
+                wrt = [param_arrays[i] for i in grad_param_idx]
+                grads, outs = jax.grad(loss_fn, has_aux=True)(wrt)
+                return outs, grads
+
+            fn = jax.jit(run_with_grads)
+        else:
+            fn = jax.jit(lambda f, p: (run_fn(f, p), []))
+
+        entry = (fn, params)
+        self._cache[key] = entry
+        return entry
+
+
+# ---------------------------------------------------------------------------
+# Active-program state (default_main_program / program_guard parity)
+# ---------------------------------------------------------------------------
+_default_main: Program = Program()
+_default_startup: Program = Program()
+_active: Optional[Program] = None
+_static_mode = False
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+def enable_static():
+    global _static_mode, _active
+    _static_mode = True
+    if _active is None:
+        _active = _default_main
+    from ..tensor import tensor as _tensor_mod
+    _tensor_mod._static_capture_hook = capture_op
+
+
+def disable_static():
+    global _static_mode, _active
+    _static_mode = False
+    _active = None
+    from ..tensor import tensor as _tensor_mod
+    _tensor_mod._static_capture_hook = None
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+def active_program() -> Optional[Program]:
+    return _active
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Program = None):
+    global _active
+    prev = _active
+    _active = main_program
+    try:
+        yield
+    finally:
+        _active = prev
+
+
+def capture_op(name: str, fn, treedef, leaves, out_tensors):
+    """Called by tensor.tensor._run_op while a program is active."""
+    if _active is not None and _static_mode:
+        _active.record(OpRecord(name, fn, treedef, list(leaves),
+                                list(out_tensors)))
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+class Executor:
+    """ref: python/paddle/base/executor.py -> InterpreterCore. ``place`` is
+    accepted for parity; XLA owns placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Program = None, feed: Dict[str, Any] = None,
+            fetch_list=None, fetch_grads_of=None, return_numpy: bool = True):
+        from ..tensor.tensor import Tensor
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        single = not isinstance(fetch_list, (list, tuple))
+        if single:
+            fetch_list = [fetch_list]
+
+        feed_names = sorted(feed.keys())
+        unknown = [n for n in feed_names if n not in program.feeds]
+        if unknown:
+            raise KeyError(f"feed names not in program: {unknown} "
+                           f"(known: {list(program.feeds)})")
+        fn, params = program.compiled(feed_names, fetch_list,
+                                      with_grads_of=fetch_grads_of)
+        feed_arrays = [
+            feed[n]._data if isinstance(feed[n], Tensor)
+            else jnp.asarray(np.asarray(feed[n])) for n in feed_names]
+        param_arrays = [p._data for p in params]
+        outs, grads = fn(feed_arrays, param_arrays)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+            grads = [np.asarray(g) for g in grads]
+        else:
+            outs = [Tensor._from_data(o) for o in outs]
+            grads = [Tensor._from_data(g) for g in grads]
+        if fetch_grads_of is not None:
+            return outs, grads
+        return outs[0] if single else outs
+
+    def close(self):
+        pass

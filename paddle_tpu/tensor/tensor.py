@@ -344,7 +344,16 @@ def _run_op(name: str, fn, args: tuple, kwargs: dict):
         out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
         wrapped = [Tensor._from_data(o, stop_gradient=True) for o in out_leaves]
     res = jax.tree_util.tree_unflatten(out_treedef, wrapped)
+    # Static-graph capture hook: installed by static.program.enable_static so
+    # an active Program appends this op to its instruction list for later jit
+    # replay (ref: ProgramDesc build). None in eager mode -> zero overhead.
+    if _static_capture_hook is not None:
+        _static_capture_hook(name, fn, treedef, leaves, wrapped)
     return res
+
+
+# Set/cleared by paddle_tpu.static.program.{enable,disable}_static.
+_static_capture_hook = None
 
 
 def apply_op(name: str, fn, *args, **kwargs):
