@@ -1,0 +1,142 @@
+"""Discrete Fourier transforms (ref: python/paddle/fft.py †).
+
+Thin autograd-taped front-ends over ``jnp.fft``: XLA lowers FFTs to its native
+``fft`` HLO, which the TPU backend executes on-chip — no custom kernels needed.
+All ops accept the reference's ``norm`` spellings ("backward"/"ortho"/"forward")
+and run through ``_run_op`` so gradients come from the recorded vjp.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, _run_op
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', 'backward' "
+            f"or 'ortho'")
+    return norm
+
+
+def _1d(name, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        nrm = _check_norm(norm)
+        return _run_op(name, lambda a: jfn(a, n=n, axis=axis, norm=nrm), (x,), {})
+    op.__name__ = name
+    return op
+
+
+def _nd(name, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        nrm = _check_norm(norm)
+        return _run_op(name, lambda a: jfn(a, s=s, axes=axes, norm=nrm), (x,), {})
+    op.__name__ = name
+    return op
+
+
+def _2d(name, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        nrm = _check_norm(norm)
+        return _run_op(name, lambda a: jfn(a, s=s, axes=axes, norm=nrm), (x,), {})
+    op.__name__ = name
+    return op
+
+
+fft = _1d("fft", jnp.fft.fft)
+ifft = _1d("ifft", jnp.fft.ifft)
+rfft = _1d("rfft", jnp.fft.rfft)
+irfft = _1d("irfft", jnp.fft.irfft)
+hfft = _1d("hfft", jnp.fft.hfft)
+ihfft = _1d("ihfft", jnp.fft.ihfft)
+
+fft2 = _2d("fft2", jnp.fft.fft2)
+ifft2 = _2d("ifft2", jnp.fft.ifft2)
+rfft2 = _2d("rfft2", jnp.fft.rfft2)
+irfft2 = _2d("irfft2", jnp.fft.irfft2)
+
+fftn = _nd("fftn", jnp.fft.fftn)
+ifftn = _nd("ifftn", jnp.fft.ifftn)
+rfftn = _nd("rfftn", jnp.fft.rfftn)
+irfftn = _nd("irfftn", jnp.fft.irfftn)
+
+
+def _hfft_nd(name, inverse):
+    """hfft2/hfftn & ihfft2/ihfftn: jnp only ships the 1-d hermitian pair, so
+    compose: full c2c over the leading axes + hermitian transform on the last."""
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        nrm = _check_norm(norm)
+
+        def f(a):
+            if axes is not None:
+                ax = list(axes)
+            elif s is not None:
+                ax = list(range(-len(s), 0))
+            else:
+                ax = list(range(a.ndim))
+            sz = list(s) if s is not None else [None] * len(ax)
+            lead_s = sz[:-1] if s is not None else None
+            if not inverse:
+                y = a
+                if len(ax) > 1:
+                    y = jnp.fft.fftn(y, s=lead_s, axes=ax[:-1], norm=nrm)
+                return jnp.fft.hfft(y, n=sz[-1], axis=ax[-1], norm=nrm)
+            y = jnp.fft.ihfft(a, n=sz[-1], axis=ax[-1], norm=nrm)
+            if len(ax) > 1:
+                y = jnp.fft.ifftn(y, s=lead_s, axes=ax[:-1], norm=nrm)
+            return y
+        return _run_op(name, f, (x,), {})
+    op.__name__ = name
+    return op
+
+
+hfftn = _hfft_nd("hfftn", inverse=False)
+ihfftn = _hfft_nd("ihfftn", inverse=True)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from ..framework import dtype as dtype_mod
+        out = out.astype(dtype_mod.convert_dtype(dtype))
+    else:
+        out = out.astype(jnp.float32)
+    return Tensor._from_data(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from ..framework import dtype as dtype_mod
+        out = out.astype(dtype_mod.convert_dtype(dtype))
+    else:
+        out = out.astype(jnp.float32)
+    return Tensor._from_data(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return _run_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), (x,), {})
+
+
+def ifftshift(x, axes=None, name=None):
+    return _run_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), (x,), {})

@@ -1,0 +1,157 @@
+"""Short-time Fourier transforms (ref: python/paddle/signal.py †).
+
+``frame``/``overlap_add`` are expressed as gather / segment-sum so XLA fuses
+them; ``stft``/``istft`` compose them with the fft module. Matches the
+reference surface: frame, overlap_add, stft, istft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, _run_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_data(a, frame_length, hop_length, axis=-1):
+    """Reference layout: axis=-1 -> (..., frame_length, num_frames);
+    axis=0 -> (num_frames, frame_length, ...)."""
+    ax = axis % a.ndim
+    n = a.shape[ax]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) exceeds signal length ({n})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # (F, L)
+    out = jnp.take(a, idx.reshape(-1), axis=ax)
+    new_shape = a.shape[:ax] + (num_frames, frame_length) + a.shape[ax + 1:]
+    out = out.reshape(new_shape)  # (..., F, L, ...) at (ax, ax+1)
+    if ax == a.ndim - 1:
+        out = jnp.swapaxes(out, ax, ax + 1)  # -> (..., L, F)
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice ``x`` into overlapping frames along ``axis``."""
+    return _run_op("frame", lambda a: _frame_data(a, frame_length, hop_length, axis),
+                   (x,), {})
+
+
+def _overlap_add_data(a, hop_length, axis=-1):
+    """Inverse of _frame_data: axis=-1 expects (..., frame_length, num_frames);
+    axis=0 expects (num_frames, frame_length, ...)."""
+    ax = axis % a.ndim
+    last = ax == a.ndim - 1
+    pair = (ax - 1, ax) if last else (ax, ax + 1)
+    # normalize pair to (..., L, F) at the end
+    if last:
+        moved = jnp.moveaxis(a, pair, (-2, -1))
+    else:
+        moved = jnp.moveaxis(a, pair, (-1, -2))  # (F, L) -> (..., L, F)
+    frame_length, num_frames = moved.shape[-2], moved.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    starts = jnp.arange(num_frames) * hop_length
+    pos = starts[None, :] + jnp.arange(frame_length)[:, None]  # (L, F)
+    flat_pos = pos.reshape(-1)
+    flat = moved.reshape(moved.shape[:-2] + (-1,))
+    out = jnp.zeros(moved.shape[:-2] + (out_len,), dtype=a.dtype)
+    out = out.at[..., flat_pos].add(flat)
+    dest = ax - 1 if last else ax
+    return jnp.moveaxis(out, -1, dest)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return _run_op("overlap_add",
+                   lambda a: _overlap_add_data(a, hop_length, axis), (x,), {})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """STFT of a (batch, seq) or (seq,) real/complex signal.
+
+    Returns (…, n_fft//2+1 or n_fft, num_frames) complex, like the reference.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xdata = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if onesided and jnp.iscomplexobj(xdata):
+        raise ValueError("stft: onesided must be False for complex input "
+                         "(reference asserts the same)")
+    if window is not None and not isinstance(window, Tensor):
+        window = Tensor(np.asarray(window))
+
+    def f(a, w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)], mode=pad_mode)
+        frames = _frame_data(a, n_fft, hop_length)        # (..., n_fft, F)
+        if w is not None:
+            lp = (n_fft - win_length) // 2
+            w_full = jnp.zeros((n_fft,), w.dtype).at[lp:lp + win_length].set(w)
+            frames = frames * w_full[:, None]
+        frames = jnp.moveaxis(frames, -2, -1)             # (..., F, n_fft)
+        if onesided and not jnp.iscomplexobj(frames):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.moveaxis(spec, -1, -2)                 # (..., freq, F)
+        return spec[0] if squeeze else spec
+
+    if window is None:
+        return _run_op("stft", lambda a: f(a, None), (x,), {})
+    return _run_op("stft", f, (x, window), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if onesided and return_complex:
+        raise ValueError("istft: onesided=True cannot produce complex output; "
+                         "pass onesided=False (reference asserts the same)")
+    if window is not None and not isinstance(window, Tensor):
+        window = Tensor(np.asarray(window))
+
+    def f(spec, w):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        sp = jnp.moveaxis(spec, -2, -1)                   # (..., F, freq)
+        if normalized:
+            sp = sp * jnp.sqrt(jnp.asarray(n_fft, sp.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(sp, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(sp, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        if w is not None:
+            lp = (n_fft - win_length) // 2
+            w_full = jnp.zeros((n_fft,), frames.real.dtype).at[lp:lp + win_length].set(w)
+        else:
+            w_full = jnp.ones((n_fft,), frames.real.dtype)
+        frames = frames * w_full
+        frames = jnp.moveaxis(frames, -1, -2)             # (..., n_fft, F)
+        sig = _overlap_add_data(frames, hop_length)
+        # normalize by the summed squared window (COLA denominator)
+        wsq = jnp.broadcast_to(w_full[:, None] ** 2, frames.shape[-2:])
+        denom = _overlap_add_data(wsq, hop_length)
+        sig = sig / jnp.where(denom > 1e-11, denom, 1.0)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:sig.shape[-1] - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig[0] if squeeze else sig
+
+    if window is None:
+        return _run_op("istft", lambda a: f(a, None), (x,), {})
+    return _run_op("istft", f, (x, window), {})

@@ -1,0 +1,99 @@
+"""paddle.fft / paddle.signal parity tests vs numpy reference implementations."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestFFT:
+    def test_fft_ifft_roundtrip(self):
+        x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+        y = paddle.fft.fft(paddle.Tensor(x))
+        np.testing.assert_allclose(_np(y), np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.ifft(y)
+        np.testing.assert_allclose(_np(back).real, x, rtol=1e-4, atol=1e-4)
+
+    def test_rfft_irfft(self):
+        x = np.random.RandomState(1).randn(3, 64).astype(np.float32)
+        y = paddle.fft.rfft(paddle.Tensor(x))
+        np.testing.assert_allclose(_np(y), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        back = paddle.fft.irfft(y, n=64)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-4, atol=1e-4)
+
+    def test_norm_modes(self):
+        x = np.random.RandomState(2).randn(16).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            y = paddle.fft.fft(paddle.Tensor(x), norm=norm)
+            np.testing.assert_allclose(_np(y), np.fft.fft(x, norm=norm),
+                                       rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.Tensor(x), norm="bogus")
+
+    def test_fft2_fftn(self):
+        x = np.random.RandomState(3).randn(2, 8, 8).astype(np.float32)
+        np.testing.assert_allclose(_np(paddle.fft.fft2(paddle.Tensor(x))),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(_np(paddle.fft.fftn(paddle.Tensor(x))),
+                                   np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+
+    def test_hfft_ihfft(self):
+        x = np.random.RandomState(4).randn(17).astype(np.float32)
+        spec = np.fft.ihfft(x)
+        y = paddle.fft.ihfft(paddle.Tensor(x))
+        np.testing.assert_allclose(_np(y), spec, rtol=1e-4, atol=1e-4)
+        back = paddle.fft.hfft(y, n=17)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_freq_shift(self):
+        f = paddle.fft.fftfreq(8, d=0.5)
+        np.testing.assert_allclose(_np(f), np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+        rf = paddle.fft.rfftfreq(8)
+        np.testing.assert_allclose(_np(rf), np.fft.rfftfreq(8), rtol=1e-6)
+        x = np.arange(8.0, dtype=np.float32)
+        np.testing.assert_allclose(
+            _np(paddle.fft.ifftshift(paddle.fft.fftshift(paddle.Tensor(x)))), x)
+
+    def test_fft_grad(self):
+        x = paddle.Tensor(np.random.RandomState(5).randn(16).astype(np.float32),
+                          stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        loss = (y.abs() ** 2).sum()
+        loss.backward()
+        assert x.grad is not None and x.grad.shape == [16]
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip(self):
+        x = np.arange(1, 17, dtype=np.float32)
+        fr = paddle.signal.frame(paddle.Tensor(x), frame_length=4, hop_length=4)
+        assert fr.shape == [4, 4]
+        back = paddle.signal.overlap_add(fr, hop_length=4)
+        np.testing.assert_allclose(_np(back), x)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(2, 512).astype(np.float32)
+        w = np.hanning(128).astype(np.float32)
+        spec = paddle.signal.stft(paddle.Tensor(x), n_fft=128, hop_length=32,
+                                  window=paddle.Tensor(w))
+        assert spec.shape == [2, 65, 1 + 512 // 32]
+        back = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                                   window=paddle.Tensor(w), length=512)
+        np.testing.assert_allclose(_np(back), x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_matches_manual_dft(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(256).astype(np.float32)
+        n_fft, hop = 64, 16
+        spec = paddle.signal.stft(paddle.Tensor(x), n_fft=n_fft, hop_length=hop,
+                                  center=False)
+        got = _np(spec)
+        # manual: frame then rfft
+        frames = np.stack([x[i * hop:i * hop + n_fft]
+                           for i in range(1 + (256 - n_fft) // hop)])
+        want = np.fft.rfft(frames, axis=-1).T
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
