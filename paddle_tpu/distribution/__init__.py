@@ -1,0 +1,21 @@
+"""paddle.distribution parity (ref: python/paddle/distribution/ †).
+
+Distributions, bijective transforms, TransformedDistribution and the KL
+registry, all over taped eager Tensors with reparameterized sampling where
+jax's samplers are implicitly differentiable.
+"""
+from .distribution import Distribution  # noqa: F401
+from .distributions import (  # noqa: F401
+    Bernoulli, Beta, Binomial, Categorical, Cauchy, ContinuousBernoulli,
+    Dirichlet, Exponential, Gamma, Geometric, Gumbel, Independent, Laplace,
+    LogNormal, Multinomial, MultivariateNormal, Normal, Poisson, StudentT,
+    Uniform,
+)
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform,
+)
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401

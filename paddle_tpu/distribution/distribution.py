@@ -1,0 +1,101 @@
+"""Distribution base class (ref: python/paddle/distribution/distribution.py †).
+
+Probability distributions over eager Tensors. Parameters are stored as
+Tensors; density methods run through ``_run_op`` so ``log_prob`` et al. are
+differentiable w.r.t. the parameters (reparameterized ``rsample`` where the
+sampler allows it — jax's gamma/dirichlet/normal samplers are implicitly
+differentiable, which the CUDA reference cannot offer).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+from ..tensor.tensor import Tensor, _run_op
+
+
+def param(x, dtype=np.float32):
+    """Coerce a distribution parameter to a Tensor (floats -> float32)."""
+    if isinstance(x, Tensor):
+        return x
+    arr = np.asarray(x)
+    if arr.dtype in (np.float64, np.int32, np.int64, int, float):
+        arr = arr.astype(dtype)
+    return Tensor(arr)
+
+
+def _shape(t):
+    return tuple(t._data.shape)
+
+
+def broadcast_batch(*tensors):
+    return tuple(np.broadcast_shapes(*[_shape(t) for t in tensors]))
+
+
+def sum_rightmost(x, k):
+    """Sum a Tensor over its rightmost ``k`` axes (taped)."""
+    if k <= 0:
+        return x
+    return _run_op("sum_rightmost",
+                   lambda a: a.sum(axis=tuple(range(a.ndim - k, a.ndim))),
+                   (x,), {})
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self, shape=()):
+        """Draw a detached sample of shape ``shape + batch_shape + event_shape``."""
+        s = self.rsample(shape)
+        return s.detach()
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement rsample")
+
+    def _extended_shape(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+    @staticmethod
+    def _key():
+        return rnd.next_key()
+
+    # -- densities ---------------------------------------------------------
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _run_op("prob", jnp.exp, (self.log_prob(value),), {})
+
+    probs = prob
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self.batch_shape}, "
+                f"event_shape={self.event_shape})")

@@ -1,0 +1,823 @@
+"""Concrete distributions (ref: python/paddle/distribution/{normal,uniform,
+bernoulli,categorical,beta,dirichlet,gamma,exponential,laplace,gumbel,
+lognormal,multinomial,geometric,cauchy,poisson,binomial,student_t,
+multivariate_normal}.py †).
+
+Continuous families are reparameterized (``rsample`` differentiates through
+jax's implicit-gradient samplers); discrete families sample detached.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jss
+import numpy as np
+
+from ..tensor.tensor import Tensor, _run_op, unwrap
+from .distribution import Distribution, broadcast_batch, param
+
+__all__ = [
+    "Normal", "LogNormal", "Uniform", "Exponential", "Gamma", "Beta",
+    "Dirichlet", "Laplace", "Gumbel", "Cauchy", "StudentT", "Bernoulli",
+    "ContinuousBernoulli", "Categorical", "Multinomial", "Binomial",
+    "Geometric", "Poisson", "MultivariateNormal", "Independent",
+]
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = param(loc)
+        self.scale = param(scale)
+        super().__init__(broadcast_batch(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return _run_op("square", jnp.square, (self.scale,), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        return _run_op("normal_rsample",
+                       lambda l, s: l + s * jax.random.normal(key, full, jnp.result_type(l, s)),
+                       (self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            var = s ** 2
+            return -((v - l) ** 2) / (2 * var) - jnp.log(s) - 0.5 * math.log(2 * math.pi)
+        return _run_op("normal_log_prob", f, (self.loc, self.scale, param(value)), {})
+
+    def entropy(self):
+        return _run_op("normal_entropy",
+                       lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+                       + jnp.zeros(self._batch_shape, s.dtype),
+                       (self.scale,), {})
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = param(loc)
+        self.scale = param(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(self._base._batch_shape)
+
+    @property
+    def mean(self):
+        return _run_op("lognormal_mean", lambda l, s: jnp.exp(l + s ** 2 / 2),
+                       (self.loc, self.scale), {})
+
+    @property
+    def variance(self):
+        return _run_op("lognormal_var",
+                       lambda l, s: (jnp.exp(s ** 2) - 1) * jnp.exp(2 * l + s ** 2),
+                       (self.loc, self.scale), {})
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return _run_op("exp", jnp.exp, (base,), {})
+
+    def log_prob(self, value):
+        v = param(value)
+        def f(l, s, v):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - logv)
+        return _run_op("lognormal_log_prob", f, (self.loc, self.scale, v), {})
+
+    def entropy(self):
+        return _run_op("lognormal_entropy",
+                       lambda l, s: l + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                       (self.loc, self.scale), {})
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = param(low)
+        self.high = param(high)
+        super().__init__(broadcast_batch(self.low, self.high))
+
+    @property
+    def mean(self):
+        return _run_op("uniform_mean", lambda a, b: (a + b) / 2,
+                       (self.low, self.high), {})
+
+    @property
+    def variance(self):
+        return _run_op("uniform_var", lambda a, b: (b - a) ** 2 / 12,
+                       (self.low, self.high), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        return _run_op("uniform_rsample",
+                       lambda a, b: a + (b - a) * jax.random.uniform(
+                           key, full, jnp.result_type(a, b)),
+                       (self.low, self.high), {})
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+        return _run_op("uniform_log_prob", f, (self.low, self.high, param(value)), {})
+
+    def entropy(self):
+        return _run_op("uniform_entropy", lambda a, b: jnp.log(b - a),
+                       (self.low, self.high), {})
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = param(rate)
+        super().__init__(broadcast_batch(self.rate))
+
+    @property
+    def mean(self):
+        return _run_op("exp_mean", lambda r: 1 / r, (self.rate,), {})
+
+    @property
+    def variance(self):
+        return _run_op("exp_var", lambda r: 1 / r ** 2, (self.rate,), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        return _run_op("exponential_rsample",
+                       lambda r: jax.random.exponential(key, full, r.dtype) / r,
+                       (self.rate,), {})
+
+    def log_prob(self, value):
+        return _run_op("exponential_log_prob",
+                       lambda r, v: jnp.log(r) - r * v, (self.rate, param(value)), {})
+
+    def entropy(self):
+        return _run_op("exponential_entropy", lambda r: 1 - jnp.log(r),
+                       (self.rate,), {})
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = param(concentration)
+        self.rate = param(rate)
+        super().__init__(broadcast_batch(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return _run_op("gamma_mean", lambda c, r: c / r,
+                       (self.concentration, self.rate), {})
+
+    @property
+    def variance(self):
+        return _run_op("gamma_var", lambda c, r: c / r ** 2,
+                       (self.concentration, self.rate), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        return _run_op("gamma_rsample",
+                       lambda c, r: jax.random.gamma(
+                           key, jnp.broadcast_to(c, full), full) / r,
+                       (self.concentration, self.rate), {})
+
+    def log_prob(self, value):
+        def f(c, r, v):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v - jss.gammaln(c))
+        return _run_op("gamma_log_prob", f,
+                       (self.concentration, self.rate, param(value)), {})
+
+    def entropy(self):
+        def f(c, r):
+            return c - jnp.log(r) + jss.gammaln(c) + (1 - c) * jss.digamma(c)
+        return _run_op("gamma_entropy", f, (self.concentration, self.rate), {})
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = param(alpha)
+        self.beta = param(beta)
+        super().__init__(broadcast_batch(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return _run_op("beta_mean", lambda a, b: a / (a + b),
+                       (self.alpha, self.beta), {})
+
+    @property
+    def variance(self):
+        return _run_op("beta_var",
+                       lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                       (self.alpha, self.beta), {})
+
+    def rsample(self, shape=()):
+        key1, key2 = jax.random.split(self._key())
+        full = self._extended_shape(shape)
+
+        def f(a, b):
+            ga = jax.random.gamma(key1, jnp.broadcast_to(a, full), full)
+            gb = jax.random.gamma(key2, jnp.broadcast_to(b, full), full)
+            return ga / (ga + gb)
+        return _run_op("beta_rsample", f, (self.alpha, self.beta), {})
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jss.gammaln(a) + jss.gammaln(b) - jss.gammaln(a + b)))
+        return _run_op("beta_log_prob", f, (self.alpha, self.beta, param(value)), {})
+
+    def entropy(self):
+        def f(a, b):
+            total = a + b
+            return (jss.gammaln(a) + jss.gammaln(b) - jss.gammaln(total)
+                    - (a - 1) * jss.digamma(a) - (b - 1) * jss.digamma(b)
+                    + (total - 2) * jss.digamma(total))
+        return _run_op("beta_entropy", f, (self.alpha, self.beta), {})
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = param(concentration)
+        shape = tuple(self.concentration._data.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return _run_op("dirichlet_mean",
+                       lambda c: c / c.sum(-1, keepdims=True),
+                       (self.concentration,), {})
+
+    @property
+    def variance(self):
+        def f(c):
+            a0 = c.sum(-1, keepdims=True)
+            m = c / a0
+            return m * (1 - m) / (a0 + 1)
+        return _run_op("dirichlet_var", f, (self.concentration,), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, full), full)
+            return g / g.sum(-1, keepdims=True)
+        return _run_op("dirichlet_rsample", f, (self.concentration,), {})
+
+    def log_prob(self, value):
+        def f(c, v):
+            return (((c - 1) * jnp.log(v)).sum(-1)
+                    + jss.gammaln(c.sum(-1)) - jss.gammaln(c).sum(-1))
+        return _run_op("dirichlet_log_prob", f,
+                       (self.concentration, param(value)), {})
+
+    def entropy(self):
+        def f(c):
+            a0 = c.sum(-1)
+            k = c.shape[-1]
+            return (jss.gammaln(c).sum(-1) - jss.gammaln(a0)
+                    + (a0 - k) * jss.digamma(a0)
+                    - ((c - 1) * jss.digamma(c)).sum(-1))
+        return _run_op("dirichlet_entropy", f, (self.concentration,), {})
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = param(loc)
+        self.scale = param(scale)
+        super().__init__(broadcast_batch(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _run_op("laplace_var", lambda s: 2 * s ** 2, (self.scale,), {})
+
+    @property
+    def stddev(self):
+        return _run_op("laplace_std", lambda s: math.sqrt(2) * s, (self.scale,), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+
+        def f(l, s):
+            u = jax.random.uniform(key, full, s.dtype, -1 + 1e-7, 1.0)
+            return l - s * jnp.sign(u) * jnp.log1p(-jnp.abs(u))
+        return _run_op("laplace_rsample", f, (self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        return _run_op("laplace_log_prob",
+                       lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                       (self.loc, self.scale, param(value)), {})
+
+    def entropy(self):
+        return _run_op("laplace_entropy", lambda s: 1 + jnp.log(2 * s),
+                       (self.scale,), {})
+
+    def cdf(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+        return _run_op("laplace_cdf", f, (self.loc, self.scale, param(value)), {})
+
+    def icdf(self, q):
+        def f(l, s, p):
+            t = p - 0.5
+            return l - s * jnp.sign(t) * jnp.log1p(-2 * jnp.abs(t))
+        return _run_op("laplace_icdf", f, (self.loc, self.scale, param(q)), {})
+
+
+class Gumbel(Distribution):
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = param(loc)
+        self.scale = param(scale)
+        super().__init__(broadcast_batch(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _run_op("gumbel_mean", lambda l, s: l + self._EULER * s,
+                       (self.loc, self.scale), {})
+
+    @property
+    def variance(self):
+        return _run_op("gumbel_var", lambda s: (math.pi ** 2 / 6) * s ** 2,
+                       (self.scale,), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        return _run_op("gumbel_rsample",
+                       lambda l, s: l + s * jax.random.gumbel(key, full, s.dtype),
+                       (self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _run_op("gumbel_log_prob", f, (self.loc, self.scale, param(value)), {})
+
+    def entropy(self):
+        return _run_op("gumbel_entropy", lambda s: jnp.log(s) + 1 + self._EULER,
+                       (self.scale,), {})
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = param(loc)
+        self.scale = param(scale)
+        super().__init__(broadcast_batch(self.loc, self.scale))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        return _run_op("cauchy_rsample",
+                       lambda l, s: l + s * jax.random.cauchy(key, full, s.dtype),
+                       (self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            return (-math.log(math.pi) - jnp.log(s)
+                    - jnp.log1p(((v - l) / s) ** 2))
+        return _run_op("cauchy_log_prob", f, (self.loc, self.scale, param(value)), {})
+
+    def entropy(self):
+        return _run_op("cauchy_entropy", lambda s: math.log(4 * math.pi) + jnp.log(s),
+                       (self.scale,), {})
+
+    def cdf(self, value):
+        def f(l, s, v):
+            return jnp.arctan((v - l) / s) / math.pi + 0.5
+        return _run_op("cauchy_cdf", f, (self.loc, self.scale, param(value)), {})
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = param(df)
+        self.loc = param(loc)
+        self.scale = param(scale)
+        super().__init__(broadcast_batch(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def f(df, s):
+            return jnp.where(df > 2, s ** 2 * df / (df - 2), jnp.inf)
+        return _run_op("studentt_var", f, (self.df, self.scale), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+
+        def f(df, l, s):
+            t = jax.random.t(key, jnp.broadcast_to(df, full), full, s.dtype)
+            return l + s * t
+        return _run_op("studentt_rsample", f, (self.df, self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        def f(df, l, s, v):
+            z = (v - l) / s
+            return (jss.gammaln((df + 1) / 2) - jss.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return _run_op("studentt_log_prob", f,
+                       (self.df, self.loc, self.scale, param(value)), {})
+
+    def entropy(self):
+        def f(df, s):
+            h = ((df + 1) / 2 * (jss.digamma((df + 1) / 2) - jss.digamma(df / 2))
+                 + 0.5 * jnp.log(df) + jss.betaln(df / 2, 0.5))
+            return h + jnp.log(s)
+        return _run_op("studentt_entropy", f, (self.df, self.scale), {})
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs / logits")
+        if probs is not None:
+            self.probs_param = param(probs)
+            self.logits = _run_op("logit",
+                                  lambda p: jnp.log(p) - jnp.log1p(-p),
+                                  (self.probs_param,), {})
+        else:
+            self.logits = param(logits)
+            self.probs_param = _run_op("sigmoid", jax.nn.sigmoid, (self.logits,), {})
+        super().__init__(broadcast_batch(self.logits))
+
+    @property
+    def mean(self):
+        return self.probs_param
+
+    @property
+    def variance(self):
+        return _run_op("bern_var", lambda p: p * (1 - p), (self.probs_param,), {})
+
+    def sample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        data = jax.random.bernoulli(key, unwrap(self.probs_param), full)
+        return Tensor._from_data(data.astype(jnp.float32))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid relaxation (ref exposes rsample via temperature)."""
+        key = self._key()
+        full = self._extended_shape(shape)
+
+        def f(lg):
+            u = jax.random.uniform(key, full, lg.dtype, 1e-6, 1 - 1e-6)
+            g = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((lg + g) / temperature)
+        return _run_op("bernoulli_rsample", f, (self.logits,), {})
+
+    def log_prob(self, value):
+        def f(lg, v):
+            return v * jax.nn.log_sigmoid(lg) + (1 - v) * jax.nn.log_sigmoid(-lg)
+        return _run_op("bernoulli_log_prob", f, (self.logits, param(value)), {})
+
+    def entropy(self):
+        def f(lg):
+            p = jax.nn.sigmoid(lg)
+            return -(p * jax.nn.log_sigmoid(lg) + (1 - p) * jax.nn.log_sigmoid(-lg))
+        return _run_op("bernoulli_entropy", f, (self.logits,), {})
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_param = param(probs)
+        self._lims = lims
+        super().__init__(broadcast_batch(self.probs_param))
+
+    def _log_norm(self, p):
+        # log C(p); taylor fallback near p=0.5 for numerical stability
+        lo, hi = self._lims
+        safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+        cut = (safe < lo) | (safe > hi)
+        pc = jnp.where(cut, safe, 0.499)
+        log_norm = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * pc))) - jnp.log(
+            jnp.abs(1 - 2 * pc))
+        taylor = math.log(2.0) + 4 / 3 * (p - 0.5) ** 2
+        return jnp.where(cut, log_norm, taylor)
+
+    def log_prob(self, value):
+        def f(p, v):
+            return (v * jnp.log(jnp.clip(p, 1e-6)) +
+                    (1 - v) * jnp.log(jnp.clip(1 - p, 1e-6)) + self._log_norm(p))
+        return _run_op("cb_log_prob", f, (self.probs_param, param(value)), {})
+
+    def sample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+
+        def icdf(p, u):
+            safe = jnp.clip(p, 1e-6, 1 - 1e-6)
+            cut = (safe < self._lims[0]) | (safe > self._lims[1])
+            pc = jnp.where(cut, safe, 0.4)
+            x = (jnp.log1p(u * (2 * pc - 1) / (1 - pc)) /
+                 (jnp.log(pc) - jnp.log1p(-pc)))
+            return jnp.where(cut, x, u)
+        p = unwrap(self.probs_param)
+        u = jax.random.uniform(key, full, p.dtype if hasattr(p, "dtype") else jnp.float32)
+        return Tensor._from_data(icdf(p, u))
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits`` (softmax-normalized).
+
+    The reference's legacy Categorical normalizes raw weights by their sum;
+    pass probabilities via ``probs=`` for that behavior.
+    """
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits / probs")
+        if probs is not None:
+            self.probs_param = param(probs)
+            self.logits = _run_op(
+                "log", lambda p: jnp.log(p / p.sum(-1, keepdims=True)),
+                (self.probs_param,), {})
+        else:
+            self.logits = param(logits)
+            self.probs_param = _run_op("softmax", jax.nn.softmax, (self.logits,), {})
+        shape = tuple(self.logits._data.shape)
+        super().__init__(shape[:-1])
+        self._num_events = shape[-1]
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no mean")
+
+    def sample(self, shape=()):
+        key = self._key()
+        full = tuple(shape) + self._batch_shape
+        data = jax.random.categorical(key, unwrap(self.logits), shape=full)
+        return Tensor._from_data(data)
+
+    def log_prob(self, value):
+        def f(lg, v):
+            logp = jax.nn.log_softmax(lg)
+            logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return _run_op("categorical_log_prob", f, (self.logits, param(value)), {})
+
+    def entropy(self):
+        def f(lg):
+            logp = jax.nn.log_softmax(lg)
+            return -(jnp.exp(logp) * logp).sum(-1)
+        return _run_op("categorical_entropy", f, (self.logits,), {})
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_param = param(probs)
+        shape = tuple(self.probs_param._data.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return _run_op("multinomial_mean",
+                       lambda p: self.total_count * p / p.sum(-1, keepdims=True),
+                       (self.probs_param,), {})
+
+    def sample(self, shape=()):
+        """Conditional-binomial chain: O(batch*K) memory regardless of
+        total_count (a one-hot over total_count draws would be O(N*batch*K))."""
+        p = unwrap(self.probs_param)
+        full = tuple(shape) + self._batch_shape
+        pn = p / p.sum(-1, keepdims=True)
+        k = pn.shape[-1]
+        remaining = jnp.full(full, float(self.total_count), jnp.float32)
+        tail = jnp.ones(full, jnp.float32)  # P(category >= i)
+        counts = []
+        for i in range(k - 1):
+            pi = jnp.broadcast_to(pn[..., i], full)
+            cond = jnp.clip(pi / jnp.clip(tail, 1e-12), 0.0, 1.0)
+            ci = jax.random.binomial(self._key(), remaining, cond, shape=full)
+            counts.append(ci)
+            remaining = remaining - ci
+            tail = tail - pi
+        counts.append(remaining)
+        return Tensor._from_data(jnp.stack(counts, -1))
+
+    def log_prob(self, value):
+        def f(p, v):
+            pn = p / p.sum(-1, keepdims=True)
+            return (jss.gammaln(v.sum(-1) + 1) - jss.gammaln(v + 1).sum(-1)
+                    + (v * jnp.log(pn)).sum(-1))
+        return _run_op("multinomial_log_prob", f,
+                       (self.probs_param, param(value)), {})
+
+    def entropy(self):
+        """Monte-Carlo-free upper bound is not in the reference; compute the
+        exact sum only for small total_count via sampling approximation."""
+        samples = self.sample((128,))
+        lp = self.log_prob(samples)
+        return _run_op("mean0", lambda a: -a.mean(0), (lp,), {})
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = param(total_count, dtype=np.float32)
+        self.probs_param = param(probs)
+        super().__init__(broadcast_batch(self.total_count, self.probs_param))
+
+    @property
+    def mean(self):
+        return _run_op("binomial_mean", lambda n, p: n * p,
+                       (self.total_count, self.probs_param), {})
+
+    @property
+    def variance(self):
+        return _run_op("binomial_var", lambda n, p: n * p * (1 - p),
+                       (self.total_count, self.probs_param), {})
+
+    def sample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        n = unwrap(self.total_count)
+        p = unwrap(self.probs_param)
+        data = jax.random.binomial(key, jnp.broadcast_to(n, full),
+                                   jnp.broadcast_to(p, full), shape=full)
+        return Tensor._from_data(data)
+
+    def log_prob(self, value):
+        def f(n, p, v):
+            return (jss.gammaln(n + 1) - jss.gammaln(v + 1) - jss.gammaln(n - v + 1)
+                    + v * jnp.log(jnp.clip(p, 1e-9))
+                    + (n - v) * jnp.log(jnp.clip(1 - p, 1e-9)))
+        return _run_op("binomial_log_prob", f,
+                       (self.total_count, self.probs_param, param(value)), {})
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p for k = 0, 1, 2, … (failures before first success)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = param(probs)
+        super().__init__(broadcast_batch(self.probs_param))
+
+    @property
+    def mean(self):
+        return _run_op("geom_mean", lambda p: (1 - p) / p, (self.probs_param,), {})
+
+    @property
+    def variance(self):
+        return _run_op("geom_var", lambda p: (1 - p) / p ** 2,
+                       (self.probs_param,), {})
+
+    def sample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        p = unwrap(self.probs_param)
+        u = jax.random.uniform(key, full, jnp.float32, 1e-7, 1.0)
+        data = jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return Tensor._from_data(data)
+
+    def log_prob(self, value):
+        return _run_op("geom_log_prob",
+                       lambda p, v: v * jnp.log1p(-p) + jnp.log(p),
+                       (self.probs_param, param(value)), {})
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return _run_op("geom_entropy", f, (self.probs_param,), {})
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = param(rate)
+        super().__init__(broadcast_batch(self.rate))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+        data = jax.random.poisson(key, unwrap(self.rate), full)
+        return Tensor._from_data(data.astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _run_op("poisson_log_prob",
+                       lambda r, v: v * jnp.log(r) - r - jss.gammaln(v + 1),
+                       (self.rate, param(value)), {})
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None, name=None):
+        self.loc = param(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError("pass exactly one of covariance_matrix / scale_tril")
+        if covariance_matrix is not None:
+            self.covariance_matrix = param(covariance_matrix)
+            self.scale_tril = _run_op("cholesky", jnp.linalg.cholesky,
+                                      (self.covariance_matrix,), {})
+        else:
+            self.scale_tril = param(scale_tril)
+            self.covariance_matrix = _run_op(
+                "mvn_cov", lambda L: L @ jnp.swapaxes(L, -1, -2),
+                (self.scale_tril,), {})
+        d = self.loc._data.shape[-1]
+        batch = np.broadcast_shapes(self.loc._data.shape[:-1],
+                                    self.scale_tril._data.shape[:-2])
+        super().__init__(tuple(batch), (d,))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _run_op("mvn_var",
+                       lambda L: jnp.square(L).sum(-1),
+                       (self.scale_tril,), {})
+
+    def rsample(self, shape=()):
+        key = self._key()
+        full = self._extended_shape(shape)
+
+        def f(l, L):
+            eps = jax.random.normal(key, full, L.dtype)
+            return l + jnp.einsum("...ij,...j->...i", L, eps)
+        return _run_op("mvn_rsample", f, (self.loc, self.scale_tril), {})
+
+    def log_prob(self, value):
+        def f(l, L, v):
+            d = l.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(L, diff.shape[:-1] + L.shape[-2:]),
+                diff[..., None], lower=True)[..., 0]
+            maha = jnp.square(sol).sum(-1)
+            logdet = jnp.log(jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))).sum(-1)
+            return -0.5 * (maha + d * math.log(2 * math.pi)) - logdet
+        return _run_op("mvn_log_prob", f,
+                       (self.loc, self.scale_tril, param(value)), {})
+
+    def entropy(self):
+        def f(L):
+            d = L.shape[-1]
+            logdet = jnp.log(jnp.abs(jnp.diagonal(L, axis1=-2, axis2=-1))).sum(-1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+        return _run_op("mvn_entropy", f, (self.scale_tril,), {})
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims as
+    event dims (ref: python/paddle/distribution/independent.py †)."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        b = tuple(base._batch_shape)
+        k = self.reinterpreted_batch_rank
+        if k > len(b):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        super().__init__(b[:len(b) - k], b[len(b) - k:] + tuple(base._event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        from .distribution import sum_rightmost
+        return sum_rightmost(self.base.log_prob(value),
+                             self.reinterpreted_batch_rank)
+
+    def entropy(self):
+        from .distribution import sum_rightmost
+        return sum_rightmost(self.base.entropy(),
+                             self.reinterpreted_batch_rank)

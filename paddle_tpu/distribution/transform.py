@@ -1,0 +1,325 @@
+"""Bijective transforms (ref: python/paddle/distribution/transform.py †).
+
+Each transform provides forward/inverse maps and log|det J| in both
+directions, all as taped eager ops so normalizing-flow stacks train with
+autograd. Variable names and the public set match the reference:
+Abs, Affine, Chain, Exp, Independent, Power, Reshape, Sigmoid, Softmax,
+Stack, StickBreaking, Tanh.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, _run_op
+from .distribution import param
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    _event_rank = 0  # rank of the event this transform acts on
+
+    # domain/codomain event ranks; differ only for shape-changing transforms
+    @property
+    def _domain_rank(self):
+        return self._event_rank
+
+    @property
+    def _codomain_rank(self):
+        return self._event_rank
+
+    def forward(self, x):
+        return _run_op(f"{type(self).__name__}_fwd", self._forward, (x,), {})
+
+    def inverse(self, y):
+        return _run_op(f"{type(self).__name__}_inv", self._inverse, (y,), {})
+
+    def forward_log_det_jacobian(self, x):
+        return _run_op(f"{type(self).__name__}_fldj", self._fldj, (x,), {})
+
+    def inverse_log_det_jacobian(self, y):
+        # via the public methods so subclasses that only override those
+        # (Affine, Power, Chain, Stack, Independent) inherit a working ildj
+        x = self.inverse(y)
+        ldj = self.forward_log_det_jacobian(x)
+        return _run_op("neg", lambda a: -a, (ldj,), {})
+
+    def forward_shape(self, shape):
+        return list(shape)
+
+    def inverse_shape(self, shape):
+        return list(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # jnp-level implementations
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right inverse (the positive branch), like the reference
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = param(loc)
+        self.scale = param(scale)
+
+    def forward(self, x):
+        return _run_op("affine_fwd", lambda l, s, x_: l + s * x_,
+                       (self.loc, self.scale, x), {})
+
+    def inverse(self, y):
+        return _run_op("affine_inv", lambda l, s, y_: (y_ - l) / s,
+                       (self.loc, self.scale, y), {})
+
+    def forward_log_det_jacobian(self, x):
+        return _run_op("affine_fldj",
+                       lambda s, x_: jnp.broadcast_to(jnp.log(jnp.abs(s)), x_.shape),
+                       (self.scale, x), {})
+
+    def inverse_log_det_jacobian(self, y):
+        return _run_op("affine_ildj",
+                       lambda s, y_: jnp.broadcast_to(-jnp.log(jnp.abs(s)), y_.shape),
+                       (self.scale, y), {})
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = param(power)
+
+    def forward(self, x):
+        return _run_op("power_fwd", lambda p, x_: jnp.power(x_, p),
+                       (self.power, x), {})
+
+    def inverse(self, y):
+        return _run_op("power_inv", lambda p, y_: jnp.power(y_, 1 / p),
+                       (self.power, y), {})
+
+    def forward_log_det_jacobian(self, x):
+        return _run_op("power_fldj",
+                       lambda p, x_: jnp.log(jnp.abs(p * jnp.power(x_, p - 1))),
+                       (self.power, x), {})
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)  # one right inverse; softmax is not injective
+
+
+class StickBreakingTransform(Transform):
+    _event_rank = 1
+
+    def _forward(self, x):
+        # R^{K-1} -> simplex^K
+        offset = x.shape[-1] - jnp.arange(x.shape[-1])
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), z.dtype)], -1)
+        onez = jnp.concatenate([jnp.ones(z.shape[:-1] + (1,), z.dtype), 1 - z], -1)
+        return zpad * jnp.cumprod(onez, -1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] - jnp.arange(y_crop.shape[-1])
+        sf = 1 - jnp.cumsum(y_crop, -1) + y_crop
+        z = y_crop / sf
+        return (jnp.log(z) - jnp.log1p(-z)
+                + jnp.log(offset.astype(y.dtype)))
+
+    def _fldj(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1])
+        xs = x - jnp.log(offset.astype(x.dtype))
+        z = jax.nn.sigmoid(xs)
+        onez = jnp.concatenate([jnp.ones(z.shape[:-1] + (1,), z.dtype), 1 - z], -1)
+        log_sf = jnp.log(jnp.cumprod(onez[..., :-1], -1))
+        return (jax.nn.log_sigmoid(xs) + jax.nn.log_sigmoid(-xs) + log_sf).sum(-1)
+
+    def forward_log_det_jacobian(self, x):
+        return _run_op("stickbreaking_fldj", self._fldj, (x,), {})
+
+    def forward_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] + 1]
+
+    def inverse_shape(self, shape):
+        return list(shape[:-1]) + [shape[-1] - 1]
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            term = t.forward_log_det_jacobian(x)
+            total = term if total is None else _run_op(
+                "add", lambda a, b: a + b, (total, term), {})
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Treat the rightmost ``reinterpreted_batch_rank`` dims as event dims:
+    log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self.reinterpreted_batch_rank
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        k = self.reinterpreted_batch_rank
+        return _run_op("indep_sum",
+                       lambda a: a.sum(axis=tuple(range(a.ndim - k, a.ndim))),
+                       (ld,), {})
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(np.prod(self.out_event_shape)):
+            raise ValueError("in/out event shapes must have the same size")
+        self._event_rank = len(self.in_event_shape)
+
+    @property
+    def _domain_rank(self):
+        return len(self.in_event_shape)
+
+    @property
+    def _codomain_rank(self):
+        return len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(shape) - len(self.in_event_shape)
+        return list(shape[:n]) + list(self.out_event_shape)
+
+    def inverse_shape(self, shape):
+        n = len(shape) - len(self.out_event_shape)
+        return list(shape[:n]) + list(self.in_event_shape)
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _slice(self, x, i):
+        return _run_op("stack_slice",
+                       lambda a: jnp.take(a, i, axis=self.axis), (x,), {})
+
+    def forward(self, x):
+        outs = [t.forward(self._slice(x, i))
+                for i, t in enumerate(self.transforms)]
+        return _run_op("stack", lambda *a: jnp.stack(a, self.axis), tuple(outs), {})
+
+    def inverse(self, y):
+        outs = [t.inverse(self._slice(y, i))
+                for i, t in enumerate(self.transforms)]
+        return _run_op("stack", lambda *a: jnp.stack(a, self.axis), tuple(outs), {})
+
+    def forward_log_det_jacobian(self, x):
+        outs = [t.forward_log_det_jacobian(self._slice(x, i))
+                for i, t in enumerate(self.transforms)]
+        return _run_op("stack", lambda *a: jnp.stack(a, self.axis), tuple(outs), {})

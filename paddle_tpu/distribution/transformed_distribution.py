@@ -1,0 +1,54 @@
+"""TransformedDistribution (ref: python/paddle/distribution/transformed_distribution.py †)."""
+from __future__ import annotations
+
+from ..tensor.tensor import _run_op
+from .distribution import Distribution, sum_rightmost
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        shape = tuple(base._batch_shape) + tuple(base._event_shape)
+        # track the event rank through the chain: each transform needs at
+        # least its domain rank, and maps domain rank -> codomain rank
+        rank = len(base._event_shape)
+        for t in self.transforms:
+            rank = max(rank, t._domain_rank)
+            rank = rank - t._domain_rank + t._codomain_rank
+            shape = tuple(t.forward_shape(shape))
+        cut = len(shape) - rank
+        super().__init__(shape[:cut], shape[cut:])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        # walk backwards tracking the event rank of y at each point
+        rank = len(self._event_shape)
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            term = sum_rightmost(
+                _run_op("neg", lambda a: -a, (ldj,), {}),
+                rank - t._codomain_rank)
+            lp = term if lp is None else _run_op("add", lambda a, b: a + b,
+                                                 (lp, term), {})
+            rank = rank - t._codomain_rank + t._domain_rank
+            y = x
+        base_lp = sum_rightmost(self.base.log_prob(y),
+                                rank - len(self.base._event_shape))
+        if lp is None:
+            return base_lp
+        return _run_op("add", lambda a, b: a + b, (lp, base_lp), {})

@@ -22,8 +22,11 @@ _NORMS = ("backward", "ortho", "forward")
 
 
 def _check_norm(norm):
-    if norm is None:
-        return "backward"
+    """Validate and canonicalize; "backward" becomes None so jnp skips its
+    norm-scaling path entirely (identity scale — and the scale multiply can
+    land on the wrong device under a non-default current place)."""
+    if norm is None or norm == "backward":
+        return None
     if norm not in _NORMS:
         raise ValueError(
             f"Unexpected norm: {norm!r}. Norm should be 'forward', 'backward' "
@@ -114,24 +117,23 @@ def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return ihfftn(x, s=s, axes=axes, norm=norm)
 
 
+def _freq(np_fn, n, d, dtype):
+    # host-side numpy so the Tensor ctor places it on the current device
+    import numpy as np
+    out = np_fn(n, d=d)
+    if dtype is None:
+        out = out.astype(np.float32)
+    return Tensor(out, dtype=dtype)
+
+
 def fftfreq(n, d=1.0, dtype=None, name=None):
-    out = jnp.fft.fftfreq(n, d=d)
-    if dtype is not None:
-        from ..framework import dtype as dtype_mod
-        out = out.astype(dtype_mod.convert_dtype(dtype))
-    else:
-        out = out.astype(jnp.float32)
-    return Tensor._from_data(out)
+    import numpy as np
+    return _freq(np.fft.fftfreq, n, d, dtype)
 
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
-    out = jnp.fft.rfftfreq(n, d=d)
-    if dtype is not None:
-        from ..framework import dtype as dtype_mod
-        out = out.astype(dtype_mod.convert_dtype(dtype))
-    else:
-        out = out.astype(jnp.float32)
-    return Tensor._from_data(out)
+    import numpy as np
+    return _freq(np.fft.rfftfreq, n, d, dtype)
 
 
 def fftshift(x, axes=None, name=None):

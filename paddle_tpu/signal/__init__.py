@@ -67,6 +67,18 @@ def overlap_add(x, hop_length, axis=-1, name=None):
                    lambda a: _overlap_add_data(a, hop_length, axis), (x,), {})
 
 
+def _check_window(n_fft, win_length, window):
+    if not 0 < win_length <= n_fft:
+        raise ValueError(
+            f"win_length ({win_length}) must be in (0, n_fft={n_fft}]")
+    if window is not None:
+        wlen = (window.shape[0] if isinstance(window, Tensor)
+                else len(window))
+        if wlen != win_length:
+            raise ValueError(
+                f"window length ({wlen}) must equal win_length ({win_length})")
+
+
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
          pad_mode="reflect", normalized=False, onesided=True, name=None):
     """STFT of a (batch, seq) or (seq,) real/complex signal.
@@ -75,6 +87,7 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     """
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    _check_window(n_fft, win_length, window)
     xdata = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     if onesided and jnp.iscomplexobj(xdata):
         raise ValueError("stft: onesided must be False for complex input "
@@ -114,6 +127,7 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
           name=None):
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    _check_window(n_fft, win_length, window)
     if onesided and return_complex:
         raise ValueError("istft: onesided=True cannot produce complex output; "
                          "pass onesided=False (reference asserts the same)")

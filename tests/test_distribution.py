@@ -1,0 +1,220 @@
+"""paddle.distribution tests: densities vs closed forms, sampling moments,
+KL registry, transforms, gradient flow (reparameterization)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+class TestDensities:
+    def test_normal_log_prob_entropy(self):
+        n = D.Normal(1.0, 2.0)
+        v = 0.5
+        want = -((v - 1.0) ** 2) / 8 - math.log(2.0) - 0.5 * math.log(2 * math.pi)
+        np.testing.assert_allclose(float(n.log_prob(paddle.Tensor(v))), want, rtol=1e-5)
+        np.testing.assert_allclose(float(n.entropy()),
+                                   0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0),
+                                   rtol=1e-5)
+
+    def test_uniform(self):
+        u = D.Uniform(0.0, 4.0)
+        assert abs(float(u.log_prob(paddle.Tensor(1.0))) - math.log(0.25)) < 1e-5
+        assert float(u.log_prob(paddle.Tensor(5.0))) == -np.inf
+        assert abs(float(u.entropy()) - math.log(4.0)) < 1e-5
+
+    def test_gamma_beta_dirichlet(self):
+        g = D.Gamma(2.0, 3.0)
+        # log p(x) = c log r + (c-1) log x - r x - lgamma(c)
+        x = 0.7
+        want = 2 * math.log(3) + math.log(x) - 3 * x - math.lgamma(2.0)
+        np.testing.assert_allclose(float(g.log_prob(paddle.Tensor(x))), want, rtol=1e-5)
+
+        b = D.Beta(2.0, 3.0)
+        x = 0.3
+        want = (math.log(x) + 2 * math.log(1 - x)
+                - (math.lgamma(2) + math.lgamma(3) - math.lgamma(5)))
+        np.testing.assert_allclose(float(b.log_prob(paddle.Tensor(x))), want, rtol=1e-5)
+
+        d = D.Dirichlet(paddle.Tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        want = (math.lgamma(6) - math.lgamma(1) - math.lgamma(2) - math.lgamma(3)
+                + 0 * math.log(0.2) + 1 * math.log(0.3) + 2 * math.log(0.5))
+        np.testing.assert_allclose(float(d.log_prob(paddle.Tensor(v))), want, rtol=1e-4)
+
+    def test_discrete(self):
+        bern = D.Bernoulli(probs=0.3)
+        np.testing.assert_allclose(float(bern.log_prob(paddle.Tensor(1.0))),
+                                   math.log(0.3), rtol=1e-5)
+        cat = D.Categorical(logits=paddle.Tensor(np.log(np.array([0.2, 0.8], np.float32))))
+        np.testing.assert_allclose(float(cat.log_prob(paddle.Tensor(np.int64(1)))),
+                                   math.log(0.8), rtol=1e-4)
+        geom = D.Geometric(0.25)
+        np.testing.assert_allclose(float(geom.log_prob(paddle.Tensor(3.0))),
+                                   3 * math.log(0.75) + math.log(0.25), rtol=1e-5)
+        poi = D.Poisson(4.0)
+        np.testing.assert_allclose(float(poi.log_prob(paddle.Tensor(2.0))),
+                                   2 * math.log(4) - 4 - math.lgamma(3.0), rtol=1e-5)
+
+    def test_mvn(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = D.MultivariateNormal(paddle.Tensor(np.zeros(2, np.float32)),
+                                   covariance_matrix=paddle.Tensor(cov))
+        v = np.array([0.3, -0.2], np.float32)
+        inv = np.linalg.inv(cov)
+        want = (-0.5 * v @ inv @ v - 0.5 * np.log(np.linalg.det(cov))
+                - math.log(2 * math.pi))
+        np.testing.assert_allclose(float(mvn.log_prob(paddle.Tensor(v))), want,
+                                   rtol=1e-4)
+
+
+class TestSampling:
+    def test_moments(self):
+        paddle.seed(7)
+        for dist, mean, std in [
+            (D.Normal(2.0, 0.5), 2.0, 0.5),
+            (D.Uniform(0.0, 1.0), 0.5, 1 / math.sqrt(12)),
+            (D.Exponential(2.0), 0.5, 0.5),
+            (D.Laplace(0.0, 1.0), 0.0, math.sqrt(2)),
+            (D.Gumbel(0.0, 1.0), 0.5772, math.pi / math.sqrt(6)),
+            (D.Gamma(4.0, 2.0), 2.0, 1.0),
+        ]:
+            s = _np(dist.sample((20000,)))
+            np.testing.assert_allclose(s.mean(), mean, atol=5 * std / math.sqrt(20000) + 0.01)
+            np.testing.assert_allclose(s.std(), std, rtol=0.1)
+
+    def test_discrete_sampling(self):
+        paddle.seed(11)
+        cat = D.Categorical(logits=paddle.Tensor(np.log(np.array([0.1, 0.6, 0.3], np.float32))))
+        s = _np(cat.sample((10000,)))
+        freq = np.bincount(s.astype(int), minlength=3) / 10000
+        np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.03)
+
+        m = D.Multinomial(10, paddle.Tensor(np.array([0.5, 0.5], np.float32)))
+        s = _np(m.sample((200,)))
+        assert s.shape == (200, 2)
+        np.testing.assert_allclose(s.sum(-1), 10)
+
+        b = D.Binomial(20, 0.3)
+        s = _np(b.sample((5000,)))
+        np.testing.assert_allclose(s.mean(), 6.0, atol=0.3)
+
+    def test_shapes(self):
+        n = D.Normal(paddle.Tensor(np.zeros((3, 4), np.float32)), 1.0)
+        assert n.batch_shape == [3, 4]
+        assert n.sample((2,)).shape == [2, 3, 4]
+        d = D.Dirichlet(paddle.Tensor(np.ones((5, 3), np.float32)))
+        assert d.batch_shape == [5] and d.event_shape == [3]
+        assert d.sample((2,)).shape == [2, 5, 3]
+        lp = d.log_prob(d.sample())
+        assert lp.shape == [5]
+
+
+class TestKL:
+    def test_normal_kl_closed_form(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        want = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), want, rtol=1e-5)
+        # KL(p||p) == 0
+        assert abs(float(D.kl_divergence(p, p))) < 1e-6
+
+    def test_kl_vs_monte_carlo(self):
+        paddle.seed(3)
+        pairs = [
+            (D.Gamma(2.0, 1.5), D.Gamma(3.0, 1.0)),
+            (D.Beta(2.0, 2.0), D.Beta(1.5, 3.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+            (D.Gumbel(0.0, 1.0), D.Gumbel(0.3, 1.4)),
+            (D.Categorical(logits=paddle.Tensor(np.array([0.3, 0.7, 1.0], np.float32))),
+             D.Categorical(logits=paddle.Tensor(np.array([1.0, 0.2, 0.1], np.float32)))),
+        ]
+        for p, q in pairs:
+            kl = float(D.kl_divergence(p, q))
+            if isinstance(p, D.Categorical):
+                s = p.sample((8000,))
+            else:
+                s = p.sample((8000,))
+            mc = float((p.log_prob(s) - q.log_prob(s)).mean())
+            assert abs(kl - mc) < max(0.08, 0.15 * abs(kl)), (type(p).__name__, kl, mc)
+
+    def test_kl_independent_and_registry(self):
+        p = D.Independent(D.Normal(paddle.Tensor(np.zeros(4, np.float32)), 1.0), 1)
+        q = D.Independent(D.Normal(paddle.Tensor(np.ones(4, np.float32)), 1.0), 1)
+        np.testing.assert_allclose(float(D.kl_divergence(p, q)), 4 * 0.5, rtol=1e-5)
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(0.0, 1.0), D.Gamma(1.0, 1.0))
+
+
+class TestTransforms:
+    def test_roundtrip_and_jacobian(self):
+        x = paddle.Tensor(np.random.RandomState(0).randn(16).astype(np.float32))
+        for t in [D.ExpTransform(), D.TanhTransform(), D.SigmoidTransform(),
+                  D.AffineTransform(1.0, 3.0)]:
+            y = t.forward(x)
+            back = t.inverse(y)
+            np.testing.assert_allclose(_np(back), _np(x), rtol=1e-3, atol=1e-4)
+            # numeric jacobian check
+            fldj = _np(t.forward_log_det_jacobian(x))
+            eps = 1e-3
+            y2 = t.forward(paddle.Tensor(_np(x) + eps))
+            num = np.log(np.abs((_np(y2) - _np(y)) / eps))
+            np.testing.assert_allclose(fldj, num, atol=2e-2)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.Tensor(np.random.RandomState(1).randn(4).astype(np.float32))
+        y = t.forward(x)
+        assert y.shape == [5]
+        np.testing.assert_allclose(_np(y).sum(), 1.0, rtol=1e-5)
+        back = t.inverse(y)
+        np.testing.assert_allclose(_np(back), _np(x), rtol=1e-3, atol=1e-4)
+
+    def test_transformed_distribution_lognormal(self):
+        paddle.seed(5)
+        td = D.TransformedDistribution(D.Normal(0.2, 0.4), [D.ExpTransform()])
+        ln = D.LogNormal(0.2, 0.4)
+        v = paddle.Tensor(np.array([0.5, 1.5], np.float32))
+        np.testing.assert_allclose(_np(td.log_prob(v)), _np(ln.log_prob(v)),
+                                   rtol=1e-4)
+        s = _np(td.sample((20000,)))
+        np.testing.assert_allclose(s.mean(), math.exp(0.2 + 0.08), rtol=0.05)
+
+    def test_chain_and_reshape(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = paddle.Tensor(np.array([0.1, 0.5], np.float32))
+        y = chain.forward(x)
+        np.testing.assert_allclose(_np(y), np.exp(2 * _np(x)), rtol=1e-5)
+        r = D.ReshapeTransform((4,), (2, 2))
+        z = r.forward(paddle.Tensor(np.arange(4.0, dtype=np.float32)))
+        assert z.shape == [2, 2]
+
+
+class TestGradients:
+    def test_reparameterized_pathwise_gradient(self):
+        paddle.seed(9)
+        # d/d mu E[x^2] where x ~ N(mu, 1) is 2 mu; check via rsample
+        mu = paddle.Tensor(np.float32(1.5), stop_gradient=False)
+        n = D.Normal(mu, 1.0)
+        loss = (n.rsample((4000,)) ** 2).mean()
+        loss.backward()
+        np.testing.assert_allclose(float(mu.grad), 3.0, atol=0.2)
+
+    def test_log_prob_gradient(self):
+        loc = paddle.Tensor(np.float32(0.0), stop_gradient=False)
+        n = D.Normal(loc, 1.0)
+        lp = n.log_prob(paddle.Tensor(2.0))
+        lp.backward()
+        np.testing.assert_allclose(float(loc.grad), 2.0, rtol=1e-5)
+
+    def test_kl_gradient(self):
+        scale = paddle.Tensor(np.float32(1.0), stop_gradient=False)
+        kl = D.kl_divergence(D.Normal(0.0, scale), D.Normal(0.0, 2.0))
+        kl.backward()
+        # d/ds [s^2/8 - log(s/2) - 1/2]... closed form: s/4 - 1/s at s=1 -> -0.75
+        np.testing.assert_allclose(float(scale.grad), -0.75, rtol=1e-4)
