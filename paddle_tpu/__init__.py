@@ -37,7 +37,7 @@ import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
              "incubate", "ops", "profiler", "device", "hapi", "static",
-             "inference", "runtime", "fft", "signal", "distribution"):
+             "inference", "runtime", "fft", "signal", "distribution", "sparse"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ImportError:

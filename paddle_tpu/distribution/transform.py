@@ -207,6 +207,25 @@ class StickBreakingTransform(Transform):
 class ChainTransform(Transform):
     def __init__(self, transforms):
         self.transforms = list(transforms)
+        # compose event ranks: widen the domain when a member needs more
+        # event dims than the running rank provides
+        rank, need = 0, 0
+        for t in self.transforms:
+            if rank < t._domain_rank:
+                need += t._domain_rank - rank
+                rank = t._domain_rank
+            rank = rank - t._domain_rank + t._codomain_rank
+        self._chain_domain_rank = need
+        self._chain_codomain_rank = rank
+        self._event_rank = max(need, rank)
+
+    @property
+    def _domain_rank(self):
+        return self._chain_domain_rank
+
+    @property
+    def _codomain_rank(self):
+        return self._chain_codomain_rank
 
     def forward(self, x):
         for t in self.transforms:
@@ -219,11 +238,18 @@ class ChainTransform(Transform):
         return y
 
     def forward_log_det_jacobian(self, x):
+        from .distribution import sum_rightmost
         total = None
+        rank = self._chain_domain_rank
         for t in self.transforms:
-            term = t.forward_log_det_jacobian(x)
+            rank = max(rank, t._domain_rank)
+            # reduce each member's per-element jacobian over the chain's
+            # event dims beyond the member's own rank, so terms line up
+            term = sum_rightmost(t.forward_log_det_jacobian(x),
+                                 rank - t._domain_rank)
             total = term if total is None else _run_op(
                 "add", lambda a, b: a + b, (total, term), {})
+            rank = rank - t._domain_rank + t._codomain_rank
             x = t.forward(x)
         return total
 
@@ -254,11 +280,9 @@ class IndependentTransform(Transform):
         return self.base.inverse(y)
 
     def forward_log_det_jacobian(self, x):
-        ld = self.base.forward_log_det_jacobian(x)
-        k = self.reinterpreted_batch_rank
-        return _run_op("indep_sum",
-                       lambda a: a.sum(axis=tuple(range(a.ndim - k, a.ndim))),
-                       (ld,), {})
+        from .distribution import sum_rightmost
+        return sum_rightmost(self.base.forward_log_det_jacobian(x),
+                             self.reinterpreted_batch_rank)
 
 
 class ReshapeTransform(Transform):

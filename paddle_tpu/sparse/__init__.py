@@ -1,0 +1,449 @@
+"""paddle.sparse parity (ref: python/paddle/sparse/ †).
+
+TPU-native design: a SparseCooTensor/SparseCsrTensor is a pair of dense
+eager Tensors (indices, values) — every sparse op is expressed as gather /
+segment-sum on the values, which XLA lowers to on-chip scatter/gather. This
+keeps sparse ops inside the same vjp tape as dense ops (gradients flow
+through ``values``), instead of a separate sparse kernel zoo like the
+reference's paddle/phi/kernels/sparse/.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, _run_op, unwrap
+
+from . import nn  # noqa: F401  (re-exported subpackage, populated below)
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape", "add", "subtract", "multiply",
+    "divide", "matmul", "masked_matmul", "mv", "transpose", "sum", "nn",
+]
+
+
+def _as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x if dtype is None else x.astype(dtype)
+    return Tensor(np.asarray(x), dtype=dtype)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: indices (sparse_dim, nnz) int64, values (nnz, *dense_dims)."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = _as_tensor(indices, dtype="int64")
+        self._values = values if isinstance(values, Tensor) else _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- paddle Tensor-protocol surface -----------------------------------
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def nnz(self):
+        return int(self._values._data.shape[0])
+
+    def indices(self):
+        return self._indices
+
+    def values(self):
+        return self._values
+
+    def to_dense(self):
+        shape = self._shape
+        sd = self._indices._data.shape[0]
+
+        def f(idx, vals):
+            out = jnp.zeros(shape[:sd] + tuple(vals.shape[1:]), vals.dtype)
+            return out.at[tuple(idx[i] for i in range(sd))].add(vals)
+        return _run_op("sparse_to_dense", f, (self._indices, self._values), {})
+
+    def to_sparse_csr(self):
+        if len(self._shape) != 2:
+            raise ValueError("to_sparse_csr requires a 2-D sparse tensor")
+        coo = self.coalesce()
+        idx = np.asarray(unwrap(coo._indices))
+        rows, cols = idx[0], idx[1]
+        crows = np.zeros(self._shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, cols, coo._values, self._shape)
+
+    def coalesce(self):
+        """Sort indices and sum duplicates (host-side index plan, taped values)."""
+        if self._coalesced:
+            return self
+        idx = np.asarray(unwrap(self._indices))
+        flat = np.ravel_multi_index(tuple(idx), self._shape[:idx.shape[0]])
+        uniq, inv = np.unique(flat, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(uniq, self._shape[:idx.shape[0]]))
+        n_out = len(uniq)
+
+        def f(vals):
+            out = jnp.zeros((n_out,) + vals.shape[1:], vals.dtype)
+            return out.at[inv].add(vals)
+        vals = _run_op("coo_coalesce", f, (self._values,), {})
+        return SparseCooTensor(new_idx, vals, self._shape, coalesced=True)
+
+    def detach(self):
+        return SparseCooTensor(self._indices, self._values.detach(), self._shape,
+                               self._coalesced)
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def transpose(self, perm):
+        return transpose(self, perm)
+
+    def matmul(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows (rows+1,), cols (nnz,), values (nnz,)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _as_tensor(crows, dtype="int64")
+        self._cols = _as_tensor(cols, dtype="int64")
+        self._values = values if isinstance(values, Tensor) else _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def nnz(self):
+        return int(self._values._data.shape[0])
+
+    def crows(self):
+        return self._crows
+
+    def cols(self):
+        return self._cols
+
+    def values(self):
+        return self._values
+
+    def _row_indices(self):
+        crows = np.asarray(unwrap(self._crows))
+        return np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        rows = self._row_indices()
+        cols = np.asarray(unwrap(self._cols))
+        idx = np.stack([rows, cols])
+        return SparseCooTensor(idx, self._values, self._shape, coalesced=True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense().numpy())
+
+    def detach(self):
+        return SparseCsrTensor(self._crows, self._cols, self._values.detach(),
+                               self._shape)
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    indices = _as_tensor(indices, dtype="int64")
+    values = _as_tensor(values, dtype=dtype)
+    if shape is None:
+        idx = np.asarray(unwrap(indices))
+        if idx.shape[1] == 0:
+            sparse_shape = (0,) * idx.shape[0]
+        else:
+            sparse_shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+        shape = sparse_shape + tuple(values._data.shape[1:])
+    t = SparseCooTensor(indices, values, shape)
+    t._values.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    values = _as_tensor(values, dtype=dtype)
+    t = SparseCsrTensor(crows, cols, values, shape)
+    t._values.stop_gradient = stop_gradient
+    return t
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+# -- elementwise sparse-sparse ops ------------------------------------------
+
+def _ewise(name, jfn):
+    def op(x, y, name=None):
+        xc, yc = _coo(x).coalesce(), _coo(y).coalesce()
+        if tuple(xc._shape) != tuple(yc._shape):
+            raise ValueError(f"sparse {name}: shape mismatch {xc._shape} vs {yc._shape}")
+        sd = xc._indices._data.shape[0]
+        xi = np.asarray(unwrap(xc._indices))
+        yi = np.asarray(unwrap(yc._indices))
+        xf = np.ravel_multi_index(tuple(xi), xc._shape[:sd])
+        yf = np.ravel_multi_index(tuple(yi), yc._shape[:sd])
+        uniq = np.union1d(xf, yf)
+        xpos = np.searchsorted(uniq, xf)
+        ypos = np.searchsorted(uniq, yf)
+        out_idx = np.stack(np.unravel_index(uniq, xc._shape[:sd]))
+        n = len(uniq)
+
+        def f(xv, yv):
+            dense_dims = xv.shape[1:]
+            a = jnp.zeros((n,) + dense_dims, xv.dtype).at[xpos].set(xv)
+            b = jnp.zeros((n,) + dense_dims, yv.dtype).at[ypos].set(yv)
+            return jfn(a, b)
+        vals = _run_op(f"sparse_{name}", f, (xc._values, yc._values), {})
+        out = SparseCooTensor(out_idx, vals, xc._shape, coalesced=True)
+        if isinstance(x, SparseCsrTensor):
+            return out.to_sparse_csr()
+        return out
+    op.__name__ = name
+    return op
+
+
+add = _ewise("add", lambda a, b: a + b)
+subtract = _ewise("subtract", lambda a, b: a - b)
+multiply = _ewise("multiply", lambda a, b: a * b)
+divide = _ewise("divide", lambda a, b: a / b)
+
+
+# -- matmul family -----------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (COO/CSR x dense; 2-D each side).
+
+    Gather rows of ``y`` by the sparse column index, scale by values, and
+    segment-sum into output rows — one fused gather/scatter pair on TPU.
+    """
+    if isinstance(x, SparseCsrTensor) or isinstance(x, SparseCooTensor):
+        xc = _coo(x).coalesce()
+        idx = np.asarray(unwrap(xc._indices))
+        rows, cols = idx[0], idx[1]
+        m = xc._shape[0]
+        ydata = y if isinstance(y, Tensor) else _as_tensor(y)
+
+        def f(vals, yd):
+            gathered = yd[cols] * vals.reshape((-1,) + (1,) * (yd.ndim - 1))
+            out = jnp.zeros((m,) + yd.shape[1:], gathered.dtype)
+            return out.at[rows].add(gathered)
+        return _run_op("sparse_matmul", f, (xc._values, ydata), {})
+    raise TypeError("sparse.matmul expects a sparse lhs")
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at ``mask``'s sparsity pattern (SDDMM)."""
+    mc = _coo(mask).coalesce() if isinstance(mask, (SparseCooTensor,)) else mask.to_sparse_coo()
+    idx = np.asarray(unwrap(mc._indices))
+    rows, cols = idx[0], idx[1]
+
+    def f(xd, yd):
+        xr = xd[rows]            # (nnz, K)
+        yc = yd[:, cols].T       # (nnz, K)
+        return (xr * yc).sum(-1)
+    vals = _run_op("masked_matmul", f,
+                   (_as_tensor(x), _as_tensor(y)), {})
+    out = SparseCooTensor(mc._indices, vals, (x.shape[0], y.shape[1]),
+                          coalesced=True)
+    if isinstance(mask, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def transpose(x, perm, name=None):
+    xc = _coo(x)
+    sd = xc._indices._data.shape[0]
+    if sorted(perm) != list(range(len(xc._shape))):
+        raise ValueError(f"transpose perm {perm} is not a permutation of "
+                         f"{len(xc._shape)} dims")
+    if any(p >= sd for p in perm[:sd]) or any(p < sd for p in perm[sd:]):
+        raise ValueError(
+            f"transpose cannot mix sparse dims (first {sd}) with dense dims")
+    new_shape = tuple(xc._shape[p] for p in perm)
+    out_idx = _run_op("coo_transpose_idx",
+                      lambda i: jnp.stack([i[p] for p in perm[:sd]]),
+                      (xc._indices,), {})
+    # values layout is (nnz, *dense_dims): permute the dense axes too
+    val_perm = (0,) + tuple(1 + (p - sd) for p in perm[sd:])
+    out_vals = _run_op("coo_transpose_vals",
+                       lambda v: jnp.transpose(v, val_perm),
+                       (xc._values,), {})
+    out = SparseCooTensor(out_idx, out_vals, new_shape)
+    if isinstance(x, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    xc = _coo(x)
+    if axis is None:
+        total = _run_op("sparse_sum_all", lambda v: v.sum(), (xc._values,), {})
+        return total
+    dense = xc.to_dense()
+    from ..tensor import math as tmath
+    return tmath.sum(dense, axis=axis, keepdim=keepdim)
+
+
+# -- unary value ops ---------------------------------------------------------
+
+def _unary(name, jfn):
+    def op(x, name=None):
+        xc = x if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else None
+        if xc is None:
+            raise TypeError(f"sparse.{name} expects a sparse tensor")
+        vals = _run_op(f"sparse_{name}", jfn, (x._values,), {})
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)
+expm1 = _unary("expm1", jnp.expm1)
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+
+
+def pow(x, factor, name=None):
+    vals = _run_op("sparse_pow", lambda v: jnp.power(v, factor), (x._values,), {})
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    vals = x._values if value_dtype is None else x._values.astype(value_dtype)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    idx = x._indices if index_dtype is None else x._indices.astype(index_dtype)
+    return SparseCooTensor(idx, vals, x._shape, x._coalesced)
+
+
+# dense Tensor -> sparse conversion methods (paddle parity)
+def _to_sparse_coo(self, sparse_dim=None):
+    data = np.asarray(unwrap(self))
+    sd = sparse_dim or data.ndim
+    nz = np.nonzero((data != 0).reshape(data.shape[:sd] + (-1,)).any(-1)
+                    if sd < data.ndim else data != 0)
+    idx = np.stack(nz) if nz[0].size else np.zeros((sd, 0), np.int64)
+
+    def f(d):
+        return d[tuple(idx[i] for i in range(sd))]
+    vals = _run_op("dense_to_coo", f, (self,), {})
+    return SparseCooTensor(idx, vals, data.shape)
+
+
+def _to_sparse_csr(self):
+    return _to_sparse_coo(self, 2).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _to_sparse_coo
+Tensor.to_sparse_csr = _to_sparse_csr
+Tensor.is_sparse = lambda self: False
+Tensor.is_sparse_coo = lambda self: False
+Tensor.is_sparse_csr = lambda self: False

@@ -1,0 +1,139 @@
+"""paddle.sparse.nn (ref: python/paddle/sparse/nn/ †).
+
+Activations apply to the values; Softmax is a per-row segment softmax;
+BatchNorm normalizes values per dense channel. Sparse 3-D convolutions
+(Conv3D/SubmConv3D, point-cloud workloads) are deferred — on TPU the
+idiomatic path is dense conv on voxelized blocks, planned atop these
+primitives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import _run_op, unwrap
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "functional"]
+
+
+def _map_values(x, name, jfn):
+    from . import SparseCooTensor, SparseCsrTensor
+    vals = _run_op(name, jfn, (x._values,), {})
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+
+
+class functional:
+    @staticmethod
+    def relu(x, name=None):
+        return _map_values(x, "sparse_relu", jax.nn.relu)
+
+    @staticmethod
+    def relu6(x, name=None):
+        return _map_values(x, "sparse_relu6", lambda v: jnp.clip(v, 0, 6))
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01, name=None):
+        return _map_values(x, "sparse_leaky_relu",
+                           lambda v: jax.nn.leaky_relu(v, negative_slope))
+
+    @staticmethod
+    def softmax(x, axis=-1, name=None):
+        """Row-wise softmax over the sparsity pattern (2-D CSR/COO)."""
+        from . import SparseCsrTensor, _coo
+        if axis != -1:
+            raise ValueError("sparse softmax only supports the last axis")
+        xc = _coo(x).coalesce()
+        rows = np.asarray(unwrap(xc._indices))[0]
+        nrows = xc._shape[0]
+
+        def f(v):
+            mx = jax.ops.segment_max(v, rows, nrows)
+            shifted = jnp.exp(v - mx[rows])
+            denom = jax.ops.segment_sum(shifted, rows, nrows)
+            return shifted / denom[rows]
+        vals = _run_op("sparse_softmax", f, (xc._values,), {})
+        from . import SparseCooTensor
+        out = SparseCooTensor(xc._indices, vals, xc._shape, coalesced=True)
+        if isinstance(x, SparseCsrTensor):
+            return out.to_sparse_csr()
+        return out
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values' trailing channel dim (NDHWC semantics:
+    normalizes each channel over all non-zero sites)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn.initializer import Constant
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter([num_features],
+                                            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter([num_features], is_bias=True)
+        from ..tensor.tensor import Tensor as _T
+        self.register_buffer("_mean", _T(np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance", _T(np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        training = self.training
+        mom = self.momentum
+
+        if training:
+            def f(v, w, b):
+                mean = v.mean(axis=tuple(range(v.ndim - 1)))
+                var = v.var(axis=tuple(range(v.ndim - 1)))
+                inv = jax.lax.rsqrt(var + self.epsilon)
+                return (v - mean) * inv * w + b, mean, var
+            vals, mean_t, var_t = _run_op(
+                "sparse_bn", f, (x._values, self.weight, self.bias), {})
+            # fold running stats from the already-computed batch moments
+            # (stays on device; .detach keeps buffers off the tape)
+            self._mean.set_value(
+                (mom * self._mean + (1 - mom) * mean_t.detach()).detach())
+            self._variance.set_value(
+                (mom * self._variance + (1 - mom) * var_t.detach()).detach())
+        else:
+            def f(v, w, b, m, var):
+                inv = jax.lax.rsqrt(var + self.epsilon)
+                return (v - m) * inv * w + b
+            vals = _run_op("sparse_bn_eval", f,
+                           (x._values, self.weight, self.bias,
+                            self._mean, self._variance), {})
+        return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)

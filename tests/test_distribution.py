@@ -218,3 +218,16 @@ class TestGradients:
         kl.backward()
         # d/ds [s^2/8 - log(s/2) - 1/2]... closed form: s/4 - 1/s at s=1 -> -0.75
         np.testing.assert_allclose(float(scale.grad), -0.75, rtol=1e-4)
+
+
+class TestChainEventRank:
+    def test_chain_with_rank1_member(self):
+        c = D.ChainTransform([D.ExpTransform(), D.StickBreakingTransform()])
+        x = paddle.Tensor(np.random.RandomState(0).randn(3).astype(np.float32))
+        assert c.forward_log_det_jacobian(x).shape == []
+        td = D.TransformedDistribution(
+            D.Normal(paddle.Tensor(np.zeros(3, np.float32)), 1.0),
+            [D.ChainTransform([D.StickBreakingTransform()])])
+        assert td.batch_shape == [] and td.event_shape == [4]
+        lp = td.log_prob(td.sample())
+        assert lp.shape == []

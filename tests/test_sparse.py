@@ -1,0 +1,157 @@
+"""paddle.sparse tests: COO/CSR creation+conversion, ops vs dense reference,
+autograd through sparse values."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype(np.float32)
+    dense[rng.rand(*shape) > density] = 0.0
+    return dense
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        dense = _rand_coo((5, 6))
+        t = paddle.Tensor(dense).to_sparse_coo()
+        assert t.is_sparse() and t.is_sparse_coo()
+        assert t.nnz() == int((dense != 0).sum())
+        np.testing.assert_allclose(t.to_dense().numpy(), dense)
+
+    def test_csr_roundtrip(self):
+        dense = _rand_coo((4, 7), seed=1)
+        t = paddle.Tensor(dense).to_sparse_csr()
+        assert t.is_sparse_csr()
+        np.testing.assert_allclose(t.to_dense().numpy(), dense)
+        back = t.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+    def test_sparse_coo_tensor_ctor(self):
+        idx = np.array([[0, 1, 2], [1, 2, 0]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        t = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(t.to_dense().numpy(), want)
+
+    def test_coalesce_sums_duplicates(self):
+        idx = np.array([[0, 0], [1, 1]])
+        t = sparse.sparse_coo_tensor(idx, np.array([2.0, 5.0], np.float32), [2, 2])
+        c = t.coalesce()
+        assert c.nnz() == 1
+        assert float(c.values()) == 7.0
+
+    def test_csr_fields(self):
+        dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+        t = paddle.Tensor(dense).to_sparse_csr()
+        np.testing.assert_array_equal(t.crows().numpy(), [0, 2, 3])
+        np.testing.assert_array_equal(t.cols().numpy(), [0, 2, 2])
+        np.testing.assert_allclose(t.values().numpy(), [1, 2, 3])
+
+
+class TestOps:
+    def test_elementwise(self):
+        a, b = _rand_coo((6, 5), seed=2), _rand_coo((6, 5), seed=3)
+        sa = paddle.Tensor(a).to_sparse_coo()
+        sb = paddle.Tensor(b).to_sparse_coo()
+        np.testing.assert_allclose((sa + sb).to_dense().numpy(), a + b, rtol=1e-5)
+        np.testing.assert_allclose((sa - sb).to_dense().numpy(), a - b, rtol=1e-5)
+        np.testing.assert_allclose(sparse.multiply(sa, sb).to_dense().numpy(),
+                                   a * b, rtol=1e-5)
+
+    def test_matmul_coo_csr(self):
+        a = _rand_coo((5, 8), seed=4)
+        y = np.random.RandomState(5).randn(8, 3).astype(np.float32)
+        for conv in ("to_sparse_coo", "to_sparse_csr"):
+            sa = getattr(paddle.Tensor(a), conv)()
+            out = sparse.matmul(sa, paddle.Tensor(y))
+            np.testing.assert_allclose(out.numpy(), a @ y, rtol=1e-4, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(4, 6).astype(np.float32)
+        y = rng.randn(6, 5).astype(np.float32)
+        mask = paddle.Tensor(_rand_coo((4, 5), seed=7)).to_sparse_coo()
+        out = sparse.masked_matmul(paddle.Tensor(x), paddle.Tensor(y), mask)
+        full = x @ y
+        want = np.where(mask.to_dense().numpy() != 0, full, 0)
+        np.testing.assert_allclose(out.to_dense().numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_transpose_unary(self):
+        a = _rand_coo((3, 4), seed=8)
+        sa = paddle.Tensor(a).to_sparse_coo()
+        np.testing.assert_allclose(sparse.transpose(sa, [1, 0]).to_dense().numpy(),
+                                   a.T)
+        np.testing.assert_allclose(sparse.sin(sa).to_dense().numpy(), np.sin(a),
+                                   rtol=1e-5, atol=1e-6)
+        assert abs(float(sparse.sum(sa)) - a.sum()) < 1e-4
+
+    def test_softmax(self):
+        a = _rand_coo((4, 6), seed=9)
+        sa = paddle.Tensor(a).to_sparse_csr()
+        sm = sparse.nn.functional.softmax(sa)
+        dense = sm.to_dense().numpy()
+        mask = a != 0
+        for r in range(4):
+            if mask[r].any():
+                vals = a[r][mask[r]]
+                want = np.exp(vals - vals.max())
+                want = want / want.sum()
+                np.testing.assert_allclose(dense[r][mask[r]], want, rtol=1e-4)
+
+
+class TestAutogradAndNN:
+    def test_grad_through_values(self):
+        dense = _rand_coo((5, 4), seed=10)
+        t = paddle.Tensor(dense).to_sparse_coo()
+        t.stop_gradient = False
+        y = np.random.RandomState(11).randn(4, 2).astype(np.float32)
+        out = sparse.matmul(t, paddle.Tensor(y))
+        out.sum().backward()
+        g = t.grad
+        assert g is not None and g.shape == [t.nnz()]
+        # d/dv sum(v_k * y[col_k, :]) = y[col_k, :].sum()
+        idx = t.indices().numpy()
+        want = y[idx[1]].sum(-1)
+        np.testing.assert_allclose(g.numpy(), want, rtol=1e-5)
+
+    def test_relu_layer_and_bn(self):
+        a = _rand_coo((6, 8), seed=12)
+        sa = paddle.Tensor(a).to_sparse_coo()
+        out = sparse.nn.ReLU()(sa)
+        np.testing.assert_allclose(out.to_dense().numpy(), np.maximum(a, 0))
+
+        bn = sparse.nn.BatchNorm(3)
+        vals_in = paddle.Tensor(np.random.RandomState(13).randn(10, 3).astype(np.float32))
+        coo = sparse.sparse_coo_tensor(
+            np.stack([np.arange(10), np.arange(10)]), vals_in, [10, 10, 3])
+        out = bn(coo)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(0), bn.bias.numpy(), atol=1e-4)
+
+
+class TestRegressions:
+    def test_transpose_dense_dims(self):
+        dense = np.arange(2 * 2 * 3 * 4, dtype=np.float32).reshape(2, 2, 3, 4)
+        coo = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                       dense[[0, 1], [1, 0]], [2, 2, 3, 4])
+        tr = sparse.transpose(coo, [0, 1, 3, 2])
+        np.testing.assert_allclose(tr.to_dense().numpy(),
+                                   coo.to_dense().numpy().transpose(0, 1, 3, 2))
+        with pytest.raises(ValueError):
+            sparse.transpose(coo, [2, 1, 0, 3])
+
+    def test_empty_coo_inferred_shape(self):
+        e = sparse.sparse_coo_tensor(np.zeros((2, 0), np.int64),
+                                     np.zeros((0,), np.float32))
+        assert e.shape == [0, 0] and e.nnz() == 0
+
+    def test_coalesce_idempotent(self):
+        coo = sparse.sparse_coo_tensor(np.array([[0], [1]]),
+                                       np.ones(1, np.float32), [2, 2])
+        c1 = coo.coalesce()
+        assert c1.coalesce() is c1
