@@ -61,16 +61,19 @@ def main():
     ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1).astype(np.int32)
 
-    # warmup (compile) + 2 steps
+    # warmup (compile) + 2 steps. NOTE: sync via device_get, not
+    # block_until_ready — the axon remote-TPU platform returns from
+    # block_until_ready before execution finishes, which inflates
+    # throughput ~1000x. A host transfer of the loss is a true barrier.
     for _ in range(3):
         params, opt, loss = step(params, opt, ids, labels)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
 
     n_steps = 10 if on_tpu else 2
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt, loss = step(params, opt, ids, labels)
-    jax.block_until_ready(loss)
+    jax.device_get(loss)
     dt = (time.perf_counter() - t0) / n_steps
 
     tokens_per_step = batch * seq

@@ -12,6 +12,15 @@ def set_interpret(value: bool | None):
     _FORCE_INTERPRET = value
 
 
+def mosaic_trace_ctx():
+    """Trace Pallas kernels with x64 off: the package enables jax_enable_x64
+    globally (Paddle dtype semantics), but Mosaic cannot legalize the 64-bit
+    index/constant types that leak into the kernel trace ("failed to legalize
+    operation 'func.return'" on v5e). Kernel inputs/outputs are explicit f32/
+    bf16, so disabling x64 inside the trace is semantics-preserving."""
+    return jax.enable_x64(False)
+
+
 def interpret_mode() -> bool:
     """Pallas kernels must run interpreted off-TPU. The axon TPU plugin stays
     the default backend even when work is pinned to host CPU devices (tests,

@@ -29,6 +29,7 @@ DEFAULT_BLOCK_K = 128
 
 
 from ._common import interpret_mode as _interpret
+from ._common import mosaic_trace_ctx as _mosaic_ctx
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
@@ -68,7 +69,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
 
     m, l, acc = lax.fori_loop(np.int32(0), nblocks, body, (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    # 2-D store ([1, BQ]); Mosaic fails to legalize 1-D vector stores.
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
@@ -80,24 +82,25 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     grid = (bh, pl.cdiv(s, block_q))
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
                                scale=scale, seq_k=sk)
-    o, lse = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
-        ],
-        interpret=_interpret(),
-    )(q, k, v)
+    with _mosaic_ctx():
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(q, k, v)
     return o, lse.reshape(bh, s)
 
 

@@ -15,6 +15,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 from ._common import interpret_mode as _interpret
+from ._common import mosaic_trace_ctx as _mosaic_ctx
 
 
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -27,17 +28,19 @@ def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
 def _rms_fwd_impl(x2d, w, eps, block_rows):
     n, h = x2d.shape
     grid = (pl.cdiv(n, block_rows),)
-    return pl.pallas_call(
-        functools.partial(_rms_kernel, eps=eps),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
-            pl.BlockSpec((h,), lambda i: (0,)),
-        ],
-        out_specs=pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
-        interpret=_interpret(),
-    )(x2d, w)
+    # Mosaic rejects 1-D blocks; feed the weight as a [1, H] tile.
+    with _mosaic_ctx():
+        return pl.pallas_call(
+            functools.partial(_rms_kernel, eps=eps),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                pl.BlockSpec((1, h), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+            interpret=_interpret(),
+        )(x2d, w.reshape(1, h))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
