@@ -59,11 +59,20 @@ class TrainStep:
                 for k, p in self.param_objs.items()}
             params = {k: jax.device_put(v, self.param_shardings[k])
                       for k, v in params.items()}
+            # ZeRO stage 1/2 (group_sharded 'os'/'os_g'): optimizer states
+            # shard over the 'sharding' axis even when the param itself is
+            # replicated — XLA then reduce-scatters grads into the update.
+            opt_shardings = {}
+            for k in self.trainable_keys:
+                os_spec = getattr(self.param_objs[k], "opt_state_pspec", None)
+                opt_shardings[k] = (NamedSharding(mesh, os_spec)
+                                    if os_spec is not None
+                                    else self.param_shardings[k])
             opt_states = {
                 k: jax.tree_util.tree_map(
-                    lambda a, s=self.param_shardings[k]: jax.device_put(
-                        a, s if a.ndim == params[k].ndim else
-                        NamedSharding(mesh, P())),
+                    lambda a, s=opt_shardings[k], nd=params[k].ndim:
+                        jax.device_put(a, s if a.ndim == nd else
+                                       NamedSharding(mesh, P())),
                     opt_states[k])
                 for k in self.trainable_keys}
             buffers = {k: jax.device_put(v, NamedSharding(mesh, P()))
@@ -72,6 +81,7 @@ class TrainStep:
         self.buffers = buffers
         self.opt_states = opt_states
 
+        param_shardings_ref = getattr(self, "param_shardings", None)
         clip = optimizer._grad_clip
         clip_norm = getattr(clip, "clip_norm", None) if clip is not None else None
         update_rule = optimizer._update
@@ -117,7 +127,14 @@ class TrainStep:
                 new_p, new_s = update_rule(
                     p32.astype(jnp.float32) if p32.dtype != jnp.float32 else p32,
                     grads[k], opt_states[k], lr, wd_map[k], {})
-                new_params[k] = new_p.astype(train_params[k].dtype)
+                new_p = new_p.astype(train_params[k].dtype)
+                if param_shardings_ref is not None:
+                    # keep the param on its declared layout: replicated for
+                    # ZeRO-1/2 (gathers the sharded update), sharded for
+                    # ZeRO-3/TP — the reference's post-step broadcast
+                    new_p = jax.lax.with_sharding_constraint(
+                        new_p, param_shardings_ref[k])
+                new_params[k] = new_p
                 new_states[k] = new_s
             return new_params, new_states, new_buffers, loss
 

@@ -35,7 +35,8 @@ from ._common import mosaic_trace_ctx as _mosaic_ctx
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
                 scale, seq_k):
     import numpy as np
-    bk_i = np.int32(block_k)  # keep ALL index math i32 (x64 mode is on)
+    bk_i = np.int32(block_k)  # i32 casts are belt-and-braces; the trace runs
+    # under mosaic_trace_ctx (x64 disabled) — see _common.mosaic_trace_ctx
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
     bq, d = q.shape
