@@ -195,3 +195,44 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         out = jax.random.categorical(key, logits, axis=-1,
                                      shape=(x._data.shape[0], num_samples))
     return Tensor._from_data(out.astype(np.int64))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return normal(mean=0.0, std=1.0, shape=shape, dtype=dtype)
+
+
+def standard_gamma(x, name=None):
+    key = random_mod.next_key()
+    return Tensor._from_data(jax.random.gamma(key, x._data))
+
+
+def poisson(x, name=None):
+    key = random_mod.next_key()
+    return Tensor._from_data(
+        jax.random.poisson(key, x._data.astype(jnp.float32)).astype(x._data.dtype))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    key = random_mod.next_key()
+    d = jax.random.normal(key, _shape_tuple(shape or ())) * std + mean
+    return Tensor._from_data(jnp.exp(d))
+
+
+def polar(abs, angle, name=None):
+    return _run_op("polar",
+                   lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                   (abs, angle), {})
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    nd = dtype_mod.convert_dtype(dtype) or np.int64
+    return Tensor._from_data(jnp.asarray(np.stack([r, c]), dtype=nd))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    nd = dtype_mod.convert_dtype(dtype) or np.int64
+    return Tensor._from_data(jnp.asarray(np.stack([r, c]), dtype=nd))

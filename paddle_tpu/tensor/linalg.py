@@ -181,3 +181,156 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 def multi_dot(x, name=None):
     return _run_op("multi_dot", lambda *ts: jnp.linalg.multi_dot(ts), tuple(x), {})
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve ``A @ out = x`` given Cholesky factor ``y`` of A
+    (ref: paddle.linalg.cholesky_solve)."""
+    return _run_op(
+        "cholesky_solve",
+        lambda b, c: jax.scipy.linalg.cho_solve((c, not upper), b), (x, y), {})
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack LAPACK-style LU factorization into P, L, U; batched like the
+    reference (ref: paddle.linalg.lu_unpack)."""
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        def one(lu2, piv2):
+            l = jnp.tril(lu2[:, :k], -1) + jnp.eye(m, k, dtype=lu2.dtype)
+            u = jnp.triu(lu2[:k, :])
+            # pivots are sequential row swaps: row i <-> row piv2[i]
+            perm = jnp.arange(m)
+            for i in range(piv2.shape[0]):
+                j = piv2[i]
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+            pmat = jnp.eye(m, dtype=lu2.dtype)[:, perm]
+            return pmat, l, u
+        if lu_.ndim == 2:
+            return one(lu_, piv)
+        bl = lu_.reshape((-1, m, n))
+        bp = piv.reshape((-1, piv.shape[-1]))
+        pm, l, u = jax.vmap(one)(bl, bp)
+        lead = lu_.shape[:-2]
+        return (pm.reshape(lead + pm.shape[1:]), l.reshape(lead + l.shape[1:]),
+                u.reshape(lead + u.shape[1:]))
+    return _run_op("lu_unpack", f, (x, y), {})
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distance between row vectors (ref: paddle.cdist)."""
+    def f(a, b):
+        if p == 2.0:
+            # MXU-friendly: |a-b|^2 = |a|^2 + |b|^2 - 2 a.b via one matmul
+            a2 = jnp.sum(a * a, axis=-1, keepdims=True)
+            b2 = jnp.sum(b * b, axis=-1, keepdims=True)
+            sq = a2 + jnp.swapaxes(b2, -1, -2) - 2.0 * jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            return jnp.sqrt(jnp.maximum(sq, 0.0))
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return _run_op("cdist", f, (x, y), {})
+
+
+def householder_product(x, tau, name=None):
+    """Product of Householder reflectors (LAPACK orgqr)
+    (ref: paddle.linalg.householder_product)."""
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        k = t.shape[-1]
+        def one(a2, t2):
+            q = jnp.eye(m, n, dtype=a2.dtype)
+            for i in range(k - 1, -1, -1):
+                v = jnp.concatenate([
+                    jnp.zeros((i,), a2.dtype), jnp.ones((1,), a2.dtype),
+                    a2[i + 1:, i]])
+                q = q - t2[i] * jnp.outer(v, v @ q)
+            return q
+        if a.ndim == 2:
+            return one(a, t)
+        batch = a.reshape((-1, m, n))
+        tb = t.reshape((-1, k))
+        out = jax.vmap(one)(batch, tb)
+        return out.reshape(a.shape[:-2] + (m, n))
+    return _run_op("householder_product", f, (x, tau), {})
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q (m x m) from a QR factorization held as reflectors,
+    applying each Householder reflector directly (ref: paddle.linalg.ormqr)."""
+    def f(a, t, other):
+        m, n = a.shape[-2], a.shape[-1]
+        k = t.shape[-1]
+        def one(a2, t2, o2):
+            def reflect(i, o):
+                v = jnp.concatenate([
+                    jnp.zeros((i,), a2.dtype), jnp.ones((1,), a2.dtype),
+                    a2[i + 1:, i]])
+                if left:
+                    return o - t2[i] * jnp.outer(v, v @ o)
+                return o - t2[i] * jnp.outer(o @ v, v)
+            # Q = H0 H1 ... H_{k-1}; Q @ y applies H_{k-1} first. Each Hi is
+            # symmetric, so Q^T @ y applies H0 first.
+            order = range(k) if (transpose == left) else range(k - 1, -1, -1)
+            for i in order:
+                o2 = reflect(i, o2)
+            return o2
+        if a.ndim == 2:
+            return one(a, t, other)
+        lead = a.shape[:-2]
+        out = jax.vmap(one)(a.reshape((-1, m, n)), t.reshape((-1, k)),
+                            other.reshape((-1,) + other.shape[-2:]))
+        return out.reshape(lead + out.shape[1:])
+    return _run_op("ormqr", f, (x, tau, y), {})
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _run_op("vander",
+                   lambda a: jnp.vander(a, N=n, increasing=increasing), (x,), {})
+
+
+def matrix_exp(x, name=None):
+    return _run_op("matrix_exp", lambda a: jax.scipy.linalg.expm(a), (x,), {})
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD via subspace iteration, of ``x - M`` when M is
+    given (ref: paddle.linalg.svd_lowrank). Deterministic sketch."""
+    if M is not None:
+        x = x - M
+    def f(a):
+        m, n = a.shape[-2], a.shape[-1]
+        key = jax.random.PRNGKey(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, q), dtype=a.dtype)
+        y = jnp.matmul(a, omega)
+        for _ in range(niter):
+            y = jnp.matmul(a, jnp.matmul(jnp.swapaxes(a, -1, -2), y))
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.matmul(jnp.swapaxes(qmat, -1, -2), a)
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return jnp.matmul(qmat, u), s, jnp.swapaxes(vh, -1, -2)
+    return _run_op("svd_lowrank", f, (x,), {})
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA (ref: paddle.linalg.pca_lowrank)."""
+    k = q if q is not None else min(6, *[int(s) for s in x.shape[-2:]])
+    if center:
+        x = x - x.mean(axis=-2, keepdim=True)
+    return svd_lowrank(x, q=k, niter=niter)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def f(a):
+        return jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim)
+    return _run_op("matrix_norm", f, (x,), {})

@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .tensor import Tensor, _run_op
+import builtins
 
 
 def _shape(s):
@@ -343,3 +344,32 @@ def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
         inside = (a >= lo) & (a < lo + per)
         return jnp.where(inside, a - lo, ignore_value)
     return _run_op("shard_index", f, (x,), {})
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _run_op("diagonal",
+                   lambda a: jnp.diagonal(a, offset, axis1, axis2), (x,), {})
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal embedding (ref: paddle.diag_embed)."""
+    def f(a):
+        n = a.shape[-1] + builtins.abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+        out = base.at[..., r, c].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return _run_op("diag_embed", f, (x,), {})
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        m = jnp.moveaxis(a, (axis1, axis2), (-2, -1))
+        idx = jnp.arange(b.shape[-1])
+        r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+        m = m.at[..., r, c].set(b)
+        return jnp.moveaxis(m, (-2, -1), (axis1, axis2))
+    return _run_op("diagonal_scatter", f, (x, y), {})

@@ -225,11 +225,32 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return _run_op("cumprod", lambda a: jnp.cumprod(a, axis=dim), (x,), {})
 
 
-def cummax(x, axis=None, dtype="int64", name=None):
-    def f(a):
-        vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis or 0)
-        return vals
-    return _run_op("cummax", f, (x,), {})
+def _cum_minmax(name, strict_cmp):
+    """Shared cummax/cummin: (values, first-occurrence indices) like the
+    reference. Tie-break keeps the earlier index, which keeps the combine
+    associative for lax.associative_scan."""
+    def op(x, axis=None, dtype="int64", name=None):
+        nd = dtype_mod.convert_dtype(dtype) or np.int64
+        def f(a):
+            flat = a.reshape(-1) if axis is None else a
+            ax = 0 if axis is None else axis % flat.ndim
+            shape = [1] * flat.ndim
+            shape[ax] = flat.shape[ax]
+            idx = jnp.broadcast_to(
+                jnp.arange(flat.shape[ax]).reshape(shape), flat.shape)
+            def combine(left, right):
+                vl, il = left
+                vr, ir = right
+                take_r = strict_cmp(vr, vl)
+                return jnp.where(take_r, vr, vl), jnp.where(take_r, ir, il)
+            vals, inds = jax.lax.associative_scan(combine, (flat, idx), axis=ax)
+            return vals, inds.astype(nd)
+        return _run_op(name, f, (x,), {})
+    op.__name__ = name
+    return op
+
+
+cummax = _cum_minmax("cummax", lambda r, l: r > l)
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
@@ -329,3 +350,112 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
 def increment(x, value=1.0, name=None):
     x._data = x._data + value
     return x
+
+
+# -- special functions / extended surface (ref: paddle.{logit,i0,...}) -------
+i0 = _unary("i0", lambda a: jax.scipy.special.i0(a))
+i0e = _unary("i0e", lambda a: jax.scipy.special.i0e(a))
+i1 = _unary("i1", lambda a: jax.scipy.special.i1(a))
+i1e = _unary("i1e", lambda a: jax.scipy.special.i1e(a))
+gammaln = lgamma
+sinc = _unary("sinc", lambda a: jnp.sinc(a))
+signbit = _unary("signbit", lambda a: jnp.signbit(a))
+isneginf = _unary("isneginf", lambda a: jnp.isneginf(a))
+isposinf = _unary("isposinf", lambda a: jnp.isposinf(a))
+isreal = _unary("isreal", lambda a: jnp.isreal(a))
+ldexp = _binary("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)))
+gammainc = _binary("gammainc", lambda a, b: jax.scipy.special.gammainc(a, b))
+gammaincc = _binary("gammaincc", lambda a, b: jax.scipy.special.gammaincc(a, b))
+bitwise_left_shift = _binary("bitwise_left_shift", lambda a, b: jnp.left_shift(a, b))
+bitwise_right_shift = _binary("bitwise_right_shift", lambda a, b: jnp.right_shift(a, b))
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        c = jnp.clip(a, eps, 1.0 - eps) if eps is not None else a
+        return jnp.log(c / (1.0 - c))
+    return _run_op("logit", f, (x,), {})
+
+
+def polygamma(x, n, name=None):
+    return _run_op("polygamma", lambda a: jax.scipy.special.polygamma(n, a), (x,), {})
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along ``axis`` to at most ``max_norm`` in p-norm."""
+    def f(a):
+        dims = tuple(d for d in range(a.ndim) if d != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return _run_op("renorm", f, (x,), {})
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _run_op("trapezoid",
+                       lambda a, b: jnp.trapezoid(a, x=b, axis=axis), (y, x), {})
+    return _run_op("trapezoid",
+                   lambda a: jnp.trapezoid(a, dx=dx if dx is not None else 1.0,
+                                           axis=axis), (y,), {})
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def steps(a, b):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(1, None)
+        sl0 = [slice(None)] * a.ndim
+        sl0[axis] = slice(None, -1)
+        avg = (a[tuple(sl)] + a[tuple(sl0)]) / 2.0
+        if b is None:
+            d = dx if dx is not None else 1.0
+            return jnp.cumsum(avg * d, axis=axis)
+        db = jnp.diff(b, axis=axis) if b.ndim == a.ndim else jnp.diff(b).reshape(
+            (-1,) + (1,) * (a.ndim - axis % a.ndim - 1))
+        return jnp.cumsum(avg * db, axis=axis)
+    if x is not None:
+        return _run_op("cumulative_trapezoid", lambda a, b: steps(a, b), (y, x), {})
+    return _run_op("cumulative_trapezoid", lambda a: steps(a, None), (y,), {})
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        flat = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, flat, axis=ax)
+    return _run_op("logcumsumexp", f, (x,), {})
+
+
+cummin = _cum_minmax("cummin", lambda r, l: r < l)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened gather (ref: paddle.take). mode: 'raise'|'wrap'|'clip'.
+
+    'raise' validates bounds eagerly on the host (indices in [-numel, numel));
+    'clip' disables negative indexing and clips to [0, numel-1];
+    'wrap' wraps indices modulo numel.
+    """
+    n = int(np.prod(x.shape)) if len(x.shape) else 1
+    if mode == "raise":
+        try:
+            host_idx = np.asarray(index.numpy() if isinstance(index, Tensor)
+                                  else index)
+        except Exception:
+            host_idx = None  # traced/abstract value; skip the eager check
+        if host_idx is not None and host_idx.size and (
+                host_idx.min() < -n or host_idx.max() >= n):
+            raise ValueError(
+                f"take(mode='raise'): index out of range for tensor with "
+                f"{n} elements: [{host_idx.min()}, {host_idx.max()}]")
+    def f(a, idx):
+        flat = a.reshape(-1)
+        ii = idx.astype(jnp.int64)
+        if mode == "wrap":
+            ii = ((ii % n) + n) % n
+        elif mode == "clip":
+            ii = jnp.clip(ii, 0, n - 1)
+        else:
+            ii = jnp.clip(jnp.where(ii < 0, ii + n, ii), 0, n - 1)
+        return flat[ii]
+    return _run_op("take", f, (x, index), {})
