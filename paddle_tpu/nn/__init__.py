@@ -9,26 +9,34 @@ from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax,
                                Softplus, Softshrink, Softsign, Swish, Tanh,
                                Tanhshrink, ThresholdedReLU)
-from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity, Dropout,
-                           Dropout2D, Embedding, Flatten, Identity, Linear,
-                           Pad2D, PixelShuffle, Unflatten, Upsample)
+from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
+                           CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+                           Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+                           Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
+                           PixelUnshuffle, Unflatten, Unfold, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D,
+                           ZeroPad2D)
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential
 from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                          Conv3D, Conv3DTranspose)
 from .layer.layers import Layer, ParamAttr, Parameter
-from .layer.loss import (BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss,
-                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
-                         NLLLoss, PoissonNLLLoss, SmoothL1Loss,
-                         TripletMarginLoss)
+from .layer.loss import (AdaptiveLogSoftmaxWithLoss, BCELoss,
+                         BCEWithLogitsLoss, CosineEmbeddingLoss,
+                         CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
+                         HingeEmbeddingLoss, HuberLoss, KLDivLoss, L1Loss,
+                         MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss,
+                         MultiMarginLoss, NLLLoss, PoissonNLLLoss, RNNTLoss,
+                         SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+                         TripletMarginWithDistanceLoss)
 from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                          GroupNorm, InstanceNorm1D, InstanceNorm2D,
                          InstanceNorm3D, LayerNorm, LocalResponseNorm, RMSNorm,
-                         SyncBatchNorm)
+                         SpectralNorm, SyncBatchNorm)
 from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
                             AdaptiveAvgPool3D, AdaptiveMaxPool2D, AvgPool1D,
                             AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
                             MaxPool3D)
-from .layer.rnn import (GRU, LSTM, RNN, GRUCell, LSTMCell, SimpleRNN,
+from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
                         SimpleRNNCell)
 from .layer.transformer import (MultiHeadAttention, Transformer,
                                 TransformerDecoder, TransformerDecoderLayer,

@@ -10,10 +10,12 @@ from .activation import (relu, relu6, relu_, gelu, silu, swish, sigmoid,
                          hardsigmoid, hardswish, hardtanh, mish, prelu,
                          rrelu, tanhshrink, softshrink, thresholded_relu,
                          maxout, glu, gumbel_softmax)
-from .common import (linear, dropout, dropout2d, embedding, one_hot, pad,
-                     interpolate, upsample, unfold, fold, pixel_shuffle,
-                     cosine_similarity, pairwise_distance, label_smooth,
-                     bilinear, alpha_dropout)
+from .common import (linear, dropout, dropout2d, dropout3d, embedding,
+                     one_hot, pad, interpolate, upsample, unfold, fold,
+                     pixel_shuffle, cosine_similarity, pairwise_distance,
+                     label_smooth, bilinear, alpha_dropout, sequence_mask)
+from .vision import (affine_grid, grid_sample, pixel_unshuffle,
+                     channel_shuffle, temporal_shift)
 from .conv import conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose
 from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
                       max_pool2d, max_pool3d, adaptive_avg_pool1d,
@@ -27,5 +29,8 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, mse_loss,
                    kl_div, margin_ranking_loss, cosine_embedding_loss,
                    hinge_embedding_loss, square_error_cost, log_loss,
                    sigmoid_focal_loss, ctc_loss, triplet_margin_loss,
-                   poisson_nll_loss)
+                   poisson_nll_loss, gaussian_nll_loss, soft_margin_loss,
+                   multi_label_soft_margin_loss, multi_margin_loss,
+                   dice_loss, npair_loss, rnnt_loss,
+                   adaptive_log_softmax_with_loss)
 from .attention import scaled_dot_product_attention, sdp_kernel

@@ -192,3 +192,27 @@ def bilinear(x1, x2, weight, bias=None, name=None):
         return out
     args = (x1, x2, weight) + ((bias,) if bias is not None else ())
     return _run_op("bilinear", f, args, {})
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """Row-wise [0,len) masks (ref: paddle.nn.functional.sequence_mask).
+
+    With maxlen=None the max length is resolved eagerly at call time (host
+    sync) so the captured op stays shape-static under jit replay.
+    """
+    from ...framework import dtype as dtype_mod
+    nd = dtype_mod.convert_dtype(dtype)
+    if maxlen is None:
+        import numpy as _np
+        maxlen = int(_np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x).max())
+    m = int(maxlen)
+    def f(lens):
+        rng = jnp.arange(m)
+        return (rng[None, :] < lens.astype(jnp.int64)[..., None]).astype(nd)
+    return _run_op("sequence_mask", f, (x,), {})

@@ -280,3 +280,176 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
             return (loss / jnp.maximum(ll.astype(jnp.float32), 1)).mean()
         return _reduce(loss, reduction)
     return _run_op("ctc_loss", f, (log_probs, labels, input_lengths, label_lengths), {})
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        v = jnp.maximum(var.astype(jnp.float32), epsilon)
+        loss = 0.5 * (jnp.log(v) + (mu - y).astype(jnp.float32) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi)
+        return _reduce(loss, reduction)
+    return _run_op("gaussian_nll_loss", f, (input, label, variance), {})
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        # softplus(-y*x) == log1p(exp(-y*x)) but stable for large |x|
+        return _reduce(jax.nn.softplus(-y.astype(x.dtype) * x), reduction)
+    return _run_op("soft_margin_loss", f, (input, label), {})
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def f(x, y, *w):
+        x32, y32 = x.astype(jnp.float32), y.astype(jnp.float32)
+        per = -(y32 * jax.nn.log_sigmoid(x32)
+                + (1 - y32) * jax.nn.log_sigmoid(-x32))
+        if w:
+            per = per * w[0]
+        return _reduce(per.mean(axis=-1), reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _run_op("multi_label_soft_margin_loss", f, args, {})
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(x, y, *w):
+        n, c = x.shape
+        x32 = x.astype(jnp.float32)
+        xy = jnp.take_along_axis(x32, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - xy + x32) ** p
+        if w:
+            m = m * jnp.take_along_axis(
+                jnp.broadcast_to(w[0], (n, c)), y[:, None].astype(jnp.int32), 1)
+        mask = jax.nn.one_hot(y.astype(jnp.int32), c)
+        return _reduce((m * (1 - mask)).sum(axis=1) / c, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _run_op("multi_margin_loss", f, args, {})
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - dice coefficient, label one-hot over the trailing class dim
+    (ref: paddle.nn.functional.dice_loss)."""
+    def f(x, y):
+        c = x.shape[-1]
+        yh = jax.nn.one_hot(jnp.squeeze(y, -1).astype(jnp.int32), c,
+                            dtype=x.dtype)
+        dims = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * yh, axis=dims)
+        union = jnp.sum(x, axis=dims) + jnp.sum(yh, axis=dims)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+    return _run_op("dice_loss", f, (input, label), {})
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (ref: paddle.nn.functional.npair_loss)."""
+    def f(a, p, y):
+        a32, p32 = a.astype(jnp.float32), p.astype(jnp.float32)
+        sim = a32 @ p32.T
+        same = (y[:, None] == y[None, :]).astype(jnp.float32)
+        tgt = same / same.sum(axis=1, keepdims=True)
+        xent = -(tgt * jax.nn.log_softmax(sim, axis=1)).sum(1).mean()
+        # reference weights the embedding penalty by 0.25 (TF npairs Beta/4)
+        reg = l2_reg * 0.25 * (jnp.sum(a32 ** 2) + jnp.sum(p32 ** 2)) / a.shape[0]
+        return xent + reg
+    return _run_op("npair_loss", f, (anchor, positive, labels), {})
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss: forward-variable DP over the (T, U) lattice as a
+    lax.scan over time with an inner scan over label positions
+    (ref: paddle.nn.functional.rnnt_loss / warprnnt)."""
+    def f(logits, lbl, il, ll):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        b, t_max, u1, _ = lp.shape
+        u = u1 - 1
+        lbl32 = lbl.astype(jnp.int32)
+        emit = jnp.take_along_axis(
+            lp[:, :, :u, :], lbl32[:, None, :, None], axis=-1)[..., 0]
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148): scale label-emission *gradients*
+            # by (1+λ) while leaving the forward loss value unchanged.
+            lam = fastemit_lambda
+            emit = emit * (1.0 + lam) - jax.lax.stop_gradient(emit * lam)
+        blankp = lp[..., blank]                      # (B, T, U+1)
+
+        # t = 0 row: alpha[0, u] = prefix-sum of emissions at t=0
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.float32),
+             jnp.cumsum(emit[:, 0, :], axis=-1)], axis=1)
+
+        def time_step(alpha_prev, t):
+            from_blank = alpha_prev + blankp[:, t - 1, :]   # stay at u, t-1 -> t
+            e_t = emit[:, t, :]                              # advance u at t
+
+            def u_step(carry, inp):
+                fb_u, e_u = inp                              # (B,), (B,)
+                val = jnp.logaddexp(fb_u, carry + e_u)
+                return val, val
+            init = from_blank[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, init,
+                (from_blank[:, 1:].T, e_t.T))
+            alpha_t = jnp.concatenate([init[:, None], rest.T], axis=1)
+            return alpha_t, alpha_t
+
+        ts = jnp.arange(1, t_max)
+        _, alphas = jax.lax.scan(time_step, alpha0, ts)
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,B,U+1)
+
+        il32 = jnp.clip(il.astype(jnp.int32) - 1, 0, t_max - 1)
+        ll32 = jnp.clip(ll.astype(jnp.int32), 0, u)
+        final_alpha = all_alphas[il32, jnp.arange(b), ll32]
+        final_blank = blankp[jnp.arange(b), il32, ll32]
+        loss = -(final_alpha + final_blank)
+        if reduction == "mean":
+            # reference divides by label length before the batch mean
+            return (loss / jnp.maximum(ll.astype(jnp.float32), 1)).mean()
+        return _reduce(loss, reduction)
+    return _run_op("rnnt_loss", f, (input, label, input_lengths, label_lengths), {})
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.): frequent classes in the head, rare
+    classes in down-projected tail clusters
+    (ref: paddle.nn.functional.adaptive_log_softmax_with_loss)."""
+    def f(x, y, hw, *rest):
+        n_clusters = len(cutoffs) - 1
+        if head_bias is not None:
+            hb = rest[-1]
+            tails = rest[:-1]
+        else:
+            hb = None
+            tails = rest
+        head_out = x @ hw
+        if hb is not None:
+            head_out = head_out + hb
+        head_lp = jax.nn.log_softmax(head_out.astype(jnp.float32), axis=-1)
+        shortlist = cutoffs[0]
+        y32 = y.astype(jnp.int32)
+
+        # head part: true class if in shortlist, else its cluster token
+        cluster_of = jnp.zeros_like(y32)
+        for i in range(n_clusters):
+            cluster_of = jnp.where(y32 >= cutoffs[i], i + 1, cluster_of)
+        head_idx = jnp.where(y32 < shortlist, y32,
+                             shortlist + cluster_of - 1)
+        lp = jnp.take_along_axis(head_lp, head_idx[:, None], 1)[:, 0]
+
+        # tail clusters: add in-cluster log prob
+        for i in range(n_clusters):
+            proj, cls_w = tails[2 * i], tails[2 * i + 1]
+            tail_lp = jax.nn.log_softmax(
+                ((x @ proj) @ cls_w).astype(jnp.float32), axis=-1)
+            local = jnp.clip(y32 - cutoffs[i], 0, cls_w.shape[-1] - 1)
+            contrib = jnp.take_along_axis(tail_lp, local[:, None], 1)[:, 0]
+            lp = lp + jnp.where(cluster_of == i + 1, contrib, 0.0)
+        return lp, -lp.mean()
+    tail_flat = tuple(w for pair in tail_weights for w in pair)
+    args = (input, label, head_weight) + tail_flat + (
+        (head_bias,) if head_bias is not None else ())
+    return _run_op("adaptive_log_softmax_with_loss", f, args, {})

@@ -93,16 +93,21 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  count_include_pad=not exclusive, data_format=data_format)
 
 
+
+
+def _adaptive_edges(size, out):
+    """torch/paddle adaptive pooling windows: start=floor(i*size/out),
+    end=ceil((i+1)*size/out). Never empty, even when out > size."""
+    starts = [(i * size) // out for i in range(out)]
+    ends = [-(-((i + 1) * size) // out) for i in range(out)]
+    return list(zip(starts, ends))
+
 def adaptive_avg_pool1d(x, output_size, name=None):
     def f(a):
         l = a.shape[-1]
         out = int(output_size)
-        a4 = a[..., None]
-        res = jax.image.resize(a4.mean(-1, keepdims=True) if False else a4,
-                               a4.shape, method="linear")
-        # exact adaptive: split into equal bins
-        bins = np.linspace(0, l, out + 1).astype(int)
-        return jnp.stack([a[..., s:e].mean(-1) for s, e in zip(bins[:-1], bins[1:])], axis=-1)
+        return jnp.stack([a[..., s:e].mean(-1)
+                          for s, e in _adaptive_edges(l, out)], axis=-1)
     return _run_op("adaptive_avg_pool1d", f, (x,), {})
 
 
@@ -110,12 +115,10 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     out = _tuple(output_size, 2)
     def f(a):
         h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
-        hb = np.linspace(0, h, out[0] + 1).astype(int)
-        wb = np.linspace(0, w, out[1] + 1).astype(int)
         rows = []
-        for hs, he in zip(hb[:-1], hb[1:]):
+        for hs, he in _adaptive_edges(h, out[0]):
             cols = []
-            for ws, we in zip(wb[:-1], wb[1:]):
+            for ws, we in _adaptive_edges(w, out[1]):
                 if data_format == "NCHW":
                     cols.append(a[:, :, hs:he, ws:we].mean((2, 3)))
                 else:
@@ -130,15 +133,12 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     out = _tuple(output_size, 3)
     def f(a):
         d, h, w = a.shape[2:]
-        db = np.linspace(0, d, out[0] + 1).astype(int)
-        hb = np.linspace(0, h, out[1] + 1).astype(int)
-        wb = np.linspace(0, w, out[2] + 1).astype(int)
         vol = []
-        for ds_, de in zip(db[:-1], db[1:]):
+        for ds_, de in _adaptive_edges(d, out[0]):
             rows = []
-            for hs, he in zip(hb[:-1], hb[1:]):
+            for hs, he in _adaptive_edges(h, out[1]):
                 cols = []
-                for ws, we in zip(wb[:-1], wb[1:]):
+                for ws, we in _adaptive_edges(w, out[2]):
                     cols.append(a[:, :, ds_:de, hs:he, ws:we].mean((2, 3, 4)))
                 rows.append(jnp.stack(cols, -1))
             vol.append(jnp.stack(rows, -2))
@@ -150,12 +150,10 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out = _tuple(output_size, 2)
     def f(a):
         h, w = a.shape[2], a.shape[3]
-        hb = np.linspace(0, h, out[0] + 1).astype(int)
-        wb = np.linspace(0, w, out[1] + 1).astype(int)
         rows = []
-        for hs, he in zip(hb[:-1], hb[1:]):
+        for hs, he in _adaptive_edges(h, out[0]):
             cols = [a[:, :, hs:he, ws:we].max((2, 3))
-                    for ws, we in zip(wb[:-1], wb[1:])]
+                    for ws, we in _adaptive_edges(w, out[1])]
             rows.append(jnp.stack(cols, axis=-1))
         return jnp.stack(rows, axis=-2)
     return _run_op("adaptive_max_pool2d", f, (x,), {})

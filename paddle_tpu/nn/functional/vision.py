@@ -1,0 +1,127 @@
+"""Vision functionals: grid_sample / affine_grid family
+(ref: python/paddle/nn/functional/vision.py).
+
+grid_sample gathers are XLA dynamic-gathers — batched and fused, no scalar
+loops, so they stay TPU-friendly.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import _run_op
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a 2D sampling grid from batched 2x3 affine matrices."""
+    n, _, h, w = [int(s) for s in out_shape]
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # (h*w, 3)
+        out = jnp.einsum("nij,pj->npi", th.astype(jnp.float32), base)
+        return out.reshape(n, h, w, 2).astype(th.dtype)
+    return _run_op("affine_grid", f, (theta,), {})
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW input at normalized grid locations (N, Hg, Wg, 2)."""
+    def f(a, g):
+        n, c, h, w = a.shape
+        gf = g.astype(jnp.float32)
+        gx, gy = gf[..., 0], gf[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * (w - 1) / 2.0
+            fy = (gy + 1.0) * (h - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * w - 1.0) / 2.0
+            fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+        def reflect(coord, size):
+            if align_corners:
+                span = size - 1
+                coord = jnp.abs(coord)
+                period = 2 * span if span > 0 else 1
+                coord = coord % period
+                return jnp.where(coord > span, period - coord, coord)
+            span = size
+            coord = jnp.abs(coord + 0.5)
+            period = 2 * span
+            coord = coord % period
+            return jnp.clip(jnp.where(coord >= span, period - coord - 1e-6,
+                                      coord) - 0.5, 0, size - 1)
+
+        if padding_mode == "reflection":
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            # (n, hg, wg) index grids -> (n, c, hg, wg) values
+            vals = a[jnp.arange(n)[:, None, None, None],
+                     jnp.arange(c)[None, :, None, None],
+                     iyc[:, None], ixc[:, None]]
+            if padding_mode == "zeros":
+                inside = ((iy >= 0) & (iy <= h - 1) & (ix >= 0)
+                          & (ix <= w - 1))[:, None]
+                vals = jnp.where(inside, vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fy), jnp.round(fx)).astype(a.dtype)
+
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1, wy1 = fx - x0, fy - y0
+        wx0, wy0 = 1.0 - wx1, 1.0 - wy1
+        out = (gather(y0, x0) * (wy0 * wx0)[:, None]
+               + gather(y0, x1) * (wy0 * wx1)[:, None]
+               + gather(y1, x0) * (wy1 * wx0)[:, None]
+               + gather(y1, x1) * (wy1 * wx1)[:, None])
+        return out.astype(a.dtype)
+    return _run_op("grid_sample", f, (x, grid), {})
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return _run_op("pixel_unshuffle", f, (x,), {})
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.transpose(a, (0, 2, 1, 3, 4))
+        return a.reshape(n, c, h, w)
+    return _run_op("channel_shuffle", f, (x,), {})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """Shift a fraction of channels one step along the segment (time) axis
+    (ref: paddle.nn.functional.temporal_shift)."""
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+            axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return _run_op("temporal_shift", f, (x,), {})

@@ -3,6 +3,7 @@ stats as non-trainable buffers updated during training forward, like the
 reference's _mean/_variance."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...tensor.tensor import Tensor
@@ -183,7 +184,47 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
+    """Spectral normalization of a weight tensor by power iteration
+    (ref: paddle.nn.SpectralNorm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned for a later round")
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= int(s)
+        self.register_buffer(
+            "weight_u", Tensor(jax.random.normal(jax.random.PRNGKey(0), (h,),
+                                                 jnp.float32)))
+        self.register_buffer(
+            "weight_v", Tensor(jax.random.normal(jax.random.PRNGKey(1), (w,),
+                                                 jnp.float32)))
+
+    def forward(self, weight):
+        from ...tensor.tensor import _run_op
+        dim, eps = self.dim, self.eps
+
+        # Power iteration runs once, eagerly, outside the grad tape — like the
+        # reference, gradients do not flow through u/v; they are buffers.
+        wmat = jnp.moveaxis(
+            (weight._data if isinstance(weight, Tensor) else weight),
+            dim, 0).reshape(weight.shape[dim], -1).astype(jnp.float32)
+        u, v = self.weight_u._data, self.weight_v._data
+        for _ in range(self.power_iters):
+            v = wmat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wmat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        self.weight_u._data = u
+        self.weight_v._data = v
+
+        def f(wt):
+            wm = jnp.moveaxis(wt, dim, 0).reshape(wt.shape[dim], -1)
+            sigma = u @ wm.astype(jnp.float32) @ v
+            return (wt / sigma).astype(wt.dtype)
+        return _run_op("spectral_norm", f, (weight,), {})

@@ -267,10 +267,58 @@ class RNN(Layer):
         outs = []
         states = initial_states
         from ...tensor import stack
+        from ...tensor.tensor import Tensor as _T
+
+        lens = None
+        if sequence_length is not None:
+            import jax.numpy as _jnp
+            lens = (sequence_length._data if isinstance(sequence_length, _T)
+                    else _jnp.asarray(sequence_length)).astype(_jnp.int32)
+
+        def _mask_tree(new, old, keep):
+            # keep: (B,) bool — take new where True else old (per-batch state)
+            if isinstance(new, (tuple, list)):
+                return type(new)(_mask_tree(n, o, keep)
+                                 for n, o in zip(new, old if old is not None
+                                                 else [None] * len(new)))
+            import jax.numpy as _jnp
+            k = keep.reshape((-1,) + (1,) * (new._data.ndim - 1))
+            # old=None means the cell's zero initial state
+            old_data = old._data if old is not None else _jnp.zeros_like(new._data)
+            return _T(_jnp.where(k, new._data, old_data))
+
         for t in steps:
             xt = inputs[:, t] if T_axis == 1 else inputs[t]
-            y, states = self.cell(xt, states)
+            y, new_states = self.cell(xt, states)
+            if lens is not None:
+                import jax.numpy as _jnp
+                valid = lens > t          # (B,)
+                states = _mask_tree(new_states, states, valid)
+                vy = valid.reshape((-1,) + (1,) * (y._data.ndim - 1))
+                y = _T(_jnp.where(vy, y._data, _jnp.zeros_like(y._data)))
+            else:
+                states = new_states
             outs.append(y)
         if self.is_reverse:
             outs = outs[::-1]
         return stack(outs, axis=T_axis), states
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (ref: paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import concat
+        if initial_states is None:
+            fw_init = bw_init = None
+        else:
+            fw_init, bw_init = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_init, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_init, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

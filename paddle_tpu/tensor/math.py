@@ -384,7 +384,8 @@ def polygamma(x, n, name=None):
 def renorm(x, p, axis, max_norm, name=None):
     """Renormalize slices along ``axis`` to at most ``max_norm`` in p-norm."""
     def f(a):
-        dims = tuple(d for d in range(a.ndim) if d != axis)
+        ax = axis % a.ndim
+        dims = tuple(d for d in range(a.ndim) if d != ax)
         norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
         factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
         return a * factor

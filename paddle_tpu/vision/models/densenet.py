@@ -1,0 +1,110 @@
+"""DenseNet (ref: python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Layer, Linear, MaxPool2D, ReLU, Sequential)
+from ...tensor import concat
+from ...tensor.manipulation import flatten
+
+
+class _DenseLayer(Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(num_input_features, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                            bias_attr=False)
+        self.drop_rate = drop_rate
+        self.dropout = Dropout(drop_rate) if drop_rate > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__()
+        self.norm = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv = Conv2D(num_input_features, num_output_features, 1,
+                           bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+               169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+               264: (6, 12, 64, 48)}
+        block_config = cfg[layers]
+        num_init_features = 96 if layers == 161 else 64
+        if layers == 161:
+            growth_rate = 48
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        feats = [Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                        bias_attr=False),
+                 BatchNorm2D(num_init_features), ReLU(),
+                 MaxPool2D(3, stride=2, padding=1)]
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            for _ in range(num_layers):
+                feats.append(_DenseLayer(num_features, growth_rate, bn_size,
+                                         dropout))
+                num_features += growth_rate
+            if i != len(block_config) - 1:
+                feats.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        feats += [BatchNorm2D(num_features), ReLU()]
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, pretrained, **kwargs)

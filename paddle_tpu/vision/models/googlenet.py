@@ -1,0 +1,78 @@
+"""GoogLeNet / Inception v1 (ref: python/paddle/vision/models/googlenet.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer,
+                   Linear, MaxPool2D, ReLU, Sequential)
+from ...tensor import concat
+from ...tensor.manipulation import flatten
+
+
+class _BasicConv(Sequential):
+    def __init__(self, inp, oup, k, **kwargs):
+        super().__init__(Conv2D(inp, oup, k, bias_attr=False, **kwargs),
+                         BatchNorm2D(oup), ReLU())
+
+
+class Inception(Layer):
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, pool_proj):
+        super().__init__()
+        self.branch1 = _BasicConv(inp, c1, 1)
+        self.branch2 = Sequential(_BasicConv(inp, c3r, 1),
+                                  _BasicConv(c3r, c3, 3, padding=1))
+        self.branch3 = Sequential(_BasicConv(inp, c5r, 1),
+                                  _BasicConv(c5r, c5, 3, padding=1))
+        self.branch4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                                  _BasicConv(inp, pool_proj, 1))
+
+    def forward(self, x):
+        return concat([self.branch1(x), self.branch2(x), self.branch3(x),
+                       self.branch4(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    """Returns (main, aux1, aux2) logits in train mode like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _BasicConv(3, 64, 7, stride=2, padding=3)
+        self.maxpool1 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.conv2 = _BasicConv(64, 64, 1)
+        self.conv3 = _BasicConv(64, 192, 3, padding=1)
+        self.maxpool2 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inception3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inception3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.maxpool3 = MaxPool2D(3, stride=2, ceil_mode=True)
+        self.inception4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inception4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inception4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inception4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inception4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.maxpool4 = MaxPool2D(2, stride=2, ceil_mode=True)
+        self.inception5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inception5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool1(self.conv1(x))
+        x = self.maxpool2(self.conv3(self.conv2(x)))
+        x = self.maxpool3(self.inception3b(self.inception3a(x)))
+        x = self.inception4e(self.inception4d(self.inception4c(
+            self.inception4b(self.inception4a(x)))))
+        x = self.inception5b(self.inception5a(self.maxpool4(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return GoogLeNet(**kwargs)

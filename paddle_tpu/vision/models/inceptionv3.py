@@ -1,0 +1,149 @@
+"""Inception v3 (ref: python/paddle/vision/models/inceptionv3.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Layer, Linear, MaxPool2D, ReLU, Sequential)
+from ...tensor import concat
+from ...tensor.manipulation import flatten
+
+
+class _BasicConv(Sequential):
+    def __init__(self, inp, oup, k, **kwargs):
+        super().__init__(Conv2D(inp, oup, k, bias_attr=False, **kwargs),
+                         BatchNorm2D(oup), ReLU())
+
+
+class InceptionA(Layer):
+    def __init__(self, inp, pool_features):
+        super().__init__()
+        self.branch1x1 = _BasicConv(inp, 64, 1)
+        self.branch5x5 = Sequential(_BasicConv(inp, 48, 1),
+                                    _BasicConv(48, 64, 5, padding=2))
+        self.branch3x3dbl = Sequential(_BasicConv(inp, 64, 1),
+                                       _BasicConv(64, 96, 3, padding=1),
+                                       _BasicConv(96, 96, 3, padding=1))
+        self.branch_pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                                      _BasicConv(inp, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.branch1x1(x), self.branch5x5(x),
+                       self.branch3x3dbl(x), self.branch_pool(x)], axis=1)
+
+
+class InceptionB(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.branch3x3 = _BasicConv(inp, 384, 3, stride=2)
+        self.branch3x3dbl = Sequential(_BasicConv(inp, 64, 1),
+                                       _BasicConv(64, 96, 3, padding=1),
+                                       _BasicConv(96, 96, 3, stride=2))
+        self.maxpool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.branch3x3(x), self.branch3x3dbl(x),
+                       self.maxpool(x)], axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, inp, channels_7x7):
+        super().__init__()
+        c7 = channels_7x7
+        self.branch1x1 = _BasicConv(inp, 192, 1)
+        self.branch7x7 = Sequential(
+            _BasicConv(inp, c7, 1),
+            _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv(c7, 192, (7, 1), padding=(3, 0)))
+        self.branch7x7dbl = Sequential(
+            _BasicConv(inp, c7, 1),
+            _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BasicConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BasicConv(c7, 192, (1, 7), padding=(0, 3)))
+        self.branch_pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                                      _BasicConv(inp, 192, 1))
+
+    def forward(self, x):
+        return concat([self.branch1x1(x), self.branch7x7(x),
+                       self.branch7x7dbl(x), self.branch_pool(x)], axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.branch3x3 = Sequential(_BasicConv(inp, 192, 1),
+                                    _BasicConv(192, 320, 3, stride=2))
+        self.branch7x7x3 = Sequential(
+            _BasicConv(inp, 192, 1),
+            _BasicConv(192, 192, (1, 7), padding=(0, 3)),
+            _BasicConv(192, 192, (7, 1), padding=(3, 0)),
+            _BasicConv(192, 192, 3, stride=2))
+        self.maxpool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.branch3x3(x), self.branch7x7x3(x),
+                       self.maxpool(x)], axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.branch1x1 = _BasicConv(inp, 320, 1)
+        self.branch3x3_1 = _BasicConv(inp, 384, 1)
+        self.branch3x3_2a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = Sequential(_BasicConv(inp, 448, 1),
+                                         _BasicConv(448, 384, 3, padding=1))
+        self.branch3x3dbl_3a = _BasicConv(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _BasicConv(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = Sequential(AvgPool2D(3, stride=1, padding=1),
+                                      _BasicConv(inp, 192, 1))
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = concat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], axis=1)
+        bd = self.branch3x3dbl_1(x)
+        bd = concat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)],
+                    axis=1)
+        return concat([b1, b3, bd, self.branch_pool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inception_stem = Sequential(
+            _BasicConv(3, 32, 3, stride=2),
+            _BasicConv(32, 32, 3),
+            _BasicConv(32, 64, 3, padding=1),
+            MaxPool2D(3, stride=2),
+            _BasicConv(64, 80, 1),
+            _BasicConv(80, 192, 3),
+            MaxPool2D(3, stride=2))
+        self.inception_block_list = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160), InceptionC(768, 160),
+            InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.avg_pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.inception_block_list(self.inception_stem(x))
+        if self.with_pool:
+            x = self.avg_pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,58 @@
+"""MobileNetV1 (ref: python/paddle/vision/models/mobilenetv1.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
+                   ReLU, Sequential)
+from ...tensor.manipulation import flatten
+
+
+def _conv_bn(inp, oup, k, stride, pad, groups=1):
+    return Sequential(
+        Conv2D(inp, oup, k, stride=stride, padding=pad, groups=groups,
+               bias_attr=False),
+        BatchNorm2D(oup), ReLU())
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, inp, oup1, oup2, stride, scale):
+        super().__init__()
+        self.dw = _conv_bn(int(inp * scale), int(oup1 * scale), 3, stride, 1,
+                           groups=int(inp * scale))
+        self.pw = _conv_bn(int(oup1 * scale), int(oup2 * scale), 1, 1, 0)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, int(32 * scale), 3, 2, 1)
+        cfg = [(32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+               (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+               (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 512, 1),
+               (512, 512, 512, 1), (512, 512, 512, 1), (512, 512, 1024, 2),
+               (1024, 1024, 1024, 1)]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(i, o1, o2, s, scale) for i, o1, o2, s in cfg])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,128 @@
+"""MobileNetV3 small/large (ref: python/paddle/vision/models/mobilenetv3.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Hardswish,
+                   Hardsigmoid, Layer, Linear, ReLU, Sequential)
+from ...tensor.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, input_channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(input_channels, squeeze_channels, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze_channels, input_channels, 1)
+        self.hardsigmoid = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hardsigmoid(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+class _ConvBNAct(Sequential):
+    def __init__(self, inp, oup, k, stride=1, groups=1, act=None):
+        pad = (k - 1) // 2
+        layers = [Conv2D(inp, oup, k, stride=stride, padding=pad,
+                         groups=groups, bias_attr=False), BatchNorm2D(oup)]
+        if act == "relu":
+            layers.append(ReLU())
+        elif act == "hardswish":
+            layers.append(Hardswish())
+        super().__init__(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, exp, oup, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if exp != inp:
+            layers.append(_ConvBNAct(inp, exp, 1, act=act))
+        layers.append(_ConvBNAct(exp, exp, k, stride=stride, groups=exp,
+                                 act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp, _make_divisible(exp // 4)))
+        layers.append(_ConvBNAct(exp, oup, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# k, exp, out, se, act, stride
+_LARGE = [(3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+          (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+          (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+          (3, 240, 80, False, "hardswish", 2),
+          (3, 200, 80, False, "hardswish", 1),
+          (3, 184, 80, False, "hardswish", 1),
+          (3, 184, 80, False, "hardswish", 1),
+          (3, 480, 112, True, "hardswish", 1),
+          (3, 672, 112, True, "hardswish", 1),
+          (5, 672, 160, True, "hardswish", 2),
+          (5, 960, 160, True, "hardswish", 1),
+          (5, 960, 160, True, "hardswish", 1)]
+_SMALL = [(3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+          (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+          (5, 240, 40, True, "hardswish", 1),
+          (5, 240, 40, True, "hardswish", 1),
+          (5, 120, 48, True, "hardswish", 1),
+          (5, 144, 48, True, "hardswish", 1),
+          (5, 288, 96, True, "hardswish", 2),
+          (5, 576, 96, True, "hardswish", 1),
+          (5, 576, 96, True, "hardswish", 1)]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        inp = _make_divisible(16 * scale)
+        layers = [_ConvBNAct(3, inp, 3, stride=2, act="hardswish")]
+        for k, exp, out, se, act, stride in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(InvertedResidual(inp, exp_c, out_c, k, stride, se,
+                                           act))
+            inp = out_c
+        last_conv = _make_divisible(6 * inp)
+        layers.append(_ConvBNAct(inp, last_conv, 1, act="hardswish"))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_conv, last_channel), Hardswish(), Dropout(0.2),
+                Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return MobileNetV3(_LARGE, 1280, scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return MobileNetV3(_SMALL, 1024, scale=scale, **kwargs)

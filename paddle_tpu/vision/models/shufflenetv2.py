@@ -1,0 +1,122 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
+                   MaxPool2D, ReLU, Sequential)
+from ...nn.functional import channel_shuffle
+from ...tensor import concat
+from ...tensor.manipulation import flatten
+
+
+def _conv_bn(inp, oup, k, stride, pad, groups=1, act=True):
+    layers = [Conv2D(inp, oup, k, stride=stride, padding=pad, groups=groups,
+                     bias_attr=False), BatchNorm2D(oup)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+        if stride > 1:
+            self.branch1 = Sequential(
+                Conv2D(inp, inp, 3, stride=stride, padding=1, groups=inp,
+                       bias_attr=False),
+                BatchNorm2D(inp),
+                Conv2D(inp, branch_features, 1, bias_attr=False),
+                BatchNorm2D(branch_features), ReLU())
+            b2_in = inp
+        else:
+            self.branch1 = None
+            b2_in = inp // 2
+        self.branch2 = Sequential(
+            Conv2D(b2_in, branch_features, 1, bias_attr=False),
+            BatchNorm2D(branch_features), ReLU(),
+            Conv2D(branch_features, branch_features, 3, stride=stride,
+                   padding=1, groups=branch_features, bias_attr=False),
+            BatchNorm2D(branch_features),
+            Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            BatchNorm2D(branch_features), ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        channels = {0.25: [24, 24, 48, 96, 512],
+                    0.33: [24, 32, 64, 128, 512],
+                    0.5: [24, 48, 96, 192, 1024],
+                    1.0: [24, 116, 232, 464, 1024],
+                    1.5: [24, 176, 352, 704, 1024],
+                    2.0: [24, 244, 488, 976, 2048]}[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, channels[0], 3, 2, 1)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = channels[0]
+        for i, repeat in enumerate(stage_repeats):
+            oup = channels[i + 1]
+            seq = [InvertedResidual(inp, oup, 2)]
+            for _ in range(repeat - 1):
+                seq.append(InvertedResidual(oup, oup, 1))
+            stages.append(Sequential(*seq))
+            inp = oup
+        self.stage2, self.stage3, self.stage4 = stages
+        self.conv5 = _conv_bn(inp, channels[-1], 1, 1, 0)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv5(self.stage4(self.stage3(self.stage2(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights require network access")
+    return ShuffleNetV2(scale=scale, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, pretrained, **kwargs)

@@ -19,6 +19,10 @@ import pytest  # noqa: E402
 # tests are deterministic, fp32-exact, and can build 8-way meshes.
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
+# Persistent compile cache: repeat suite runs skip XLA compilation entirely.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 
 @pytest.fixture(autouse=True)
 def _seed_everything():

@@ -1,0 +1,282 @@
+"""Extended nn layers/functionals vs torch-cpu and numpy references."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState(7)
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+class TestVisionFunctionals:
+    def test_affine_grid(self):
+        theta = R.randn(2, 2, 3).astype(np.float32)
+        got = F.affine_grid(t(theta), [2, 3, 4, 5], align_corners=True)
+        ref = tF.affine_grid(torch.tensor(theta), [2, 3, 4, 5],
+                             align_corners=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_grid_sample(self, mode, align):
+        x = R.randn(2, 3, 5, 6).astype(np.float32)
+        grid = np.clip(R.randn(2, 4, 4, 2), -1.2, 1.2).astype(np.float32)
+        got = F.grid_sample(t(x), t(grid), mode=mode, align_corners=align)
+        ref = tF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                             padding_mode="zeros", align_corners=align)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_grid_sample_through_affine(self):
+        x = R.randn(1, 2, 6, 6).astype(np.float32)
+        theta = np.array([[[1.0, 0.0, 0.1], [0.0, 1.0, -0.1]]], np.float32)
+        grid = F.affine_grid(t(theta), [1, 2, 6, 6], align_corners=False)
+        got = F.grid_sample(t(x), grid, align_corners=False)
+        tgrid = tF.affine_grid(torch.tensor(theta), [1, 2, 6, 6],
+                               align_corners=False)
+        ref = tF.grid_sample(torch.tensor(x), tgrid, align_corners=False)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_pixel_unshuffle_roundtrip(self):
+        x = R.randn(2, 3, 8, 8).astype(np.float32)
+        down = F.pixel_unshuffle(t(x), 2)
+        assert list(down.shape) == [2, 12, 4, 4]
+        back = F.pixel_shuffle(down, 2)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+    def test_channel_shuffle(self):
+        x = R.randn(1, 6, 2, 2).astype(np.float32)
+        got = F.channel_shuffle(t(x), 3)
+        ref = tF.channel_shuffle(torch.tensor(x), 3)
+        np.testing.assert_allclose(got.numpy(), ref.numpy())
+
+    def test_temporal_shift(self):
+        x = R.randn(4, 8, 3, 3).astype(np.float32)  # nt=4 (n=2, seg=2)
+        got = F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25)
+        v = x.reshape(2, 2, 8, 3, 3)
+        ref = np.zeros_like(v)
+        ref[:, :-1, :2] = v[:, 1:, :2]     # shift left
+        ref[:, 1:, 2:4] = v[:, :-1, 2:4]   # shift right
+        ref[:, :, 4:] = v[:, :, 4:]
+        np.testing.assert_allclose(got.numpy(), ref.reshape(4, 8, 3, 3))
+
+    def test_sequence_mask(self):
+        got = F.sequence_mask(t(np.array([1, 3, 2])), maxlen=4)
+        ref = np.array([[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+        np.testing.assert_array_equal(got.numpy(), ref)
+
+
+class TestNewLosses:
+    def test_gaussian_nll(self):
+        x, y = R.randn(4, 3).astype(np.float32), R.randn(4, 3).astype(np.float32)
+        var = R.uniform(0.5, 2, (4, 3)).astype(np.float32)
+        got = F.gaussian_nll_loss(t(x), t(y), t(var))
+        ref = tF.gaussian_nll_loss(torch.tensor(x), torch.tensor(y),
+                                   torch.tensor(var))
+        np.testing.assert_allclose(float(got.numpy()), float(ref), atol=1e-5)
+
+    def test_soft_margin(self):
+        x = R.randn(4, 3).astype(np.float32)
+        y = np.sign(R.randn(4, 3)).astype(np.float32)
+        got = F.soft_margin_loss(t(x), t(y))
+        ref = tF.soft_margin_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(float(got.numpy()), float(ref), atol=1e-5)
+
+    def test_multi_label_soft_margin(self):
+        x = R.randn(4, 5).astype(np.float32)
+        y = (R.rand(4, 5) > 0.5).astype(np.float32)
+        got = F.multi_label_soft_margin_loss(t(x), t(y))
+        ref = tF.multilabel_soft_margin_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(float(got.numpy()), float(ref), atol=1e-5)
+
+    def test_multi_margin(self):
+        x = R.randn(4, 5).astype(np.float32)
+        y = R.randint(0, 5, (4,))
+        got = F.multi_margin_loss(t(x), t(y))
+        ref = tF.multi_margin_loss(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(float(got.numpy()), float(ref), atol=1e-5)
+
+    def test_dice_loss(self):
+        x = np.abs(R.rand(2, 4, 3)).astype(np.float32)
+        x = x / x.sum(-1, keepdims=True)
+        y = R.randint(0, 3, (2, 4, 1))
+        got = float(F.dice_loss(t(x), t(y)).numpy())
+        assert 0.0 < got < 1.0
+
+    def test_npair_loss(self):
+        a = R.randn(4, 8).astype(np.float32)
+        p = R.randn(4, 8).astype(np.float32)
+        y = np.array([0, 1, 0, 2])
+        got = float(F.npair_loss(t(a), t(p), t(y)).numpy())
+        assert np.isfinite(got) and got > 0
+
+    def test_rnnt_loss_vs_dp(self):
+        """Tiny lattice: compare against a brute-force numpy DP."""
+        B, T, U, V = 1, 3, 2, 4
+        logits = R.randn(B, T, U + 1, V).astype(np.float32)
+        labels = np.array([[1, 2]], np.int32)
+        il, ll = np.array([T]), np.array([U])
+        got = float(F.rnnt_loss(t(logits), t(labels), t(il), t(ll),
+                                reduction="none").numpy())
+
+        lp = torch.log_softmax(torch.tensor(logits), -1).numpy()[0]
+        alpha = np.full((T, U + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for u_i in range(1, U + 1):
+            alpha[0, u_i] = alpha[0, u_i - 1] + lp[0, u_i - 1, labels[0, u_i - 1]]
+        for t_i in range(1, T):
+            alpha[t_i, 0] = alpha[t_i - 1, 0] + lp[t_i - 1, 0, 0]
+            for u_i in range(1, U + 1):
+                stay = alpha[t_i - 1, u_i] + lp[t_i - 1, u_i, 0]
+                adv = alpha[t_i, u_i - 1] + lp[t_i, u_i - 1, labels[0, u_i - 1]]
+                alpha[t_i, u_i] = np.logaddexp(stay, adv)
+        ref = -(alpha[T - 1, U] + lp[T - 1, U, 0])
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_adaptive_log_softmax_layer(self):
+        layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10])
+        x = t(R.randn(8, 16).astype(np.float32))
+        y = t(R.randint(0, 20, (8,)))
+        lp, loss = layer(x, y)
+        assert list(lp.shape) == [8]
+        assert float(loss.numpy()) > 0
+        # log-probs must be <= 0
+        assert (lp.numpy() <= 1e-6).all()
+
+
+class TestNewLayers:
+    def test_pads(self):
+        x = t(R.randn(2, 3, 4).astype(np.float32))
+        assert list(nn.Pad1D([1, 1])(x).shape) == [2, 3, 6]
+        x3 = t(R.randn(1, 1, 2, 3, 4).astype(np.float32))
+        assert list(nn.Pad3D([1, 1, 1, 1, 1, 1])(x3).shape) == [1, 1, 4, 5, 6]
+        x2 = t(R.randn(1, 1, 3, 3).astype(np.float32))
+        out = nn.ZeroPad2D([1, 1, 1, 1])(x2)
+        assert list(out.shape) == [1, 1, 5, 5]
+        assert float(out.numpy()[0, 0, 0, 0]) == 0.0
+
+    def test_upsampling(self):
+        x = t(R.randn(1, 2, 4, 4).astype(np.float32))
+        assert list(nn.UpsamplingNearest2D(scale_factor=2)(x).shape) == [1, 2, 8, 8]
+        assert list(nn.UpsamplingBilinear2D(size=[6, 6])(x).shape) == [1, 2, 6, 6]
+
+    def test_fold_unfold_layers(self):
+        x = t(R.randn(1, 2, 6, 6).astype(np.float32))
+        cols = nn.Unfold(kernel_sizes=[2, 2], strides=2)(x)
+        back = nn.Fold(output_sizes=[6, 6], kernel_sizes=[2, 2], strides=2)(cols)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-6)
+
+    def test_spectral_norm(self):
+        w = R.randn(6, 4).astype(np.float32)
+        sn = nn.SpectralNorm([6, 4], dim=0, power_iters=20)
+        out = sn(t(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        got_sigma = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+        np.testing.assert_allclose(got_sigma, 1.0, atol=1e-3)
+        np.testing.assert_allclose(out.numpy() * sigma, w, rtol=1e-2, atol=1e-2)
+
+    def test_birnn(self):
+        cell_fw = nn.SimpleRNNCell(4, 8)
+        cell_bw = nn.SimpleRNNCell(4, 8)
+        x = t(R.randn(2, 5, 4).astype(np.float32))
+        out, (st_f, st_b) = nn.BiRNN(cell_fw, cell_bw)(x)
+        assert list(out.shape) == [2, 5, 16]
+
+    def test_loss_layers_run(self):
+        x = t(R.randn(4, 3).astype(np.float32))
+        y = t(R.randn(4, 3).astype(np.float32))
+        lab = t(np.sign(R.randn(4, 3)).astype(np.float32))
+        assert float(nn.HuberLoss()(x, y).numpy()) >= 0
+        assert float(nn.SoftMarginLoss()(x, lab).numpy()) >= 0
+        a, p, n = (t(R.randn(3, 6).astype(np.float32)) for _ in range(3))
+        assert float(nn.TripletMarginWithDistanceLoss()(a, p, n).numpy()) >= 0
+        v = t(R.uniform(0.5, 1, (4, 3)).astype(np.float32))
+        assert float(nn.GaussianNLLLoss()(x, y, v).numpy()) is not None
+
+    def test_dropout3d_layer(self):
+        x = t(np.ones((2, 4, 2, 2, 2), np.float32))
+        layer = nn.Dropout3D(p=0.5)
+        layer.train()
+        out = layer(x).numpy()
+        # whole channels dropped or kept (scaled)
+        per_chan = out.reshape(2, 4, -1)
+        for b in range(2):
+            for c in range(4):
+                vals = np.unique(per_chan[b, c])
+                assert len(vals) == 1
+        layer.eval()
+        np.testing.assert_allclose(layer(x).numpy(), x.numpy())
+
+    def test_pairwise_distance_layer(self):
+        x = R.randn(4, 6).astype(np.float32)
+        y = R.randn(4, 6).astype(np.float32)
+        got = nn.PairwiseDistance()(t(x), t(y))
+        ref = tF.pairwise_distance(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_channel_shuffle_layer(self):
+        x = t(R.randn(1, 6, 2, 2).astype(np.float32))
+        assert list(nn.ChannelShuffle(2)(x).shape) == [1, 6, 2, 2]
+
+
+class TestReviewFixes:
+    def test_soft_margin_stable(self):
+        x = t(np.array([[100.0, -100.0]], np.float32))
+        y = t(np.array([[-1.0, 1.0]], np.float32))
+        got = float(F.soft_margin_loss(x, y).numpy())
+        assert np.isfinite(got) and abs(got - 100.0) < 1e-3
+
+    def test_rnnt_mean_divides_by_label_len(self):
+        B, T, U, V = 2, 4, 3, 5
+        logits = R.randn(B, T, U + 1, V).astype(np.float32)
+        labels = np.array([[1, 2, 3], [2, 1, 0]], np.int32)
+        il, ll = np.array([T, T]), np.array([3, 2])
+        per = F.rnnt_loss(t(logits), t(labels), t(il), t(ll),
+                          reduction="none").numpy()
+        mean = float(F.rnnt_loss(t(logits), t(labels), t(il), t(ll),
+                                 reduction="mean").numpy())
+        np.testing.assert_allclose(mean, (per / np.array([3, 2])).mean(),
+                                   rtol=1e-6)
+
+    def test_rnn_reverse_sequence_length(self):
+        cell = nn.SimpleRNNCell(3, 4)
+        x = R.randn(2, 5, 3).astype(np.float32)
+        lens = np.array([3, 5])
+        out, st = nn.RNN(cell, is_reverse=True)(t(x), sequence_length=t(lens))
+        # sample 0: same as running length-3 prefix alone reversed
+        out_ref, st_ref = nn.RNN(cell, is_reverse=True)(t(x[:1, :3]))
+        np.testing.assert_allclose(out.numpy()[0, :3], out_ref.numpy()[0],
+                                   atol=1e-5)
+        # padding positions emit zeros
+        np.testing.assert_allclose(out.numpy()[0, 3:], 0.0)
+        # final state equals the prefix run's state
+        np.testing.assert_allclose(st.numpy()[0], st_ref.numpy()[0], atol=1e-5)
+
+    def test_fastemit_changes_grads_not_loss(self):
+        B, T, U, V = 1, 3, 2, 4
+        logits = R.randn(B, T, U + 1, V).astype(np.float64)
+        labels = np.array([[1, 2]], np.int32)
+        il, ll = np.array([T]), np.array([U])
+        base = lambda lam: F.rnnt_loss(
+            paddle.to_tensor(logits, stop_gradient=False), t(labels), t(il),
+            t(ll), fastemit_lambda=lam, reduction="sum")
+        l0 = base(0.0)
+        l1 = base(0.5)
+        np.testing.assert_allclose(float(l0.numpy()), float(l1.numpy()),
+                                   rtol=1e-9)
+
+        x0 = paddle.to_tensor(logits, stop_gradient=False)
+        loss0 = F.rnnt_loss(x0, t(labels), t(il), t(ll), fastemit_lambda=0.0,
+                            reduction="sum")
+        loss0.backward()
+        x1 = paddle.to_tensor(logits, stop_gradient=False)
+        loss1 = F.rnnt_loss(x1, t(labels), t(il), t(ll), fastemit_lambda=0.5,
+                            reduction="sum")
+        loss1.backward()
+        assert not np.allclose(x0.grad.numpy(), x1.grad.numpy())

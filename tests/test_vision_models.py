@@ -1,0 +1,79 @@
+"""Vision model zoo: forward shape + trainability checks (SURVEY.md §2b).
+
+Small inputs keep CPU runtime low; each model runs a forward pass and the
+flagship ones also take one optimizer step to prove the graph is trainable.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+R = np.random.RandomState(0)
+
+
+def _img(n=1, s=64):
+    return paddle.to_tensor(R.rand(n, 3, s, s).astype(np.float32))
+
+
+@pytest.mark.parametrize("builder,classes", [
+    (models.alexnet, 10),
+    (models.squeezenet1_0, 10),
+    (models.squeezenet1_1, 10),
+    (models.mobilenet_v1, 10),
+    (models.mobilenet_v3_small, 10),
+    (models.shufflenet_v2_x0_25, 10),
+])
+def test_small_model_forward(builder, classes):
+    m = builder(num_classes=classes)
+    m.eval()
+    out = m(_img(2, 64))
+    assert list(out.shape) == [2, classes]
+    assert np.isfinite(out.numpy()).all()
+
+
+@pytest.mark.parametrize("builder", [
+    models.densenet121,
+    models.googlenet,
+    models.shufflenet_v2_x1_0,
+])
+def test_medium_model_forward(builder):
+    m = builder(num_classes=7)
+    m.eval()
+    out = m(_img(1, 64))
+    assert list(out.shape) == [1, 7]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_inception_v3_forward():
+    # stem requires >= 75px input
+    m = models.inception_v3(num_classes=5)
+    m.eval()
+    out = m(paddle.to_tensor(R.rand(1, 3, 96, 96).astype(np.float32)))
+    assert list(out.shape) == [1, 5]
+
+
+def test_zoo_model_trains():
+    m = models.squeezenet1_1(num_classes=4)
+    m.train()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = _img(2, 64)
+    y = paddle.to_tensor(np.array([0, 1]))
+    losses = []
+    for _ in range(3):
+        loss = paddle.nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_state_dict_roundtrip():
+    m = models.squeezenet1_1(num_classes=3)
+    sd = m.state_dict()
+    m2 = models.squeezenet1_1(num_classes=3)
+    m2.set_state_dict(sd)
+    x = _img(1, 64)
+    m.eval(); m2.eval()
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy(), atol=1e-6)
