@@ -37,7 +37,8 @@ import importlib as _importlib
 
 for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
              "incubate", "ops", "profiler", "device", "hapi", "static",
-             "inference", "runtime", "fft", "signal", "distribution", "sparse"):
+             "inference", "runtime", "fft", "signal", "distribution", "sparse",
+             "quantization", "audio", "text", "onnx", "linalg"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ImportError:

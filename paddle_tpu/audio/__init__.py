@@ -1,0 +1,8 @@
+"""paddle.audio parity: spectral feature layers + window/mel functional
+(ref: python/paddle/audio/). Built on paddle_tpu.signal's XLA-native STFT."""
+from . import features
+from . import functional
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+
+__all__ = ["features", "functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

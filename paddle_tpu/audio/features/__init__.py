@@ -1,0 +1,3 @@
+from .layers import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

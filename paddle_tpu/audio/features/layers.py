@@ -1,0 +1,91 @@
+"""Audio feature layers (ref: python/paddle/audio/features/layers.py).
+
+All lower to the XLA-native STFT in paddle_tpu.signal (batched matmul against
+the DFT basis -> MXU work), so feature extraction runs on-device.
+"""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...signal import stft
+from ...tensor import matmul
+from ...tensor.tensor import Tensor, _run_op
+from ..functional import (compute_fbank_matrix, create_dct, get_window,
+                          power_to_db)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, hop_length=self.hop_length,
+                    win_length=self.win_length, window=self.fft_window,
+                    center=self.center, pad_mode=self.pad_mode)
+        import jax.numpy as jnp
+        p = self.power
+        return _run_op("spec_power",
+                       lambda s: jnp.abs(s) ** p, (spec,), {})
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, dtype)
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # (..., n_freq, n_frames)
+        return matmul(self.fbank_matrix, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return power_to_db(self._melspectrogram(x), ref_value=self.ref_value,
+                           amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        mel = self._log_melspectrogram(x)  # (..., n_mels, n_frames)
+        from ...tensor.manipulation import swapaxes
+        return swapaxes(matmul(swapaxes(mel, -1, -2), self.dct_matrix),
+                        -1, -2)

@@ -1,0 +1,97 @@
+"""Mel/dB/DCT helpers (ref: python/paddle/audio/functional/functional.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, list, tuple))
+    f = np.asarray(freq.numpy() if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz)
+                       / logstep, mel)
+    return float(mel) if scalar else Tensor(jnp.asarray(mel, jnp.float32))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, list, tuple))
+    m = np.asarray(mel.numpy() if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = np.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else Tensor(jnp.asarray(hz, jnp.float32))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = np.linspace(low, high, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray(mel_to_hz(list(mels), htk).numpy()), dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.asarray(np.linspace(0, sr / 2, 1 + n_fft // 2), dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank, (n_mels, 1 + n_fft//2)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fftfreqs = np.asarray(fft_frequencies(sr, n_fft).numpy(), np.float64)
+    melpts = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy(), np.float64)
+    fdiff = np.diff(melpts)
+    ramps = melpts[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / np.maximum(fdiff[:-1, None], 1e-10)
+    upper = ramps[2:] / np.maximum(fdiff[1:, None], 1e-10)
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melpts[2:n_mels + 2] - melpts[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights, dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    from ...tensor.tensor import _run_op
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    return _run_op("power_to_db", f, (spect,), {})
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """(n_mels, n_mfcc) DCT-II matrix (ref: functional.create_dct)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= np.sqrt(1.0 / n_mels)
+        dct[:, 1:] *= np.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, dtype))

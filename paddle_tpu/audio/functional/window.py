@@ -1,0 +1,39 @@
+"""Window functions (ref: python/paddle/audio/functional/window.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import scipy.signal.windows as sw
+
+from ...tensor.tensor import Tensor
+
+_WINDOWS = {
+    "hamming": sw.hamming, "hann": sw.hann, "blackman": sw.blackman,
+    "bartlett": sw.bartlett, "bohman": sw.bohman, "nuttall": sw.nuttall,
+    "cosine": sw.cosine, "triang": sw.triang,
+}
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    if isinstance(window, tuple):
+        name, *args = window
+        if name in ("gaussian",):
+            data = sw.gaussian(win_length, *args, sym=not fftbins)
+        elif name in ("kaiser",):
+            data = sw.kaiser(win_length, *args, sym=not fftbins)
+        elif name in ("taylor",):
+            data = sw.taylor(win_length, sym=not fftbins)
+        elif name in ("general_gaussian",):
+            data = sw.general_gaussian(win_length, *args, sym=not fftbins)
+        elif name in ("exponential",):
+            data = sw.exponential(win_length, *args, sym=not fftbins)
+        elif name in ("tukey",):
+            data = sw.tukey(win_length, *args, sym=not fftbins)
+        else:
+            raise ValueError(f"unknown window {name}")
+    else:
+        fn = _WINDOWS.get(window)
+        if fn is None:
+            raise ValueError(f"unknown window {window}")
+        data = fn(win_length, sym=not fftbins)
+    return Tensor(jnp.asarray(np.asarray(data), dtype))

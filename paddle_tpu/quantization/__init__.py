@@ -1,0 +1,23 @@
+"""paddle.quantization parity: QAT fake-quant + PTQ calibration
+(ref: python/paddle/quantization/{config,qat,ptq}.py, quanters/, observers/).
+
+TPU-native design: fake-quant is a pure function with a straight-through
+estimator (round() forward, identity backward via the stop-gradient trick),
+so QAT graphs stay fully XLA-fusable — quant/dequant folds into the
+surrounding matmul. int8 inference export maps to XLA int8 dot when lowered.
+"""
+from .config import QuantConfig
+from .quanters import (FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax,
+                       quant_dequant_abs_max)
+from .observers import AbsmaxObserver, HistObserver, KLObserver
+from .qat import QAT
+from .ptq import PTQ
+from .quanted_layers import QuantedLinear, QuantedConv2D
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "FakeQuanterWithAbsMax", "FakeQuanterChannelWiseAbsMax",
+    "quant_dequant_abs_max",
+    "AbsmaxObserver", "HistObserver", "KLObserver",
+    "QuantedLinear", "QuantedConv2D",
+]

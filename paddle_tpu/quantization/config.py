@@ -1,0 +1,52 @@
+"""QuantConfig: which layers get which quanters
+(ref: python/paddle/quantization/config.py)."""
+from __future__ import annotations
+
+import copy
+
+
+class _FactoryWrapper:
+    """Defers quanter construction so one config instantiates many layers."""
+
+    def __init__(self, cls_or_instance):
+        self._spec = cls_or_instance
+
+    def instance(self):
+        spec = self._spec
+        if spec is None:
+            return None
+        if isinstance(spec, type):
+            return spec()
+        if callable(getattr(spec, "_instance", None)):
+            return spec._instance()
+        return copy.deepcopy(spec)
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global_activation = _FactoryWrapper(activation)
+        self._global_weight = _FactoryWrapper(weight)
+        self._layer_configs = []    # (predicate, act_factory, w_factory)
+        self._type_configs = []     # (layer_type, act_factory, w_factory)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_configs.append(
+                (l, _FactoryWrapper(activation), _FactoryWrapper(weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = (layer_type if isinstance(layer_type, (list, tuple))
+                 else [layer_type])
+        for t in types:
+            self._type_configs.append(
+                (t, _FactoryWrapper(activation), _FactoryWrapper(weight)))
+
+    def _config_for(self, layer):
+        for target, act, w in self._layer_configs:
+            if layer is target:
+                return act, w
+        for t, act, w in self._type_configs:
+            if type(layer) is t:
+                return act, w
+        return self._global_activation, self._global_weight

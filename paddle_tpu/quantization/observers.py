@@ -1,0 +1,109 @@
+"""PTQ observers: collect activation statistics during calibration
+(ref: python/paddle/quantization/observers/)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+class _BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def forward(self, x):
+        self._observe(np.asarray(x.numpy() if isinstance(x, Tensor) else x))
+        return x
+
+    def cal_thresholds(self):
+        pass
+
+    def scales(self):
+        self.cal_thresholds()
+        return self._scale
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return self.quant_bits
+
+
+class AbsmaxObserver(_BaseObserver):
+    """Running abs-max (ref: observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def _observe(self, a):
+        self._max = max(self._max, float(np.abs(a).max()))
+
+    def cal_thresholds(self):
+        self._scale = self._max or 1e-8
+
+
+class HistObserver(_BaseObserver):
+    """Histogram-percentile threshold (ref: observers/hist.py)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self.bins_count = bins_count
+        self.percent = percent
+        self._samples = []
+
+    def _observe(self, a):
+        self._samples.append(np.abs(a).reshape(-1))
+
+    def cal_thresholds(self):
+        if not self._samples:
+            self._scale = 1e-8
+            return
+        allv = np.concatenate(self._samples)
+        hist, edges = np.histogram(allv, bins=self.bins_count)
+        cdf = np.cumsum(hist) / max(1, hist.sum())
+        idx = int(np.searchsorted(cdf, self.percent))
+        self._scale = float(edges[min(idx + 1, len(edges) - 1)]) or 1e-8
+
+
+class KLObserver(_BaseObserver):
+    """KL-divergence calibration (TensorRT-style, ref: observers/kl.py)."""
+
+    def __init__(self, quant_bits=8, bins_count=1024):
+        super().__init__(quant_bits)
+        self.bins_count = bins_count
+        self._samples = []
+
+    def _observe(self, a):
+        self._samples.append(np.abs(a).reshape(-1))
+
+    def cal_thresholds(self):
+        if not self._samples:
+            self._scale = 1e-8
+            return
+        allv = np.concatenate(self._samples)
+        hist, edges = np.histogram(allv, bins=self.bins_count)
+        hist = hist.astype(np.float64)
+        levels = 2 ** (self.quant_bits - 1)
+        best_kl, best_i = np.inf, len(hist)
+        for i in range(levels, len(hist) + 1, max(1, len(hist) // 64)):
+            p = hist[:i].copy()
+            p[-1] += hist[i:].sum()  # clip tail into last bin
+            if p.sum() == 0:
+                continue
+            # quantize p into `levels` buckets then expand back
+            chunks = np.array_split(p, levels)
+            q = np.concatenate([
+                np.full(len(c), c.sum() / max(1, (c > 0).sum())) * (c > 0)
+                for c in chunks])
+            pn = p / p.sum()
+            qn = q / max(q.sum(), 1e-12)
+            mask = pn > 0
+            kl = float(np.sum(pn[mask] * np.log(pn[mask]
+                                                / np.maximum(qn[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        self._scale = float(edges[best_i]) or 1e-8

@@ -1,0 +1,83 @@
+"""PTQ: insert observers, calibrate on sample data, convert to fake-quant
+(ref: python/paddle/quantization/ptq.py)."""
+from __future__ import annotations
+
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from .quanted_layers import QuantedConv2D, QuantedLinear
+
+_PTQ_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Wrap supported layers with observers; run calibration data through
+        the returned model, then call convert()."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._insert(model)
+        return model
+
+    def _insert(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            qcls = _PTQ_MAP.get(type(sub))
+            if qcls is not None:
+                act_f, w_f = self._config._config_for(sub)
+                act, w = act_f.instance(), w_f.instance()
+                if act is not None or w is not None:
+                    layer._sub_layers[name] = qcls(sub, act, w)
+                    continue
+            self._insert(sub)
+
+    def convert(self, model, inplace=False):
+        """Freeze observer thresholds into static fake-quant ops."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer):
+        import jax.numpy as jnp
+
+        from ..tensor.tensor import Tensor
+        from .observers import _BaseObserver
+        from .quanters import quant_dequant_abs_max
+
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                act = sub.activation_quanter
+                if isinstance(act, _BaseObserver):
+                    scale = act.scales()
+                    bits = act.bit_length()
+                    sub.activation_quanter = _FrozenQuant(scale, bits)
+                wq = sub.weight_quanter
+                if isinstance(wq, _BaseObserver):
+                    w = sub._origin.weight
+                    frozen = quant_dequant_abs_max(
+                        w, Tensor(jnp.asarray(
+                            float(jnp.max(jnp.abs(w._data))), jnp.float32)),
+                        wq.bit_length())
+                    sub._origin.weight._data = frozen._data
+                    sub.weight_quanter = None
+            else:
+                self._convert(sub)
+
+
+class _FrozenQuant:
+    """Static fake-quant with a calibrated scale."""
+
+    def __init__(self, scale, bits):
+        import jax.numpy as jnp
+
+        from ..tensor.tensor import Tensor
+        self._scale = Tensor(jnp.asarray(float(scale), jnp.float32))
+        self._bits = bits
+
+    def __call__(self, x):
+        from .quanters import quant_dequant_abs_max
+        return quant_dequant_abs_max(x, self._scale, self._bits)

@@ -1,0 +1,55 @@
+"""QAT: swap Linear/Conv2D for quant-aware twins per QuantConfig
+(ref: python/paddle/quantization/qat.py)."""
+from __future__ import annotations
+
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from .quanted_layers import QuantedConv2D, QuantedLinear
+
+_QAT_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        """Replace supported sublayers with quant-aware versions."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            qcls = _QAT_MAP.get(type(sub))
+            if qcls is not None:
+                act_f, w_f = self._config._config_for(sub)
+                act, w = act_f.instance(), w_f.instance()
+                if act is not None or w is not None:
+                    layer._sub_layers[name] = qcls(sub, act, w)
+                    continue
+            self._convert(sub)
+
+    def convert(self, model, inplace=False):
+        """Strip quanters, freezing weight fake-quant into the weights —
+        the exported model is inference-ready (ref: QAT.convert)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._deconvert(model)
+        return model
+
+    def _deconvert(self, layer):
+        from ..tensor.tensor import Tensor
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                origin = sub._origin
+                if sub.weight_quanter is not None:
+                    frozen = sub.weight_quanter(origin.weight)
+                    origin.weight._data = (
+                        frozen._data if isinstance(frozen, Tensor) else frozen)
+                layer._sub_layers[name] = origin
+            else:
+                self._deconvert(sub)

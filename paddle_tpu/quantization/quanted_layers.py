@@ -1,0 +1,41 @@
+"""Quant-aware replacements for Linear/Conv2D
+(ref: python/paddle/nn/quant/qat/ — QuantedLinear, QuantedConv2D)."""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+
+
+class _QuantedBase(Layer):
+    def __init__(self, origin, act_quanter, weight_quanter):
+        super().__init__()
+        self._origin = origin
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    @property
+    def weight(self):
+        return self._origin.weight
+
+    @property
+    def bias(self):
+        return self._origin.bias
+
+    def _q(self, x, quanter):
+        return quanter(x) if quanter is not None else x
+
+
+class QuantedLinear(_QuantedBase):
+    def forward(self, x):
+        x = self._q(x, self.activation_quanter)
+        w = self._q(self._origin.weight, self.weight_quanter)
+        return F.linear(x, w, self._origin.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    def forward(self, x):
+        x = self._q(x, self.activation_quanter)
+        w = self._q(self._origin.weight, self.weight_quanter)
+        o = self._origin
+        return F.conv2d(x, w, o.bias, stride=o._stride, padding=o._padding,
+                        dilation=o._dilation, groups=o._groups)

@@ -1,0 +1,92 @@
+"""Fake quanters: quantize-dequantize with straight-through gradients
+(ref: python/paddle/quantization/quanters/abs_max.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor, _run_op
+
+
+def _ste_round(x):
+    """round() in the forward pass, identity gradient in the backward."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def quant_dequant_abs_max(x, scale, bit_length=8):
+    """Symmetric fake quant: q = round(x/s * qmax) clamped, back to float."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    def f(a, s):
+        s = jnp.maximum(s.astype(jnp.float32), 1e-8)
+        q = _ste_round(jnp.clip(a.astype(jnp.float32) / s * qmax,
+                                -qmax - 1, qmax))
+        return (q * s / qmax).astype(a.dtype)
+    return _run_op("quant_dequant_abs_max", f, (x, scale), {})
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT activation/weight quanter with a running abs-max scale
+    (ref: FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, name=None, moving_rate=0.9, bit_length=8, dtype=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self._initialized = False
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.max(jnp.abs(
+                x._data if isinstance(x, Tensor) else x)).astype(jnp.float32))
+            if not self._initialized:
+                new_scale = cur
+                self._initialized = True
+            else:
+                prev = float(self.scale._data)
+                r = self.moving_rate
+                new_scale = r * prev + (1 - r) * cur
+            self.scale._data = jnp.asarray(new_scale, jnp.float32)
+        return quant_dequant_abs_max(x, self.scale, self.bit_length)
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        return self.scale
+
+
+class FakeQuanterChannelWiseAbsMax(Layer):
+    """Per-output-channel weight quanter (ref: quanters/abs_max.py
+    FakeQuanterChannelWiseAbsMax). quant_axis 0 = Linear rows / Conv filters."""
+
+    def __init__(self, name=None, bit_length=8, quant_axis=0, dtype=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self._quant_axis = quant_axis
+        self.register_buffer("scale", Tensor(jnp.ones((1,), jnp.float32)))
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        ax = self._quant_axis
+
+        data = x._data if isinstance(x, Tensor) else x
+        dims = tuple(d for d in range(data.ndim) if d != ax)
+        self.scale._data = jnp.max(jnp.abs(data.astype(jnp.float32)),
+                                   axis=dims)
+
+        def f(a):
+            a32 = a.astype(jnp.float32)
+            red = tuple(d for d in range(a.ndim) if d != ax)
+            s = jnp.maximum(jnp.max(jnp.abs(a32), axis=red, keepdims=True),
+                            1e-8)
+            q = _ste_round(jnp.clip(a32 / s * qmax, -qmax - 1, qmax))
+            return (q * s / qmax).astype(a.dtype)
+        return _run_op("quant_dequant_channel_abs_max", f, (x,), {})
+
+    def quant_axis(self):
+        return self._quant_axis
+
+    def scales(self):
+        return self.scale

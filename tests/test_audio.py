@@ -1,0 +1,74 @@
+"""paddle.audio features vs scipy references."""
+import numpy as np
+import scipy.signal as ss
+
+import paddle_tpu as paddle
+import paddle_tpu.audio as audio
+from paddle_tpu.audio.functional import (compute_fbank_matrix, create_dct,
+                                         get_window, hz_to_mel, mel_to_hz,
+                                         power_to_db)
+
+SR = 16000
+
+
+def _tone(freq=440.0, secs=0.5):
+    tt = np.arange(int(SR * secs), dtype=np.float32) / SR
+    return np.sin(2 * np.pi * freq * tt)
+
+
+def test_spectrogram_peak_bin():
+    wav = paddle.to_tensor(_tone(1000.0)[None])
+    spec = audio.Spectrogram(n_fft=512, center=False)(wav).numpy()[0]
+    peak = int(spec.mean(-1).argmax())
+    assert abs(peak - round(1000 * 512 / SR)) <= 1
+
+
+def test_spectrogram_vs_scipy():
+    wav = _tone(440.0)
+    spec = audio.Spectrogram(n_fft=256, hop_length=128, window="hann",
+                             power=1.0, center=False)(
+        paddle.to_tensor(wav[None])).numpy()[0]
+    f, t, z = ss.stft(wav, nperseg=256, noverlap=128, window="hann",
+                      boundary=None, padded=False)
+    ref = np.abs(z) * 256 / 2  # scipy normalizes by window sum
+    assert spec.shape[0] == ref.shape[0]
+    corr = np.corrcoef(spec[:, :ref.shape[1]].reshape(-1),
+                       ref[:, :spec.shape[1]].reshape(-1))[0, 1]
+    assert corr > 0.99
+
+
+def test_mel_hz_roundtrip():
+    for htk in (False, True):
+        hz = mel_to_hz(hz_to_mel(440.0, htk), htk)
+        assert abs(hz - 440.0) < 1e-6
+
+
+def test_fbank_rows_nonzero():
+    fb = compute_fbank_matrix(SR, 512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_power_to_db_topdb():
+    x = paddle.to_tensor(np.array([[1.0, 1e-8]], np.float32))
+    db = power_to_db(x, top_db=30.0).numpy()
+    assert db.max() == 0.0 and db.min() >= -30.0
+
+
+def test_dct_orthonormal():
+    d = create_dct(13, 40).numpy()
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+
+def test_mfcc_shapes_finite():
+    wav = paddle.to_tensor(_tone()[None])
+    out = audio.MFCC(sr=SR, n_mfcc=13, n_fft=512)(wav).numpy()
+    assert out.shape[1] == 13
+    assert np.isfinite(out).all()
+
+
+def test_get_window_tuple():
+    w = get_window(("gaussian", 7), 64).numpy()
+    ref = ss.windows.gaussian(64, 7, sym=False)
+    np.testing.assert_allclose(w, ref, atol=1e-6)

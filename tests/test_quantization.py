@@ -1,0 +1,94 @@
+"""QAT/PTQ: fake-quant numerics, observer calibration, model conversion."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (QAT, PTQ, AbsmaxObserver,
+                                     FakeQuanterChannelWiseAbsMax,
+                                     FakeQuanterWithAbsMax, HistObserver,
+                                     QuantConfig, QuantedLinear,
+                                     quant_dequant_abs_max)
+
+R = np.random.RandomState(11)
+
+
+def _model():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_quant_dequant_roundtrip():
+    x = paddle.to_tensor(R.uniform(-1, 1, (4, 8)).astype(np.float32))
+    s = paddle.to_tensor(np.float32(1.0))
+    q = quant_dequant_abs_max(x, s, bit_length=8)
+    # quantization error bounded by scale/qmax/2
+    assert float(np.abs(q.numpy() - x.numpy()).max()) <= 1.0 / 127 / 2 + 1e-6
+
+
+def test_ste_gradient_passes_through():
+    x = paddle.to_tensor(R.uniform(-1, 1, (4, 8)).astype(np.float32),
+                         stop_gradient=False)
+    s = paddle.to_tensor(np.float32(1.0))
+    out = quant_dequant_abs_max(x, s)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 8)), atol=1e-6)
+
+
+def test_qat_swaps_and_trains():
+    model = _model()
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMax,
+                      weight=FakeQuanterChannelWiseAbsMax)
+    qat = QAT(cfg)
+    qmodel = qat.quantize(model, inplace=True)
+    assert isinstance(qmodel._sub_layers["0"], QuantedLinear)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=qmodel.parameters())
+    x = paddle.to_tensor(R.rand(16, 8).astype(np.float32))
+    y = paddle.to_tensor(R.randint(0, 4, (16,)))
+    losses = []
+    for _ in range(5):
+        loss = paddle.nn.functional.cross_entropy(qmodel(x), y)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+    infer = qat.convert(qmodel, inplace=True)
+    assert isinstance(infer._sub_layers["0"], nn.Linear)
+    out = infer(x)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_ptq_calibrate_convert():
+    model = _model()
+    model.eval()
+    x = paddle.to_tensor(R.rand(32, 8).astype(np.float32))
+    ref = model(x).numpy()
+
+    cfg = QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver)
+    ptq = PTQ(cfg)
+    qmodel = ptq.quantize(model, inplace=False)
+    qmodel(x)  # calibration pass
+    inf = ptq.convert(qmodel, inplace=True)
+    got = inf(x).numpy()
+    # int8 PTQ should stay close to fp32 on this tiny net
+    assert np.abs(got - ref).max() < 0.15
+    assert np.corrcoef(got.reshape(-1), ref.reshape(-1))[0, 1] > 0.99
+
+
+def test_hist_observer_threshold():
+    obs = HistObserver(percent=0.99)
+    data = np.concatenate([R.uniform(-1, 1, 10000),
+                           np.array([100.0])]).astype(np.float32)
+    obs._observe(data)
+    obs.cal_thresholds()
+    # outlier must be clipped away
+    assert obs.scales() < 5.0
+
+
+def test_type_config_override():
+    model = _model()
+    cfg = QuantConfig(activation=None, weight=None)
+    cfg.add_type_config(nn.Linear, activation=FakeQuanterWithAbsMax,
+                        weight=FakeQuanterWithAbsMax)
+    q = QAT(cfg).quantize(model, inplace=True)
+    assert isinstance(q._sub_layers["0"], QuantedLinear)
