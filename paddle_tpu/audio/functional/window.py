@@ -22,7 +22,7 @@ def get_window(window, win_length, fftbins=True, dtype="float32"):
         elif name in ("kaiser",):
             data = sw.kaiser(win_length, *args, sym=not fftbins)
         elif name in ("taylor",):
-            data = sw.taylor(win_length, sym=not fftbins)
+            data = sw.taylor(win_length, *args, sym=not fftbins)
         elif name in ("general_gaussian",):
             data = sw.general_gaussian(win_length, *args, sym=not fftbins)
         elif name in ("exponential",):

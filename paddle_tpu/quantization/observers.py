@@ -46,6 +46,39 @@ class AbsmaxObserver(_BaseObserver):
         self._scale = self._max or 1e-8
 
 
+class _RunningHist:
+    """Fixed-size running histogram over [0, range); the range doubles when a
+    batch exceeds it and existing counts are re-binned — O(bins) memory, like
+    the reference's per-step accumulation (ref: observers/hist.py)."""
+
+    def __init__(self, bins_count):
+        self.bins = bins_count
+        self.counts = np.zeros(bins_count, np.float64)
+        self.range = 0.0
+
+    def add(self, a):
+        a = np.abs(a).reshape(-1).astype(np.float64)
+        if a.size == 0:
+            return
+        amax = float(a.max())
+        if amax > self.range:
+            new_range = max(amax, self.range * 2 or amax)
+            if self.range > 0 and self.counts.sum() > 0:
+                # re-bin old counts into the widened histogram
+                old_centers = (np.arange(self.bins) + 0.5) * (self.range / self.bins)
+                idx = np.minimum((old_centers / new_range * self.bins).astype(int),
+                                 self.bins - 1)
+                new_counts = np.zeros_like(self.counts)
+                np.add.at(new_counts, idx, self.counts)
+                self.counts = new_counts
+            self.range = new_range
+        hist, _ = np.histogram(a, bins=self.bins, range=(0.0, self.range))
+        self.counts += hist
+
+    def edges(self):
+        return np.linspace(0.0, self.range, self.bins + 1)
+
+
 class HistObserver(_BaseObserver):
     """Histogram-percentile threshold (ref: observers/hist.py)."""
 
@@ -53,18 +86,18 @@ class HistObserver(_BaseObserver):
         super().__init__(quant_bits)
         self.bins_count = bins_count
         self.percent = percent
-        self._samples = []
+        self._hist = _RunningHist(bins_count)
 
     def _observe(self, a):
-        self._samples.append(np.abs(a).reshape(-1))
+        self._hist.add(a)
 
     def cal_thresholds(self):
-        if not self._samples:
+        hist, edges = self._hist.counts, self._hist.edges()
+        total = hist.sum()
+        if total == 0:
             self._scale = 1e-8
             return
-        allv = np.concatenate(self._samples)
-        hist, edges = np.histogram(allv, bins=self.bins_count)
-        cdf = np.cumsum(hist) / max(1, hist.sum())
+        cdf = np.cumsum(hist) / total
         idx = int(np.searchsorted(cdf, self.percent))
         self._scale = float(edges[min(idx + 1, len(edges) - 1)]) or 1e-8
 
@@ -75,18 +108,16 @@ class KLObserver(_BaseObserver):
     def __init__(self, quant_bits=8, bins_count=1024):
         super().__init__(quant_bits)
         self.bins_count = bins_count
-        self._samples = []
+        self._hist = _RunningHist(bins_count)
 
     def _observe(self, a):
-        self._samples.append(np.abs(a).reshape(-1))
+        self._hist.add(a)
 
     def cal_thresholds(self):
-        if not self._samples:
+        hist, edges = self._hist.counts.copy(), self._hist.edges()
+        if hist.sum() == 0:
             self._scale = 1e-8
             return
-        allv = np.concatenate(self._samples)
-        hist, edges = np.histogram(allv, bins=self.bins_count)
-        hist = hist.astype(np.float64)
         levels = 2 ** (self.quant_bits - 1)
         best_kl, best_i = np.inf, len(hist)
         for i in range(levels, len(hist) + 1, max(1, len(hist) // 64)):

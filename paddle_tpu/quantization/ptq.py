@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from ..nn.layer.common import Linear
 from ..nn.layer.conv import Conv2D
+from .qat import _resolve_configs
 from .quanted_layers import QuantedConv2D, QuantedLinear
 
 _PTQ_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
@@ -16,22 +17,24 @@ class PTQ:
     def quantize(self, model, inplace=False):
         """Wrap supported layers with observers; run calibration data through
         the returned model, then call convert()."""
+        resolved = _resolve_configs(self._config, model)
         if not inplace:
             import copy
             model = copy.deepcopy(model)
-        self._insert(model)
+        self._insert(model, "", resolved)
         return model
 
-    def _insert(self, layer):
+    def _insert(self, layer, prefix, resolved):
         for name, sub in list(layer._sub_layers.items()):
+            path = f"{prefix}.{name}" if prefix else name
             qcls = _PTQ_MAP.get(type(sub))
             if qcls is not None:
-                act_f, w_f = self._config._config_for(sub)
+                act_f, w_f = resolved[path]
                 act, w = act_f.instance(), w_f.instance()
                 if act is not None or w is not None:
-                    layer._sub_layers[name] = qcls(sub, act, w)
+                    setattr(layer, name, qcls(sub, act, w))
                     continue
-            self._insert(sub)
+            self._insert(sub, path, resolved)
 
     def convert(self, model, inplace=False):
         """Freeze observer thresholds into static fake-quant ops."""
@@ -52,18 +55,22 @@ class PTQ:
             if isinstance(sub, (QuantedLinear, QuantedConv2D)):
                 act = sub.activation_quanter
                 if isinstance(act, _BaseObserver):
-                    scale = act.scales()
-                    bits = act.bit_length()
-                    sub.activation_quanter = _FrozenQuant(scale, bits)
+                    frozen_q = _FrozenQuant(act.scales(), act.bit_length())
+                    # drop the observer sublayer entry (it holds calibration
+                    # state) before binding the plain-callable replacement
+                    sub._sub_layers.pop("activation_quanter", None)
+                    object.__setattr__(sub, "activation_quanter", frozen_q)
                 wq = sub.weight_quanter
                 if isinstance(wq, _BaseObserver):
                     w = sub._origin.weight
+                    # honor the observer's calibrated threshold (Hist/KL
+                    # differ from raw abs-max by design)
                     frozen = quant_dequant_abs_max(
-                        w, Tensor(jnp.asarray(
-                            float(jnp.max(jnp.abs(w._data))), jnp.float32)),
+                        w, Tensor(jnp.asarray(float(wq.scales()), jnp.float32)),
                         wq.bit_length())
                     sub._origin.weight._data = frozen._data
-                    sub.weight_quanter = None
+                    sub._sub_layers.pop("weight_quanter", None)
+                    object.__setattr__(sub, "weight_quanter", None)
             else:
                 self._convert(sub)
 

@@ -9,28 +9,45 @@ from .quanted_layers import QuantedConv2D, QuantedLinear
 _QAT_MAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
 
 
+def _resolve_configs(config, model):
+    """Map sublayer path -> (act_factory, w_factory), resolved against the
+    ORIGINAL model so per-layer (identity-matched) configs survive the
+    deepcopy that inplace=False performs."""
+    out = {}
+    def walk(layer, prefix):
+        for name, sub in layer._sub_layers.items():
+            path = f"{prefix}.{name}" if prefix else name
+            out[path] = config._config_for(sub)
+            walk(sub, path)
+    walk(model, "")
+    return out
+
+
 class QAT:
     def __init__(self, config):
         self._config = config
 
     def quantize(self, model, inplace=False):
         """Replace supported sublayers with quant-aware versions."""
+        resolved = _resolve_configs(self._config, model)
         if not inplace:
             import copy
             model = copy.deepcopy(model)
-        self._convert(model)
+        self._convert(model, "", resolved, _QAT_MAP)
         return model
 
-    def _convert(self, layer):
+    def _convert(self, layer, prefix, resolved, mapping):
         for name, sub in list(layer._sub_layers.items()):
-            qcls = _QAT_MAP.get(type(sub))
+            path = f"{prefix}.{name}" if prefix else name
+            qcls = mapping.get(type(sub))
             if qcls is not None:
-                act_f, w_f = self._config._config_for(sub)
+                act_f, w_f = resolved[path]
                 act, w = act_f.instance(), w_f.instance()
                 if act is not None or w is not None:
-                    layer._sub_layers[name] = qcls(sub, act, w)
+                    # setattr keeps _sub_layers AND the attribute in sync
+                    setattr(layer, name, qcls(sub, act, w))
                     continue
-            self._convert(sub)
+            self._convert(sub, path, resolved, mapping)
 
     def convert(self, model, inplace=False):
         """Strip quanters, freezing weight fake-quant into the weights —
@@ -50,6 +67,6 @@ class QAT:
                     frozen = sub.weight_quanter(origin.weight)
                     origin.weight._data = (
                         frozen._data if isinstance(frozen, Tensor) else frozen)
-                layer._sub_layers[name] = origin
+                setattr(layer, name, origin)
             else:
                 self._deconvert(sub)

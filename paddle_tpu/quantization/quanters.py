@@ -38,16 +38,15 @@ class FakeQuanterWithAbsMax(Layer):
 
     def forward(self, x):
         if self.training:
-            cur = float(jnp.max(jnp.abs(
-                x._data if isinstance(x, Tensor) else x)).astype(jnp.float32))
+            # stays on device: no host sync on the training hot path
+            cur = jnp.max(jnp.abs(
+                x._data if isinstance(x, Tensor) else x)).astype(jnp.float32)
             if not self._initialized:
-                new_scale = cur
+                self.scale._data = cur
                 self._initialized = True
             else:
-                prev = float(self.scale._data)
                 r = self.moving_rate
-                new_scale = r * prev + (1 - r) * cur
-            self.scale._data = jnp.asarray(new_scale, jnp.float32)
+                self.scale._data = r * self.scale._data + (1 - r) * cur
         return quant_dequant_abs_max(x, self.scale, self.bit_length)
 
     def quant_axis(self):
@@ -71,18 +70,19 @@ class FakeQuanterChannelWiseAbsMax(Layer):
         qmax = float(2 ** (self.bit_length - 1) - 1)
         ax = self._quant_axis
 
+        # one reduction, shared by the scale buffer and the quant op; the
+        # scale is a constant wrt gradients (STE passes through regardless)
         data = x._data if isinstance(x, Tensor) else x
         dims = tuple(d for d in range(data.ndim) if d != ax)
-        self.scale._data = jnp.max(jnp.abs(data.astype(jnp.float32)),
-                                   axis=dims)
+        s_full = jnp.maximum(
+            jnp.max(jnp.abs(data.astype(jnp.float32)), axis=dims,
+                    keepdims=True), 1e-8)
+        self.scale._data = s_full.reshape(-1)
 
         def f(a):
             a32 = a.astype(jnp.float32)
-            red = tuple(d for d in range(a.ndim) if d != ax)
-            s = jnp.maximum(jnp.max(jnp.abs(a32), axis=red, keepdims=True),
-                            1e-8)
-            q = _ste_round(jnp.clip(a32 / s * qmax, -qmax - 1, qmax))
-            return (q * s / qmax).astype(a.dtype)
+            q = _ste_round(jnp.clip(a32 / s_full * qmax, -qmax - 1, qmax))
+            return (q * s_full / qmax).astype(a.dtype)
         return _run_op("quant_dequant_channel_abs_max", f, (x,), {})
 
     def quant_axis(self):
