@@ -14,6 +14,8 @@ from .env import (get_rank, get_world_size, init_parallel_env, is_initialized,
                   parallel_device_count)
 from .parallel import DataParallel, spawn
 from . import checkpoint
+from . import rpc
+from . import ps
 from . import auto_parallel
 from .auto_parallel.api import (shard_tensor, Shard, Replicate, Partial,
                                 ProcessMesh)

@@ -57,6 +57,7 @@ class PipelineLayer(Layer):
         if num_stages is None:
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self._num_stages = num_stages
+        self._num_virtual_stages = num_virtual_pipeline_stages or 1
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
         self._shared_layers = {}
@@ -85,7 +86,10 @@ class PipelineLayer(Layer):
 
     def _segment(self, seg_method):
         n = len(self._built)
-        s = self._num_stages
+        # with interleaving the layer list splits into S*V chunks; chunk c is
+        # hosted by stage c % S (Megatron round-robin layout, ref:
+        # pp_layers.py _segment_network_for_interleave)
+        s = self._num_stages * self._num_virtual_stages
         if seg_method.startswith("layer:"):
             # segment at layers whose class name matches
             pat = seg_method.split(":", 1)[1]
@@ -103,8 +107,21 @@ class PipelineLayer(Layer):
             per = n / s
             bounds = [int(round(i * per)) for i in range(s + 1)]
         self.segment_parts = bounds
-        self._stage_layers = [
+        self._chunks = [
             self._built[bounds[i]:bounds[i + 1]] for i in range(s)]
+        if self._num_virtual_stages == 1:
+            self._stage_layers = self._chunks
+        else:
+            # stage_layers[s] = its V chunks in pipeline order
+            self._stage_layers = [self.get_model_chunks(st)
+                                  for st in range(self._num_stages)]
+
+    def get_model_chunks(self, stage_id=None):
+        """Chunk list (interleave): all chunks, or this stage's V chunks."""
+        if stage_id is None:
+            return self._chunks
+        return [self._chunks[c] for c in range(len(self._chunks))
+                if c % self._num_stages == stage_id]
 
     # -- dense (non-pipelined) execution: numerically the ground truth ------
     def forward(self, x):

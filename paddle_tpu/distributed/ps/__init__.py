@@ -1,0 +1,17 @@
+"""Parameter-server mode, minimal but real (ref: paddle/fluid/distributed/ps/
+and python/paddle/distributed/ps/ — SURVEY.md §2a 'Parameter server').
+
+The reference's PS is a brpc service with sparse/dense tables for
+recommendation workloads. This TPU-native equivalent keeps the same worker
+API surface (pull/push dense + sparse tables, server/worker roles, fleet-style
+init_server/init_worker) over the framework RPC layer. Dense training belongs
+on the SPMD collective path; PS covers the huge-sparse-embedding case where
+tables exceed device memory and live host-side.
+"""
+from .service import (create_dense_table, create_sparse_table, pull_dense,
+                      pull_sparse, push_dense, push_sparse, stat)
+from .ps import PSClient, PSServer
+
+__all__ = ["PSServer", "PSClient", "create_dense_table",
+           "create_sparse_table", "pull_dense", "push_dense", "pull_sparse",
+           "push_sparse", "stat"]

@@ -1,0 +1,62 @@
+"""PS roles over the rpc layer (ref: python/paddle/distributed/ps/,
+fleet PS mode: fleet.init_server / run_server / init_worker)."""
+from __future__ import annotations
+
+from .. import rpc as rpc_mod
+from . import service
+
+
+class PSServer:
+    """Server role: joins the rpc world as ``ps_server:{idx}`` and serves
+    table requests until stop() (ref: fleet.run_server)."""
+
+    def __init__(self, server_index=0, rank=None, world_size=None,
+                 master_endpoint=None):
+        self.name = f"ps_server:{server_index}"
+        rpc_mod.init_rpc(self.name, rank=rank, world_size=world_size,
+                         master_endpoint=master_endpoint)
+
+    def stop(self):
+        rpc_mod.shutdown()
+
+
+class PSClient:
+    """Worker-side handle (ref: fleet init_worker + pull/push APIs)."""
+
+    def __init__(self, worker_name, server_name="ps_server:0", rank=None,
+                 world_size=None, master_endpoint=None):
+        self.server = server_name
+        if rank is not None or rpc_mod.rpc._state["server"] is None:
+            rpc_mod.init_rpc(worker_name, rank=rank, world_size=world_size,
+                             master_endpoint=master_endpoint)
+
+    # dense ---------------------------------------------------------------
+    def create_dense_table(self, name, shape, init="zeros"):
+        return rpc_mod.rpc_sync(self.server, service.create_dense_table,
+                                args=(name, shape, init))
+
+    def pull_dense(self, name):
+        return rpc_mod.rpc_sync(self.server, service.pull_dense, args=(name,))
+
+    def push_dense(self, name, grad, lr=0.01):
+        return rpc_mod.rpc_sync(self.server, service.push_dense,
+                                args=(name, grad, lr))
+
+    # sparse --------------------------------------------------------------
+    def create_sparse_table(self, name, emb_dim, init_std=0.01):
+        return rpc_mod.rpc_sync(self.server, service.create_sparse_table,
+                                args=(name, emb_dim, init_std))
+
+    def pull_sparse(self, name, ids):
+        return rpc_mod.rpc_sync(self.server, service.pull_sparse,
+                                args=(name, list(map(int, ids))))
+
+    def push_sparse(self, name, ids, grads, lr=0.01):
+        return rpc_mod.rpc_sync(self.server, service.push_sparse,
+                                args=(name, list(map(int, ids)), grads, lr))
+
+    def stat(self):
+        return rpc_mod.rpc_sync(self.server, service.stat)
+
+    def stop(self):
+        rpc_mod.shutdown()

@@ -1,0 +1,84 @@
+"""Table service functions — executed inside the server process.
+
+Module-level functions pickle by reference, so an rpc call from a worker
+binds to THIS module's state on the server side (the table registry below
+lives in the server process only), mirroring how the reference's table
+accessors live in the brpc server (ref: paddle/fluid/distributed/ps/table/).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+_TABLES = {}
+_LOCK = threading.Lock()
+
+
+def create_dense_table(name, shape, init="zeros", seed=0):
+    with _LOCK:
+        if name in _TABLES:
+            return False
+        if init == "zeros":
+            data = np.zeros(shape, np.float32)
+        else:
+            rng = np.random.RandomState(seed)
+            data = (rng.standard_normal(shape) * 0.01).astype(np.float32)
+        _TABLES[name] = {"kind": "dense", "data": data}
+    return True
+
+
+def pull_dense(name):
+    return _TABLES[name]["data"]
+
+
+def push_dense(name, grad, lr=0.01):
+    """SGD-apply a dense gradient on the server (async-PS semantics)."""
+    with _LOCK:
+        _TABLES[name]["data"] -= lr * np.asarray(grad, np.float32)
+    return True
+
+
+def create_sparse_table(name, emb_dim, init_std=0.01, seed=0):
+    with _LOCK:
+        if name in _TABLES:
+            return False
+        _TABLES[name] = {"kind": "sparse", "dim": int(emb_dim),
+                         "rows": {}, "std": init_std,
+                         "rng": np.random.RandomState(seed)}
+    return True
+
+
+def pull_sparse(name, ids):
+    """Fetch rows for ids; unseen ids are lazily initialized (the reference's
+    accessor 'create on miss' behavior)."""
+    t = _TABLES[name]
+    with _LOCK:
+        out = np.empty((len(ids), t["dim"]), np.float32)
+        for i, key in enumerate(ids):
+            row = t["rows"].get(int(key))
+            if row is None:
+                row = (t["rng"].standard_normal(t["dim"])
+                       * t["std"]).astype(np.float32)
+                t["rows"][int(key)] = row
+            out[i] = row
+    return out
+
+
+def push_sparse(name, ids, grads, lr=0.01):
+    t = _TABLES[name]
+    grads = np.asarray(grads, np.float32)
+    with _LOCK:
+        for key, g in zip(ids, grads):
+            row = t["rows"].get(int(key))
+            if row is not None:
+                row -= lr * g
+    return True
+
+
+def stat():
+    with _LOCK:
+        return {name: (t["kind"],
+                       t["data"].shape if t["kind"] == "dense"
+                       else len(t["rows"]))
+                for name, t in _TABLES.items()}

@@ -1,0 +1,12 @@
+"""paddle.distributed.rpc parity (ref: python/paddle/distributed/rpc/).
+
+init_rpc / rpc_sync / rpc_async / shutdown over a plain TCP protocol: each
+worker runs a daemon server thread executing pickled (fn, args, kwargs)
+requests. Worker discovery goes through the framework's TCPStore (the same
+C++ store used for collective rendezvous — SURVEY.md §5.8).
+"""
+from .rpc import (WorkerInfo, get_all_worker_infos, get_current_worker_info,
+                  get_worker_info, init_rpc, rpc_async, rpc_sync, shutdown)
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "WorkerInfo"]

@@ -1,0 +1,156 @@
+"""RPC core (ref: python/paddle/distributed/rpc/rpc.py).
+
+Protocol: 4-byte big-endian length + pickle payload, one request per
+connection. The reference rides brpc; here a stdlib socketserver keeps the
+runtime dependency-free — throughput-sensitive tensor traffic belongs on the
+XLA collective path, not RPC (RPC is control-plane, like the reference's).
+"""
+from __future__ import annotations
+
+import concurrent.futures as futures
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import namedtuple
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = {"workers": {}, "server": None, "name": None, "pool": None}
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    header = b""
+    while len(header) < 4:
+        chunk = sock.recv(4 - len(header))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        header += chunk
+    n = struct.unpack(">I", header)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        if req.get("op") == "call":
+            try:
+                fn = req["fn"]
+                result = fn(*req["args"], **req["kwargs"])
+                _send_msg(self.request, {"ok": True, "value": result})
+            except Exception as e:  # noqa: BLE001 - errors travel to caller
+                _send_msg(self.request, {"ok": False, "error": repr(e)})
+        elif req.get("op") == "ping":
+            _send_msg(self.request, {"ok": True, "value": "pong"})
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start the local RPC server and rendezvous with peers.
+
+    master_endpoint: "ip:port" of the TCPStore master (defaults to
+    PADDLE_MASTER / PADDLE_TRAINER_ENDPOINTS env like the reference).
+    """
+    from ...runtime import TCPStore, TCPStoreServer
+
+    rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size or int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29601")
+    ip, port = master_endpoint.rsplit(":", 1)
+
+    server = _Server(("127.0.0.1", 0), _Handler)
+    my_port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    _state["server"] = server
+    _state["name"] = name
+    _state["pool"] = futures.ThreadPoolExecutor(max_workers=8)
+
+    if rank == 0:
+        store_server = TCPStoreServer(port=int(port))
+        _state["store_server"] = store_server
+    deadline = time.time() + 30
+    store = None
+    while time.time() < deadline:
+        try:
+            store = TCPStore(ip, int(port))
+            break
+        except (ConnectionError, OSError):
+            time.sleep(0.05)
+    if store is None:
+        raise ConnectionError(f"rpc: cannot reach store at {master_endpoint}")
+
+    info = WorkerInfo(name, rank, "127.0.0.1", my_port)
+    store.set(f"rpc/{rank}", pickle.dumps(info))
+    store.add("rpc/count", 1)
+    while store.add("rpc/count", 0) < world_size:
+        time.sleep(0.02)
+    for r in range(world_size):
+        peer = pickle.loads(store.get(f"rpc/{r}", timeout=30.0))
+        _state["workers"][peer.name] = peer
+    _state["store"] = store
+
+
+def _call(to, fn, args, kwargs, timeout):
+    peer = _state["workers"][to]
+    with socket.create_connection((peer.ip, peer.port), timeout=timeout) as s:
+        _send_msg(s, {"op": "call", "fn": fn, "args": args or (),
+                      "kwargs": kwargs or {}})
+        s.settimeout(timeout)
+        resp = _recv_msg(s)
+    if not resp["ok"]:
+        raise RuntimeError(f"rpc to {to} failed: {resp['error']}")
+    return resp["value"]
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
+    return _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+
+
+def shutdown():
+    server = _state.get("server")
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    pool = _state.get("pool")
+    if pool is not None:
+        pool.shutdown(wait=False)
+    _state["workers"].clear()
+    _state["server"] = None
+
+
+def get_worker_info(name):
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def get_current_worker_info():
+    return _state["workers"][_state["name"]]

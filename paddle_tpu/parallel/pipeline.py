@@ -75,6 +75,77 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
     return run
 
 
+def pipeline_apply_interleave(stage_fn: Callable, num_stages: int,
+                              num_virtual: int, num_microbatches: int,
+                              axis_name: str = "pp", remat: bool = True):
+    """Interleaved (virtual-stage) collective pipeline — the SPMD equivalent
+    of the reference's PipelineParallelWithInterleave (ref:
+    meta_parallel/pipeline_parallel.py).
+
+    Megatron round-robin layout: the layer list is cut into V*S chunks and
+    chunk c lives on device c % S; each device holds a [V, ...] stack of
+    chunk params. Activations rotate one device per tick over ICI; a wrap
+    from the last device back to device 0 advances the virtual slot.
+
+    Scheduling note: in this one-program formulation every tick applies all V
+    resident chunks (inactive slots are masked, costing FLOPs), so prefer the
+    plain `pipeline_apply` schedule when layers fit one chunk per stage — its
+    bubble (S-1)/(M+S-1) is already 1F1B-equivalent. Interleave matters here
+    for weight-placement parity and when per-chunk memory forces V > 1.
+
+    stage_fn(chunk_params, h) -> h. x_mb: [M, ...]; output [M, ...] valid on
+    the last device (slot V-1 exits there).
+    """
+    S, V, M = num_stages, num_virtual, num_microbatches
+    D = V * S
+    T = M + D - 1
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def run(params_local, x_mb):
+        # shard_map hands this device its [V, ...] chunk stack
+        params_chunks = params_local
+        stage = lax.axis_index(axis_name)
+        h0 = jnp.zeros((V,) + x_mb.shape[1:], x_mb.dtype)
+        out0 = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            h_buf, outputs = carry
+            outs = []
+            for v in range(V):
+                hop = v * S + stage              # global hop index this slot
+                mb = t - hop
+                active = (mb >= 0) & (mb < M)
+                fresh = x_mb[jnp.clip(t, 0, M - 1)]
+                x_in = jnp.where((stage == 0) & (v == 0), fresh, h_buf[v])
+                chunk_params = jax.tree_util.tree_map(
+                    lambda a, _v=v: a[_v], params_chunks)
+                out = body(chunk_params, x_in)
+                out = jnp.where(active, out, jnp.zeros_like(out))
+                # final hop D-1 exits on device S-1, slot V-1
+                write = active & (stage == S - 1) & (v == V - 1)
+                idx = jnp.clip(mb, 0, M - 1)
+                outputs = outputs.at[idx].set(
+                    jnp.where(write, out, outputs[idx]))
+                outs.append(out)
+            out_stack = jnp.stack(outs)          # [V, ...]
+            if S > 1:
+                perm = [(i, (i + 1) % S) for i in range(S)]
+                rotated = lax.ppermute(out_stack, axis_name, perm)
+            else:
+                rotated = out_stack
+            # wrap S-1 -> 0 advances the slot: device 0 receives hop v*S
+            # output into slot v+1; other devices keep the same slot
+            shifted = jnp.concatenate(
+                [jnp.zeros_like(rotated[:1]), rotated[:-1]], axis=0)
+            h_next = jnp.where(stage == 0, shifted, rotated)
+            return (h_next, outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (h0, out0), jnp.arange(T))
+        return outputs
+
+    return run
+
+
 def last_stage_value(value, num_stages: int, axis_name: str = "pp"):
     """Broadcast a value computed on the last stage to all stages (call inside
     shard_map): zero elsewhere + psum."""

@@ -69,8 +69,7 @@ class PTQ:
                         w, Tensor(jnp.asarray(float(wq.scales()), jnp.float32)),
                         wq.bit_length())
                     sub._origin.weight._data = frozen._data
-                    sub._sub_layers.pop("weight_quanter", None)
-                    object.__setattr__(sub, "weight_quanter", None)
+                    sub.weight_quanter = None  # Layer.__setattr__ pops it
             else:
                 self._convert(sub)
 

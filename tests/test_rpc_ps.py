@@ -1,0 +1,122 @@
+"""distributed.rpc + parameter-server mode: in-process and multi-process."""
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu  # noqa: F401
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _double(x):
+    return x * 2
+
+
+def _boom():
+    raise ValueError("intentional")
+
+
+def test_rpc_single_world():
+    from paddle_tpu.distributed import rpc
+    port = _free_port()
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    assert rpc.rpc_sync("worker0", _double, args=(21,)) == 42
+    fut = rpc.rpc_async("worker0", _double, args=(5,))
+    assert fut.result(timeout=10) == 10
+    info = rpc.get_current_worker_info()
+    assert info.name == "worker0" and info.rank == 0
+    rpc.shutdown()
+
+
+def test_rpc_error_propagates():
+    from paddle_tpu.distributed import rpc
+    port = _free_port()
+    rpc.init_rpc("workerE", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        rpc.rpc_sync("workerE", _boom)
+        raised = False
+    except RuntimeError as e:
+        raised = "intentional" in str(e)
+    finally:
+        rpc.shutdown()
+    assert raised
+
+
+def test_ps_tables_inprocess():
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PSClient, service
+    service._TABLES.clear()
+    port = _free_port()
+    rpc.init_rpc("ps_server:0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    client = PSClient("ps_server:0")
+    assert client.create_dense_table("w", [4, 3])
+    w0 = client.pull_dense("w")
+    assert w0.shape == (4, 3) and (w0 == 0).all()
+    g = np.ones((4, 3), np.float32)
+    client.push_dense("w", g, lr=0.1)
+    np.testing.assert_allclose(client.pull_dense("w"), -0.1 * g)
+
+    assert client.create_sparse_table("emb", 8)
+    rows = client.pull_sparse("emb", [3, 7, 3])
+    assert rows.shape == (3, 8)
+    np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+    client.push_sparse("emb", [3], np.ones((1, 8), np.float32), lr=0.5)
+    rows2 = client.pull_sparse("emb", [3])
+    np.testing.assert_allclose(rows2[0], rows[0] - 0.5)
+    st = client.stat()
+    assert st["w"][0] == "dense" and st["emb"] == ("sparse", 2)
+    rpc.shutdown()
+    service._TABLES.clear()
+
+
+_WORKER_SCRIPT = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, os.environ["REPO"])
+from paddle_tpu.distributed import rpc
+
+def fn(a, b):
+    return a + b
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+rpc.init_rpc(f"w{rank}", rank=rank, world_size=2,
+             master_endpoint=f"127.0.0.1:{port}")
+if rank == 0:
+    out = rpc.rpc_sync("w1", fn, args=(40, 2))
+    assert out == 42, out
+    print("RPC_OK")
+else:
+    import time
+    time.sleep(2.0)
+rpc.shutdown()
+"""
+
+
+def test_rpc_two_processes(tmp_path):
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(_WORKER_SCRIPT)
+    port = str(_free_port())
+    env = dict(os.environ, REPO="/root/repo",
+               PYTHONPATH="/root/repo:" + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), port],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in (0, 1)]
+    outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+    assert procs[0].returncode == 0, outs[0]
+    assert procs[1].returncode == 0, outs[1]
+    assert "RPC_OK" in outs[0]
