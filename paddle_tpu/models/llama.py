@@ -80,6 +80,10 @@ class ParallelConfig:
     sep: int = 1        # context parallel (ring attention)
     microbatches: int = 1
     remat: bool = True
+    # 'full' recomputes the whole block; 'dots' saves matmul outputs and
+    # recomputes only cheap elementwise ops (jax checkpoint_policies) —
+    # trades a little memory for most of the recompute FLOPs back.
+    remat_policy: str = "full"
     zero_stage: int = 3  # what 'sharding' shards: 1=os, 2=os+g, 3=os+g+p
     use_flash: Optional[bool] = None  # None = auto (TPU yes, CPU no)
 
@@ -220,6 +224,10 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
         from ..nn.functional.attention import _xla_sdpa
         attn = _xla_sdpa(q, k, v, is_causal=True)
     attn = attn.reshape(b, s, nh * hd)
+    # named so the 'save_attn' remat policy can keep it (skips recomputing
+    # the flash kernel in backward at the cost of one [B,S,H*D] residual)
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
+    attn = _ckpt_name(attn, "attn_out")
     attn_out = attn @ p["o_proj"]
     if tp_axis is not None:
         attn_out = lax.psum(attn_out, tp_axis)
@@ -251,9 +259,21 @@ def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
     body = functools.partial(decoder_layer, config=c, parallel=parallel,
                              mesh=mesh, use_flash=use_flash,
                              in_shard_map=in_shard_map)
-    scan_body = (jax.checkpoint(lambda h, p: (body(p, h, cos, sin), None))
-                 if parallel.remat else
-                 (lambda h, p: (body(p, h, cos, sin), None)))
+    raw_body = lambda h, p: (body(p, h, cos, sin), None)
+    if parallel.remat:
+        if parallel.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif parallel.remat_policy == "save_attn":
+            policy = jax.checkpoint_policies.save_only_these_names("attn_out")
+        elif parallel.remat_policy == "full":
+            policy = None
+        else:
+            raise ValueError(
+                f"unknown remat_policy {parallel.remat_policy!r}; "
+                "expected 'full', 'dots', or 'save_attn'")
+        scan_body = jax.checkpoint(raw_body, policy=policy)
+    else:
+        scan_body = raw_body
     layer_params = params["layers"]
     if layer_slice is not None:
         layer_params = jax.tree_util.tree_map(lambda a: a[layer_slice],

@@ -41,16 +41,11 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
 
 
 def _export_onnx(layer, path, input_spec, opset_version):
-    """Skeleton ModelProto emitter (runs only when the optional onnx package
-    is present, which this image does not ship). The StableHLO bundle written
-    above is the first-class interchange format for this framework; full
-    op-graph conversion belongs to an external converter exactly as the
-    reference delegates to paddle2onnx."""
-    import onnx
-    from onnx import helper
-
-    graph = helper.make_graph(nodes=[], name="paddle_tpu_model",
-                              inputs=[], outputs=[])
-    model = helper.make_model(graph, producer_name="paddle_tpu")
-    onnx.save(model, path + ".onnx")
-    return path + ".onnx"
+    """Full op-graph conversion belongs to an external converter, exactly as
+    the reference delegates to paddle2onnx — emitting a structurally-empty
+    ModelProto here would be a silent lie, so be explicit instead."""
+    raise NotImplementedError(
+        "ONNX op-graph conversion is delegated to external converters (the "
+        "reference requires paddle2onnx the same way). Use the StableHLO "
+        f"bundle saved at '{path}' (paddle.jit.load / "
+        "paddle.inference.create_predictor) for deployment.")

@@ -4,12 +4,13 @@ Ref: paddle/phi/kernels/gpu/flash_attn_kernel.cu (the reference dlopens its
 FlashAttention-2 fork). TPU-native rewrite, not a translation:
 
 - forward: Pallas kernel, online-softmax over KV tiles held in VMEM, fp32
-  accumulators, MXU matmuls via jnp.dot(preferred_element_type=f32). The
-  [S, S] score matrix never exists in HBM. Also emits the per-row logsumexp.
-- backward: blockwise lax.scan in jnp using the saved logsumexp (the standard
-  FA2 recomputation identities: dV = PᵀdO, dS = P∘(dP − rowsum(dO∘O)),
-  dQ/dK from dS) — O(S·Bk) working set, fused by XLA. A hand-written Pallas
-  backward is a further optimization, not a correctness need.
+  accumulators, MXU matmuls with bf16 operands (preferred_element_type=f32).
+  The [S, S] score matrix never exists in HBM. Emits per-row logsumexp.
+- backward: two Pallas kernels using the saved logsumexp (standard FA2
+  identities: dV = PᵀdO, dS = P∘(dP − rowsum(dO∘O)), dQ/dK from dS) —
+  dK/dV over k-tiles x inner q loop, dQ over q-tiles x inner k loop, all
+  tiles resident in VMEM. Ragged lengths via zero-pad + mask (see
+  _flash_fwd / _flash_bwd_pallas docstrings).
 
 Layout [B, S, H, D] (the reference's), GQA via KV-head repeat.
 interpret=True under CPU so the same code runs in tests.
@@ -24,28 +25,54 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# v5e-tuned: 1024x1024 tiles keep the MXU fed (2.7x over 128x128 measured);
+# min() clamps both to the actual sequence length for small inputs.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 
 
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
 
 
+def _fit_block(block, n):
+    """Largest useful block <= `block` for length n, 128-aligned (Mosaic
+    requires lane-tile-aligned vector loads; min(block, n) could yield e.g.
+    300 which fails to legalize)."""
+    return min(block, -(-n // 128) * 128)
+
+
+def _pad_rows(x, multiple):
+    """Zero-pad axis 1 up to a multiple; returns (padded, original_len)."""
+    n = x.shape[1]
+    rem = (-n) % multiple
+    if rem:
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (0, rem)
+        x = jnp.pad(x, pad)
+    return x, n
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
-                scale, seq_k):
+                scale, seq_k, kv_len):
+    """seq_k is the PADDED key length (multiple of block_k); kv_len the true
+    one — key positions >= kv_len are masked out so padding never attends."""
     import numpy as np
     bk_i = np.int32(block_k)  # i32 casts are belt-and-braces; the trace runs
     # under mosaic_trace_ctx (x64 disabled) — see _common.mosaic_trace_ctx
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    # keep q/k in their storage dtype (bf16) for the dot — the MXU runs
+    # bf16 x bf16 -> f32 at full rate, while f32 x f32 is ~8x slower; the
+    # fp32 scale is applied to the f32 accumulator after the matmul.
+    q = q_ref[0]                                      # [BQ, D]
     bq, d = q.shape
     bq_i = np.int32(bq)
     m = jnp.full((bq, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((bq, 1), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
 
-    nblocks = np.int32(pl.cdiv(seq_k, block_k))
+    mask_kv = kv_len != seq_k
+    nblocks = np.int32(seq_k // block_k)
     if causal:
         # only blocks whose start <= last query position of this tile
         last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
@@ -53,13 +80,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(j * bk_i, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * bk_i, block_k), :]
         v = v_ref[0, pl.ds(j * bk_i, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [BQ, BK]
-        if causal:
-            rows = qi * bq_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal or mask_kv:
             cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            s = jnp.where(rows >= cols, s, -1e30)
+            ok = cols < np.int32(kv_len) if mask_kv else None
+            if causal:
+                rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
+                                                        (bq, block_k), 0)
+                ok = (rows >= cols) if ok is None else (ok & (rows >= cols))
+            s = jnp.where(ok, s, -1e30)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
@@ -75,73 +106,201 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    """q, k, v: [BH, S, D] (same head count). Returns (o, lse)."""
+    """q, k, v: [BH, S, D] (same head count). Returns (o, lse).
+
+    Ragged sequence lengths are handled by zero-padding to block multiples
+    (manual `pl.ds` slices clamp out-of-bounds starts, which would silently
+    re-read earlier rows) and masking padded key positions."""
     bh, s, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, s)
-    block_k = min(block_k, sk)
-    grid = (bh, pl.cdiv(s, block_q))
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, sk)
+    qp, _ = _pad_rows(q, block_q)
+    kp, _ = _pad_rows(k, block_k)
+    vp, _ = _pad_rows(v, block_k)
+    sp, skp = qp.shape[1], kp.shape[1]
+    grid = (bh, sp // block_q)
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale, seq_k=sk)
+                               scale=scale, seq_k=skp, kv_len=sk)
     with _mosaic_ctx():
         o, lse = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct(q.shape, q.dtype),
-                jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+                jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, sp), jnp.float32),
             ],
             interpret=_interpret(),
-        )(q, k, v)
-    return o, lse.reshape(bh, s)
+        )(qp, kp, vp)
+    return o[:, :s], lse.reshape(bh, sp)[:, :s]
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, scale, block_k):
-    """Blockwise FA2 backward in jnp. All [BH, S, D]."""
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, causal, scale, seq_q, q_len):
+    """dK/dV: grid (bh, k_blocks); inner loop over q tiles >= the diagonal.
+
+    seq_q is the padded query length (block_q multiple); q rows >= q_len are
+    zero padding and get masked so exp(0 - lse_pad) can't contribute."""
+    import numpy as np
+    ki = pl.program_id(1)
+    k = k_ref[0]                                  # [BK, D] storage dtype
+    v = v_ref[0]
+    bk, d = k.shape
+    bq_i = np.int32(block_q)
+    bk_i = np.int32(bk)
+    acc_dk = jnp.zeros((bk, d), jnp.float32)
+    acc_dv = jnp.zeros((bk, d), jnp.float32)
+    mask_q = q_len != seq_q
+    nq = np.int32(seq_q // block_q)
+    start = (ki * bk_i) // bq_i if causal else np.int32(0)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * bq_i, block_q), :]        # [BQ, D]
+        dob = do_ref[0, pl.ds(i * bq_i, block_q), :]
+        lseb = lse_ref[0, 0, pl.ds(i * bq_i, block_q)]    # [BQ] f32
+        deltab = delta_ref[0, 0, pl.ds(i * bq_i, block_q)]
+        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
+        if causal or mask_q:
+            rows = i * bq_i + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            ok = rows < np.int32(q_len) if mask_q else None
+            if causal:
+                cols = ki * bk_i + lax.broadcasted_iota(jnp.int32,
+                                                        (block_q, bk), 1)
+                ok = (rows >= cols) if ok is None else (ok & (rows >= cols))
+            s = jnp.where(ok, s, -1e30)
+        p = jnp.exp(s - lseb[:, None])                    # [BQ, BK] f32
+        p_lo = p.astype(v.dtype)
+        dv = dv + jnp.dot(p_lo.T, dob, preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(v.dtype)
+        dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    acc_dk, acc_dv = lax.fori_loop(start, nq, body, (acc_dk, acc_dv))
+    dk_ref[0] = acc_dk.astype(dk_ref.dtype)
+    dv_ref[0] = acc_dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, block_k, causal, scale, seq_k, kv_len):
+    """dQ: grid (bh, q_blocks); inner loop over k tiles <= the diagonal.
+    seq_k is padded; key positions >= kv_len are masked out."""
+    import numpy as np
+    qi = pl.program_id(1)
+    qb = q_ref[0]                                 # [BQ, D]
+    dob = do_ref[0]
+    bq, d = qb.shape
+    bq_i = np.int32(bq)
+    bk_i = np.int32(block_k)
+    lseb = lse_ref[0, 0, :]                       # [BQ]
+    deltab = delta_ref[0, 0, :]
+    acc = jnp.zeros((bq, d), jnp.float32)
+    mask_kv = kv_len != seq_k
+    nblocks = np.int32(seq_k // block_k)
+    if causal:
+        last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
+        nblocks = jnp.minimum(nblocks, last_q // bk_i + np.int32(1))
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * bk_i, block_k), :]
+        vb = v_ref[0, pl.ds(j * bk_i, block_k), :]
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        if causal or mask_kv:
+            cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            ok = cols < np.int32(kv_len) if mask_kv else None
+            if causal:
+                rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
+                                                        (bq, block_k), 0)
+                ok = (rows >= cols) if ok is None else (ok & (rows >= cols))
+            s = jnp.where(ok, s, -1e30)
+        p = jnp.exp(s - lseb[:, None])
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
+        return acc + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    acc = lax.fori_loop(np.int32(0), nblocks, body, acc)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
+    """Pallas FA2 backward: tiles stay in VMEM (the jnp formulation streamed
+    [S, BK] intermediates through HBM — bandwidth-bound).
+
+    Ragged lengths: inputs are zero-padded to block multiples with padded
+    positions masked in the kernels (see _flash_fwd). Known limit: each
+    kernel stages the full opposing sequence (q/do resp. k/v) in VMEM per
+    grid step, so VMEM bounds the practical single-shard sequence length
+    (~16k at d=64 on v5e); longer contexts belong on the ring-attention
+    path which shards the sequence."""
     bh, s, d = q.shape
     sk = k.shape[1]
-    block_k = min(block_k, sk)
-    nblocks = sk // block_k
-    q32 = q.astype(jnp.float32)
-    do32 = do.astype(jnp.float32)
-    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)  # [BH, S]
+    block_q = _fit_block(block_q, s)
+    block_k = _fit_block(block_k, sk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
-    kb = k.reshape(bh, nblocks, block_k, d).swapaxes(0, 1)
-    vb = v.reshape(bh, nblocks, block_k, d).swapaxes(0, 1)
-    pos_q = jnp.arange(s)
+    qp, _ = _pad_rows(q, block_q)
+    dop, _ = _pad_rows(do, block_q)
+    kp, _ = _pad_rows(k, block_k)
+    vp, _ = _pad_rows(v, block_k)
+    sp, skp = qp.shape[1], kp.shape[1]
+    lse3, _ = _pad_rows(lse.reshape(bh, s, 1), block_q)
+    delta3, _ = _pad_rows(delta.reshape(bh, s, 1), block_q)
+    lse3 = lse3.reshape(bh, 1, sp)
+    delta3 = delta3.reshape(bh, 1, sp)
 
-    def block_grads(carry, inp):
-        dq_acc = carry
-        j, k_j, v_j = inp
-        s_j = jnp.einsum("bqd,bkd->bqk", q32, k_j.astype(jnp.float32)) * scale
-        if causal:
-            cols = j * block_k + jnp.arange(block_k)
-            mask = pos_q[:, None] >= cols[None, :]
-            s_j = jnp.where(mask[None], s_j, -1e30)
-        p_j = jnp.exp(s_j - lse[:, :, None])                    # [BH, S, BK]
-        dv_j = jnp.einsum("bqk,bqd->bkd", p_j, do32)
-        dp_j = jnp.einsum("bqd,bkd->bqk", do32, v_j.astype(jnp.float32))
-        ds_j = p_j * (dp_j - delta[:, :, None]) * scale
-        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds_j,
-                                     k_j.astype(jnp.float32))
-        dk_j = jnp.einsum("bqk,bqd->bkd", ds_j, q32)
-        return dq_acc, (dk_j, dv_j)
+    kv_grid = (bh, skp // block_k)
+    with _mosaic_ctx():
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
+                              scale=scale, seq_q=sp, q_len=s),
+            grid=kv_grid,
+            in_specs=[
+                pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # q
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # do
+                pl.BlockSpec((1, 1, sp), lambda b, j: (b, 0, 0)),     # lse
+                pl.BlockSpec((1, 1, sp), lambda b, j: (b, 0, 0)),     # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                jax.ShapeDtypeStruct(vp.shape, v.dtype),
+            ],
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, lse3, delta3)
 
-    dq0 = jnp.zeros((bh, s, d), jnp.float32)
-    dq, (dk_b, dv_b) = lax.scan(block_grads, dq0,
-                                (jnp.arange(nblocks), kb, vb))
-    dk = dk_b.swapaxes(0, 1).reshape(bh, sk, d)
-    dv = dv_b.swapaxes(0, 1).reshape(bh, sk, d)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        q_grid = (bh, sp // block_q)
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
+                              scale=scale, seq_k=skp, kv_len=sk),
+            grid=q_grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, lse3, delta3)
+    return dq[:, :s], dk[:, :sk], dv[:, :sk]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -157,7 +316,8 @@ def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_attention_bwd(causal, scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, causal, scale, block_k)
+    return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q,
+                             block_k)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

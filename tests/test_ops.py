@@ -129,3 +129,27 @@ def test_ulysses_matches_dense():
     out = fn(q, k, v)
     ref = _xla_sdpa(q, k, v, is_causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+@pytest.mark.parametrize("s,sk", [(300, 300), (1500, 1500), (384, 640)])
+def test_flash_ragged_lengths(s, sk):
+    """Sequence lengths that are not block multiples: zero-pad + mask path
+    (regression: clamped pl.ds slices silently double-counted rows)."""
+    rng = np.random.RandomState(5)
+    B, H, D = 1, 2, 64
+    q = jnp.asarray(rng.randn(B, s, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, sk, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, sk, H, D), jnp.float32)
+    causal = s == sk
+    o = flash_attention_bshd(q, k, v, causal=causal)
+    ref = _xla_sdpa(q, k, v, is_causal=causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref), atol=2e-5)
+
+    if causal:
+        gf = jax.grad(lambda *a: (flash_attention_bshd(*a, causal=True)
+                                  ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: (_xla_sdpa(*a, is_causal=True) ** 2).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3)
