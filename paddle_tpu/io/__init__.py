@@ -362,3 +362,17 @@ class DataLoader:
 
 def get_worker_info():
     return None
+
+
+class SubsetRandomSampler(Sampler):
+    """Random order over a fixed index subset (ref: io.SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        order = np.random.permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)

@@ -2,10 +2,9 @@
 
 The reference's ``paddle.onnx.export`` is a thin wrapper that REQUIRES the
 external ``paddle2onnx`` package and raises if it is missing. This build keeps
-the same optional-dependency contract: with the ``onnx`` package installed a
-ModelProto is emitted for the traced graph; without it, the portable
-StableHLO artifact (the TPU-native interchange format — same role, compiled
-by any XLA backend) is saved and an ImportError explains the ONNX gap.
+the same delegation contract: it always saves the portable StableHLO bundle
+(the TPU-native interchange format — same role, compiled by any XLA backend)
+and raises pointing ONNX conversion at an external converter.
 """
 from __future__ import annotations
 
@@ -15,10 +14,10 @@ import os
 def export(layer, path, input_spec=None, opset_version=9, **configs):
     """Export ``layer`` for interchange.
 
-    With the optional ``onnx`` package: writes ``{path}.onnx``.
-    Without it: writes the StableHLO bundle via ``paddle.jit.save`` at
-    ``{path}`` and raises ImportError naming the missing dependency, matching
-    the reference's behavior when paddle2onnx is absent.
+    Always writes the StableHLO bundle via ``paddle.jit.save`` at ``{path}``,
+    then raises (ImportError without the onnx package, NotImplementedError
+    with it) directing op-graph ONNX conversion to an external converter —
+    the reference behaves the same way about paddle2onnx.
     """
     try:
         import onnx  # noqa: F401

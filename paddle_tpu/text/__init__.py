@@ -1,0 +1,13 @@
+"""paddle.text parity (ref: python/paddle/text/).
+
+ViterbiDecoder/viterbi_decode run as XLA scans (the reference's CUDA
+viterbi_decode op). Datasets mirror the reference classes; with no network in
+this environment they load from a local ``data_file`` or raise a clear error
+pointing at it (the reference downloads from bj.bcebos.com).
+"""
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,
+                       WMT14, WMT16)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]

@@ -1,0 +1,210 @@
+"""paddle.text datasets (ref: python/paddle/text/datasets/*).
+
+Same class names and (mode, transform) signatures. No network egress exists
+here, so each dataset loads from an explicit local ``data_file``; without one
+it raises pointing at the expected archive instead of downloading.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+class _LocalOnlyDataset(Dataset):
+    _URL = ""
+
+    def _require(self, data_file):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__}: no network egress in this "
+                f"environment; pass data_file= pointing at a local copy of "
+                f"{self._URL or 'the reference archive'}")
+        return data_file
+
+
+class UCIHousing(_LocalOnlyDataset):
+    """Boston housing regression (ref: text/datasets/uci_housing.py).
+    13 features + price; 80/20 train/test split like the reference."""
+
+    _URL = "https://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file=None, mode="train", transform=None):
+        data_file = self._require(data_file)
+        raw = np.loadtxt(data_file).astype(np.float32)
+        raw = raw.reshape(-1, self.FEATURE_NUM)
+        maxs, mins = raw.max(0), raw.min(0)
+        avgs = raw.mean(0)
+        feat = (raw[:, :-1] - avgs[:-1]) / np.maximum(
+            maxs[:-1] - mins[:-1], 1e-8)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = feat[:split]
+            self.label = raw[:split, -1:]
+        else:
+            self.data = feat[split:]
+            self.label = raw[split:, -1:]
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        x = self.data[idx]
+        if self.transform:
+            x = self.transform(x)
+        return x, self.label[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(_LocalOnlyDataset):
+    """IMDB sentiment (ref: text/datasets/imdb.py): aclImdb tar with
+    train/test pos/neg text files; builds a word index on load."""
+
+    _URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        data_file = self._require(data_file)
+        import re
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = {}
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                if pat.match(member.name):
+                    text = tf.extractfile(member).read().decode(
+                        "utf-8", "ignore").lower()
+                    words = re.sub(r"[^a-z ]", " ", text).split()
+                    docs.append(words)
+                    labels.append(0 if "/pos/" in member.name else 1)
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+        kept = [w for w, c in sorted(freq.items(),
+                                     key=lambda kv: (-kv[1], kv[0]))
+                if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(kept)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(w, unk) for w in d],
+                              np.int64) for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(_LocalOnlyDataset):
+    """PTB n-gram LM dataset (ref: text/datasets/imikolov.py)."""
+
+    _URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        data_file = self._require(data_file)
+        name = f"./simple-examples/data/ptb.{'train' if mode == 'train' else 'valid'}.txt"
+        freq = {}
+        lines = []
+        with tarfile.open(data_file) as tf:
+            f = tf.extractfile(name)
+            for line in f.read().decode().splitlines():
+                words = line.strip().split()
+                lines.append(words)
+                for w in words:
+                    freq[w] = freq.get(w, 0) + 1
+        kept = sorted((w for w, c in freq.items()
+                       if c >= min_word_freq and w != "<unk>"))
+        self.word_idx = {w: i for i, w in enumerate(kept)}
+        self.word_idx["<unk>"] = len(kept)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for words in lines:
+            ids = [self.word_idx.get(w, unk)
+                   for w in ["<s>"] * (window_size - 1) + words + ["<e>"]
+                   if True]
+            if data_type.upper() == "NGRAM":
+                for i in range(window_size, len(ids) + 1):
+                    self.data.append(np.array(ids[i - window_size:i],
+                                              np.int64))
+            else:  # SEQ
+                self.data.append(np.array(ids, np.int64))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(_LocalOnlyDataset):
+    """MovieLens-1M ratings (ref: text/datasets/movielens.py)."""
+
+    _URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        data_file = self._require(data_file)
+        import zipfile
+        rng = np.random.RandomState(rand_seed)
+        rows = []
+        with zipfile.ZipFile(data_file) as zf:
+            with zf.open("ml-1m/ratings.dat") as f:
+                for line in f.read().decode("latin1").splitlines():
+                    uid, mid, rating, _ = line.strip().split("::")
+                    rows.append((int(uid), int(mid), float(rating)))
+        rows = np.array(rows, np.float32)
+        mask = rng.rand(len(rows)) < test_ratio
+        self.data = rows[mask] if mode == "test" else rows[~mask]
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.data[idx]
+        return np.int64(uid), np.int64(mid), np.float32(rating)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(_LocalOnlyDataset):
+    """CoNLL-2005 SRL (ref: text/datasets/conll05.py). Local archive only."""
+
+    _URL = "https://dataset.bj.bcebos.com/conll05st%2Fconll05st-tests.tar.gz"
+
+    def __init__(self, data_file=None, **kwargs):
+        self._require(data_file)
+        raise NotImplementedError(
+            "Conll05st parsing requires the full props/words archives; "
+            "supply and parse locally (reference: text/datasets/conll05.py)")
+
+
+class _WMT(_LocalOnlyDataset):
+    """Shared WMT loader: pickled (src_ids, trg_ids, trg_ids_next) tuples."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        data_file = self._require(data_file)
+        with tarfile.open(data_file) as tf:
+            names = [n for n in tf.getnames() if mode in n]
+            if not names:
+                raise RuntimeError(f"no '{mode}' member in {data_file}")
+            raw = tf.extractfile(names[0]).read()
+        self.samples = pickle.loads(raw) if raw[:1] == b"\x80" else []
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT14(_WMT):
+    _URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+
+
+class WMT16(_WMT):
+    _URL = "https://dataset.bj.bcebos.com/wmt16%2Fwmt16.tar.gz"

@@ -1,0 +1,81 @@
+"""Viterbi decoding (ref: python/paddle/text/viterbi_decode.py,
+paddle/phi/kernels/gpu/viterbi_decode_kernel.cu).
+
+TPU-native: the DP over time steps is a lax.scan (max-product forward pass),
+the argmax backtrace a reverse scan — no dynamic shapes, jit-friendly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import _run_op
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Best tag sequence per batch.
+
+    potentials: [B, T, N] unary emissions; transition_params: [N, N];
+    lengths: [B] valid lengths. Returns (scores [B], paths [B, T]).
+    With include_bos_eos_tag=True the last two tags are treated as BOS/EOS
+    like the reference (start transitions from BOS, end transitions to EOS).
+    """
+    def f(pot, trans, lens):
+        b, t_max, n = pot.shape
+        pot32 = pot.astype(jnp.float32)
+        trans32 = trans.astype(jnp.float32)
+
+        if include_bos_eos_tag:
+            bos, eos = n - 2, n - 1
+            init = pot32[:, 0] + trans32[bos][None, :]
+        else:
+            init = pot32[:, 0]
+
+        def step(carry, xs):
+            alpha, t = carry, xs
+            # alpha: [B, N]; scores[b, i, j] = alpha[b, i] + trans[i, j]
+            scores = alpha[:, :, None] + trans32[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+            alpha_new = jnp.max(scores, axis=1) + pot32[:, t]
+            # freeze past each sequence's end
+            valid = (t < lens)[:, None]
+            alpha_new = jnp.where(valid, alpha_new, alpha)
+            best_prev = jnp.where(valid, best_prev,
+                                  jnp.arange(n)[None, :])
+            return alpha_new, best_prev
+
+        ts = jnp.arange(1, t_max)
+        alpha, backptrs = jax.lax.scan(step, init, ts)        # [T-1, B, N]
+
+        if include_bos_eos_tag:
+            alpha = alpha + trans32[:, n - 1][None, :]
+
+        scores = jnp.max(alpha, axis=-1)
+        last_tag = jnp.argmax(alpha, axis=-1)                 # [B]
+
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        first_tag, tags_rev = jax.lax.scan(back, last_tag, backptrs,
+                                           reverse=True)
+        paths = jnp.concatenate([first_tag[None], tags_rev], axis=0)  # [T, B]
+        return scores, paths.T.astype(jnp.int64)
+    return _run_op("viterbi_decode", f,
+                   (potentials, transition_params, lengths), {})
+
+
+class ViterbiDecoder(Layer):
+    """ref: paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -138,3 +138,346 @@ def normalize(img, mean, std, data_format="CHW"):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+def _hwc_view(arr):
+    """Return (hwc_array, was_chw) — transforms below operate in HWC."""
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+    if chw:
+        return arr.transpose(1, 2, 0), True
+    return arr, False
+
+
+def _restore(arr, was_chw):
+    return arr.transpose(2, 0, 1) if was_chw else arr
+
+
+class Transpose(BaseTransform):
+    """HWC -> CHW (ref: paddle.vision.transforms.Transpose)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            axis = 1 if (arr.ndim == 3 and arr.shape[0] in (1, 3)) else 0
+            return np.flip(arr, axis=axis).copy()
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4  # left, top, right, bottom
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + [(0, 0)] * (hwc.ndim - 2)
+        mode = {"constant": "constant", "edge": "edge",
+                "reflect": "reflect", "symmetric": "symmetric"}[self.padding_mode]
+        if mode == "constant":
+            out = np.pad(hwc, pads, mode=mode, constant_values=self.fill)
+        else:
+            out = np.pad(hwc, pads, mode=mode)
+        return _restore(out, was_chw)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        if hwc.ndim == 2:
+            gray = hwc[..., None].astype(np.float32)
+        else:
+            w = np.array([0.299, 0.587, 0.114], np.float32)[: hwc.shape[-1]]
+            gray = (hwc.astype(np.float32) @ (w / w.sum()))[..., None]
+        out = np.repeat(gray, self.num_output_channels, axis=-1)
+        return _restore(out.astype(arr.dtype), was_chw)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _factor(self):
+        v = self.value
+        if isinstance(v, (tuple, list)):   # explicit (min, max) range
+            return np.random.uniform(v[0], v[1])
+        return np.random.uniform(max(0, 1 - v), 1 + v)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        out = arr * self._factor()
+        return np.clip(out, 0, 255 if arr.max() > 1.5 else 1.0).astype(
+            np.asarray(img).dtype)
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        mean = arr.mean()
+        out = (arr - mean) * self._factor() + mean
+        return np.clip(out, 0, 255 if arr.max() > 1.5 else 1.0).astype(
+            np.asarray(img).dtype)
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        f = hwc.astype(np.float32)
+        w = np.array([0.299, 0.587, 0.114], np.float32)[: f.shape[-1]]
+        gray = (f @ (w / w.sum()))[..., None]
+        out = gray + (f - gray) * self._factor()
+        out = np.clip(out, 0, 255 if f.max() > 1.5 else 1.0).astype(arr.dtype)
+        return _restore(out, was_chw)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        assert 0 <= value <= 0.5
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        if hwc.ndim == 2 or hwc.shape[-1] < 3:
+            return img  # hue rotation is identity on grayscale
+        scale = 255.0 if hwc.max() > 1.5 else 1.0
+        f = hwc.astype(np.float32) / scale
+        shift = np.random.uniform(-self.value, self.value)
+        # vectorized RGB->HSV->RGB hue rotation
+        r, g, b = f[..., 0], f[..., 1], f[..., 2]
+        maxc = np.maximum(np.maximum(r, g), b)
+        minc = np.minimum(np.minimum(r, g), b)
+        v = maxc
+        c = maxc - minc
+        s = np.where(maxc > 0, c / np.maximum(maxc, 1e-8), 0)
+        rc = np.where(c > 0, (maxc - r) / np.maximum(c, 1e-8), 0)
+        gc = np.where(c > 0, (maxc - g) / np.maximum(c, 1e-8), 0)
+        bc = np.where(c > 0, (maxc - b) / np.maximum(c, 1e-8), 0)
+        h = np.where(r == maxc, bc - gc,
+                     np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+        h = (h / 6.0) % 1.0
+        h = (h + shift) % 1.0
+        i = np.floor(h * 6.0)
+        fr = h * 6.0 - i
+        p = v * (1.0 - s)
+        q = v * (1.0 - s * fr)
+        t = v * (1.0 - s * (1.0 - fr))
+        i = i.astype(np.int32) % 6
+        r2 = np.choose(i, [v, q, p, p, t, v])
+        g2 = np.choose(i, [t, v, v, q, p, p])
+        b2 = np.choose(i, [p, p, t, v, v, q])
+        out = np.stack([r2, g2, b2], axis=-1) * scale
+        return _restore(out.astype(arr.dtype), was_chw)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for idx in order:
+            img = self.transforms[idx](img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        import scipy.ndimage as ndi
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        angle = np.random.uniform(*self.degrees)
+        out = ndi.rotate(hwc, angle, axes=(0, 1), reshape=False, order=1,
+                         mode="constant", cval=self.fill)
+        return _restore(out.astype(arr.dtype), was_chw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if th <= h and tw <= w:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                crop = hwc[i:i + th, j:j + tw]
+                break
+        else:
+            s = min(h, w)
+            i, j = (h - s) // 2, (w - s) // 2
+            crop = hwc[i:i + s, j:j + s]
+        import jax
+        import jax.numpy as jnp
+        tgt = (self.size[0], self.size[1]) + crop.shape[2:]
+        out = np.asarray(jax.image.resize(jnp.asarray(crop, jnp.float32),
+                                          tgt, "linear"))
+        return _restore(out.astype(arr.dtype), was_chw)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img).copy()
+        hwc, was_chw = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        for _ in range(10):
+            target = h * w * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                hwc[i:i + eh, j:j + ew] = self.value
+                break
+        return _restore(hwc, was_chw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_range = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        import scipy.ndimage as ndi
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        squeeze_gray = hwc.ndim == 2
+        if squeeze_gray:
+            hwc = hwc[:, :, None]
+        h, w = hwc.shape[:2]
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        sc = (np.random.uniform(*self.scale_range)
+              if self.scale_range else 1.0)
+        if isinstance(self.shear, numbers.Number):
+            shear = np.deg2rad(np.random.uniform(-self.shear, self.shear))
+        elif self.shear is not None:
+            shear = np.deg2rad(np.random.uniform(self.shear[0], self.shear[1]))
+        else:
+            shear = 0.0
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        ca, sa = np.cos(angle), np.sin(angle)
+        mat = np.array([[ca, -np.sin(angle + shear)],
+                        [sa, np.cos(angle + shear)]]) * sc
+        center = np.array([h / 2, w / 2])
+        offset = center - mat @ center + np.array([ty, tx])
+        chans = [ndi.affine_transform(hwc[..., c], mat, offset=offset,
+                                      order=1, mode="constant",
+                                      cval=self.fill)
+                 for c in range(hwc.shape[-1])]
+        out = np.stack(chans, axis=-1)
+        if squeeze_gray:
+            out = out[:, :, 0]
+        return _restore(out.astype(arr.dtype), was_chw)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        d = self.distortion_scale
+        # jittered corners -> fit projective map with least squares
+        src = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                       np.float32)
+        jit = np.random.uniform(0, d, (4, 2)).astype(np.float32)
+        dst = src + jit * np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]],
+                                   np.float32) * np.array([w / 2, h / 2])
+        A = []
+        for (x, y), (u, vv) in zip(dst, src):
+            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+            A.append([0, 0, 0, x, y, 1, -vv * x, -vv * y])
+        A = np.asarray(A, np.float32)
+        bvec = src.reshape(-1)
+        coef, *_ = np.linalg.lstsq(A, bvec, rcond=None)
+        Hm = np.append(coef, 1.0).reshape(3, 3)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        ones = np.ones_like(xx)
+        pts = np.stack([xx, yy, ones], axis=-1).reshape(-1, 3).T
+        mapped = Hm @ pts   # dst->src fit IS the inverse warp
+        mx = (mapped[0] / mapped[2]).reshape(h, w)
+        my = (mapped[1] / mapped[2]).reshape(h, w)
+        xi = np.clip(np.round(mx).astype(int), 0, w - 1)
+        yi = np.clip(np.round(my).astype(int), 0, h - 1)
+        inside = (mx >= 0) & (mx <= w - 1) & (my >= 0) & (my <= h - 1)
+        out = hwc[yi, xi]
+        out[~inside] = self.fill
+        return _restore(out.astype(arr.dtype), was_chw)

@@ -1,0 +1,67 @@
+"""paddle.text: viterbi decode vs brute force; dataset offline contract."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import UCIHousing, ViterbiDecoder, viterbi_decode
+
+
+def _brute_force(pot, trans, length, bos_eos):
+    n = pot.shape[-1]
+    import itertools
+    tags = range(n)
+    best, best_path = -np.inf, None
+    for path in itertools.product(tags, repeat=length):
+        s = pot[0, path[0]]
+        if bos_eos:
+            s += trans[n - 2, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if bos_eos:
+            s += trans[path[-1], n - 1]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("bos_eos", [False, True])
+def test_viterbi_matches_brute_force(bos_eos):
+    rng = np.random.RandomState(0)
+    T, N = 4, 4
+    pot = rng.randn(1, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([T], np.int64)
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+    ref_score, ref_path = _brute_force(pot[0], trans, T, bos_eos)
+    np.testing.assert_allclose(float(scores.numpy()[0]), ref_score, atol=1e-4)
+    assert paths.numpy()[0].tolist() == ref_path
+
+
+def test_viterbi_layer_and_batch():
+    rng = np.random.RandomState(1)
+    B, T, N = 3, 5, 6
+    pot = paddle.to_tensor(rng.randn(B, T, N).astype(np.float32))
+    trans = paddle.to_tensor(rng.randn(N, N).astype(np.float32))
+    lens = paddle.to_tensor(np.array([5, 3, 4], np.int64))
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+    scores, paths = dec(pot, lens)
+    assert list(scores.shape) == [B] and list(paths.shape) == [B, T]
+
+
+def test_uci_housing_local_file(tmp_path):
+    rng = np.random.RandomState(2)
+    rows = rng.rand(50, 14).astype(np.float32)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    train = UCIHousing(data_file=str(f), mode="train")
+    test = UCIHousing(data_file=str(f), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_dataset_offline_error():
+    with pytest.raises(RuntimeError, match="data_file"):
+        UCIHousing(data_file=None)
