@@ -20,6 +20,7 @@ from .framework import (  # noqa: F401
     get_rng_state, set_rng_state, set_default_dtype, get_default_dtype,
     is_compiled_with_cuda, is_compiled_with_tpu,
 )
+from .framework.dtype import iinfo, finfo  # noqa: F401
 from .framework.dtype import (  # noqa: F401
     bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
     float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2, DType,
@@ -35,6 +36,9 @@ from . import autograd  # noqa: F401
 # Subsystems land incrementally during the build; import what exists.
 import importlib as _importlib
 
+from . import version  # noqa: F401
+from . import utils  # noqa: F401
+
 for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
              "incubate", "ops", "profiler", "device", "hapi", "static",
              "inference", "runtime", "fft", "signal", "distribution", "sparse",
@@ -46,6 +50,7 @@ for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
 
 if "hapi" in globals():
     from .hapi.model import Model  # noqa: F401
+    from .hapi.summary import flops, summary  # noqa: F401
 if "nn" in globals():
     from .nn.layer.layers import ParamAttr  # noqa: F401
 

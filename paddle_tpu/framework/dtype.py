@@ -106,3 +106,43 @@ def is_integer(dtype) -> bool:
 def is_complex(dtype) -> bool:
     nd = convert_dtype(dtype)
     return jnp.issubdtype(nd, np.complexfloating)
+
+
+class _IInfo:
+    def __init__(self, np_info):
+        self.min = int(np_info.min)
+        self.max = int(np_info.max)
+        self.bits = int(np_info.bits)
+        self.dtype = str(np_info.dtype)
+
+    def __repr__(self):
+        return (f"paddle.iinfo(min={self.min}, max={self.max}, "
+                f"bits={self.bits}, dtype={self.dtype})")
+
+
+class _FInfo:
+    def __init__(self, np_info):
+        self.min = float(np_info.min)
+        self.max = float(np_info.max)
+        self.eps = float(np_info.eps)
+        self.tiny = float(np_info.tiny)
+        self.smallest_normal = float(np_info.tiny)
+        self.resolution = float(np_info.resolution)
+        self.bits = int(np_info.bits)
+        self.dtype = str(np_info.dtype)
+
+    def __repr__(self):
+        return (f"paddle.finfo(min={self.min}, max={self.max}, "
+                f"eps={self.eps}, bits={self.bits}, dtype={self.dtype})")
+
+
+def iinfo(dtype):
+    """ref: paddle.iinfo."""
+    import numpy as _np
+    return _IInfo(_np.iinfo(convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    """ref: paddle.finfo. Works for bfloat16 via ml_dtypes."""
+    import jax.numpy as _jnp
+    return _FInfo(_jnp.finfo(convert_dtype(dtype)))

@@ -1,0 +1,95 @@
+"""paddle.summary / paddle.flops (ref: python/paddle/hapi/model_summary.py,
+python/paddle/hapi/dynamic_flops.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-by-layer output shapes + param counts; returns the totals dict
+    and prints a table like the reference."""
+    import paddle_tpu as paddle
+    from ..nn.layer.layers import Layer
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) else output
+            shape = list(getattr(out, "shape", [])) or ["-"]
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr._parameters.values()
+                           if p is not None)
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}", shape,
+                         n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = (input_size if isinstance(input_size, (list, tuple))
+                 and isinstance(input_size[0], (list, tuple))
+                 else [input_size])
+        dts = dtypes or ["float32"] * len(sizes)
+        input = [paddle.zeros(list(s), dtype=d) for s, d in zip(sizes, dts)]
+        out = net(*input)
+    else:
+        out = net(input)
+    for h in hooks:
+        h.remove()
+
+    total_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    line = "-" * 64
+    print(line)
+    print(f"{'Layer (type)':<28}{'Output Shape':<22}{'Param #':>12}")
+    print(line)
+    for name, shape, n in rows:
+        print(f"{name:<28}{str(shape):<22}{n:>12,}")
+    print(line)
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total_params - trainable:,}")
+    print(line)
+    return {"total_params": total_params, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough MAC count for Conv2D/Linear stacks (ref: paddle.flops)."""
+    import paddle_tpu as paddle
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    total = [0]
+    hooks = []
+
+    def conv_hook(lyr, inputs, output):
+        out = output[0] if isinstance(output, (tuple, list)) else output
+        oh, ow = out.shape[-2], out.shape[-1]
+        # weight [out_c, in_c/groups, kh, kw] already reflects grouping
+        macs = int(np.prod(lyr.weight.shape)) * oh * ow
+        total[0] += macs
+
+    def linear_hook(lyr, inputs, output):
+        total[0] += int(np.prod(lyr.weight.shape))
+
+    for _, sub in net.named_sublayers():
+        if isinstance(sub, Conv2D):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+
+    x = paddle.zeros(list(input_size))
+    net(x)
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        print(f"Total FLOPs (MACs): {total[0]:,}")
+    return total[0]

@@ -1,3 +1,4 @@
 """Incubate: experimental API surface (ref: python/paddle/incubate/)."""
-from . import nn
+from . import asp
 from . import distributed
+from . import nn

@@ -1,0 +1,91 @@
+"""Automatic SParsity: 2:4 structured pruning
+(ref: python/paddle/incubate/asp/ — prune_model, decorate, calculate_density).
+
+TPU note: 2:4 sparsity has no MXU fast path (that's an Ampere tensor-core
+feature), so here the masks buy model compression / regularization; matmuls
+run dense. Mask semantics and the API match the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_MASKS = {}          # id(param) -> (param, np mask)
+_EXCLUDED = set()    # layer full names excluded from pruning
+
+
+def calculate_density(x):
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_1d(weight, n=2, m=4):
+    """Keep the n largest-|w| of every m consecutive weights along axis 0
+    (the input dim of a Linear [in, out] weight)."""
+    w = np.asarray(weight)
+    flat = w.reshape(-1, w.shape[-1]) if w.ndim > 1 else w.reshape(-1, 1)
+    rows, cols = flat.shape
+    pad = (-rows) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad, cols), flat.dtype)])
+    groups = np.abs(flat).reshape(-1, m, cols)
+    order = np.argsort(groups, axis=1)           # ascending
+    mask = np.ones_like(groups)
+    drop = order[:, : m - n, :]
+    np.put_along_axis(mask, drop, 0.0, axis=1)
+    mask = mask.reshape(-1, cols)[:rows]
+    return mask.reshape(w.shape).astype(np.float32)
+
+
+def check_sparsity(weight, n=2, m=4):
+    """True if every m-group along axis 0 has at most n nonzeros."""
+    w = np.asarray(weight)
+    flat = w.reshape(-1, w.shape[-1]) if w.ndim > 1 else w.reshape(-1, 1)
+    rows, cols = flat.shape
+    pad = (-rows) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad, cols), flat.dtype)])
+    groups = flat.reshape(-1, m, cols)
+    return bool(((groups != 0).sum(axis=1) <= n).all())
+
+
+def set_excluded_layers(model, layer_names):
+    for name in layer_names:
+        _EXCLUDED.add(name)
+
+
+def reset_excluded_layers(model=None):
+    _EXCLUDED.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every supported (Linear) weight in-place and
+    remember them so `decorate`d optimizers re-apply after each step."""
+    import jax.numpy as jnp
+
+    from ...nn.layer.common import Linear
+    pruned = {}
+    for name, layer in model.named_sublayers():
+        if not isinstance(layer, Linear) or name in _EXCLUDED:
+            continue
+        w = layer.weight
+        mask = _mask_1d(w.numpy(), n=n, m=m)
+        w._data = w._data * jnp.asarray(mask, w._data.dtype)
+        if with_mask:
+            _MASKS[id(w)] = (w, mask)
+        pruned[name] = float(mask.mean())
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step so masks survive the update (ref: asp.decorate)."""
+    import jax.numpy as jnp
+    orig_step = optimizer.step
+
+    def masked_step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for w, mask in _MASKS.values():
+            w._data = w._data * jnp.asarray(mask, w._data.dtype)
+        return out
+
+    optimizer.step = masked_step
+    return optimizer

@@ -1,0 +1,64 @@
+"""paddle.utils parity (ref: python/paddle/utils/): the pieces that are
+meaningful off-CUDA — deprecation decorator, layer tools, download guard,
+dlpack bridge, unique_name."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import unique_name  # noqa: F401
+from .layers_utils import flatten, map_structure, pack_sequence_as  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """ref: paddle.utils.deprecated decorator."""
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """ref: paddle.utils.try_import."""
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Required optional module '{module_name}' is not "
+            "installed (no network egress here; bake it into the image)")
+
+
+def run_check():
+    """ref: paddle.utils.run_check — sanity-check the install."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).numpy()
+    assert (y == 2).all()
+    n = len(__import__("jax").devices())
+    print(f"paddle_tpu is installed successfully! {n} device(s) visible.")
+
+
+class download:
+    """Namespace stub: dataset/model downloads need egress; local files only
+    (ref: paddle.utils.download.get_weights_path_from_url)."""
+
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            f"no network egress in this environment; download {url} "
+            "externally and load it via a local path")

@@ -1,0 +1,18 @@
+"""Structure utilities (ref: python/paddle/utils/layers_utils.py)."""
+from __future__ import annotations
+
+import jax
+
+
+def flatten(nest):
+    leaves, _ = jax.tree_util.tree_flatten(nest)
+    return leaves
+
+
+def pack_sequence_as(structure, flat_sequence):
+    _, treedef = jax.tree_util.tree_flatten(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat_sequence)
+
+
+def map_structure(func, *structures):
+    return jax.tree_util.tree_map(func, *structures)

@@ -1,0 +1,34 @@
+"""ref: python/paddle/utils/unique_name.py — name generators for layers."""
+from __future__ import annotations
+
+import contextlib
+
+_counters = {}
+
+
+def generate(key):
+    n = _counters.get(key, 0)
+    _counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+def generate_with_ignorable_key(key):
+    return generate(key)
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = {}
+    try:
+        yield
+    finally:
+        _counters = old
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = {}
+    return old

@@ -1,0 +1,26 @@
+"""Version info (ref: python/paddle/version.py, generated at build time)."""
+full_version = "3.0.0+tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # parity field; this build targets TPU via XLA
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("backend: TPU (jax/XLA/Pallas)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,68 @@
+"""version/utils/iinfo/finfo/summary/flops/asp parity checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_version():
+    assert paddle.version.full_version.startswith("3.")
+    paddle.version.show()
+
+
+def test_iinfo_finfo():
+    ii = paddle.iinfo(paddle.int32)
+    assert ii.max == 2**31 - 1 and ii.bits == 32
+    fi = paddle.finfo(paddle.float32)
+    assert fi.bits == 32 and 1e38 < fi.max < 4e38
+    bf = paddle.finfo(paddle.bfloat16)
+    assert bf.bits == 16
+
+
+def test_utils():
+    from paddle_tpu.utils import deprecated, map_structure, try_import, unique_name
+    n1, n2 = unique_name.generate("fc"), unique_name.generate("fc")
+    assert n1 != n2
+    assert map_structure(lambda a: a + 1, {"x": 1, "y": (2, 3)}) == {"x": 2, "y": (3, 4)}
+
+    @deprecated(update_to="paddle.new_api", since="2.0")
+    def old():
+        return 42
+    with pytest.warns(DeprecationWarning):
+        assert old() == 42
+    with pytest.raises(ImportError):
+        try_import("definitely_not_a_module_xyz")
+    paddle.utils.run_check()
+
+
+def test_summary_and_flops(capsys):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = paddle.summary(net, (2, 8))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    fl = paddle.flops(net, (2, 8))
+    assert fl == 8 * 16 + 16 * 4
+
+
+def test_asp_prune_and_decorate():
+    from paddle_tpu.incubate import asp
+    net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    pruned = asp.prune_model(net, n=2, m=4)
+    assert pruned and all(abs(d - 0.5) < 1e-6 for d in pruned.values())
+    w = net._sub_layers["0"].weight
+    assert asp.check_sparsity(w.numpy())
+    assert abs(asp.calculate_density(w) - 0.5) < 0.05
+
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.rand(4, 16).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 4, (4,)))
+    loss = paddle.nn.functional.cross_entropy(net(x), y)
+    loss.backward()
+    opt.step()
+    # mask survives the optimizer step
+    assert asp.check_sparsity(net._sub_layers["0"].weight.numpy())
+    asp._MASKS.clear()
+    asp.reset_excluded_layers()
