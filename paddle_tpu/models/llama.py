@@ -309,6 +309,113 @@ def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
 
 
 # ---------------------------------------------------------------------------
+# KV-cache decode (ref: fused_multi_transformer_op.cu — the reference's
+# inference kernel is a full decoder stack with an in-place KV cache)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
+    """Stacked per-layer cache: k/v of [L, B, max_len, KV, HD]."""
+    c = config
+    shape = (c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
+             c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def llama_decode_step(params, cache, ids, config: LlamaConfig):
+    """One incremental decode step: ids [B, 1] -> (logits [B, vocab], cache).
+
+    jit-stable: cache position is a traced scalar, cache updates are
+    dynamic_update_slice, attention masks positions >= pos+1. The layer loop
+    is a lax.scan over the stacked layer params + cache slices.
+    """
+    c = config
+    b = ids.shape[0]
+    max_len = cache["k"].shape[2]
+    pos = cache["pos"]
+    h = jnp.take(params["embed"], ids[:, 0], axis=0).astype(c.dtype)  # [B, H]
+
+    cos_all, sin_all = build_rope_cache(max_len, c.head_dim,
+                                        base=c.rope_theta)
+    cos = lax.dynamic_slice_in_dim(cos_all, pos, 1, 0)
+    sin = lax.dynamic_slice_in_dim(sin_all, pos, 1, 0)
+
+    def layer_step(h, xs):
+        p, k_cache, v_cache = xs
+        hd = c.head_dim
+        nh = p["q_proj"].shape[-1] // hd
+        nkv = p["k_proj"].shape[-1] // hd
+        x = fused_rms_norm(h[:, None], p["input_norm"], c.rms_norm_eps)
+        q = (x @ p["q_proj"]).reshape(b, 1, nh, hd)
+        k = (x @ p["k_proj"]).reshape(b, 1, nkv, hd)
+        v = (x @ p["v_proj"]).reshape(b, 1, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        zero = jnp.zeros((), jnp.int32)
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (zero, pos, zero, zero))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (zero, pos, zero, zero))
+        # grouped-query scores against the unrepeated cache: no [B,T,NH,HD]
+        # head-repeat temporaries in the decode hot loop
+        rep = nh // nkv
+        qg = q[:, 0].reshape(b, nkv, rep, hd)
+        scores = jnp.einsum("bgrd,btgd->bgrt", qg, k_cache,
+                            preferred_element_type=jnp.float32)
+        scores = scores / (hd ** 0.5)
+        valid = jnp.arange(max_len)[None, None, None, :] <= pos
+        scores = jnp.where(valid, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+        attn = jnp.einsum("bgrt,btgd->bgrd", probs, v_cache,
+                          preferred_element_type=jnp.float32).astype(c.dtype)
+        attn_out = attn.reshape(b, nh * hd) @ p["o_proj"]
+        h = h + attn_out
+
+        x2 = fused_rms_norm(h[:, None], p["post_norm"], c.rms_norm_eps)[:, 0]
+        gated = jax.nn.silu(x2 @ p["gate_proj"]) * (x2 @ p["up_proj"])
+        h = h + gated @ p["down_proj"]
+        return h, (k_cache, v_cache)
+
+    h, (new_k, new_v) = lax.scan(layer_step, h,
+                                 (params["layers"], cache["k"], cache["v"]))
+    logits = llama_logits(params, h[:, None], config)[:, 0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v,
+                                        "pos": pos + 1}
+
+
+def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
+                    max_len=None):
+    """Greedy decoding: prefill token-by-token through the cached step (one
+    compiled step reused for every position), then generate."""
+    prompt = np.asarray(prompt_ids)
+    b, plen = prompt.shape
+    if plen == 0:
+        raise ValueError("greedy_generate: prompt must be non-empty")
+    if max_new_tokens <= 0:
+        return np.zeros((b, 0), np.int64)
+    max_len = max_len or (plen + max_new_tokens)
+    if max_len < plen + max_new_tokens:
+        raise ValueError(
+            f"greedy_generate: max_len={max_len} < prompt {plen} + "
+            f"max_new_tokens {max_new_tokens}; the cache would overflow")
+    cache = init_kv_cache(config, b, max_len)
+    # donate the cache so XLA updates k/v in place (old cache is never reused)
+    step = jax.jit(functools.partial(llama_decode_step, config=config),
+                   donate_argnums=(1,))
+
+    logits = None
+    for t in range(plen):
+        logits, cache = step(params, cache, prompt[:, t:t + 1])
+    out = [np.asarray(jnp.argmax(logits, axis=-1))]
+    for _ in range(max_new_tokens - 1):
+        nxt = jnp.asarray(out[-1][:, None])
+        logits, cache = step(params, cache, nxt)
+        out.append(np.asarray(jnp.argmax(logits, axis=-1)))
+    return np.stack(out, axis=1)
+
+
+# ---------------------------------------------------------------------------
 # compiled SPMD train step
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,62 @@
+"""KV-cache decode must match the full (uncached) forward exactly."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.models.llama import (ParallelConfig, greedy_generate,
+                                     init_kv_cache, init_llama_params,
+                                     llama_decode_step, llama_hidden,
+                                     llama_logits, llama_tiny)
+
+
+def test_decode_matches_full_forward():
+    config = llama_tiny(vocab=64, hidden=32, layers=3, heads=4, kv_heads=2,
+                        inter=64, seq=16)
+    params = init_llama_params(config, seed=0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (2, 8)).astype(np.int32)
+
+    # full forward logits at every position
+    h = llama_hidden(params, jnp.asarray(ids), config,
+                     ParallelConfig(), use_flash=False)
+    full_logits = np.asarray(llama_logits(params, h, config), np.float32)
+
+    # cached decode, one token at a time
+    cache = init_kv_cache(config, 2, 8)
+    step_logits = []
+    for t in range(8):
+        logits, cache = llama_decode_step(params, cache,
+                                          jnp.asarray(ids[:, t:t + 1]),
+                                          config)
+        step_logits.append(np.asarray(logits))
+    step_logits = np.stack(step_logits, axis=1)
+
+    np.testing.assert_allclose(step_logits, full_logits, atol=2e-4,
+                               rtol=1e-3)
+    assert int(cache["pos"]) == 8
+
+
+def test_greedy_generate_deterministic():
+    config = llama_tiny(vocab=32, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=32)
+    params = init_llama_params(config, seed=1)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    out1 = greedy_generate(params, prompt, config, max_new_tokens=5)
+    out2 = greedy_generate(params, prompt, config, max_new_tokens=5)
+    assert out1.shape == (1, 5)
+    np.testing.assert_array_equal(out1, out2)
+    assert (out1 >= 0).all() and (out1 < 32).all()
+
+
+def test_generate_edge_cases():
+    import pytest
+    config = llama_tiny(vocab=16, hidden=16, layers=1, heads=2, kv_heads=2,
+                        inter=32, seq=8)
+    params = init_llama_params(config, seed=2)
+    prompt = np.array([[1, 2]], np.int32)
+    assert greedy_generate(params, prompt, config, max_new_tokens=0).shape == (1, 0)
+    with pytest.raises(ValueError, match="overflow"):
+        greedy_generate(params, prompt, config, max_new_tokens=5, max_len=4)
+    with pytest.raises(ValueError, match="non-empty"):
+        greedy_generate(params, np.zeros((1, 0), np.int32), config,
+                        max_new_tokens=2)
