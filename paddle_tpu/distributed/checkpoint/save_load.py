@@ -11,15 +11,21 @@ from ...tensor.tensor import Tensor
 
 
 def _to_arrays(state_dict):
-    return {k: (v._data if isinstance(v, Tensor) else v)
+    # host-gathered views: orbax then restores without needing concrete
+    # shardings, and load_state_dict re-shards onto each target tensor's
+    # layout (single-controller: the host sees every shard anyway)
+    return {k: (np.asarray(v._data) if isinstance(v, Tensor) else np.asarray(v))
             for k, v in state_dict.items()}
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False):
-    """Each shard is written by its owner; layout metadata rides along so
-    load_state_dict can reshard onto a different mesh."""
+    """Single-controller save: arrays are host-gathered and written once;
+    load_state_dict reshards onto the target tensors' (possibly different)
+    mesh layout. Multi-host owner-writes-its-shard saving would pass the
+    jax.Arrays straight to orbax with per-leaf shardings instead — not
+    needed in this single-controller deployment."""
     import orbax.checkpoint as ocp
     arrays = _to_arrays(state_dict)
     path = os.path.abspath(path)

@@ -322,6 +322,48 @@ def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
             "pos": jnp.zeros((), jnp.int32)}
 
 
+def llama_prefill(params, cache, ids, config: LlamaConfig):
+    """Batched prompt prefill: one pass over [B, S] fills the KV cache and
+    returns last-position logits — S single-token decode dispatches collapse
+    into one compiled call with MXU-sized matmuls."""
+    c = config
+    b, s = ids.shape
+    max_len = cache["k"].shape[2]
+    h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)  # [B, S, H]
+    cos_all, sin_all = build_rope_cache(max_len, c.head_dim, base=c.rope_theta)
+    cos, sin = cos_all[:s], sin_all[:s]
+
+    def layer_step(h, xs):
+        p, k_cache, v_cache = xs
+        hd = c.head_dim
+        nh = p["q_proj"].shape[-1] // hd
+        nkv = p["k_proj"].shape[-1] // hd
+        x = fused_rms_norm(h, p["input_norm"], c.rms_norm_eps)
+        q = (x @ p["q_proj"]).reshape(b, s, nh, hd)
+        k = (x @ p["k_proj"]).reshape(b, s, nkv, hd)
+        v = (x @ p["v_proj"]).reshape(b, s, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        k_cache = lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+        v_cache = lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+        from ..nn.functional.attention import _xla_sdpa
+        attn = _xla_sdpa(q, k, v, is_causal=True)
+        attn_out = attn.reshape(b, s, nh * hd) @ p["o_proj"]
+        h = h + attn_out
+        x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
+        gated = jax.nn.silu(x2 @ p["gate_proj"]) * (x2 @ p["up_proj"])
+        h = h + gated @ p["down_proj"]
+        return h, (k_cache, v_cache)
+
+    h, (new_k, new_v) = lax.scan(layer_step, h,
+                                 (params["layers"], cache["k"], cache["v"]))
+    logits = llama_logits(params, h[:, -1:], config)[:, 0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v,
+                                        "pos": cache["pos"] + s}
+
+
 def llama_decode_step(params, cache, ids, config: LlamaConfig):
     """One incremental decode step: ids [B, 1] -> (logits [B, vocab], cache).
 
@@ -403,10 +445,10 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     # donate the cache so XLA updates k/v in place (old cache is never reused)
     step = jax.jit(functools.partial(llama_decode_step, config=config),
                    donate_argnums=(1,))
+    prefill = jax.jit(functools.partial(llama_prefill, config=config),
+                      donate_argnums=(1,))
 
-    logits = None
-    for t in range(plen):
-        logits, cache = step(params, cache, prompt[:, t:t + 1])
+    logits, cache = prefill(params, cache, jnp.asarray(prompt))
     out = [np.asarray(jnp.argmax(logits, axis=-1))]
     for _ in range(max_new_tokens - 1):
         nxt = jnp.asarray(out[-1][:, None])

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import nn  # noqa: F401
 from .program import (Executor, Program, active_program,  # noqa: F401
                       default_main_program, default_startup_program,
                       disable_static, enable_static, in_static_mode,

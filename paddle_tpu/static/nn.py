@@ -1,0 +1,86 @@
+"""paddle.static.nn: static-graph layer builders (ref: python/paddle/static/nn/).
+
+Reference semantics: every unnamed builder call creates FRESH parameters with
+a unique auto-generated name (the reference's unique_name machinery); passing
+``name=`` shares one parameter set across calls with that name. Named layers
+live in a registry cleared by ``paddle.static.disable_static()`` /
+``reset_parameters()`` so unrelated programs start clean.
+"""
+from __future__ import annotations
+
+from .. import nn as _nn
+from ..utils import unique_name as _unique_name
+
+_NAMED = {}
+
+
+def reset_parameters():
+    """Drop all named shared layers (called on disable_static)."""
+    _NAMED.clear()
+
+
+def _layer(name, builder):
+    if name is None:
+        # fresh parameters per call — the reference's default behavior
+        layer = builder()
+        layer._full_name = _unique_name.generate(type(layer).__name__.lower())
+        return layer
+    if name not in _NAMED:
+        _NAMED[name] = builder()
+    return _NAMED[name]
+
+
+def _apply_act(out, act, supported=("relu", "tanh", "sigmoid")):
+    if act is None:
+        return out
+    if act not in supported:
+        raise NotImplementedError(
+            f"activation {act!r} not supported here; apply "
+            f"paddle.nn.functional.{act} to the output instead")
+    return getattr(_nn.functional, act)(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_dim = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_dim *= int(s)
+    layer = _layer(name, lambda: _nn.Linear(
+        in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    from ..tensor.manipulation import flatten as _flatten
+    h = (_flatten(x, num_flatten_dims)
+         if len(x.shape) > num_flatten_dims + 1 else x)
+    return _apply_act(layer(h), activation)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    in_ch = int(input.shape[1])
+    layer = _layer(name, lambda: _nn.Conv2D(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    return _apply_act(layer(input), act)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    ch = int(input.shape[1])
+    layer = _layer(name, lambda: _nn.BatchNorm2D(
+        ch, momentum=momentum, epsilon=epsilon))
+    # per-call mode, never sticky: is_test only affects this application
+    layer.eval() if is_test else layer.train()
+    return _apply_act(layer(input), act)
+
+
+def embedding(input, size, is_sparse=False, param_attr=None, dtype="float32",
+              name=None):
+    layer = _layer(name, lambda: _nn.Embedding(size[0], size[1],
+                                               weight_attr=param_attr))
+    return layer(input)
+
+
+def sequence_conv(*a, **k):
+    raise NotImplementedError(
+        "sequence (LoD) ops are not carried over: variable-length batches "
+        "use dense padding + paddle.nn.functional.sequence_mask on TPU")

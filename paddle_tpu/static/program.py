@@ -188,6 +188,8 @@ def disable_static():
     _active = None
     from ..tensor import tensor as _tensor_mod
     _tensor_mod._static_capture_hook = None
+    from . import nn as _static_nn
+    _static_nn.reset_parameters()
 
 
 def in_static_mode() -> bool:

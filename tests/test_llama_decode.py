@@ -60,3 +60,36 @@ def test_generate_edge_cases():
     with pytest.raises(ValueError, match="non-empty"):
         greedy_generate(params, np.zeros((1, 0), np.int32), config,
                         max_new_tokens=2)
+
+
+def test_prefill_matches_stepwise():
+    from paddle_tpu.models.llama import llama_prefill
+    import jax
+    config = llama_tiny(vocab=64, hidden=32, layers=3, heads=4, kv_heads=2,
+                        inter=64, seq=16)
+    params = init_llama_params(config, seed=0)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 64, (2, 6)).astype(np.int32)
+
+    cache_a = init_kv_cache(config, 2, 12)
+    logits_a, cache_a = llama_prefill(params, cache_a,
+                                      jnp.asarray(ids), config)
+
+    cache_b = init_kv_cache(config, 2, 12)
+    logits_b = None
+    for t in range(6):
+        logits_b, cache_b = llama_decode_step(params, cache_b,
+                                              jnp.asarray(ids[:, t:t + 1]),
+                                              config)
+    np.testing.assert_allclose(np.asarray(logits_a), np.asarray(logits_b),
+                               atol=2e-4, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(cache_a["k"][:, :, :6]),
+                               np.asarray(cache_b["k"][:, :, :6]), atol=1e-5)
+    assert int(cache_a["pos"]) == 6
+
+    # continuing decode from a prefilled cache matches stepwise continuation
+    nxt = jnp.asarray(rng.randint(0, 64, (2, 1)).astype(np.int32))
+    la, _ = llama_decode_step(params, cache_a, nxt, config)
+    lb, _ = llama_decode_step(params, cache_b, nxt, config)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4,
+                               rtol=1e-3)

@@ -357,3 +357,47 @@ class TestLinalgOps(OpTest):
         u, s, vh = paddle.linalg.svd(paddle.to_tensor(m))
         rec = (u.numpy() * s.numpy()) @ vh.numpy()
         np.testing.assert_allclose(rec, m, atol=1e-4)
+
+
+class TestBf16Ops(OpTest):
+    """Low-precision parametrization (the reference runs its OpTest fleet in
+    fp16/bf16 with widened tolerances — SURVEY.md §4)."""
+
+    BF16_CASES = [
+        ("add", paddle.add, np.add, 2),
+        ("multiply", paddle.multiply, np.multiply, 2),
+        ("exp", paddle.exp, np.exp, 1),
+        ("tanh", paddle.tanh, np.tanh, 1),
+        ("sigmoid", paddle.sigmoid, lambda a: 1 / (1 + np.exp(-a)), 1),
+        ("sqrt", paddle.sqrt, np.sqrt, 1),
+    ]
+
+    @pytest.mark.parametrize("case", BF16_CASES, ids=[c[0] for c in BF16_CASES])
+    def test_bf16(self, case):
+        name, fn, ref, arity = case
+        import jax.numpy as jnp
+        xs = [_pos((3, 4)).astype(np.float32) for _ in range(arity)]
+        ts = [paddle.to_tensor(x).astype("bfloat16") for x in xs]
+        got = np.asarray(fn(*ts).astype("float32").numpy())
+        want = ref(*xs)
+        # bf16 has ~3 decimal digits
+        np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+    def test_bf16_matmul_f32_accum(self):
+        """bf16 matmul must accumulate better than naive bf16 summation."""
+        x = np.ones((1, 4096), np.float32) * 0.1
+        y = np.ones((4096, 1), np.float32) * 0.1
+        got = float(paddle.matmul(
+            paddle.to_tensor(x).astype("bfloat16"),
+            paddle.to_tensor(y).astype("bfloat16")).astype("float32").numpy())
+        # true value 40.96; bf16-accumulated would be off by >1
+        assert abs(got - 40.96) < 0.5
+
+    def test_grad_dtype_matches_param(self):
+        x = paddle.to_tensor(np.random.rand(3, 3).astype(np.float32),
+                             stop_gradient=False)
+        xb = x.astype("bfloat16")
+        loss = (xb * xb).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert str(x.grad.dtype).endswith("float32")
