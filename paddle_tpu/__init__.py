@@ -42,7 +42,7 @@ from . import utils  # noqa: F401
 for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
              "incubate", "ops", "profiler", "device", "hapi", "static",
              "inference", "runtime", "fft", "signal", "distribution", "sparse",
-             "quantization", "audio", "text", "onnx", "linalg"):
+             "quantization", "audio", "text", "onnx", "linalg", "geometric"):
     try:
         globals()[_sub] = _importlib.import_module(f".{_sub}", __name__)
     except ImportError:

@@ -11,11 +11,14 @@ from ...tensor.tensor import Tensor
 
 
 def _to_arrays(state_dict):
-    # host-gathered views: orbax then restores without needing concrete
+    # host-gathered leaves: orbax then restores without needing concrete
     # shardings, and load_state_dict re-shards onto each target tensor's
-    # layout (single-controller: the host sees every shard anyway)
-    return {k: (np.asarray(v._data) if isinstance(v, Tensor) else np.asarray(v))
-            for k, v in state_dict.items()}
+    # layout (single-controller: the host sees every shard anyway). Nested
+    # pytrees (optimizer states etc.) pass through with Tensor/array leaves
+    # converted in place.
+    return jax.tree_util.tree_map(
+        lambda v: np.asarray(v._data if isinstance(v, Tensor) else v),
+        state_dict, is_leaf=lambda v: isinstance(v, Tensor))
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,

@@ -1,0 +1,126 @@
+"""paddle.geometric parity (ref: python/paddle/geometric/): graph message
+passing + segment reductions, all as XLA segment ops (gather/segment_sum is
+the TPU-native form of the reference's CUDA scatter kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, _run_op
+
+
+def _num_segments(count, data):
+    if count is not None:
+        return int(count)
+    ids = data._data if isinstance(data, Tensor) else data
+    try:
+        import numpy as _np
+        return int(_np.asarray(ids).max()) + 1 if ids.size else 0
+    except jax.errors.TracerArrayConversionError:
+        raise ValueError(
+            "segment op under tracing needs a static segment count: call "
+            "send_u_recv/send_ue_recv with out_size=..., or run the segment "
+            "reduction eagerly outside jit") from None
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(None, segment_ids)
+    return _run_op("segment_sum",
+                   lambda d, s: jax.ops.segment_sum(d, s.astype(jnp.int32),
+                                                    num_segments=n),
+                   (data, segment_ids), {})
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(None, segment_ids)
+    def f(d, s):
+        s32 = s.astype(jnp.int32)
+        tot = jax.ops.segment_sum(d, s32, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(d[..., :1]), s32,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1)
+    return _run_op("segment_mean", f, (data, segment_ids), {})
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(None, segment_ids)
+    return _run_op("segment_min",
+                   lambda d, s: jax.ops.segment_min(d, s.astype(jnp.int32),
+                                                    num_segments=n),
+                   (data, segment_ids), {})
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(None, segment_ids)
+    return _run_op("segment_max",
+                   lambda d, s: jax.ops.segment_max(d, s.astype(jnp.int32),
+                                                    num_segments=n),
+                   (data, segment_ids), {})
+
+
+_POOLS = {"sum": segment_sum, "mean": segment_mean,
+          "min": segment_min, "max": segment_max}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges, reduce at destinations
+    (ref: geometric.send_u_recv)."""
+    n = out_size or x.shape[0]
+    def f(feat, src, dst):
+        msgs = feat[src.astype(jnp.int32)]
+        red = {"sum": jax.ops.segment_sum, "mean": None,
+               "min": jax.ops.segment_min, "max": jax.ops.segment_max}
+        d32 = dst.astype(jnp.int32)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, d32, num_segments=int(n))
+            cnt = jax.ops.segment_sum(jnp.ones_like(msgs[..., :1]), d32,
+                                      num_segments=int(n))
+            return tot / jnp.maximum(cnt, 1)
+        return red[reduce_op](msgs, d32, num_segments=int(n))
+    return _run_op("send_u_recv", f, (x, src_index, dst_index), {})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features with edge features, then reduce
+    (ref: geometric.send_ue_recv)."""
+    n = out_size or x.shape[0]
+    def f(feat, edge, src, dst):
+        msgs = feat[src.astype(jnp.int32)]
+        if message_op == "add":
+            msgs = msgs + edge
+        elif message_op == "sub":
+            msgs = msgs - edge
+        elif message_op == "mul":
+            msgs = msgs * edge
+        elif message_op == "div":
+            msgs = msgs / edge
+        d32 = dst.astype(jnp.int32)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, d32, num_segments=int(n))
+            cnt = jax.ops.segment_sum(jnp.ones_like(msgs[..., :1]), d32,
+                                      num_segments=int(n))
+            return tot / jnp.maximum(cnt, 1)
+        red = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}
+        return red[reduce_op](msgs, d32, num_segments=int(n))
+    return _run_op("send_ue_recv", f, (x, y, src_index, dst_index), {})
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages from src and dst node features
+    (ref: geometric.send_uv)."""
+    def f(xa, ya, src, dst):
+        u = xa[src.astype(jnp.int32)]
+        v = ya[dst.astype(jnp.int32)]
+        return {"add": u + v, "sub": u - v, "mul": u * v,
+                "div": u / v}[message_op]
+    return _run_op("send_uv", f, (x, y, src_index, dst_index), {})
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    raise NotImplementedError(
+        "reindex_graph: host-side graph preprocessing; use numpy upstream of "
+        "the device pipeline")

@@ -428,8 +428,9 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
 
 def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
                     max_len=None):
-    """Greedy decoding: prefill token-by-token through the cached step (one
-    compiled step reused for every position), then generate."""
+    """Greedy decoding: one batched prefill pass fills the KV cache (one
+    compile per distinct prompt length), then the per-token cached decode
+    step (compiled once) generates."""
     prompt = np.asarray(prompt_ids)
     b, plen = prompt.shape
     if plen == 0:

@@ -19,15 +19,22 @@ def reset_parameters():
     _NAMED.clear()
 
 
-def _layer(name, builder):
+def _layer(name, builder, config_key=None):
     if name is None:
         # fresh parameters per call — the reference's default behavior
         layer = builder()
         layer._full_name = _unique_name.generate(type(layer).__name__.lower())
         return layer
     if name not in _NAMED:
-        _NAMED[name] = builder()
-    return _NAMED[name]
+        _NAMED[name] = (builder(), config_key)
+        return _NAMED[name][0]
+    layer, existing_key = _NAMED[name]
+    if config_key != existing_key:
+        raise ValueError(
+            f"static.nn: name={name!r} already built with config "
+            f"{existing_key}, cannot reuse it with {config_key} (the "
+            "reference shape-checks shared parameters the same way)")
+    return layer
 
 
 def _apply_act(out, act, supported=("relu", "tanh", "sigmoid")):
@@ -46,7 +53,8 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     for s in x.shape[num_flatten_dims:]:
         in_dim *= int(s)
     layer = _layer(name, lambda: _nn.Linear(
-        in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr))
+        in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr),
+        config_key=("fc", in_dim, size))
     from ..tensor.manipulation import flatten as _flatten
     h = (_flatten(x, num_flatten_dims)
          if len(x.shape) > num_flatten_dims + 1 else x)
@@ -59,24 +67,32 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     layer = _layer(name, lambda: _nn.Conv2D(
         in_ch, num_filters, filter_size, stride=stride, padding=padding,
         dilation=dilation, groups=groups, weight_attr=param_attr,
-        bias_attr=bias_attr))
+        bias_attr=bias_attr),
+        config_key=("conv2d", in_ch, num_filters, filter_size, stride,
+                    padding, dilation, groups))
     return _apply_act(layer(input), act)
 
 
 def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
                bias_attr=None, data_layout="NCHW", is_test=False, name=None):
-    ch = int(input.shape[1])
+    ch = int(input.shape[-1] if data_layout == "NHWC" else input.shape[1])
     layer = _layer(name, lambda: _nn.BatchNorm2D(
-        ch, momentum=momentum, epsilon=epsilon))
+        ch, momentum=momentum, epsilon=epsilon),
+        config_key=("bn", ch, momentum, epsilon))
     # per-call mode, never sticky: is_test only affects this application
     layer.eval() if is_test else layer.train()
+    if data_layout == "NHWC":
+        from ..tensor.manipulation import transpose
+        out = layer(transpose(input, [0, 3, 1, 2]))
+        return _apply_act(transpose(out, [0, 2, 3, 1]), act)
     return _apply_act(layer(input), act)
 
 
 def embedding(input, size, is_sparse=False, param_attr=None, dtype="float32",
               name=None):
     layer = _layer(name, lambda: _nn.Embedding(size[0], size[1],
-                                               weight_attr=param_attr))
+                                               weight_attr=param_attr),
+        config_key=("embedding", tuple(size)))
     return layer(input)
 
 

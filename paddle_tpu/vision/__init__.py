@@ -1,2 +1,4 @@
 """Vision: models/datasets/transforms (ref: python/paddle/vision/)."""
 from . import datasets, models, transforms
+
+from . import ops  # noqa: F401

@@ -1,0 +1,197 @@
+"""paddle.vision.ops (ref: python/paddle/vision/ops.py): detection ops.
+
+TPU-native: roi_align/roi_pool are gather-interpolates in pure jnp
+(jit-able, static shapes); NMS runs greedy suppression on the host and
+returns a variable-length index tensor like the reference's dynamic-shape
+op (truncated, unpadded, when top_k is given).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, _run_op
+
+
+def box_area(boxes):
+    return _run_op("box_area",
+                   lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]),
+                   (boxes,), {})
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU of [N,4] x [M,4] xyxy boxes (ref: vision.ops.box_iou)."""
+    def f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-10)
+    return _run_op("box_iou", f, (boxes1, boxes2), {})
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Returns kept indices sorted by score (ref: ops.nms).
+
+    Eager host-side result sizing (like the reference's dynamic-shape op);
+    category-aware when category_idxs is given.
+    """
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
+    n = b.shape[0]
+    s = (np.asarray(scores.numpy() if isinstance(scores, Tensor) else scores)
+         if scores is not None else np.ones(n, np.float32))
+    cats = (np.asarray(category_idxs.numpy()
+                       if isinstance(category_idxs, Tensor) else category_idxs)
+            if category_idxs is not None else np.zeros(n, np.int64))
+
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        lt = np.maximum(b[i, :2], b[:, :2])
+        rb = np.minimum(b[i, 2:], b[:, 2:])
+        wh = np.clip(rb - lt, 0, None)
+        inter = wh[:, 0] * wh[:, 1]
+        iou = inter / np.maximum(area[i] + area - inter, 1e-10)
+        suppressed |= (iou > iou_threshold) & (cats == cats[i])
+        suppressed[i] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None, _reduce="mean"):
+    """RoIAlign (ref: ops.roi_align). x: [N,C,H,W]; boxes: [R,4] xyxy in
+    input coords; boxes_num: [N] rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        # image index per roi from boxes_num
+        img_idx = jnp.repeat(jnp.arange(n), rois_num, total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        ns = sampling_ratio if sampling_ratio > 0 else 2
+
+        # sample grid: [R, ph, pw, ns, ns] coordinates
+        iy = (jnp.arange(ph)[None, :, None] * bin_h[:, None, None]
+              + y1[:, None, None])            # [R, ph, 1] top of bin
+        ix = (jnp.arange(pw)[None, :, None] * bin_w[:, None, None]
+              + x1[:, None, None])
+        sy = (jnp.arange(ns) + 0.5) / ns
+        yy = iy[:, :, :] + sy[None, None, :] * bin_h[:, None, None]  # [R,ph,ns]
+        xx = ix[:, :, :] + sy[None, None, :] * bin_w[:, None, None]
+
+        def bilinear(imgs, py, px):
+            # imgs [R, C, H, W]; py/px [R, S] -> [R, C, S]
+            y0 = jnp.clip(jnp.floor(py), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(px), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy1 = jnp.clip(py - y0, 0, 1)
+            wx1 = jnp.clip(px - x0, 0, 1)
+            wy0, wx0 = 1 - wy1, 1 - wx1
+
+            def g(yi, xi):
+                yi = yi.astype(jnp.int32)
+                xi = xi.astype(jnp.int32)
+                return imgs[jnp.arange(imgs.shape[0])[:, None, None],
+                            jnp.arange(c)[None, :, None],
+                            yi[:, None, :], xi[:, None, :]]
+            return (g(y0, x0) * (wy0 * wx0)[:, None]
+                    + g(y0, x1_) * (wy0 * wx1)[:, None]
+                    + g(y1_, x0) * (wy1 * wx0)[:, None]
+                    + g(y1_, x1_) * (wy1 * wx1)[:, None])
+
+        roi_feats = feat[img_idx]                            # [R, C, H, W]
+        # flatten sampling positions: [R, ph*ns * pw*ns]
+        py = jnp.broadcast_to(yy[:, :, None, :, None],
+                              (r, ph, pw, ns, ns)).reshape(r, -1)
+        px = jnp.broadcast_to(xx[:, None, :, None, :],
+                              (r, ph, pw, ns, ns)).reshape(r, -1)
+        vals = bilinear(roi_feats, py, px)                   # [R, C, S]
+        vals = vals.reshape(r, c, ph, pw, ns * ns)
+        return vals.max(-1) if _reduce == "max" else vals.mean(-1)
+    return _run_op("roi_align", f, (x, boxes, boxes_num), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI (ref: ops.roi_pool): within-bin MAX over a dense sample
+    grid (the reference maxes over integer bin cells; a 4-sample max per bin
+    approximates it on the interpolated surface)."""
+    return roi_align(x, boxes, boxes_num, output_size,
+                     spatial_scale=spatial_scale, sampling_ratio=4,
+                     aligned=False, _reduce="max")
+
+
+def generate_proposals(*a, **k):
+    raise NotImplementedError(
+        "generate_proposals: RPN-specific; compose box_iou/nms/roi_align")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "deformable conv has no MXU-friendly lowering; use grid_sample + "
+            "conv2d composition (paddle.nn.functional.grid_sample)")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLO head predictions to boxes+scores (ref: ops.yolo_box)."""
+    def f(pred, imgs):
+        b, _, h, w = pred.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(np.array(anchors, np.float32).reshape(na, 2))
+        p = pred.reshape(b, na, 5 + class_num, h, w)
+        gx = (jnp.arange(w)[None, None, None, :] +
+              jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2) / w
+        gy = (jnp.arange(h)[None, None, :, None] +
+              jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2) / h
+        gw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / (
+            w * downsample_ratio)
+        gh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / (
+            h * downsample_ratio)
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        probs = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        imgs_f = imgs.astype(jnp.float32)
+        iw = imgs_f[:, 1][:, None, None, None]
+        ih = imgs_f[:, 0][:, None, None, None]
+        x1 = (gx - gw / 2) * iw
+        y1 = (gy - gh / 2) * ih
+        x2 = (gx + gw / 2) * iw
+        y2 = (gy + gh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(b, -1, 4)
+        mask = (conf > conf_thresh).reshape(b, -1, 1)
+        scores = (probs.transpose(0, 1, 3, 4, 2).reshape(b, -1, class_num)
+                  * mask)
+        return boxes * mask, scores
+    return _run_op("yolo_box", f, (x, img_size), {})

@@ -1,0 +1,97 @@
+"""vision.ops (nms/iou/roi_align/yolo_box) + geometric segment ops."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.geometric import (segment_max, segment_mean, segment_sum,
+                                  send_u_recv, send_uv)
+from paddle_tpu.vision.ops import box_iou, nms, roi_align
+
+R = np.random.RandomState(9)
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+class TestBoxOps:
+    def test_box_iou(self):
+        a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+        iou = box_iou(t(a), t(b)).numpy()
+        assert abs(iou[0, 0] - 1.0) < 1e-6
+        assert iou[0, 1] == 0.0
+        assert abs(iou[1, 0] - (1 / 7)) < 1e-6  # 1 overlap / (4+4-1)
+
+    def test_nms_suppresses(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = nms(t(boxes), iou_threshold=0.5, scores=t(scores)).numpy()
+        assert keep.tolist() == [0, 2]
+
+    def test_nms_category_aware(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        keep = nms(t(boxes), iou_threshold=0.5, scores=t(scores),
+                   category_idxs=t(cats), categories=[0, 1]).numpy()
+        assert sorted(keep.tolist()) == [0, 1]  # different class: both kept
+
+    def test_roi_align_uniform(self):
+        # constant feature map -> every pooled value equals the constant
+        x = np.full((1, 2, 8, 8), 3.0, np.float32)
+        boxes = np.array([[0, 0, 8, 8], [2, 2, 6, 6]], np.float32)
+        out = roi_align(t(x), t(boxes), t(np.array([2])), output_size=2,
+                        spatial_scale=1.0)
+        assert list(out.shape) == [2, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), 3.0, atol=1e-5)
+
+    def test_roi_align_gradient_region(self):
+        # linear ramp along x: pooled values must increase along x
+        ramp = np.tile(np.arange(8, dtype=np.float32), (8, 1))
+        x = ramp[None, None]
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        out = roi_align(t(x), t(boxes), t(np.array([1])),
+                        output_size=4).numpy()[0, 0]
+        assert (np.diff(out.mean(0)) > 0).all()
+
+    def test_yolo_box_shapes(self):
+        from paddle_tpu.vision.ops import yolo_box
+        b, na, cls, h = 2, 3, 5, 4
+        x = R.randn(b, na * (5 + cls), h, h).astype(np.float32)
+        img = np.array([[64, 64], [32, 32]], np.int32)
+        boxes, scores = yolo_box(t(x), t(img), anchors=[10, 13, 16, 30, 33, 23],
+                                 class_num=cls, conf_thresh=0.01,
+                                 downsample_ratio=8)
+        assert list(boxes.shape) == [b, na * h * h, 4]
+        assert list(scores.shape) == [b, na * h * h, cls]
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+        seg = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            segment_sum(t(data), t(seg)).numpy(), [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            segment_mean(t(data), t(seg)).numpy(), [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            segment_max(t(data), t(seg)).numpy(), [[3, 4], [7, 8]])
+
+    def test_send_u_recv(self):
+        x = np.array([[1.], [2.], [4.]], np.float32)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 0, 2])
+        out = send_u_recv(t(x), t(src), t(dst), reduce_op="sum").numpy()
+        # node1 <- x0; node2 <- x1 + x0; node0 <- x2
+        np.testing.assert_allclose(out, [[4.], [1.], [3.]])
+        out_max = send_u_recv(t(x), t(src), t(dst), reduce_op="max").numpy()
+        np.testing.assert_allclose(out_max, [[4.], [1.], [2.]])
+
+    def test_send_uv(self):
+        x = np.array([[1.], [2.]], np.float32)
+        y = np.array([[10.], [20.]], np.float32)
+        src = np.array([0, 1])
+        dst = np.array([1, 0])
+        out = send_uv(t(x), t(y), t(src), t(dst), message_op="add").numpy()
+        np.testing.assert_allclose(out, [[21.], [12.]])
