@@ -44,18 +44,27 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(path)
-    for k, v in state_dict.items():
-        if k not in restored:
-            continue
-        arr = restored[k]
-        if isinstance(v, Tensor):
-            data = jax.numpy.asarray(np.asarray(arr), dtype=v._data.dtype)
+
+    def fill(target, saved):
+        """Recursively fill Tensor leaves in place; returns the new value for
+        non-Tensor leaves so nested optimizer-state dicts restore too."""
+        if isinstance(target, Tensor):
+            data = jax.numpy.asarray(np.asarray(saved), dtype=target._data.dtype)
             try:
-                shardings = v._data.sharding
-                data = jax.device_put(data, shardings)
+                data = jax.device_put(data, target._data.sharding)
             except Exception:
                 pass
-            v._data = data
-        else:
-            state_dict[k] = arr
+            target._data = data
+            return target
+        if isinstance(target, dict) and isinstance(saved, dict):
+            for k in target:
+                if k in saved:
+                    target[k] = fill(target[k], saved[k])
+            return target
+        if isinstance(target, (list, tuple)) and isinstance(saved, (list, tuple)):
+            out = [fill(t, s) for t, s in zip(target, saved)]
+            return type(target)(out)
+        return saved
+
+    fill(state_dict, restored)
     return state_dict

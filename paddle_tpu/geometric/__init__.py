@@ -23,61 +23,43 @@ def _num_segments(count, data):
             "reduction eagerly outside jit") from None
 
 
-def segment_sum(data, segment_ids, name=None):
-    n = _num_segments(None, segment_ids)
-    return _run_op("segment_sum",
-                   lambda d, s: jax.ops.segment_sum(d, s.astype(jnp.int32),
-                                                    num_segments=n),
-                   (data, segment_ids), {})
-
-
-def segment_mean(data, segment_ids, name=None):
-    n = _num_segments(None, segment_ids)
-    def f(d, s):
-        s32 = s.astype(jnp.int32)
-        tot = jax.ops.segment_sum(d, s32, num_segments=n)
-        cnt = jax.ops.segment_sum(jnp.ones_like(d[..., :1]), s32,
+def _segment_reduce(msgs, seg_ids, n, op):
+    """One shared reduction for every segment/message-passing op."""
+    s32 = seg_ids.astype(jnp.int32)
+    if op == "mean":
+        tot = jax.ops.segment_sum(msgs, s32, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(msgs[..., :1]), s32,
                                   num_segments=n)
         return tot / jnp.maximum(cnt, 1)
-    return _run_op("segment_mean", f, (data, segment_ids), {})
+    red = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+           "max": jax.ops.segment_max}[op]
+    return red(msgs, s32, num_segments=n)
 
 
-def segment_min(data, segment_ids, name=None):
-    n = _num_segments(None, segment_ids)
-    return _run_op("segment_min",
-                   lambda d, s: jax.ops.segment_min(d, s.astype(jnp.int32),
-                                                    num_segments=n),
-                   (data, segment_ids), {})
+def _make_segment_op(op):
+    def fn(data, segment_ids, name=None):
+        n = _num_segments(None, segment_ids)
+        return _run_op(f"segment_{op}",
+                       lambda d, s: _segment_reduce(d, s, n, op),
+                       (data, segment_ids), {})
+    fn.__name__ = f"segment_{op}"
+    return fn
 
 
-def segment_max(data, segment_ids, name=None):
-    n = _num_segments(None, segment_ids)
-    return _run_op("segment_max",
-                   lambda d, s: jax.ops.segment_max(d, s.astype(jnp.int32),
-                                                    num_segments=n),
-                   (data, segment_ids), {})
-
-
-_POOLS = {"sum": segment_sum, "mean": segment_mean,
-          "min": segment_min, "max": segment_max}
+segment_sum = _make_segment_op("sum")
+segment_mean = _make_segment_op("mean")
+segment_min = _make_segment_op("min")
+segment_max = _make_segment_op("max")
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 name=None):
     """Gather source-node features along edges, reduce at destinations
     (ref: geometric.send_u_recv)."""
-    n = out_size or x.shape[0]
+    n = int(out_size or x.shape[0])
     def f(feat, src, dst):
         msgs = feat[src.astype(jnp.int32)]
-        red = {"sum": jax.ops.segment_sum, "mean": None,
-               "min": jax.ops.segment_min, "max": jax.ops.segment_max}
-        d32 = dst.astype(jnp.int32)
-        if reduce_op == "mean":
-            tot = jax.ops.segment_sum(msgs, d32, num_segments=int(n))
-            cnt = jax.ops.segment_sum(jnp.ones_like(msgs[..., :1]), d32,
-                                      num_segments=int(n))
-            return tot / jnp.maximum(cnt, 1)
-        return red[reduce_op](msgs, d32, num_segments=int(n))
+        return _segment_reduce(msgs, dst, n, reduce_op)
     return _run_op("send_u_recv", f, (x, src_index, dst_index), {})
 
 
@@ -85,26 +67,12 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                  reduce_op="sum", out_size=None, name=None):
     """Combine node features with edge features, then reduce
     (ref: geometric.send_ue_recv)."""
-    n = out_size or x.shape[0]
+    n = int(out_size or x.shape[0])
     def f(feat, edge, src, dst):
         msgs = feat[src.astype(jnp.int32)]
-        if message_op == "add":
-            msgs = msgs + edge
-        elif message_op == "sub":
-            msgs = msgs - edge
-        elif message_op == "mul":
-            msgs = msgs * edge
-        elif message_op == "div":
-            msgs = msgs / edge
-        d32 = dst.astype(jnp.int32)
-        if reduce_op == "mean":
-            tot = jax.ops.segment_sum(msgs, d32, num_segments=int(n))
-            cnt = jax.ops.segment_sum(jnp.ones_like(msgs[..., :1]), d32,
-                                      num_segments=int(n))
-            return tot / jnp.maximum(cnt, 1)
-        red = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
-               "max": jax.ops.segment_max}
-        return red[reduce_op](msgs, d32, num_segments=int(n))
+        msgs = {"add": msgs + edge, "sub": msgs - edge,
+                "mul": msgs * edge, "div": msgs / edge}[message_op]
+        return _segment_reduce(msgs, dst, n, reduce_op)
     return _run_op("send_ue_recv", f, (x, y, src_index, dst_index), {})
 
 

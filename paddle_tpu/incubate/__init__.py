@@ -2,3 +2,4 @@
 from . import asp
 from . import distributed
 from . import nn
+from . import optimizer

@@ -1,0 +1,115 @@
+"""Incubate optimizers (ref: python/paddle/incubate/optimizer/):
+LookAhead and ModelAverage wrappers over any inner optimizer."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """k steps forward, one step back (Zhang et al. 2019;
+    ref: incubate/optimizer/lookahead.py). Wraps an inner optimizer; every k
+    inner steps the slow weights interpolate toward the fast ones and the
+    fast weights reset to the slow track."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {id(p): jnp.array(p._data)
+                      for p in inner_optimizer._parameter_list}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (
+                    p._data.astype(slow.dtype) - slow)
+                self._slow[id(p)] = slow
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_num": self._step_num}
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters applied at eval time
+    (ref: incubate/optimizer/modelaverage.py). ``apply()`` swaps averaged
+    weights in (a context manager), ``restore()`` puts the live ones back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage needs the parameter list")
+        self._params = list(parameters)
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        # two-level sums like the reference: when the recent window fills,
+        # it rolls into the old buffer, so the effective average covers
+        # [max_average_window, 2*max_average_window) recent steps.
+        zeros = lambda p: jnp.zeros_like(p._data.astype(jnp.float32))
+        self._sum_new = {id(p): zeros(p) for p in self._params}
+        self._sum_old = {id(p): zeros(p) for p in self._params}
+        self._cnt_new = 0
+        self._cnt_old = 0
+        self._num_updates = 0
+        self._backup = None
+
+    def _window(self):
+        return max(self.min_average_window,
+                   min(int(self.average_window_rate * self._num_updates),
+                       self.max_average_window))
+
+    def step(self):
+        self._num_updates += 1
+        for p in self._params:
+            self._sum_new[id(p)] = (self._sum_new[id(p)]
+                                    + p._data.astype(jnp.float32))
+        self._cnt_new += 1
+        if self._cnt_new >= self._window():
+            self._sum_old = dict(self._sum_new)
+            self._cnt_old = self._cnt_new
+            zeros = lambda p: jnp.zeros_like(p._data.astype(jnp.float32))
+            self._sum_new = {id(p): zeros(p) for p in self._params}
+            self._cnt_new = 0
+
+    def _averaged(self, p):
+        total = self._sum_new[id(p)] + self._sum_old[id(p)]
+        count = max(self._cnt_new + self._cnt_old, 1)
+        return (total / count).astype(p._data.dtype)
+
+    def apply(self, executor=None, need_restore=True):
+        class _Ctx:
+            def __init__(ctx):
+                ctx.need_restore = need_restore
+
+            def __enter__(ctx):
+                self._backup = {id(p): p._data for p in self._params}
+                for p in self._params:
+                    p._data = self._averaged(p)
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if ctx.need_restore:
+                    self.restore()
+                return False
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p in self._params:
+                p._data = self._backup[id(p)]
+            self._backup = None

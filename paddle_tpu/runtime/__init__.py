@@ -723,3 +723,62 @@ def native_flag_get(name: str) -> Optional[str]:
         v = lib.pd_flag_get(name.encode())
         return v.decode() if v is not None else None
     return None
+
+
+class DeadlockWatchdog:
+    """Hang detector for collective regions (SURVEY.md §5.2: the TPU build's
+    answer to NCCL hang debugging — the reference relies on env timeouts).
+
+    Wrap a collective-heavy region; if it doesn't finish within ``timeout``
+    seconds the watchdog dumps every thread's stack to stderr (and optionally
+    invokes ``on_timeout``), so a stuck psum/all_gather across ranks leaves a
+    diagnosable trace instead of a silent hang.
+
+        with rt.DeadlockWatchdog(timeout=300, tag="allreduce"):
+            out = step(params, batch)
+
+    Re-entrant and cheap: one timer thread per active region.
+    """
+
+    def __init__(self, timeout: float, tag: str = "collective",
+                 on_timeout=None, abort: bool = False):
+        self.timeout = timeout
+        self.tag = tag
+        self.on_timeout = on_timeout
+        self.abort = abort
+        self._timers = []   # stack: nested regions each get their own timer
+        self.fired = False
+
+    def _fire(self):
+        import sys
+        self.fired = True
+        try:
+            sys.stderr.write(
+                f"\n=== DeadlockWatchdog[{self.tag}]: no completion within "
+                f"{self.timeout}s — dumping all thread stacks ===\n")
+            import faulthandler
+            # needs a real fd; captured/replaced stderr (pytest) lacks one
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:
+            import traceback
+            for tid, frame in sys._current_frames().items():
+                sys.stderr.write(f"--- thread {tid} ---\n")
+                sys.stderr.write("".join(traceback.format_stack(frame)))
+        finally:
+            if self.on_timeout is not None:
+                self.on_timeout()
+            if self.abort:
+                import os
+                os._exit(99)
+
+    def __enter__(self):
+        timer = threading.Timer(self.timeout, self._fire)
+        timer.daemon = True
+        timer.start()
+        self._timers.append(timer)
+        return self
+
+    def __exit__(self, *exc):
+        if self._timers:
+            self._timers.pop().cancel()
+        return False

@@ -104,13 +104,18 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         xx = ix[:, :, :] + sy[None, None, :] * bin_w[:, None, None]
 
         def bilinear(imgs, py, px):
-            # imgs [R, C, H, W]; py/px [R, S] -> [R, C, S]
-            y0 = jnp.clip(jnp.floor(py), 0, h - 1)
-            x0 = jnp.clip(jnp.floor(px), 0, w - 1)
+            # imgs [R, C, H, W]; py/px [R, S] -> [R, C, S]. Samples outside
+            # [-1, H] x [-1, W] contribute ZERO like the reference kernel
+            # (not replicated border pixels).
+            inside = ((py > -1.0) & (py < h) & (px > -1.0) & (px < w))
+            pyc = jnp.clip(py, 0.0, h - 1)
+            pxc = jnp.clip(px, 0.0, w - 1)
+            y0 = jnp.floor(pyc)
+            x0 = jnp.floor(pxc)
             y1_ = jnp.clip(y0 + 1, 0, h - 1)
             x1_ = jnp.clip(x0 + 1, 0, w - 1)
-            wy1 = jnp.clip(py - y0, 0, 1)
-            wx1 = jnp.clip(px - x0, 0, 1)
+            wy1 = jnp.clip(pyc - y0, 0, 1)
+            wx1 = jnp.clip(pxc - x0, 0, 1)
             wy0, wx0 = 1 - wy1, 1 - wx1
 
             def g(yi, xi):
@@ -119,10 +124,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                 return imgs[jnp.arange(imgs.shape[0])[:, None, None],
                             jnp.arange(c)[None, :, None],
                             yi[:, None, :], xi[:, None, :]]
-            return (g(y0, x0) * (wy0 * wx0)[:, None]
-                    + g(y0, x1_) * (wy0 * wx1)[:, None]
-                    + g(y1_, x0) * (wy1 * wx0)[:, None]
-                    + g(y1_, x1_) * (wy1 * wx1)[:, None])
+            val = (g(y0, x0) * (wy0 * wx0)[:, None]
+                   + g(y0, x1_) * (wy0 * wx1)[:, None]
+                   + g(y1_, x0) * (wy1 * wx0)[:, None]
+                   + g(y1_, x1_) * (wy1 * wx1)[:, None])
+            return val * inside[:, None, :]
 
         roi_feats = feat[img_idx]                            # [R, C, H, W]
         # flatten sampling positions: [R, ph*ns * pw*ns]

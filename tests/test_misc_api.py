@@ -66,3 +66,42 @@ def test_asp_prune_and_decorate():
     assert asp.check_sparsity(net._sub_layers["0"].weight.numpy())
     asp._MASKS.clear()
     asp.reset_excluded_layers()
+
+
+def test_lookahead():
+    from paddle_tpu.incubate.optimizer import LookAhead
+    net = nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.5,
+                                 parameters=net.parameters())
+    la = LookAhead(inner, alpha=0.5, k=2)
+    w0 = net.weight.numpy().copy()
+    x = paddle.to_tensor(np.random.rand(8, 4).astype("float32"))
+    for i in range(2):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+    # after k=2 steps: w == slow = w0 + 0.5*(fast - w0) -> between w0 and fast
+    w_now = net.weight.numpy()
+    assert not np.allclose(w_now, w0)
+    # one more pair of steps still works
+    loss = (net(x) ** 2).mean()
+    loss.backward()
+    la.step()
+    assert np.isfinite(net.weight.numpy()).all()
+
+
+def test_model_average():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+    net = nn.Linear(2, 2, bias_attr=False)
+    ma = ModelAverage(parameters=net.parameters())
+    vals = []
+    for v in (1.0, 3.0):
+        net.weight._data = net.weight._data * 0 + v
+        ma.step()
+        vals.append(v)
+    live = net.weight.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(net.weight.numpy(), np.mean(vals),
+                                   atol=1e-6)
+    np.testing.assert_allclose(net.weight.numpy(), live)

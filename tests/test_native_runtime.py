@@ -206,3 +206,22 @@ def test_native_flags_mirror():
     rt.mirror_flag_set("test_mirror_flag", "9")
     if rt.available():
         assert rt.native_flag_get("test_mirror_flag") == "9"
+
+
+def test_deadlock_watchdog_fires_and_cancels(capsys):
+    import sys
+    import time as _time
+
+    from paddle_tpu import runtime as rt
+
+    # completes in time: nothing fires
+    with rt.DeadlockWatchdog(timeout=5.0, tag="fast") as wd:
+        pass
+    assert not wd.fired
+
+    # hangs past the timeout: stacks dumped + callback invoked
+    hits = []
+    with rt.DeadlockWatchdog(timeout=0.2, tag="slow",
+                             on_timeout=lambda: hits.append(1)) as wd:
+        _time.sleep(0.6)
+    assert wd.fired and hits == [1]
