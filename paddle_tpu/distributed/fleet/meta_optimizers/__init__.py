@@ -1,2 +1,3 @@
 from .dygraph_optimizer.hybrid_parallel_optimizer import (
     DygraphShardingOptimizer, HybridParallelClipGrad, HybridParallelOptimizer)
+from .localsgd_dgc import DGCMomentumOptimizer, LocalSGDOptimizer  # noqa: F401,E501

@@ -105,3 +105,34 @@ def test_model_average():
         np.testing.assert_allclose(net.weight.numpy(), np.mean(vals),
                                    atol=1e-6)
     np.testing.assert_allclose(net.weight.numpy(), live)
+
+
+def test_localsgd_and_dgc():
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        DGCMomentumOptimizer, LocalSGDOptimizer)
+    net = nn.Linear(6, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    ls = LocalSGDOptimizer(inner, k_steps=2)
+    x = paddle.to_tensor(np.random.rand(8, 6).astype("float32"))
+    for _ in range(4):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        ls.step()
+        ls.clear_grad()
+    assert np.isfinite(net.weight.numpy()).all()
+
+    net2 = nn.Linear(6, 4)
+    dgc = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               parameters=net2.parameters(), sparsity=0.75)
+    w0 = net2.weight.numpy().copy()
+    losses = []
+    for _ in range(6):
+        loss = (net2(x) ** 2).mean()
+        loss.backward()
+        dgc.step()
+        dgc.clear_grad()
+        losses.append(float(loss.numpy()))
+    # sparse exchanges still optimize
+    assert losses[-1] < losses[0]
+    assert not np.allclose(net2.weight.numpy(), w0)
