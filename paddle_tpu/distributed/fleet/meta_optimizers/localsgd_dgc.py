@@ -13,12 +13,26 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _effective_world(group):
+    """Ranks actually participating in a reduction: all_reduce is the
+    identity unless the group's mesh axis is bound (communication.py), so
+    dividing by a bigger world would silently shrink the values."""
+    from ... import communication as comm
+    if group is None or group.axis_name is None:
+        return 1
+    if not comm._axis_bound(group.axis_name):
+        return 1
+    return group.nranks
+
+
 class LocalSGDOptimizer:
     """Run k local steps, then average parameters across the data-parallel
     group (ref: LocalSGDOptimizer)."""
 
     def __init__(self, inner_optimizer, k_steps=1, group=None):
         self.inner_optimizer = inner_optimizer
+        if int(k_steps) < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
         self.k_steps = int(k_steps)
         self.group = group
         self._step_num = 0
@@ -31,14 +45,13 @@ class LocalSGDOptimizer:
 
     def _sync_params(self):
         from ... import communication as comm
-        from ...env import get_world_size
-        world = (self.group.nranks if self.group is not None
-                 else get_world_size())
+        world = _effective_world(self.group)
         if world <= 1:
             return
         for p in self.inner_optimizer._parameter_list:
-            comm.all_reduce(p, group=self.group)
-            p._data = p._data / world
+            # all_reduce is functional: capture the summed result
+            reduced = comm.all_reduce(p, group=self.group)
+            p._data = reduced._data / world
 
     def clear_grad(self):
         self.inner_optimizer.clear_grad()
@@ -68,15 +81,15 @@ class DGCMomentumOptimizer:
         """Top-(1-sparsity) by |value|: returns (sent, residual)."""
         flat = g.reshape(-1)
         k = max(1, int(round(flat.size * (1.0 - self.sparsity))))
-        thresh = jnp.sort(jnp.abs(flat))[-k]
+        # k-th largest via top_k: O(n) vs a full sort
+        import jax as _jax
+        thresh = _jax.lax.top_k(jnp.abs(flat), k)[0][-1]
         mask = (jnp.abs(g) >= thresh).astype(g.dtype)
         return g * mask, g * (1 - mask)
 
     def step(self):
         from ... import communication as comm
-        from ...env import get_world_size
-        world = (self.group.nranks if self.group is not None
-                 else get_world_size())
+        world = _effective_world(self.group)
         for p in self._params:
             if p.grad is None:
                 continue
@@ -90,9 +103,8 @@ class DGCMomentumOptimizer:
             self._u[id(p)] = self._u[id(p)] * (sent == 0)
             if world > 1:
                 from ....tensor.tensor import Tensor
-                t = Tensor(sent)
-                comm.all_reduce(t, group=self.group)
-                sent = t._data / world
+                reduced = comm.all_reduce(Tensor(sent), group=self.group)
+                sent = reduced._data / world
             p._data = (p._data.astype(jnp.float32)
                        - self.lr * sent).astype(p._data.dtype)
 
