@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def _effective_world(group):
@@ -82,8 +83,7 @@ class DGCMomentumOptimizer:
         flat = g.reshape(-1)
         k = max(1, int(round(flat.size * (1.0 - self.sparsity))))
         # k-th largest via top_k: O(n) vs a full sort
-        import jax as _jax
-        thresh = _jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+        thresh = lax.top_k(jnp.abs(flat), k)[0][-1]
         mask = (jnp.abs(g) >= thresh).astype(g.dtype)
         return g * mask, g * (1 - mask)
 

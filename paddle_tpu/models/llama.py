@@ -426,6 +426,26 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
                                         "pos": pos + 1}
 
 
+def generate_scan(params, cache, first_token, num_tokens,
+                  config: LlamaConfig):
+    """Generate ``num_tokens`` greedily INSIDE one jit: lax.scan over decode
+    steps, so a whole generation is a single device dispatch (the per-token
+    host round-trip through the remote-TPU tunnel costs ~5 ms each).
+
+    first_token: [B, 1] int32 (normally argmax of the prefill logits).
+    Returns (tokens [B, num_tokens], cache).
+    """
+    def step(carry, _):
+        cache, tok = carry
+        logits, cache = llama_decode_step(params, cache, tok, config)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        return (cache, nxt), nxt[:, 0]
+
+    (cache, _), toks = lax.scan(step, (cache, first_token),
+                                None, length=num_tokens)
+    return toks.T, cache
+
+
 def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
                     max_len=None):
     """Greedy decoding: one batched prefill pass fills the KV cache (one
@@ -444,18 +464,34 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
             f"max_new_tokens {max_new_tokens}; the cache would overflow")
     cache = init_kv_cache(config, b, max_len)
     # donate the cache so XLA updates k/v in place (old cache is never reused)
-    step = jax.jit(functools.partial(llama_decode_step, config=config),
-                   donate_argnums=(1,))
-    prefill = jax.jit(functools.partial(llama_prefill, config=config),
-                      donate_argnums=(1,))
-
+    prefill = _jitted_prefill(_freeze_config(config))
     logits, cache = prefill(params, cache, jnp.asarray(prompt))
-    out = [np.asarray(jnp.argmax(logits, axis=-1))]
-    for _ in range(max_new_tokens - 1):
-        nxt = jnp.asarray(out[-1][:, None])
-        logits, cache = step(params, cache, nxt)
-        out.append(np.asarray(jnp.argmax(logits, axis=-1)))
-    return np.stack(out, axis=1)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    if max_new_tokens == 1:
+        return np.asarray(first)
+    # the whole continuation is one compiled scan (one device dispatch)
+    gen = _jitted_generate(_freeze_config(config), max_new_tokens - 1)
+    toks, cache = gen(params, cache, first)
+    return np.concatenate([np.asarray(first), np.asarray(toks)], axis=1)
+
+
+def _freeze_config(config):
+    return dataclasses.astuple(config)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_prefill(frozen):
+    config = LlamaConfig(*frozen)
+    return jax.jit(functools.partial(llama_prefill, config=config),
+                   donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_generate(frozen, num_tokens):
+    config = LlamaConfig(*frozen)
+    return jax.jit(functools.partial(generate_scan, config=config,
+                                     num_tokens=num_tokens),
+                   donate_argnums=(1,))
 
 
 # ---------------------------------------------------------------------------

@@ -93,3 +93,25 @@ def test_prefill_matches_stepwise():
     lb, _ = llama_decode_step(params, cache_b, nxt, config)
     np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=2e-4,
                                rtol=1e-3)
+
+
+def test_generate_scan_matches_eager_loop():
+    """One-dispatch scan generation == per-token eager generation."""
+    from paddle_tpu.models.llama import generate_scan, llama_prefill
+    config = llama_tiny(vocab=48, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=32)
+    params = init_llama_params(config, seed=4)
+    prompt = np.array([[5, 9, 2]], np.int32)
+    N = 6
+
+    # eager reference: stepwise loop
+    cache = init_kv_cache(config, 1, 3 + N)
+    logits, cache = llama_prefill(params, cache, jnp.asarray(prompt), config)
+    toks = [int(np.argmax(np.asarray(logits)))]
+    for _ in range(N - 1):
+        logits, cache = llama_decode_step(
+            params, cache, jnp.asarray([[toks[-1]]], np.int32), config)
+        toks.append(int(np.argmax(np.asarray(logits))))
+
+    out = greedy_generate(params, prompt, config, max_new_tokens=N)
+    assert out[0].tolist() == toks
