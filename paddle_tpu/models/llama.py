@@ -449,8 +449,10 @@ def generate_scan(params, cache, first_token, num_tokens,
 def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
                     max_len=None):
     """Greedy decoding: one batched prefill pass fills the KV cache (one
-    compile per distinct prompt length), then the per-token cached decode
-    step (compiled once) generates."""
+    compile per distinct prompt length), then the whole continuation runs as
+    a single compiled lax.scan dispatch (generate_scan). num_tokens is
+    bucketed to powers of two so sweeping max_new_tokens doesn't recompile
+    per value; both jitted wrappers donate the cache for in-place k/v."""
     prompt = np.asarray(prompt_ids)
     b, plen = prompt.shape
     if plen == 0:
@@ -462,17 +464,21 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
         raise ValueError(
             f"greedy_generate: max_len={max_len} < prompt {plen} + "
             f"max_new_tokens {max_new_tokens}; the cache would overflow")
-    cache = init_kv_cache(config, b, max_len)
-    # donate the cache so XLA updates k/v in place (old cache is never reused)
-    prefill = _jitted_prefill(_freeze_config(config))
-    logits, cache = prefill(params, cache, jnp.asarray(prompt))
+    frozen = _freeze_config(config)
+    # bucket the scan length (next power of two) so nearby max_new_tokens
+    # values share one compiled executable; extra steps run after the last
+    # wanted token (sequential scan), so slicing the output is safe
+    n_cont = max_new_tokens - 1
+    bucket = 1 << (n_cont - 1).bit_length() if n_cont > 0 else 0
+    cache = init_kv_cache(config, b, max(max_len, plen + 1 + bucket))
+    logits, cache = _jitted_prefill(frozen)(params, cache,
+                                            jnp.asarray(prompt))
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
     if max_new_tokens == 1:
         return np.asarray(first)
-    # the whole continuation is one compiled scan (one device dispatch)
-    gen = _jitted_generate(_freeze_config(config), max_new_tokens - 1)
-    toks, cache = gen(params, cache, first)
-    return np.concatenate([np.asarray(first), np.asarray(toks)], axis=1)
+    toks, cache = _jitted_generate(frozen, bucket)(params, cache, first)
+    return np.concatenate([np.asarray(first), np.asarray(toks)[:, :n_cont]],
+                          axis=1)
 
 
 def _freeze_config(config):

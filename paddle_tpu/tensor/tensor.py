@@ -146,6 +146,22 @@ class Tensor:
     def detach(self):
         return Tensor._from_data(self._data, stop_gradient=True)
 
+    def clone(self):
+        from .creation import clone as _clone
+        return _clone(self)
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    rank = dim
+    ndimension = dim
+
+    def is_contiguous(self):
+        return True  # XLA arrays are always dense/contiguous logically
+
+    def contiguous(self):
+        return self
+
     def detach_(self):
         self._grad_node = None
         self.stop_gradient = True

@@ -97,7 +97,7 @@ def test_prefill_matches_stepwise():
 
 def test_generate_scan_matches_eager_loop():
     """One-dispatch scan generation == per-token eager generation."""
-    from paddle_tpu.models.llama import generate_scan, llama_prefill
+    from paddle_tpu.models.llama import llama_prefill
     config = llama_tiny(vocab=48, hidden=32, layers=2, heads=4, kv_heads=4,
                         inter=64, seq=32)
     params = init_llama_params(config, seed=4)
