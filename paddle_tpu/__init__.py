@@ -14,6 +14,7 @@ import jax as _jax
 # int32. Float creation paths still default to float32 (see tensor/creation).
 _jax.config.update("jax_enable_x64", True)
 
+from .framework import set_printoptions  # noqa: F401
 from .framework import (  # noqa: F401
     CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
     set_device, get_device, device_count, get_flags, set_flags, seed,

@@ -7,3 +7,22 @@ from .place import (Place, CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
                     is_compiled_with_cuda, is_compiled_with_tpu)
 from .flags import define_flag, get_flags, get_flag, set_flags
 from .random import seed, get_rng_state, set_rng_state, get_rng_state_tracker
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """ref: paddle.set_printoptions — forwards to numpy's print options,
+    which Tensor.__repr__ uses."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)

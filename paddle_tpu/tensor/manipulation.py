@@ -373,3 +373,145 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
         m = m.at[..., r, c].set(b)
         return jnp.moveaxis(m, (-2, -1), (axis1, axis2))
     return _run_op("diagonal_scatter", f, (x, y), {})
+
+
+# -- stacking / splitting family (ref: paddle.{hstack,vstack,...}) -----------
+
+def _seq(xs):
+    return tuple(xs) if isinstance(xs, (list, tuple)) else (xs,)
+
+
+def hstack(x, name=None):
+    return _run_op("hstack", lambda *ts: jnp.hstack(ts), _seq(x), {})
+
+
+def vstack(x, name=None):
+    return _run_op("vstack", lambda *ts: jnp.vstack(ts), _seq(x), {})
+
+
+def dstack(x, name=None):
+    return _run_op("dstack", lambda *ts: jnp.dstack(ts), _seq(x), {})
+
+
+def column_stack(x, name=None):
+    return _run_op("column_stack", lambda *ts: jnp.column_stack(ts), _seq(x), {})
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [_run_op("atleast_1d", jnp.atleast_1d, (t,), {}) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [_run_op("atleast_2d", jnp.atleast_2d, (t,), {}) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [_run_op("atleast_3d", jnp.atleast_3d, (t,), {}) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis))
+    return list(_run_op("tensor_split", f, (x,), {}))
+
+
+def hsplit(x, num_or_indices, name=None):
+    # numpy semantics: 1-D input splits along axis 0
+    return tensor_split(x, num_or_indices,
+                        axis=0 if len(x.shape) == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    # NB: builtins.slice — this module defines a paddle.slice op
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sr)
+        return a.at[tuple(idx)].set(v)
+    return _run_op("slice_scatter", f, (x, value), {})
+
+
+def select_scatter(x, value, axis, index, name=None):
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+    return _run_op("select_scatter", f, (x, value), {})
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions with consecutive values (ref:
+    paddle.masked_scatter). Eager: mask is concretized for the stable
+    ordering the reference defines."""
+    import numpy as _np
+    m = _np.asarray(mask.numpy() if isinstance(mask, Tensor) else mask)
+    needed = int(m.sum())
+    n_vals = int(_np.prod(value.shape)) if len(value.shape) else 1
+    if n_vals < needed:
+        raise ValueError(
+            f"masked_scatter: value has {n_vals} elements but mask selects "
+            f"{needed}")
+    def f(a, v):
+        flatm = m.reshape(-1)
+        picks = _np.zeros(flatm.shape, _np.int64)
+        picks[flatm] = _np.arange(int(flatm.sum()))
+        taken = v.reshape(-1)[jnp.asarray(picks)]
+        return jnp.where(jnp.asarray(flatm).reshape(a.shape),
+                         taken.reshape(a.shape), a)
+    return _run_op("masked_scatter", f, (x, value), {})
+
+
+def index_fill(x, index, axis, value, name=None):
+    def g(a, idx):
+        sl = [builtins.slice(None)] * a.ndim
+        sl[axis] = idx.astype(jnp.int32)
+        return a.at[tuple(sl)].set(value)
+    return _run_op("index_fill", g, (x, index), {})
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        ii = tuple(i.astype(jnp.int64) for i in idx)
+        if accumulate:
+            return a.at[ii].add(v)
+        return a.at[ii].set(v)
+    return _run_op("index_put", f, (x, value) + tuple(indices), {})
+
+
+def block_diag(inputs, name=None):
+    return _run_op("block_diag",
+                   lambda *ts: jax.scipy.linalg.block_diag(*ts),
+                   tuple(inputs), {})
+
+
+def cartesian_prod(x, name=None):
+    def f(*ts):
+        grids = jnp.meshgrid(*ts, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return _run_op("cartesian_prod", f, tuple(x), {})
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools as _it
+    n = int(x.shape[0])
+    combo = (_it.combinations_with_replacement(range(n), r)
+             if with_replacement else _it.combinations(range(n), r))
+    idx = np.array(list(combo), np.int64).reshape(-1, r)
+    def f(a):
+        return a[jnp.asarray(idx)]
+    return _run_op("combinations", f, (x,), {})

@@ -460,3 +460,85 @@ def take(x, index, mode="raise", name=None):
             ii = jnp.clip(jnp.where(ii < 0, ii + n, ii), 0, n - 1)
         return flat[ii]
     return _run_op("take", f, (x, index), {})
+
+
+positive = _unary("positive", lambda a: +a)
+negative = neg
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    import numpy as _np
+    d = x._data.dtype if isinstance(x, Tensor) else _np.asarray(x).dtype
+    return bool(jnp.issubdtype(d, jnp.floating))
+
+
+def is_integer(x):
+    import numpy as _np
+    d = x._data.dtype if isinstance(x, Tensor) else _np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.integer)
+
+
+def is_complex(x):
+    import numpy as _np
+    d = x._data.dtype if isinstance(x, Tensor) else _np.asarray(x).dtype
+    return jnp.issubdtype(d, jnp.complexfloating)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _run_op("nanmedian",
+                   lambda a: jnp.nanmedian(a, axis=_norm_axis(axis),
+                                           keepdims=keepdim), (x,), {})
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return _run_op("nanquantile",
+                   lambda a: jnp.nanquantile(a, q, axis=_norm_axis(axis),
+                                             keepdims=keepdim), (x,), {})
+
+
+def frexp(x, name=None):
+    return _run_op("frexp", lambda a: tuple(jnp.frexp(a)), (x,), {})
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = ((min, max) if (min != 0 or max != 0)
+                  else (a.min(), a.max()))
+        return jnp.histogram_bin_edges(a, bins=bins, range=(lo, hi))
+    return _run_op("histogram_bin_edges", f, (x,), {})
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    def f(a, *w):
+        h, edges = jnp.histogramdd(a, bins=bins, range=ranges,
+                                   density=density,
+                                   weights=w[0] if w else None)
+        return (h,) + tuple(edges)
+    args = (x,) + ((weights,) if weights is not None else ())
+    out = _run_op("histogramdd", f, args, {})
+    return out[0], list(out[1:])
+
+
+def clip_(x, min=None, max=None, name=None):
+    def v(b):
+        return b._data if isinstance(b, Tensor) else b
+    x._data = jnp.clip(x._data, v(min), v(max))
+    x._grad_node = None
+    return x
+
+
+def trunc_(x, name=None):
+    x._data = jnp.trunc(x._data)
+    x._grad_node = None
+    return x
+
+
+def copysign_(x, y, name=None):
+    x._data = jnp.copysign(x._data, y._data if isinstance(y, Tensor) else y)
+    x._grad_node = None
+    return x

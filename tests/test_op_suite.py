@@ -401,3 +401,76 @@ class TestBf16Ops(OpTest):
         loss.backward()
         assert x.grad is not None
         assert str(x.grad.dtype).endswith("float32")
+
+
+class TestStackSplitScatter(OpTest):
+    def test_stack_family(self):
+        a = _any((2, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.hstack([paddle.to_tensor(a)] * 2).numpy(),
+            np.hstack([a, a]))
+        np.testing.assert_allclose(
+            paddle.vstack([paddle.to_tensor(a)] * 2).numpy(),
+            np.vstack([a, a]))
+        np.testing.assert_allclose(
+            paddle.dstack([paddle.to_tensor(a)] * 2).numpy(),
+            np.dstack([a, a]))
+
+    def test_tensor_split_matches_numpy(self):
+        a = _any((2, 7)).astype(np.float32)
+        got = [x.numpy() for x in paddle.tensor_split(
+            paddle.to_tensor(a), 3, axis=1)]
+        ref = np.array_split(a, 3, axis=1)
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r)
+
+    def test_scatter_family(self):
+        base = np.zeros((4, 4), np.float32)
+        out = paddle.slice_scatter(paddle.to_tensor(base),
+                                   paddle.ones([2, 4]), [0], [1], [3], [1])
+        ref = base.copy(); ref[1:3] = 1
+        np.testing.assert_allclose(out.numpy(), ref)
+        out2 = paddle.select_scatter(paddle.to_tensor(base),
+                                     paddle.ones([4]), 0, 2)
+        ref2 = base.copy(); ref2[2] = 1
+        np.testing.assert_allclose(out2.numpy(), ref2)
+
+    def test_masked_scatter_order(self):
+        mask = np.array([[True, False], [True, True]])
+        vals = np.array([1., 2., 3.], np.float32)
+        out = paddle.masked_scatter(paddle.zeros([2, 2]),
+                                    paddle.to_tensor(mask),
+                                    paddle.to_tensor(vals))
+        np.testing.assert_allclose(out.numpy(), [[1, 0], [2, 3]])
+
+    def test_combinations_and_cartesian(self):
+        x = paddle.to_tensor(np.array([1, 2, 3, 4]))
+        got = paddle.combinations(x, r=2).numpy()
+        import itertools
+        ref = np.array(list(itertools.combinations([1, 2, 3, 4], 2)))
+        np.testing.assert_array_equal(got, ref)
+        cp = paddle.cartesian_prod(
+            [paddle.to_tensor(np.array([0, 1])),
+             paddle.to_tensor(np.array([5, 6]))]).numpy()
+        np.testing.assert_array_equal(cp, [[0, 5], [0, 6], [1, 5], [1, 6]])
+
+    def test_block_diag(self):
+        from scipy.linalg import block_diag as ref_bd
+        a, b = _any((2, 2)).astype(np.float32), _any((3, 1)).astype(np.float32)
+        got = paddle.block_diag([paddle.to_tensor(a),
+                                 paddle.to_tensor(b)]).numpy()
+        np.testing.assert_allclose(got, ref_bd(a, b))
+
+    def test_nan_reductions(self):
+        a = np.array([[1., np.nan, 3.], [np.nan, 5., 6.]], np.float32)
+        got = paddle.nanmedian(paddle.to_tensor(a), axis=1).numpy()
+        np.testing.assert_allclose(got, np.nanmedian(a, 1))
+        gq = paddle.nanquantile(paddle.to_tensor(a), 0.5, axis=1).numpy()
+        np.testing.assert_allclose(gq, np.nanquantile(a, 0.5, 1))
+
+    def test_frexp(self):
+        a = np.array([8.0, 0.5, -3.0], np.float32)
+        m, e = paddle.frexp(paddle.to_tensor(a))
+        mr, er = np.frexp(a)
+        np.testing.assert_allclose(m.numpy(), mr)
+        np.testing.assert_array_equal(e.numpy(), er)
