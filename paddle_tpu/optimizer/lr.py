@@ -292,3 +292,81 @@ class ReduceOnPlateau(LRScheduler):
                 self.last_lr = new_lr
                 self.cooldown_counter = self.cooldown
                 self.num_bad = 0
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr *= lr_lambda(epoch) cumulatively (ref: lr.MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        factor = 1.0
+        for e in range(1, self.last_epoch + 1):
+            factor *= self.lr_lambda(e)
+        return self.base_lr * factor
+
+
+class LinearLR(LRScheduler):
+    """Linear ramp from start_factor to end_factor over total_steps
+    (ref: lr.LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        frac = t / self.total_steps
+        factor = self.start_factor + (self.end_factor - self.start_factor) * frac
+        return self.base_lr * factor
+
+
+class CyclicLR(LRScheduler):
+    """Triangular cyclic schedule (ref: lr.CyclicLR; Smith 2015).
+
+    modes: 'triangular' (constant amplitude), 'triangular2' (halved per
+    cycle), 'exp_range' (gamma**step scaling).
+    """
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up=2000, step_size_down=None, mode="triangular",
+                 exp_gamma=1.0, scale_fn=None, scale_mode="cycle",
+                 last_epoch=-1, verbose=False):
+        self.max_lr = float(max_learning_rate)
+        self.step_size_up = step_size_up
+        self.step_size_down = (step_size_down if step_size_down is not None
+                               else step_size_up)
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        self._scale_fn = scale_fn
+        self.scale_mode = scale_mode
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def _scale(self, cycle, step):
+        if self._scale_fn is not None:
+            return self._scale_fn(cycle if self.scale_mode == "cycle"
+                                  else step)
+        if self.mode == "triangular":
+            return 1.0
+        if self.mode == "triangular2":
+            return 1.0 / (2.0 ** (cycle - 1))
+        if self.mode == "exp_range":
+            return self.exp_gamma ** step
+        raise ValueError(f"unknown CyclicLR mode {self.mode!r}")
+
+    def get_lr(self):
+        step = max(self.last_epoch, 0)
+        total = self.step_size_up + self.step_size_down
+        cycle = step // total + 1
+        pos = step % total
+        if pos < self.step_size_up:
+            frac = pos / self.step_size_up
+        else:
+            frac = 1.0 - (pos - self.step_size_up) / self.step_size_down
+        amp = (self.max_lr - self.base_lr) * frac
+        return self.base_lr + amp * self._scale(cycle, step)

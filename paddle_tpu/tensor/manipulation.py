@@ -460,6 +460,7 @@ def masked_scatter(x, mask, value, name=None):
     ordering the reference defines."""
     import numpy as _np
     m = _np.asarray(mask.numpy() if isinstance(mask, Tensor) else mask)
+    m = _np.broadcast_to(m, tuple(int(d) for d in x.shape))
     needed = int(m.sum())
     n_vals = int(_np.prod(value.shape)) if len(value.shape) else 1
     if n_vals < needed:
@@ -500,10 +501,13 @@ def block_diag(inputs, name=None):
 
 
 def cartesian_prod(x, name=None):
+    xs = tuple(x)
+    if len(xs) == 1:          # reference special case: 1-D result
+        return _run_op("cartesian_prod", lambda a: a.reshape(-1), xs, {})
     def f(*ts):
         grids = jnp.meshgrid(*ts, indexing="ij")
         return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
-    return _run_op("cartesian_prod", f, tuple(x), {})
+    return _run_op("cartesian_prod", f, xs, {})
 
 
 def combinations(x, r=2, with_replacement=False, name=None):

@@ -158,3 +158,43 @@ def test_amp_gradscaler_flow():
     scaler.step(o)
     scaler.update()
     assert scaler.state_dict()["scale"] == 1024.0
+
+
+def test_cyclic_lr_triangle():
+    from paddle_tpu.optimizer.lr import CyclicLR
+    s = CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5,
+                 step_size_up=4, step_size_down=4)
+    lrs = []
+    for _ in range(9):
+        lrs.append(s())
+        s.step()
+    assert abs(lrs[0] - 0.1) < 1e-9
+    assert abs(lrs[4] - 0.5) < 1e-9   # peak after step_size_up
+    assert abs(lrs[8] - 0.1) < 1e-9   # back to base after a full cycle
+    # triangular2 halves the second cycle's amplitude
+    s2 = CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5,
+                  step_size_up=2, step_size_down=2, mode="triangular2")
+    seq = []
+    for _ in range(7):
+        seq.append(s2())
+        s2.step()
+    assert abs(seq[2] - 0.5) < 1e-9
+    assert abs(seq[6] - 0.3) < 1e-9   # base + (0.4)*1*0.5
+
+
+def test_linear_lr_and_multiplicative():
+    from paddle_tpu.optimizer.lr import LinearLR, MultiplicativeDecay
+    s = LinearLR(learning_rate=0.2, total_steps=4, start_factor=0.5,
+                 end_factor=1.0)
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    assert abs(vals[0] - 0.1) < 1e-9 and abs(vals[4] - 0.2) < 1e-9
+
+    m = MultiplicativeDecay(learning_rate=1.0, lr_lambda=lambda e: 0.5)
+    seq = []
+    for _ in range(3):
+        seq.append(m())
+        m.step()
+    assert seq == [1.0, 0.5, 0.25]
