@@ -242,6 +242,19 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
     return out
 
 
+def _remat_policy(parallel):
+    """Resolve ParallelConfig.remat_policy to a jax checkpoint policy."""
+    if parallel.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if parallel.remat_policy == "save_attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if parallel.remat_policy == "full":
+        return None
+    raise ValueError(
+        f"unknown remat_policy {parallel.remat_policy!r}; "
+        "expected 'full', 'dots', or 'save_attn'")
+
+
 def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
                  layer_slice=None, in_shard_map=False):
     """Embed + scan decoder stack. Returns final hidden (pre-norm)."""
@@ -261,17 +274,7 @@ def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
                              in_shard_map=in_shard_map)
     raw_body = lambda h, p: (body(p, h, cos, sin), None)
     if parallel.remat:
-        if parallel.remat_policy == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        elif parallel.remat_policy == "save_attn":
-            policy = jax.checkpoint_policies.save_only_these_names("attn_out")
-        elif parallel.remat_policy == "full":
-            policy = None
-        else:
-            raise ValueError(
-                f"unknown remat_policy {parallel.remat_policy!r}; "
-                "expected 'full', 'dots', or 'save_attn'")
-        scan_body = jax.checkpoint(raw_body, policy=policy)
+        scan_body = jax.checkpoint(raw_body, policy=_remat_policy(parallel))
     else:
         scan_body = raw_body
     layer_params = params["layers"]
@@ -300,12 +303,12 @@ def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
-    count = jnp.maximum(jnp.sum(mask), 1)
+    count = jnp.sum(mask)
     if in_shard_map and parallel.sep > 1:
         # only 'sep' is manual; dp/sharding stay auto (GSPMD reduces them)
         loss_sum = lax.psum(loss_sum, "sep")
         count = lax.psum(count, "sep")
-    return loss_sum / count
+    return loss_sum / jnp.maximum(count, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -661,22 +664,29 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
     act = _act_spec(parallel)
     batch_axes = act[0]
     tp_axis = "mp" if parallel.mp > 1 else None
+    sep_on = parallel.sep > 1
 
     def stage_fn(stage_params, h, cos, sin):
         body = functools.partial(decoder_layer, config=c, parallel=parallel,
                                  mesh=None, use_flash=use_flash,
-                                 tp_axis=tp_axis)
+                                 tp_axis=tp_axis, in_shard_map=sep_on)
         def scan_body(hh, p):
             return body(p, hh, cos, sin), None
         if parallel.remat:
-            scan_body = jax.checkpoint(scan_body)
+            scan_body = jax.checkpoint(scan_body, policy=_remat_policy(parallel))
         h, _ = lax.scan(scan_body, h, stage_params)
         return h
 
     def pipelined_loss(p, ids, labels):
-        # inside shard_map: manual over 'pp' (and batch axes for psums)
+        # inside shard_map: manual over 'pp' (and batch axes for psums).
+        # With sep>1 ids/labels arrive sequence-sharded: [B, S_local].
         b, s = ids.shape
-        cos, sin = build_rope_cache(s, c.head_dim, base=c.rope_theta)
+        s_total = s * (parallel.sep if sep_on else 1)
+        cos, sin = build_rope_cache(s_total, c.head_dim, base=c.rope_theta)
+        if sep_on:
+            idx = lax.axis_index("sep") * s
+            cos = lax.dynamic_slice_in_dim(cos, idx, s, 0)
+            sin = lax.dynamic_slice_in_dim(sin, idx, s, 0)
         h = jnp.take(p["embed"], ids, axis=0).astype(c.dtype)
         from ..parallel.pipeline import microbatch, pipeline_apply, last_stage_value
         h_mb = microbatch(h, M)
@@ -691,13 +701,21 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
         safe = jnp.where(mask, labels, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
         picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        loss = jnp.sum(jnp.where(mask, -picked, 0.0)) / jnp.maximum(mask.sum(), 1)
+        loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
+        count = jnp.sum(mask)
+        if sep_on:
+            loss_sum = lax.psum(loss_sum, "sep")
+            count = lax.psum(count, "sep")
+        loss = loss_sum / jnp.maximum(count, 1)
         return last_stage_value(loss, S, "pp")
 
     # Manual over 'pp' (+ 'mp' when TP is on: the explicit Megatron psum
-    # pattern — mixing manual pp with auto mp collectives crashes XLA's SPMD
-    # group expansion). dp/sharding stay auto/GSPMD.
-    manual_axes = {"pp"} | ({"mp"} if tp_axis else set())
+    # pattern, + 'sep' when context parallel is on: ring attention's explicit
+    # ppermute — mixing manual pp with auto mp/sep collectives crashes XLA's
+    # SPMD group expansion, spmd_partitioner_util CHECK failure at 32 devices).
+    # dp/sharding stay auto/GSPMD.
+    manual_axes = ({"pp"} | ({"mp"} if tp_axis else set())
+                   | ({"sep"} if sep_on else set()))
 
     def manual_spec(full_spec, lead_pp: bool):
         parts = ["pp"] if lead_pp else []
@@ -717,7 +735,8 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
     pp_manual["final_norm"] = P()
     if "lm_head" in pp_manual:
         pp_manual["lm_head"] = P()
-    in_specs = (pp_manual, P(), P())
+    ids_spec = P(None, "sep") if sep_on else P()
+    in_specs = (pp_manual, ids_spec, ids_spec)
     smap_loss = shard_map(pipelined_loss, mesh=mesh, in_specs=in_specs,
                           out_specs=P(), axis_names=manual_axes,
                           check_vma=False)
@@ -729,7 +748,8 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
         return new_p, new_opt, loss
 
     jit_step = jax.jit(step, donate_argnums=(0, 1))
-    batch_sharding = NamedSharding(mesh, P(batch_axes, None))
+    batch_sharding = NamedSharding(
+        mesh, P(batch_axes, "sep" if sep_on else None))
 
     def step_fn(p, opt, ids, labels):
         ids = jax.device_put(jnp.asarray(ids, jnp.int32), batch_sharding)

@@ -58,6 +58,17 @@ def test_ring_attention_sep(ref_run):
     np.testing.assert_allclose(a1, l1, rtol=2e-3)
 
 
+def test_pp_sep_composition(ref_run):
+    # sep composed with pp: both axes in one manual shard_map region (the
+    # auto/manual mix crashed XLA's SPMD partitioner at 32 devices in r1).
+    cfg, ids, labels, l0, l1 = ref_run
+    par = ParallelConfig(pp=2, sep=2, mp=2, microbatches=4, use_flash=False,
+                         remat=False)
+    a0, a1 = _run2(cfg, par, ids, labels)
+    np.testing.assert_allclose(a0, l0, rtol=2e-4)
+    np.testing.assert_allclose(a1, l1, rtol=2e-3)
+
+
 def test_hybrid_pp_mp_dp(ref_run):
     cfg, ids, labels, l0, l1 = ref_run
     par = ParallelConfig(dp=2, pp=2, mp=2, microbatches=4, use_flash=False,
