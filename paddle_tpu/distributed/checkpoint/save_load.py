@@ -62,7 +62,14 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                     target[k] = fill(target[k], saved[k])
             return target
         if isinstance(target, (list, tuple)) and isinstance(saved, (list, tuple)):
+            if len(target) != len(saved):
+                raise ValueError(
+                    f"checkpoint sequence length mismatch: target has "
+                    f"{len(target)} entries, saved has {len(saved)}")
             out = [fill(t, s) for t, s in zip(target, saved)]
+            if hasattr(target, "_fields"):
+                # namedtuples take positional fields, not an iterable
+                return type(target)(*out)
             return type(target)(out)
         return saved
 

@@ -292,23 +292,31 @@ def llama_logits(params, h, config):
     return x @ head
 
 
-def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
-               mesh=None, use_flash=True, in_shard_map=False):
-    """Causal LM loss, fp32 softmax. labels: [B, S] with -100 = ignore."""
-    h = llama_hidden(params, ids, config, parallel, mesh, use_flash,
-                     in_shard_map=in_shard_map)
-    logits = llama_logits(params, h, config).astype(jnp.float32)
+def masked_ce_loss(logits, labels, sep_psum: bool = False):
+    """Mean CE over labels != -100 (fp32 logits). With sep_psum, the sum and
+    the token count are psum'd over the manual 'sep' axis BEFORE the clamp so
+    sequence shards with no valid tokens don't deflate the denominator."""
     mask = labels != -100
     safe = jnp.where(mask, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
     count = jnp.sum(mask)
-    if in_shard_map and parallel.sep > 1:
-        # only 'sep' is manual; dp/sharding stay auto (GSPMD reduces them)
+    if sep_psum:
         loss_sum = lax.psum(loss_sum, "sep")
         count = lax.psum(count, "sep")
     return loss_sum / jnp.maximum(count, 1)
+
+
+def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
+               mesh=None, use_flash=True, in_shard_map=False):
+    """Causal LM loss, fp32 softmax. labels: [B, S] with -100 = ignore."""
+    h = llama_hidden(params, ids, config, parallel, mesh, use_flash,
+                     in_shard_map=in_shard_map)
+    logits = llama_logits(params, h, config).astype(jnp.float32)
+    # only 'sep' is manual; dp/sharding stay auto (GSPMD reduces them)
+    return masked_ce_loss(logits, labels,
+                          sep_psum=in_shard_map and parallel.sep > 1)
 
 
 # ---------------------------------------------------------------------------
@@ -461,7 +469,7 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     if plen == 0:
         raise ValueError("greedy_generate: prompt must be non-empty")
     if max_new_tokens <= 0:
-        return np.zeros((b, 0), np.int64)
+        return np.zeros((b, 0), np.int32)  # match the prefill/scan dtype
     max_len = max_len or (plen + max_new_tokens)
     if max_len < plen + max_new_tokens:
         raise ValueError(
@@ -697,16 +705,7 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
         out_mb = pipe(p["layers"], h_mb)
         h_out = out_mb.reshape(b, s, c.hidden_size)
         logits = llama_logits(p, h_out, c).astype(jnp.float32)
-        mask = labels != -100
-        safe = jnp.where(mask, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
-        count = jnp.sum(mask)
-        if sep_on:
-            loss_sum = lax.psum(loss_sum, "sep")
-            count = lax.psum(count, "sep")
-        loss = loss_sum / jnp.maximum(count, 1)
+        loss = masked_ce_loss(logits, labels, sep_psum=sep_on)
         return last_stage_value(loss, S, "pp")
 
     # Manual over 'pp' (+ 'mp' when TP is on: the explicit Megatron psum

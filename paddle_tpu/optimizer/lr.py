@@ -299,13 +299,18 @@ class MultiplicativeDecay(LRScheduler):
 
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
         self.lr_lambda = lr_lambda
+        self._cum_epoch = 0
+        self._cum_factor = 1.0
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        factor = 1.0
-        for e in range(1, self.last_epoch + 1):
-            factor *= self.lr_lambda(e)
-        return self.base_lr * factor
+        # cache the running product: O(1) per step instead of O(epoch)
+        if self.last_epoch < self._cum_epoch:
+            self._cum_epoch, self._cum_factor = 0, 1.0
+        while self._cum_epoch < self.last_epoch:
+            self._cum_epoch += 1
+            self._cum_factor *= self.lr_lambda(self._cum_epoch)
+        return self.base_lr * self._cum_factor
 
 
 class LinearLR(LRScheduler):
@@ -314,6 +319,9 @@ class LinearLR(LRScheduler):
 
     def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
                  end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError(
+                f"LinearLR: total_steps must be positive, got {total_steps}")
         self.total_steps = total_steps
         self.start_factor = start_factor
         self.end_factor = end_factor

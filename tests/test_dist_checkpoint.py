@@ -46,3 +46,20 @@ def test_load_partial_keys(tmp_path):
     load_state_dict(target, str(tmp_path / "ckpt"))
     np.testing.assert_allclose(target["w"].numpy(), 1.0)
     np.testing.assert_allclose(target["extra"].numpy(), 0.0)
+
+
+def test_namedtuple_and_length_mismatch(tmp_path):
+    import collections
+    import pytest
+    Pair = collections.namedtuple("Pair", ["a", "b"])
+    sd = {"p": [paddle.ones([2]), paddle.zeros([2])]}
+    save_state_dict(sd, str(tmp_path / "ckpt"))
+    # namedtuple target restores via positional-field construction
+    target = {"p": Pair(paddle.zeros([2]), paddle.zeros([2]))}
+    load_state_dict(target, str(tmp_path / "ckpt"))
+    assert isinstance(target["p"], Pair)
+    np.testing.assert_allclose(target["p"].a.numpy(), 1.0)
+    # length mismatch raises instead of silently truncating
+    bad = {"p": [paddle.zeros([2])]}
+    with pytest.raises(ValueError, match="length mismatch"):
+        load_state_dict(bad, str(tmp_path / "ckpt"))

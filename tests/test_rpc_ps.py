@@ -54,6 +54,44 @@ def test_rpc_error_propagates():
     assert raised
 
 
+def test_rpc_rejects_unauthenticated():
+    """A connection without the shared-secret preamble must be dropped
+    before any unpickling (no code execution for strangers)."""
+    import socket
+    import struct
+    from paddle_tpu.distributed import rpc
+    port = _free_port()
+    rpc.init_rpc("workerA", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    try:
+        info = rpc.get_current_worker_info()
+        payload = pickle.dumps({"op": "call", "fn": _double,
+                                "args": (1,), "kwargs": {}})
+        with socket.create_connection((info.ip, info.port), timeout=5) as s:
+            # no token preamble: server must close without replying
+            s.sendall(struct.pack(">I", len(payload)) + payload)
+            s.settimeout(2.0)
+            try:
+                data = s.recv(1024)
+            except (socket.timeout, ConnectionError):
+                data = b""
+        assert data == b""
+        # wrong token: also dropped (single send so the server's early close
+        # can't race a second sendall into BrokenPipeError)
+        with socket.create_connection((info.ip, info.port), timeout=5) as s:
+            s.sendall(b"\x00" * 32 + struct.pack(">I", len(payload)) + payload)
+            s.settimeout(2.0)
+            try:
+                data = s.recv(1024)
+            except (socket.timeout, ConnectionError):
+                data = b""
+        assert data == b""
+        # the authenticated path still works
+        assert rpc.rpc_sync("workerA", _double, args=(4,)) == 8
+    finally:
+        rpc.shutdown()
+
+
 def test_ps_tables_inprocess():
     from paddle_tpu.distributed import rpc
     from paddle_tpu.distributed.ps import PSClient, service
