@@ -34,26 +34,12 @@ def peak_flops(device) -> float:
     return PEAK_BF16_FLOPS["cpu"]
 
 
-def main():
+def run_config(config, batch, seq, dev):
+    """Train-step MFU for one model config. Returns (mfu, tok_s, dt, loss)."""
     import jax
-    import jax.numpy as jnp
-    from paddle_tpu.models.llama import (LlamaConfig, ParallelConfig,
-                                         build_train_step,
+    from paddle_tpu.models.llama import (ParallelConfig, build_train_step,
                                          train_flops_per_token)
-
-    dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    seq = 2048 if on_tpu else 128
-    batch = 4 if on_tpu else 2
-    if on_tpu:
-        config = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                             intermediate_size=4096, num_hidden_layers=24,
-                             num_attention_heads=16, num_key_value_heads=16,
-                             max_position_embeddings=seq, dtype=jnp.bfloat16)
-    else:
-        from paddle_tpu.models.llama import llama_tiny
-        config = llama_tiny(seq=seq)
-
     parallel = ParallelConfig(remat=True, use_flash=on_tpu)
     step, params, opt = build_train_step(config, parallel, lr=1e-4)
 
@@ -76,23 +62,66 @@ def main():
     jax.device_get(loss)
     dt = (time.perf_counter() - t0) / n_steps
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step / dt
-    flops_per_token = train_flops_per_token(config, seq)
-    mfu = tok_s * flops_per_token / peak_flops(dev)
+    tok_s = batch * seq / dt
+    mfu = tok_s * train_flops_per_token(config, seq) / peak_flops(dev)
+    del params, opt
+    return mfu, tok_s, dt, float(jax.device_get(loss))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    seq = 2048 if on_tpu else 128
+    batch = 4 if on_tpu else 2
+    if on_tpu:
+        # flagship shape: head_dim=128 (Llama-2's), MXU-sized matmuls
+        config = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                             intermediate_size=8192, num_hidden_layers=12,
+                             num_attention_heads=16, num_key_value_heads=16,
+                             max_position_embeddings=seq, dtype=jnp.bfloat16)
+        # round-1 shape (head_dim=64), kept for cross-round comparability
+        config_hd64 = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                                  intermediate_size=4096, num_hidden_layers=24,
+                                  num_attention_heads=16,
+                                  num_key_value_heads=16,
+                                  max_position_embeddings=seq,
+                                  dtype=jnp.bfloat16)
+    else:
+        from paddle_tpu.models.llama import llama_tiny
+        config = llama_tiny(seq=seq)
+        config_hd64 = None
+
+    mfu, tok_s, dt, loss = run_config(config, batch, seq, dev)
+    detail = {
+        "tokens_per_sec_per_chip": round(tok_s, 1),
+        "step_time_s": round(dt, 4),
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+        "seq_len": seq, "batch": batch,
+        "hidden": config.hidden_size, "layers": config.num_hidden_layers,
+        "head_dim": config.head_dim,
+        "loss": round(loss, 4),
+    }
+    if config_hd64 is not None:
+        mfu64, tok_s64, dt64, _ = run_config(config_hd64, batch, seq, dev)
+        detail["hd64_shape"] = {
+            "mfu": round(float(mfu64), 4),
+            "tokens_per_sec_per_chip": round(tok_s64, 1),
+            "step_time_s": round(dt64, 4),
+            "hidden": config_hd64.hidden_size,
+            "layers": config_hd64.num_hidden_layers,
+            "head_dim": config_hd64.head_dim,
+        }
 
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(float(mfu), 4),
         "unit": "MFU",
         "vs_baseline": round(float(mfu) / 0.45, 4),
-        "detail": {
-            "tokens_per_sec_per_chip": round(tok_s, 1),
-            "step_time_s": round(dt, 4),
-            "device": str(getattr(dev, "device_kind", dev.platform)),
-            "seq_len": seq, "batch": batch,
-            "loss": round(float(jax.device_get(loss)), 4),
-        },
+        "detail": detail,
     }))
 
 

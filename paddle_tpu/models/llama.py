@@ -217,7 +217,8 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
 
     if parallel.sep > 1 and in_shard_map:
         from ..parallel.ring_attention import ring_attention
-        attn = ring_attention(q, k, v, axis_name="sep", causal=True)
+        attn = ring_attention(q, k, v, axis_name="sep", causal=True,
+                              impl="flash" if use_flash else "xla")
     elif use_flash:
         attn = flash_attention_bshd(q, k, v, causal=True)
     else:

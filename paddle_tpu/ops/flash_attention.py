@@ -56,7 +56,12 @@ def _pad_rows(x, multiple):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
                 scale, seq_k, kv_len):
     """seq_k is the PADDED key length (multiple of block_k); kv_len the true
-    one — key positions >= kv_len are masked out so padding never attends."""
+    one — key positions >= kv_len are masked out so padding never attends.
+
+    The KV loop is split into an unmasked region (blocks fully below the
+    causal diagonal and clear of padding) and a masked tail: the mask iota/
+    where work is VPU-side and the kernel is softmax-(VPU-)bound at small D,
+    so skipping it on interior blocks is a real win."""
     import numpy as np
     bk_i = np.int32(block_k)  # i32 casts are belt-and-braces; the trace runs
     # under mosaic_trace_ctx (x64 disabled) — see _common.mosaic_trace_ctx
@@ -78,12 +83,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
         last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
         nblocks = jnp.minimum(nblocks, last_q // bk_i + np.int32(1))
 
-    def body(j, carry):
+    def body(j, carry, *, masked):
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * bk_i, block_k), :]
         v = v_ref[0, pl.ds(j * bk_i, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal or mask_kv:
+        if masked:
             cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             ok = cols < np.int32(kv_len) if mask_kv else None
             if causal:
@@ -99,7 +104,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
                                         preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
-    m, l, acc = lax.fori_loop(np.int32(0), nblocks, body, (m, l, acc))
+    if causal or mask_kv:
+        # first block index that needs any masking: the causal diagonal
+        # (rows >= cols can fail once j*bk > qi*bq) and/or the padded tail.
+        first_masked = nblocks
+        if causal:
+            first_masked = jnp.minimum(first_masked, (qi * bq_i) // bk_i)
+        if mask_kv:
+            first_masked = jnp.minimum(first_masked, nblocks - np.int32(1))
+        first_masked = jnp.maximum(first_masked, np.int32(0))
+        carry = lax.fori_loop(np.int32(0), first_masked,
+                              functools.partial(body, masked=False),
+                              (m, l, acc))
+        m, l, acc = lax.fori_loop(first_masked, nblocks,
+                                  functools.partial(body, masked=True), carry)
+    else:
+        m, l, acc = lax.fori_loop(np.int32(0), nblocks,
+                                  functools.partial(body, masked=False),
+                                  (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # 2-D store ([1, BQ]); Mosaic fails to legalize 1-D vector stores.
     lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
@@ -163,14 +185,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     nq = np.int32(seq_q // block_q)
     start = (ki * bk_i) // bq_i if causal else np.int32(0)
 
-    def body(i, carry):
+    def body(i, carry, *, masked):
         dk, dv = carry
         qb = q_ref[0, pl.ds(i * bq_i, block_q), :]        # [BQ, D]
         dob = do_ref[0, pl.ds(i * bq_i, block_q), :]
         lseb = lse_ref[0, 0, pl.ds(i * bq_i, block_q)]    # [BQ] f32
         deltab = delta_ref[0, 0, pl.ds(i * bq_i, block_q)]
         s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
-        if causal or mask_q:
+        if masked:
             rows = i * bq_i + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             ok = rows < np.int32(q_len) if mask_q else None
             if causal:
@@ -186,7 +208,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
         return dk, dv
 
-    acc_dk, acc_dv = lax.fori_loop(start, nq, body, (acc_dk, acc_dv))
+    if causal or mask_q:
+        # q tiles straddling the causal diagonal need the mask; tiles fully
+        # below it don't; the last tile needs it again when q is padded.
+        if causal:
+            diag_end = -((ki * bk_i + bk_i) // -bq_i)     # ceil-div
+            diag_end = jnp.clip(diag_end, start, nq)
+        else:
+            diag_end = start
+        un_end = jnp.maximum(diag_end, nq - np.int32(1)) if mask_q else nq
+        carry = lax.fori_loop(start, diag_end,
+                              functools.partial(body, masked=True),
+                              (acc_dk, acc_dv))
+        carry = lax.fori_loop(diag_end, un_end,
+                              functools.partial(body, masked=False), carry)
+        acc_dk, acc_dv = lax.fori_loop(un_end, nq,
+                                       functools.partial(body, masked=True),
+                                       carry)
+    else:
+        acc_dk, acc_dv = lax.fori_loop(start, nq,
+                                       functools.partial(body, masked=False),
+                                       (acc_dk, acc_dv))
     dk_ref[0] = acc_dk.astype(dk_ref.dtype)
     dv_ref[0] = acc_dv.astype(dv_ref.dtype)
 
@@ -211,11 +253,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
         nblocks = jnp.minimum(nblocks, last_q // bk_i + np.int32(1))
 
-    def body(j, acc):
+    def body(j, acc, *, masked):
         kb = k_ref[0, pl.ds(j * bk_i, block_k), :]
         vb = v_ref[0, pl.ds(j * bk_i, block_k), :]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        if causal or mask_kv:
+        if masked:
             cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             ok = cols < np.int32(kv_len) if mask_kv else None
             if causal:
@@ -228,7 +270,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
         return acc + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
-    acc = lax.fori_loop(np.int32(0), nblocks, body, acc)
+    if causal or mask_kv:
+        first_masked = nblocks
+        if causal:
+            first_masked = jnp.minimum(first_masked, (qi * bq_i) // bk_i)
+        if mask_kv:
+            first_masked = jnp.minimum(first_masked, nblocks - np.int32(1))
+        first_masked = jnp.maximum(first_masked, np.int32(0))
+        acc = lax.fori_loop(np.int32(0), first_masked,
+                            functools.partial(body, masked=False), acc)
+        acc = lax.fori_loop(first_masked, nblocks,
+                            functools.partial(body, masked=True), acc)
+    else:
+        acc = lax.fori_loop(np.int32(0), nblocks,
+                            functools.partial(body, masked=False), acc)
     dq_ref[0] = acc.astype(dq_ref.dtype)
 
 
@@ -258,11 +313,23 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
     lse3 = lse3.reshape(bh, 1, sp)
     delta3 = delta3.reshape(bh, 1, sp)
 
+    dq, dk, dv = _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal,
+                                   scale, block_q, block_k, q_len=s,
+                                   kv_len=sk)
+    return dq[:, :s], dk[:, :sk], dv[:, :sk]
+
+
+def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
+                      block_k, q_len, kv_len):
+    """The two backward pallas_calls on already-padded [BH, Sp, D] operands.
+    lse3/delta3: [BH, 1, Sp] f32. Returns padded (dq, dk, dv)."""
+    bh, sp, d = qp.shape
+    skp = kp.shape[1]
     kv_grid = (bh, skp // block_k)
     with _mosaic_ctx():
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
-                              scale=scale, seq_q=sp, q_len=s),
+                              scale=scale, seq_q=sp, q_len=q_len),
             grid=kv_grid,
             in_specs=[
                 pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # q
@@ -277,8 +344,8 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct(kp.shape, k.dtype),
-                jax.ShapeDtypeStruct(vp.shape, v.dtype),
+                jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                jax.ShapeDtypeStruct(vp.shape, vp.dtype),
             ],
             interpret=_interpret(),
         )(qp, kp, vp, dop, lse3, delta3)
@@ -286,7 +353,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
         q_grid = (bh, sp // block_q)
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
-                              scale=scale, seq_k=skp, kv_len=sk),
+                              scale=scale, seq_k=skp, kv_len=kv_len),
             grid=q_grid,
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -297,10 +364,10 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
                 pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
             ],
             out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
             interpret=_interpret(),
         )(qp, kp, vp, dop, lse3, delta3)
-    return dq[:, :s], dk[:, :sk], dv[:, :sk]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -341,3 +408,47 @@ def flash_attention_bshd(q, k, v, causal=False, scale=None,
     o = _flash_attention(to_bh(q, s), to_bh(k, sk), to_bh(v, sk),
                          causal, float(scale), block_q, block_k)
     return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# block-level entry points for ring attention (parallel/ring_attention.py):
+# per-KV-block flash with the (o, lse) partials exposed so the caller can
+# merge partial softmaxes across sequence shards, and the FA2 backward with
+# caller-provided GLOBAL lse/delta (the identities hold per block when the
+# statistics are global).
+# ---------------------------------------------------------------------------
+
+def flash_block_fwd(q, k, v, causal, scale, block_q=DEFAULT_BLOCK_Q,
+                    block_k=DEFAULT_BLOCK_K):
+    """q/k/v: [BH, S, D]. Returns (o [BH, S, D], lse [BH, S] f32)."""
+    return _flash_fwd(q, k, v, causal, float(scale), block_q, block_k)
+
+
+def flash_block_bwd(q, k, v, do, lse, delta, causal, scale,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """FA2 backward for one KV block with global statistics.
+
+    q/do: [BH, Sq, D]; k/v: [BH, Sk, D]; lse/delta: [BH, Sq] f32 computed
+    over the FULL (all-block) attention. Sq/Sk must be 128-aligned (ring
+    shards are; enforced here rather than padded because padding q rows
+    with lse=0 would make exp(0-lse) contribute garbage to dk/dv).
+    Returns (dq, dk, dv)."""
+    bh, s, d = q.shape
+    sk = k.shape[1]
+    if s % 128 or sk % 128:
+        raise ValueError(f"flash_block_bwd needs 128-aligned lengths, got "
+                         f"q={s}, k={sk}")
+
+    def fit_divisor(block, n):
+        # largest 128-multiple <= block that divides n (n is 128-aligned)
+        b = min(block, n)
+        while n % b:
+            b -= 128
+        return b
+
+    block_q = fit_divisor(block_q, s)
+    block_k = fit_divisor(block_k, sk)
+    lse3 = lse.reshape(bh, 1, s)
+    delta3 = delta.reshape(bh, 1, s)
+    return _bwd_pallas_calls(q, k, v, do, lse3, delta3, causal, float(scale),
+                             block_q, block_k, q_len=s, kv_len=sk)
