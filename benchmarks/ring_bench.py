@@ -6,13 +6,18 @@ compute both ways at long-context shard sizes (>= 8k per shard), fwd and
 fwd+bwd. Run on the TPU: `python benchmarks/ring_bench.py`.
 """
 import functools
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from bench import peak_flops
 from paddle_tpu.ops.flash_attention import flash_block_fwd, flash_block_bwd
 from paddle_tpu.parallel.ring_attention import _merge_partials
 
@@ -98,9 +103,6 @@ def main():
             z = jnp.zeros((bh, s, d), jnp.float32)
             return lax.fori_loop(0, N, body, (z, z, z))
 
-        import sys
-        sys.path.insert(0, __file__.rsplit("/", 2)[0])
-        from bench import peak_flops
         peak = peak_flops(dev)
         t_e = bench(einsum_N, q, k, v)
         t_f = bench(flash_N, q, k, v)

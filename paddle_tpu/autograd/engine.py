@@ -85,17 +85,35 @@ class GradNode:
     structure, and accumulated pending cotangents per output slot.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_treedef", "out_avals",
-                 "pending", "out_hooks", "__weakref__")
+    __slots__ = ("name", "vjp_fn", "call_fn", "inputs", "out_treedef",
+                 "out_avals", "pending", "out_hooks", "input_versions",
+                 "__weakref__")
 
-    def __init__(self, name, vjp_fn, inputs, out_treedef, out_avals):
+    def __init__(self, name, vjp_fn, inputs, out_treedef, out_avals,
+                 call_fn=None):
         self.name = name
         self.vjp_fn = vjp_fn
+        self.call_fn = call_fn         # raw forward (for create_graph re-vjp)
         self.inputs = inputs           # list[Tensor], positional wrt vjp primals
         self.out_treedef = out_treedef
         self.out_avals = out_avals     # list[(shape, dtype)] per flat output
         self.pending: Dict[int, Any] = {}
         self.out_hooks: Dict[int, List] = {}
+        self.input_versions: Optional[List[int]] = None  # inplace_version @ record
+
+    def check_versions(self):
+        """Reference inplace_version check: raise if any input was modified
+        in place after this op recorded it (its grads would otherwise be
+        routed through the post-write graph silently)."""
+        if self.input_versions is None:
+            return
+        for t, v in zip(self.inputs, self.input_versions):
+            if t._inplace_version != v:
+                raise RuntimeError(
+                    f"tensor used by {self.name} (recorded inplace_version "
+                    f"{v}) was modified by an in-place operation "
+                    f"(current version {t._inplace_version}); gradient "
+                    "computation through the old value is not possible")
 
     def producers(self):
         seen = []
@@ -127,8 +145,68 @@ class GradNode:
         ct_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
         return self.vjp_fn(ct_tree)
 
+    def run_vjp_taped(self):
+        """create_graph mode: the node's backward is itself RECORDED as a
+        taped op (ref: the reference's codegen'd double-grad nodes,
+        paddle/fluid/eager/backward.cc). The saved vjp closure can't be used
+        — it bakes the primal residuals in as constants, so second
+        derivatives w.r.t. the primals would silently be zero. Instead the
+        op's forward is re-vjp'd INSIDE a taped grad op whose inputs are
+        (primals, cotangents); grad-of-grad then flows through both."""
+        from ..tensor.tensor import Tensor, apply_op
+        if self.call_fn is None:
+            raise RuntimeError(
+                f"GradNode {self.name} has no retained forward; double "
+                "backward requires the graph to have been built with grad "
+                "enabled (and not released by a prior backward)")
+        inexact_out = [i for i, (_, d) in enumerate(self.out_avals)
+                       if jnp.issubdtype(d, jnp.inexact)]
+        cts = []
+        for i in inexact_out:
+            shape, dtype = self.out_avals[i]
+            g = self.pending.get(i)
+            if g is None:
+                g = Tensor._from_data(jnp.zeros(shape, dtype),
+                                      stop_gradient=True)
+            else:
+                for hook in self.out_hooks.get(i, ()):
+                    res = hook(g)
+                    if res is not None:
+                        g = res
+            cts.append(g)
+        self.pending.clear()
+        n_in = len(self.inputs)
+        diff_idx = [i for i, t in enumerate(self.inputs)
+                    if jnp.issubdtype(t._data.dtype, jnp.inexact)]
+        call_fn = self.call_fn
+        out_treedef, out_avals = self.out_treedef, self.out_avals
+        inexact_set = set(inexact_out)
+
+        def grad_fn(*primals_and_cts):
+            primals = primals_and_cts[:n_in]
+            it = iter(primals_and_cts[n_in:])
+            flat_cts = []
+            for i, (shape, dtype) in enumerate(out_avals):
+                if i in inexact_set:
+                    flat_cts.append(next(it))
+                else:
+                    flat_cts.append(np.zeros(shape, jax.dtypes.float0))
+            ct_tree = jax.tree_util.tree_unflatten(out_treedef, flat_cts)
+            _, vjp_fn = jax.vjp(call_fn, *primals)
+            gs = vjp_fn(ct_tree)
+            return tuple(gs[i] for i in diff_idx)
+
+        outs = apply_op(f"{self.name}_grad", grad_fn, *self.inputs, *cts)
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        full = [None] * n_in
+        for j, i in enumerate(diff_idx):
+            full[i] = outs[j]
+        return full
+
     def release(self):
         self.vjp_fn = None
+        self.call_fn = None
         self.inputs = ()
         self.pending.clear()
 
@@ -153,9 +231,39 @@ def _accumulate_leaf(tensor, g):
         tensor.grad._data = tensor.grad._data + g
 
 
-def backward(tensor, grad_tensor=None, retain_graph: bool = False):
-    """Run backward from ``tensor``, accumulating into leaf ``.grad``s."""
+def _accumulate_leaf_taped(tensor, g):
+    """create_graph mode: g is a taped Tensor; .grad keeps its graph so
+    paddle.grad(grad, x) can differentiate through it."""
+    for hook in tensor._hooks:
+        res = hook(g)
+        if res is not None:
+            g = res
+    tensor.grad = g if tensor.grad is None else tensor.grad + g
+
+
+def backward(tensor, grad_tensor=None, retain_graph: bool = False,
+             create_graph: bool = False, _sink: Optional[Dict[int, Any]] = None):
+    """Run backward from ``tensor``, accumulating into leaf ``.grad``s.
+
+    With create_graph, every node's backward is recorded on the tape (see
+    GradNode.run_vjp_taped) so the resulting grads are differentiable.
+    With _sink (paddle.grad), leaf grads go into the side table keyed by
+    id(tensor) instead of .grad — grad() must not touch ANY leaf's .grad,
+    including leaves the caller didn't ask about."""
     from ..tensor.tensor import Tensor
+
+    def leaf_accumulate(t, g):
+        if _sink is not None:
+            for hook in t._hooks:
+                res = hook(g) if create_graph else hook_call(hook, g)
+                if res is not None:
+                    g = res
+            cur = _sink.get(id(t))
+            _sink[id(t)] = g if cur is None else cur + g
+        elif create_graph:
+            _accumulate_leaf_taped(t, g)
+        else:
+            _accumulate_leaf(t, g)
 
     data = tensor._data
     if grad_tensor is None:
@@ -167,11 +275,19 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
     else:
         seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
         seed = jnp.broadcast_to(seed, data.shape).astype(data.dtype)
+    if create_graph:
+        # a graph-carrying grad_tensor seeds the tape directly (shape must
+        # match); otherwise the seed is a constant
+        if (isinstance(grad_tensor, Tensor) and not grad_tensor.stop_gradient
+                and grad_tensor.shape == tuple(data.shape)):
+            seed = grad_tensor
+        else:
+            seed = Tensor._from_data(seed, stop_gradient=True)
 
     root = tensor._grad_node
     if root is None:
         if not tensor.stop_gradient:
-            _accumulate_leaf(tensor, seed)
+            leaf_accumulate(tensor, seed)
         return
 
     # Count reachable consumer edges per node (Kahn over the reverse graph).
@@ -191,7 +307,8 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
     queue: List[GradNode] = [root]
     while queue:
         n = queue.pop()
-        in_grads = n.run_vjp()
+        n.check_versions()
+        in_grads = n.run_vjp_taped() if create_graph else n.run_vjp()
         consumed_inputs = n.inputs
         for t, g in zip(consumed_inputs, in_grads):
             if g is None or _is_float0(g):
@@ -200,7 +317,7 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
                 continue
             p = t._grad_node
             if p is None:
-                _accumulate_leaf(t, g)
+                leaf_accumulate(t, g)
             else:
                 p.accumulate(t._out_index, g)
         for p in n.producers():
@@ -208,7 +325,7 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False):
             indeg[pid] -= 1
             if indeg[pid] == 0:
                 queue.append(p)
-        if not retain_graph:
+        if not (retain_graph or create_graph):
             n.release()
 
 
@@ -227,24 +344,29 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
 
-    saved = [(t.grad, t.stop_gradient) for t in inputs]
+    from ..tensor.tensor import Tensor as _T
+    sink: Dict[int, Any] = {}
+    saved_sg = [t.stop_gradient for t in inputs]
     for t in inputs:
-        t.grad = None
         t.stop_gradient = False
     try:
-        for o, go in zip(outputs, grad_outputs):
-            backward(o, go, retain_graph=retain_graph or create_graph)
+        with enable_grad() if create_graph else contextlib.nullcontext():
+            for o, go in zip(outputs, grad_outputs):
+                backward(o, go, retain_graph=retain_graph or create_graph,
+                         create_graph=create_graph, _sink=sink)
         results = []
         for t in inputs:
-            if t.grad is None:
+            g = sink.get(id(t))
+            if g is None:
                 if not allow_unused:
                     raise RuntimeError("an input tensor received no gradient; "
                                        "pass allow_unused=True to permit this")
                 results.append(None)
+            elif isinstance(g, _T):
+                results.append(g)
             else:
-                results.append(t.grad)
+                results.append(_T._from_data(g, stop_gradient=True))
         return results
     finally:
-        for t, (g, sg) in zip(inputs, saved):
-            t.grad = g
+        for t, sg in zip(inputs, saved_sg):
             t.stop_gradient = sg

@@ -56,6 +56,30 @@ class _PyLayerNode(engine.GradNode):
             out.append(None if g is None else (g._data if isinstance(g, Tensor) else g))
         return tuple(out)
 
+    def run_vjp_taped(self):
+        """create_graph mode: run the user's backward WITH grad enabled so
+        its eager ops land on the tape and the returned grads are themselves
+        differentiable (the reference's PyLayer double-grad contract)."""
+        from ..tensor.tensor import Tensor
+        cts = []
+        for i, (shape, dtype) in enumerate(self.out_avals):
+            g = self.pending.get(i)
+            if g is None:
+                g = Tensor._from_data(engine._zero_cotangent(shape, dtype),
+                                      stop_gradient=True)
+            else:
+                for hook in self.out_hooks.get(i, ()):
+                    res = hook(g)
+                    if res is not None:
+                        g = res
+            cts.append(g)
+        self.pending.clear()
+        with engine.enable_grad():
+            grads = self.layer_cls.backward(self.ctx, *cts)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        return tuple(grads)
+
     def release(self):
         self.ctx = None
         self.inputs = ()

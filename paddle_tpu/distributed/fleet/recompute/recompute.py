@@ -49,16 +49,28 @@ class _RecomputeFunction(PyLayer):
         diff_outs = [o for o in outs if isinstance(o, Tensor) and not o.stop_gradient]
         diff_grads = [g for o, g in zip(outs, grads)
                       if isinstance(o, Tensor) and not o.stop_gradient]
-        inputs_need = [d for _, d in tensor_inputs if not d.stop_gradient]
-        if not inputs_need:
-            return tuple(None for _ in tensor_inputs)
-        gs = engine.grad(diff_outs, inputs_need, grad_outputs=diff_grads,
-                         allow_unused=True)
-        out_grads = []
-        it = iter(gs)
+        # reentrant backward (torch-checkpoint style): engine.backward
+        # accumulates into every reachable leaf — the module's PARAMETERS
+        # (captured inside run_function, not passed as args) get their .grad
+        # here, while the detached inputs collect the grads this PyLayer
+        # must return. engine.grad would be wrong: it routes grads to a side
+        # table and must not touch param .grad.
         for _, d in tensor_inputs:
-            out_grads.append(next(it) if not d.stop_gradient else None)
-        return tuple(out_grads)
+            d.grad = None
+        if engine.is_grad_enabled():
+            # run_vjp_taped invoked us (create_graph double backward). The
+            # reentrant scheme detaches its inputs, which severs the
+            # second-order path to the caller's graph — same limitation as
+            # the reference's (and torch's use_reentrant=True) checkpoint.
+            raise RuntimeError(
+                "recompute does not support double backward "
+                "(create_graph=True): the recomputed forward runs on "
+                "detached inputs. Compute gradient-penalty terms on a "
+                "non-recomputed block instead.")
+        for o, g in zip(diff_outs, diff_grads):
+            engine.backward(o, g, retain_graph=True)
+        return tuple(d.grad if not d.stop_gradient else None
+                     for _, d in tensor_inputs)
 
 
 def recompute(function, *args, **kwargs):

@@ -26,7 +26,8 @@ def _is_tensor(x):
 
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_grad_node", "_out_index",
-                 "name", "persistable", "_hooks", "__weakref__", "__dict__")
+                 "name", "persistable", "_hooks", "_inplace_version",
+                 "__weakref__", "__dict__")
 
     _counter = 0
 
@@ -54,6 +55,7 @@ class Tensor:
         self._out_index = 0
         self.persistable = False
         self._hooks = []
+        self._inplace_version = 0
         if name is None:
             Tensor._counter += 1
             name = f"generated_tensor_{Tensor._counter}"
@@ -70,6 +72,7 @@ class Tensor:
         t._out_index = out_index
         t.persistable = False
         t._hooks = []
+        t._inplace_version = 0
         Tensor._counter += 1
         t.name = f"generated_tensor_{Tensor._counter}"
         return t
@@ -242,12 +245,50 @@ class Tensor:
         return _run_op("getitem", lambda a: a[idx], (self,), {})
 
     def __setitem__(self, idx, value):
+        """In-place write with reference inplace_version semantics: the write
+        is recorded as a taped functional op (grads flow to the untouched
+        region AND to `value`), this tensor's version is bumped, and any
+        EARLIER consumer of the old value raises at backward instead of
+        silently receiving grads routed through the post-write graph."""
         idx = _unwrap_index(idx)
+        needs_grad = engine.is_grad_enabled() and (
+            not self.stop_gradient
+            or (isinstance(value, Tensor) and not value.stop_gradient))
+        if not needs_grad:
+            if isinstance(value, Tensor):
+                value = value._data
+            self._data = self._data.at[idx].set(value)
+            self._inplace_version += 1
+            return
+        if self._grad_node is None and not self.stop_gradient:
+            # same contract as the reference/torch: writing into a leaf that
+            # requires grad would orphan its accumulated gradient
+            raise RuntimeError(
+                "a leaf Tensor that requires grad is being used in an "
+                "in-place operation; detach() it or wrap the write in "
+                "no_grad()")
+        # alias preserves the pre-write graph edge (and version) so the
+        # taped setitem op routes grads to the OLD node, then this object is
+        # rebound to the op output
+        alias = Tensor._from_data(self._data, node=self._grad_node,
+                                  out_index=self._out_index,
+                                  stop_gradient=self.stop_gradient)
+        alias._inplace_version = self._inplace_version
         if isinstance(value, Tensor):
-            value = value._data
-        self._data = self._data.at[idx].set(value)
-        # in-place write re-roots the tensor (reference bumps inplace_version)
-        self._grad_node = None
+            out = _run_op("setitem",
+                          lambda a, v: a.at[idx].set(
+                              jnp.asarray(v).astype(a.dtype)),
+                          (alias, value), {})
+        else:
+            out = _run_op("setitem", lambda a: a.at[idx].set(value),
+                          (alias,), {})
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._out_index = out._out_index
+        # the write may introduce grad flow (value requires grad even though
+        # this tensor didn't) — adopt the taped output's flag
+        self.stop_gradient = out.stop_gradient
+        self._inplace_version += 1
 
     def __len__(self):
         if self.ndim == 0:
@@ -352,7 +393,9 @@ def _run_op(name: str, fn, args: tuple, kwargs: dict):
         out, vjp_fn = jax.vjp(call, *datas)
         out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
         avals = [(tuple(o.shape), o.dtype) for o in out_leaves]
-        node = engine.GradNode(name, vjp_fn, tensors, out_treedef, avals)
+        node = engine.GradNode(name, vjp_fn, tensors, out_treedef, avals,
+                               call_fn=call)
+        node.input_versions = [t._inplace_version for t in tensors]
         wrapped = [Tensor._from_data(o, node=node, out_index=i, stop_gradient=False)
                    for i, o in enumerate(out_leaves)]
     else:

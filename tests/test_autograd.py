@@ -142,3 +142,162 @@ def test_retain_graph():
     y.backward(retain_graph=True)
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), 8.0)
+
+
+# ---------------------------------------------------------------------------
+# double backward (ref: egr::Backward double-grad; SURVEY §2a eager autograd)
+# ---------------------------------------------------------------------------
+
+def test_grad_of_grad_scalar():
+    # y = x^3: dy/dx = 3x^2, d2y/dx2 = 6x
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(float(g1), 12.0, rtol=1e-6)
+    (g2,) = paddle.grad(g1, x)
+    np.testing.assert_allclose(float(g2), 12.0, rtol=1e-6)
+
+
+def test_grad_of_grad_elementwise():
+    xs = np.array([0.5, -1.0, 2.0], np.float32)
+    x = paddle.to_tensor(xs, stop_gradient=False)
+    y = paddle.sum(paddle.exp(x) * x)
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    # dy/dx = e^x (x + 1); d2y/dx2 = e^x (x + 2)
+    np.testing.assert_allclose(g1.numpy(), np.exp(xs) * (xs + 1), rtol=1e-5)
+    (g2,) = paddle.grad(paddle.sum(g1), x)
+    np.testing.assert_allclose(g2.numpy(), np.exp(xs) * (xs + 2), rtol=1e-5)
+
+
+def test_grad_of_grad_matches_numeric():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4).astype(np.float32)
+
+    def f(t):
+        return paddle.sum(paddle.tanh(t) * t * t)
+
+    x = paddle.to_tensor(xs, stop_gradient=False)
+    (g1,) = paddle.grad(f(x), x, create_graph=True)
+    (g2,) = paddle.grad(paddle.sum(g1), x)
+
+    eps = 1e-3
+    num = np.zeros_like(xs)
+    for i in range(len(xs)):
+        e = np.zeros_like(xs); e[i] = eps
+        # numeric d/dx_i of sum(grad): central difference of sum-of-grad
+        xp = paddle.to_tensor(xs + e, stop_gradient=False)
+        xm = paddle.to_tensor(xs - e, stop_gradient=False)
+        (gp,) = paddle.grad(f(xp), xp)
+        (gm,) = paddle.grad(f(xm), xm)
+        num[i] = (gp.numpy().sum() - gm.numpy().sum()) / (2 * eps)
+    np.testing.assert_allclose(g2.numpy(), num, rtol=5e-2, atol=5e-3)
+
+
+def test_gradient_penalty_pattern():
+    # WGAN-GP style: loss = (||d critic/d x||_2 - 1)^2 must be trainable,
+    # i.e. backward through the grad must reach the critic weights.
+    rng = np.random.RandomState(1)
+    w = paddle.to_tensor(rng.randn(3, 1).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(rng.randn(2, 3).astype(np.float32),
+                         stop_gradient=False)
+
+    out = paddle.sum(paddle.matmul(x, w))          # critic(x)
+    (gx,) = paddle.grad(out, x, create_graph=True)  # d out / d x = w^T rows
+    norm = paddle.sqrt(paddle.sum(gx * gx))
+    penalty = (norm - 1.0) * (norm - 1.0)
+    penalty.backward()
+    assert w.grad is not None
+    # analytic: penalty depends on w only via ||w||: d/dw (sqrt(2)||w|| - 1)^2
+    wn = np.linalg.norm(w.numpy())
+    expected = 2 * (np.sqrt(2) * wn - 1) * np.sqrt(2) * w.numpy() / wn
+    np.testing.assert_allclose(w.grad.numpy(), expected, rtol=1e-4)
+
+
+def test_double_backward_pylayer():
+    class Square(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, t):
+            ctx.save_for_backward(t)
+            return t * t
+
+        @staticmethod
+        def backward(ctx, g):
+            (t,) = ctx.saved_tensor()
+            return g * 2.0 * t
+
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = Square.apply(x)
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(float(g1), 6.0, rtol=1e-6)
+    (g2,) = paddle.grad(g1, x)
+    np.testing.assert_allclose(float(g2), 2.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# in-place __setitem__ (ref: inplace_version tracking in dense_tensor)
+# ---------------------------------------------------------------------------
+
+def test_setitem_differentiable():
+    x = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    v = paddle.to_tensor(np.array([5.0], np.float32), stop_gradient=False)
+    y = x * 2.0
+    y[1] = v[0] * 3.0
+    loss = paddle.sum(y * y)
+    loss.backward()
+    # y = [2, 15, 2, 2]; dloss/dx = 2*y*2 on untouched slots, 0 at slot 1
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 0.0, 8.0, 8.0])
+    # dloss/dv = 2*15*3 = 90
+    np.testing.assert_allclose(v.grad.numpy(), [90.0])
+
+
+def test_setitem_stale_use_raises():
+    x = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    y = x * 2.0
+    z = y * 3.0       # consumer of pre-write y
+    y[0] = 7.0        # in-place write bumps y's version
+    try:
+        paddle.sum(z).backward()
+    except RuntimeError as e:
+        assert "in-place" in str(e)
+    else:
+        raise AssertionError("stale in-place use must raise")
+
+
+def test_setitem_leaf_requires_grad_raises():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    try:
+        x[0] = 2.0
+    except RuntimeError as e:
+        assert "leaf" in str(e)
+    else:
+        raise AssertionError("leaf in-place write must raise")
+    # allowed under no_grad (e.g. optimizer-style updates)
+    with paddle.no_grad():
+        x[0] = 2.0
+    np.testing.assert_allclose(x.numpy(), [2.0, 1.0, 1.0])
+
+
+def test_setitem_value_grad_into_stopped_tensor():
+    # writing a grad-requiring value into a stop_gradient tensor must make
+    # grads flow to the value downstream
+    x = paddle.to_tensor(np.ones(3, np.float32))  # stop_gradient=True
+    v = paddle.to_tensor(2.0, stop_gradient=False)
+    x[0] = v * 2.0
+    loss = paddle.sum(x * 3.0)
+    loss.backward()
+    np.testing.assert_allclose(float(v.grad), 6.0)
+
+
+def test_double_backward_through_recompute_raises():
+    # reentrant recompute detaches its inputs, severing the second-order
+    # path (reference/torch use_reentrant parity) -> must raise clearly
+    from paddle_tpu.distributed.fleet.recompute import recompute
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = recompute(lambda t: t * t * t, x)
+    try:
+        paddle.grad(y, x, create_graph=True)
+    except RuntimeError as e:
+        assert "double backward" in str(e)
+    else:
+        raise AssertionError("recompute double backward must raise")
