@@ -129,19 +129,29 @@ class GradNode:
         cur = self.pending.get(idx)
         self.pending[idx] = g if cur is None else cur + g
 
-    def run_vjp(self):
+    def collect_cts(self, slots, zero_fn, taped_hooks):
+        """Shared cotangent collection: zero-fill missing output slots,
+        apply output hooks (raw-array style or Tensor style), clear pending.
+        Used by all four run_vjp variants (GradNode/_PyLayerNode x
+        plain/taped) so the semantics can't diverge."""
         cts = []
-        for i, (shape, dtype) in enumerate(self.out_avals):
+        for i in slots:
+            shape, dtype = self.out_avals[i]
             g = self.pending.get(i)
             if g is None:
-                g = _zero_cotangent(shape, dtype)
+                g = zero_fn(shape, dtype)
             else:
                 for hook in self.out_hooks.get(i, ()):
-                    res = hook_call(hook, g)
+                    res = hook(g) if taped_hooks else hook_call(hook, g)
                     if res is not None:
                         g = res
             cts.append(g)
         self.pending.clear()  # consumed; a retained graph must start fresh
+        return cts
+
+    def run_vjp(self):
+        cts = self.collect_cts(range(len(self.out_avals)), _zero_cotangent,
+                               taped_hooks=False)
         ct_tree = jax.tree_util.tree_unflatten(self.out_treedef, cts)
         return self.vjp_fn(ct_tree)
 
@@ -161,20 +171,11 @@ class GradNode:
                 "enabled (and not released by a prior backward)")
         inexact_out = [i for i, (_, d) in enumerate(self.out_avals)
                        if jnp.issubdtype(d, jnp.inexact)]
-        cts = []
-        for i in inexact_out:
-            shape, dtype = self.out_avals[i]
-            g = self.pending.get(i)
-            if g is None:
-                g = Tensor._from_data(jnp.zeros(shape, dtype),
-                                      stop_gradient=True)
-            else:
-                for hook in self.out_hooks.get(i, ()):
-                    res = hook(g)
-                    if res is not None:
-                        g = res
-            cts.append(g)
-        self.pending.clear()
+        cts = self.collect_cts(
+            inexact_out,
+            lambda s, d: Tensor._from_data(jnp.zeros(s, d),
+                                           stop_gradient=True),
+            taped_hooks=True)
         n_in = len(self.inputs)
         diff_idx = [i for i, t in enumerate(self.inputs)
                     if jnp.issubdtype(t._data.dtype, jnp.inexact)]
@@ -250,6 +251,16 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
     With _sink (paddle.grad), leaf grads go into the side table keyed by
     id(tensor) instead of .grad — grad() must not touch ANY leaf's .grad,
     including leaves the caller didn't ask about."""
+    backward_multi([(tensor, grad_tensor)], retain_graph=retain_graph,
+                   create_graph=create_graph, _sink=_sink)
+
+
+def backward_multi(pairs, retain_graph: bool = False,
+                   create_graph: bool = False,
+                   _sink: Optional[Dict[int, Any]] = None):
+    """One reverse sweep over the union graph of several (output, grad)
+    roots: every shared node's vjp runs exactly once with all cotangents
+    seeded, instead of once per output."""
     from ..tensor.tensor import Tensor
 
     def leaf_accumulate(t, g):
@@ -265,35 +276,46 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
         else:
             _accumulate_leaf(t, g)
 
-    data = tensor._data
-    if grad_tensor is None:
-        if data.size != 1:
-            raise RuntimeError(
-                "grad_tensor can only be None for scalar outputs "
-                f"(got shape {tuple(data.shape)})")
-        seed = jnp.ones_like(data)
-    else:
-        seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
-        seed = jnp.broadcast_to(seed, data.shape).astype(data.dtype)
-    if create_graph:
-        # a graph-carrying grad_tensor seeds the tape directly (shape must
-        # match); otherwise the seed is a constant
-        if (isinstance(grad_tensor, Tensor) and not grad_tensor.stop_gradient
-                and grad_tensor.shape == tuple(data.shape)):
-            seed = grad_tensor
+    roots: List[GradNode] = []
+    root_ids = set()
+    for tensor, grad_tensor in pairs:
+        data = tensor._data
+        if grad_tensor is None:
+            if data.size != 1:
+                raise RuntimeError(
+                    "grad_tensor can only be None for scalar outputs "
+                    f"(got shape {tuple(data.shape)})")
+            seed = jnp.ones_like(data)
         else:
-            seed = Tensor._from_data(seed, stop_gradient=True)
+            seed = (grad_tensor._data if isinstance(grad_tensor, Tensor)
+                    else jnp.asarray(grad_tensor))
+            seed = jnp.broadcast_to(seed, data.shape).astype(data.dtype)
+        if create_graph:
+            # a graph-carrying grad_tensor seeds the tape directly (shape
+            # must match); otherwise the seed is a constant
+            if (isinstance(grad_tensor, Tensor)
+                    and not grad_tensor.stop_gradient
+                    and grad_tensor.shape == tuple(data.shape)):
+                seed = grad_tensor
+            else:
+                seed = Tensor._from_data(seed, stop_gradient=True)
 
-    root = tensor._grad_node
-    if root is None:
-        if not tensor.stop_gradient:
-            leaf_accumulate(tensor, seed)
+        root = tensor._grad_node
+        if root is None:
+            if not tensor.stop_gradient:
+                leaf_accumulate(tensor, seed)
+            continue
+        root.accumulate(tensor._out_index, seed)
+        if id(root) not in root_ids:
+            root_ids.add(id(root))
+            roots.append(root)
+    if not roots:
         return
 
     # Count reachable consumer edges per node (Kahn over the reverse graph).
-    indeg: Dict[int, int] = {id(root): 0}
-    nodes: Dict[int, GradNode] = {id(root): root}
-    stack = [root]
+    indeg: Dict[int, int] = {id(r): 0 for r in roots}
+    nodes: Dict[int, GradNode] = {id(r): r for r in roots}
+    stack = list(roots)
     while stack:
         n = stack.pop()
         for p in n.producers():
@@ -303,8 +325,7 @@ def backward(tensor, grad_tensor=None, retain_graph: bool = False,
                 nodes[pid] = p
                 stack.append(p)
 
-    root.accumulate(tensor._out_index, seed)
-    queue: List[GradNode] = [root]
+    queue: List[GradNode] = [r for r in roots if indeg[id(r)] == 0]
     while queue:
         n = queue.pop()
         n.check_versions()
@@ -351,9 +372,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
         t.stop_gradient = False
     try:
         with enable_grad() if create_graph else contextlib.nullcontext():
-            for o, go in zip(outputs, grad_outputs):
-                backward(o, go, retain_graph=retain_graph or create_graph,
-                         create_graph=create_graph, _sink=sink)
+            backward_multi(list(zip(outputs, grad_outputs)),
+                           retain_graph=retain_graph or create_graph,
+                           create_graph=create_graph, _sink=sink)
         results = []
         for t in inputs:
             g = sink.get(id(t))

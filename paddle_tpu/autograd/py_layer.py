@@ -35,18 +35,9 @@ class _PyLayerNode(engine.GradNode):
 
     def run_vjp(self):
         from ..tensor.tensor import Tensor
-        cts = []
-        for i, (shape, dtype) in enumerate(self.out_avals):
-            g = self.pending.get(i)
-            if g is None:
-                g = engine._zero_cotangent(shape, dtype)
-            else:
-                for hook in self.out_hooks.get(i, ()):
-                    res = engine.hook_call(hook, g)
-                    if res is not None:
-                        g = res
-            cts.append(Tensor._from_data(g, stop_gradient=True))
-        self.pending.clear()
+        cts = self.collect_cts(range(len(self.out_avals)),
+                               engine._zero_cotangent, taped_hooks=False)
+        cts = [Tensor._from_data(g, stop_gradient=True) for g in cts]
         with engine.no_grad():
             grads = self.layer_cls.backward(self.ctx, *cts)
         if not isinstance(grads, (tuple, list)):
@@ -61,19 +52,11 @@ class _PyLayerNode(engine.GradNode):
         its eager ops land on the tape and the returned grads are themselves
         differentiable (the reference's PyLayer double-grad contract)."""
         from ..tensor.tensor import Tensor
-        cts = []
-        for i, (shape, dtype) in enumerate(self.out_avals):
-            g = self.pending.get(i)
-            if g is None:
-                g = Tensor._from_data(engine._zero_cotangent(shape, dtype),
-                                      stop_gradient=True)
-            else:
-                for hook in self.out_hooks.get(i, ()):
-                    res = hook(g)
-                    if res is not None:
-                        g = res
-            cts.append(g)
-        self.pending.clear()
+        cts = self.collect_cts(
+            range(len(self.out_avals)),
+            lambda s, d: Tensor._from_data(engine._zero_cotangent(s, d),
+                                           stop_gradient=True),
+            taped_hooks=True)
         with engine.enable_grad():
             grads = self.layer_cls.backward(self.ctx, *cts)
         if not isinstance(grads, (tuple, list)):

@@ -67,8 +67,8 @@ class _RecomputeFunction(PyLayer):
                 "(create_graph=True): the recomputed forward runs on "
                 "detached inputs. Compute gradient-penalty terms on a "
                 "non-recomputed block instead.")
-        for o, g in zip(diff_outs, diff_grads):
-            engine.backward(o, g, retain_graph=True)
+        engine.backward_multi(list(zip(diff_outs, diff_grads)),
+                              retain_graph=True)
         return tuple(d.grad if not d.stop_gradient else None
                      for _, d in tensor_inputs)
 

@@ -1,6 +1,8 @@
 """Compilation: dygraph -> XLA (ref: python/paddle/jit/)."""
 import os as _os
 
+from . import dy2static
+from .dy2static import convert_to_static
 from .functional import TracedLayer, functional_call, state_arrays, to_static
 from .save_load import TranslatedLayer, load, save
 from .train_step import TrainStep

@@ -1,0 +1,523 @@
+"""Dynamic-to-static control-flow translation (dy2static).
+
+Ref: python/paddle/jit/dy2static — the reference rewrites Python AST so
+`if`/`while`/`for` over tensor values become ProgramDesc control-flow ops
+(cond/while_op), falling back to plain Python when the predicate is a host
+value. TPU-native equivalent: the same AST pass, but the targets are XLA's
+structured control flow — `lax.cond`, `lax.while_loop`, `lax.fori_loop` —
+selected AT RUNTIME by whether the predicate is a jax tracer:
+
+- eager call / concrete predicate  -> plain Python branch/loop (zero cost)
+- under jit tracing, tensor pred   -> lax.cond / lax.while_loop
+
+The transform:
+  if c:  A            _t, _f = (lifted branch fns over assigned vars)
+  else:  B      ->    vars = _jst.convert_ifelse(c, _t, _f, vars)
+
+  while c: A    ->    vars = _jst.convert_while(cond_fn, body_fn, vars)
+
+  for i in range(n): A  ->  vars = _jst.convert_for_range(n, body_fn, vars)
+
+Loops/branches containing `break`/`continue`/`return` are left untranslated
+(they keep Python semantics eagerly; under tracing jax raises its usual
+concretization error) — the reference handles these with control-flow flag
+rewriting, a documented non-goal here.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+import warnings
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import core as jax_core
+from jax import lax
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (the `_jst` namespace injected into transformed code)
+# ---------------------------------------------------------------------------
+
+def _is_traced(x) -> bool:
+    data = getattr(x, "_data", x)
+    return isinstance(data, jax_core.Tracer)
+
+
+def _pred_value(x):
+    """Concrete bool of an eager predicate."""
+    data = getattr(x, "_data", x)
+    if hasattr(data, "item"):
+        return bool(data.item())
+    return bool(data)
+
+
+def _unwrap_vars(vs):
+    from ..tensor.tensor import Tensor
+    flags, raw = [], []
+    for v in vs:
+        if isinstance(v, Tensor):
+            flags.append(True)
+            raw.append(v._data)
+        else:
+            flags.append(False)
+            raw.append(v)
+    return flags, tuple(raw)
+
+
+def _wrap_vars(flags, raw):
+    from ..tensor.tensor import Tensor
+    return tuple(Tensor._from_data(r) if f else r
+                 for f, r in zip(flags, raw))
+
+
+def _to_carry(raw):
+    """Loop/branch carries must be arrays: lift numeric python scalars,
+    reject unliftable types with a clear message."""
+    out = []
+    for r in raw:
+        if isinstance(r, jax.Array) or hasattr(r, "aval"):
+            out.append(r)
+        elif isinstance(r, (bool, int, float, complex)):
+            out.append(jnp.asarray(r))
+        elif hasattr(r, "__array__"):
+            out.append(jnp.asarray(r))
+        else:
+            raise TypeError(
+                f"dy2static: variable of type {type(r).__name__} is assigned "
+                "inside tensor-dependent control flow and cannot be carried "
+                "through lax.cond/while_loop; hoist it out of the branch or "
+                "keep the predicate a Python value")
+    return tuple(out)
+
+
+class _Undefined:
+    """Sentinel for a variable not bound on (at least) one path through a
+    converted branch (the reference's UndefinedVar): any USE raises with a
+    clear message instead of a confusing NameError downstream."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            "dy2static: this variable is only assigned on one path of a "
+            "tensor-dependent branch, so it has no defined value here; "
+            "assign it on every path (or before the `if`) to use it after")
+
+    __getattr__ = __call__ = __add__ = __radd__ = __sub__ = __mul__ = _raise
+    __truediv__ = __getitem__ = __iter__ = __bool__ = __float__ = _raise
+
+
+UNDEF = _Undefined()
+
+
+def preval(name, local_ns):
+    """Pre-branch value of `name`, or UNDEF if unbound (generated code)."""
+    return local_ns.get(name, UNDEF)
+
+
+def convert_ifelse(pred, true_fn, false_fn, vs):
+    """vs: tuple of pre-values of the variables assigned in either branch.
+
+    Concrete predicate: run one branch, plain Python. Traced predicate:
+    run BOTH (pure) branches under the trace and jnp.where-select the
+    outputs — select semantics, which is how XLA lowers small conditionals
+    anyway, and which handles variables first bound inside the branches
+    without the reference's undefined-var ceremony. A position left unbound
+    by one branch becomes UNDEF (raises on use). Data-dependent trip counts
+    (the case where avoiding both-paths execution actually matters) use
+    real lax loops — see convert_while/convert_for_range."""
+    from ..tensor.tensor import Tensor
+    if not _is_traced(pred):
+        return true_fn(*vs) if _pred_value(pred) else false_fn(*vs)
+    t_out = true_fn(*vs)
+    f_out = false_fn(*vs)
+    pred_raw = getattr(pred, "_data", pred)
+    out = []
+    for a, b in zip(t_out, f_out):
+        if a is UNDEF or b is UNDEF:
+            out.append(UNDEF)
+            continue
+        tensorish = isinstance(a, Tensor) or isinstance(b, Tensor)
+        ar = getattr(a, "_data", a)
+        br = getattr(b, "_data", b)
+        sel = jnp.where(pred_raw, ar, br)
+        out.append(Tensor._from_data(sel) if tensorish or _is_traced(sel)
+                   else sel)
+    return tuple(out)
+
+
+def convert_while(cond_fn, body_fn, vs):
+    if not _is_traced(cond_fn(*vs)):
+        while _pred_value(cond_fn(*vs)):
+            vs = body_fn(*vs)
+        return vs
+    if any(v is UNDEF for v in vs):
+        raise ValueError(
+            "dy2static: every variable assigned in a tensor-dependent while "
+            "loop must be bound before the loop (the trip count may be zero)")
+    flags, raw = _unwrap_vars(vs)
+
+    def cond(carry):
+        p = cond_fn(*_wrap_vars(flags, carry))
+        return getattr(p, "_data", p)
+
+    def body(carry):
+        outs = body_fn(*_wrap_vars(flags, carry))
+        _, raw_out = _unwrap_vars(outs)
+        return _to_carry(raw_out)
+
+    out = lax.while_loop(cond, body, _to_carry(raw))
+    return _wrap_vars(flags, out)
+
+
+def convert_for_range(bounds, body_fn, vs):
+    """bounds: (start, stop, step) as written in `range(...)`. body_fn takes
+    (i, *vars) and returns the updated vars."""
+    from ..tensor.tensor import Tensor
+    start, stop, step = bounds
+    if not any(_is_traced(b) for b in bounds):
+        s = [int(getattr(b, "_data", b)) if not isinstance(b, int) else b
+             for b in bounds]
+        for i in range(*s):
+            vs = body_fn(i, *vs)
+        return vs
+    if isinstance(step, Tensor) or _is_traced(step):
+        raise NotImplementedError(
+            "dy2static: tensor-valued range() step is not supported; use a "
+            "while loop")
+    if any(v is UNDEF for v in vs):
+        raise ValueError(
+            "dy2static: every variable assigned in a tensor-bounded for loop "
+            "must be bound before the loop (the trip count may be zero); "
+            "initialize it before the `for`")
+    flags, raw = _unwrap_vars(vs)
+    lo = getattr(start, "_data", start)
+    hi = getattr(stop, "_data", stop)
+    if step not in (1, None):
+        # fori_loop is unit-step; fold the step into the index
+        n = (hi - lo + step - (1 if step > 0 else -1)) // step
+        def body(t, carry):
+            i = lo + t * step
+            outs = body_fn(Tensor._from_data(jnp.asarray(i)),
+                           *_wrap_vars(flags, carry))
+            _, raw_out = _unwrap_vars(outs)
+            return _to_carry(raw_out)
+        out = lax.fori_loop(0, n, body, _to_carry(raw))
+    else:
+        def body(i, carry):
+            outs = body_fn(Tensor._from_data(jnp.asarray(i)),
+                           *_wrap_vars(flags, carry))
+            _, raw_out = _unwrap_vars(outs)
+            return _to_carry(raw_out)
+        out = lax.fori_loop(lo, hi, body, _to_carry(raw))
+    return _wrap_vars(flags, out)
+
+
+def convert_bool(x):
+    """`if t and u` style: bool() on a traced tensor must raise jax's usual
+    error; on eager tensors return the python bool."""
+    if _is_traced(x):
+        return x  # let the caller (convert_ifelse) handle the tracer
+    return x
+
+
+# ---------------------------------------------------------------------------
+# the AST pass
+# ---------------------------------------------------------------------------
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by assignments/augassigns/for-targets within a block
+    (not descending into nested function/class defs)."""
+
+    def __init__(self):
+        self.names = []
+
+    def _add(self, target):
+        if isinstance(target, ast.Name):
+            if target.id not in self.names:
+                self.names.append(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._add(e)
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._add(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._add(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs have their own scope
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        pass
+
+
+def _assigned(stmts) -> list:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _contains_flow_escape(stmts) -> bool:
+    """break/continue/return anywhere in the block (not in nested defs)."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Break(self, n):
+            self.found = True
+
+        def visit_Continue(self, n):
+            self.found = True
+
+        def visit_Return(self, n):
+            self.found = True
+
+        def visit_FunctionDef(self, n):
+            pass
+
+        def visit_AsyncFunctionDef(self, n):
+            pass
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _make_fn(name, argnames, body, returns):
+    """def name(a, b, ...): <body>; return (a', b', ...)"""
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=a) for a in argnames],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+    ret = ast.Return(value=ast.Tuple(
+        elts=[_name(r, ast.Load()) for r in returns], ctx=ast.Load()))
+    return ast.FunctionDef(name=name, args=args, body=body + [ret],
+                           decorator_list=[], returns=None, type_params=[])
+
+
+def _assign_tuple(names, value):
+    if len(names) == 1:
+        target = ast.Tuple(elts=[_name(names[0], ast.Store())],
+                           ctx=ast.Store())
+    else:
+        target = ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                           ctx=ast.Store())
+    return ast.Assign(targets=[target], value=value)
+
+
+def _call_jst(fname, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst", ast.Load()), attr=fname,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _prevals_tuple(names):
+    """(_jst.preval('a', locals()), ...) — reads that tolerate names not yet
+    bound (first bound inside the branch/loop body)."""
+    return ast.Tuple(
+        elts=[_call_jst("preval",
+                        [ast.Constant(value=n),
+                         ast.Call(func=_name("locals", ast.Load()),
+                                  args=[], keywords=[])])
+              for n in names], ctx=ast.Load())
+
+
+class _Dy2StaticTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains_flow_escape(node.body) or _contains_flow_escape(node.orelse):
+            return node  # python semantics preserved; traced pred will raise
+        assigned = _assigned(node.body + node.orelse)
+        if not assigned:
+            # branch with no bindings (e.g. only side-effect calls): keep
+            return node
+        uid = self._uid()
+        tname, fname = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        true_fn = _make_fn(tname, assigned, node.body, assigned)
+        false_fn = _make_fn(fname, assigned,
+                            node.orelse or [ast.Pass()], assigned)
+        call = _call_jst("convert_ifelse", [
+            node.test,
+            _name(tname, ast.Load()),
+            _name(fname, ast.Load()),
+            _prevals_tuple(assigned),
+        ])
+        return [true_fn, false_fn, _assign_tuple(assigned, call)]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains_flow_escape(node.body):
+            return node
+        loop_vars = _assigned(node.body)  # cond reads non-assigned names
+        if not loop_vars:                 # via closure; only stores carry
+            return node
+        uid = self._uid()
+        cname, bname = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        cond_fn = ast.FunctionDef(
+            name=cname,
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=a) for a in loop_vars],
+                               vararg=None, kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        body_fn = _make_fn(bname, loop_vars, node.body, loop_vars)
+        call = _call_jst("convert_while", [
+            _name(cname, ast.Load()),
+            _name(bname, ast.Load()),
+            _prevals_tuple(loop_vars),
+        ])
+        return [cond_fn, body_fn, _assign_tuple(loop_vars, call)]
+
+    # -- for i in range(...) ----------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse or _contains_flow_escape(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords):
+            return node
+        assigned = [n for n in _assigned(node.body) if n != node.target.id]
+        if not assigned:
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            bounds = [ast.Constant(value=0), rargs[0], ast.Constant(value=1)]
+        elif len(rargs) == 2:
+            bounds = [rargs[0], rargs[1], ast.Constant(value=1)]
+        else:
+            bounds = list(rargs)
+        uid = self._uid()
+        bname = f"__dy2st_forbody_{uid}"
+        body_fn = _make_fn(bname, [node.target.id] + assigned, node.body,
+                           assigned)
+        call = _call_jst("convert_for_range", [
+            ast.Tuple(elts=bounds, ctx=ast.Load()),
+            _name(bname, ast.Load()),
+            _prevals_tuple(assigned),
+        ])
+        return [body_fn, _assign_tuple(assigned, call)]
+
+
+# ---------------------------------------------------------------------------
+# entry: transform a function's source
+# ---------------------------------------------------------------------------
+
+_JST_NS = types.SimpleNamespace(
+    convert_ifelse=convert_ifelse,
+    convert_while=convert_while,
+    convert_for_range=convert_for_range,
+    convert_bool=convert_bool,
+    preval=preval,
+)
+
+
+_STRIP_DECORATORS = ("to_static", "jit.to_static", "paddle.jit.to_static",
+                     "dy2static", "convert_control_flow")
+
+
+def _should_strip(dec) -> bool:
+    # call-form decorators (@to_static(input_spec=...)) match on their func
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    expr = ast.unparse(dec) if hasattr(ast, "unparse") else ""
+    return any(expr.endswith(s) for s in _STRIP_DECORATORS)
+
+
+@functools.lru_cache(maxsize=256)
+def _transform_cached(fn):
+    return _transform(fn)
+
+
+def _transform(fn: Callable) -> Callable:
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn  # no source (C ext, REPL lambda): fall back to trace-only
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = [d for d in fdef.decorator_list
+                           if not _should_strip(d)]
+    transformer = _Dy2StaticTransformer()
+    new_tree = transformer.visit(tree)
+    if transformer.counter == 0:
+        return fn  # nothing to convert
+    ast.fix_missing_locations(new_tree)
+    ns = dict(fn.__globals__)
+    # closures: snapshot cell contents into the namespace (read-only view)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                ns[name] = cell.cell_contents
+            except ValueError:
+                pass
+    ns["_jst"] = _JST_NS
+    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    exec(code, ns)
+    new_fn = ns[fdef.name]
+    new_fn.__wrapped__ = fn
+    return new_fn
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """Public: AST-translate tensor control flow in `fn`. Falls back to the
+    original function when source is unavailable or nothing needs converting
+    (the reference's fallback-to-eager contract)."""
+    try:
+        return _transform_cached(fn)
+    except TypeError:  # unhashable callables
+        return _transform(fn)
+    except Exception as e:  # transform bug: never break the user's function
+        warnings.warn(f"dy2static: falling back to trace-only for "
+                      f"{getattr(fn, '__name__', fn)}: {e}")
+        return fn

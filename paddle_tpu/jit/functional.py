@@ -117,10 +117,17 @@ class TracedLayer:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               full_graph=True, backend=None):
-    """paddle.jit.to_static parity: Layer -> TracedLayer; function -> jitted."""
+    """paddle.jit.to_static parity: Layer -> TracedLayer; function -> jitted.
+
+    Function forwards run through the dy2static AST pass first (jit/
+    dy2static.py): `if`/`while`/`for range` over tensor values become
+    lax.cond / while_loop / fori_loop under tracing, plain Python eagerly."""
     def decorate(obj):
         if isinstance(obj, Layer):
             return TracedLayer(obj)
+
+        from .dy2static import convert_to_static
+        converted = convert_to_static(obj)
 
         @functools.wraps(obj)
         def wrapper(*args, **kwargs):
@@ -132,7 +139,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                     t_args = [Tensor._from_data(a) if _is_array(a) else a
                               for a in arg_arrays]
                     with engine.no_grad():
-                        out = obj(*t_args, **kwargs)
+                        out = converted(*t_args, **kwargs)
                     return jax.tree_util.tree_map(
                         lambda x: x._data if isinstance(x, Tensor) else x, out,
                         is_leaf=lambda x: isinstance(x, Tensor))

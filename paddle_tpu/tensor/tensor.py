@@ -311,6 +311,14 @@ class Tensor:
     def __int__(self):
         return int(self.numpy())
 
+    def __index__(self):
+        # lets eager integer tensors drive range()/slicing (paddle parity)
+        if not jnp.issubdtype(self._data.dtype, jnp.integer):
+            raise TypeError(
+                f"only integer tensors can be used as an index, got "
+                f"{self._data.dtype}")
+        return int(self.numpy())
+
     def __float__(self):
         return float(self.numpy())
 
