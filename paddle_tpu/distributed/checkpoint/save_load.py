@@ -1,13 +1,47 @@
-"""Sharded async checkpoint via orbax/TensorStore."""
+"""Sharded checkpoint save/load with async (TensorStore-style) writes.
+
+Ref: SURVEY §5.4 — the reference's distributed checkpoint saves per-rank
+shards with metadata; the TPU equivalent is an async, sharded array
+checkpoint keyed by mesh/sharding metadata. Here:
+
+- save_state_dict(async_save=True) snapshots device arrays to host (the
+  only part that must block the training loop) and hands the actual write
+  to a background thread, returning an AsyncSaveHandle. Step time hides the
+  file I/O entirely; callers (or the next save) wait on the handle.
+- every leaf's sharding metadata (mesh axis names/shape + PartitionSpec)
+  is written alongside the arrays, so a load onto a DIFFERENT topology can
+  verify compatibility and reshard (load re-shards onto each target
+  tensor's current layout — single-controller, the host sees every shard).
+"""
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict
+import threading
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
 from ...tensor.tensor import Tensor
+
+
+def _leaf_sharding_meta(v):
+    """JSON-able sharding metadata for a jax.Array leaf (None for host)."""
+    data = v._data if isinstance(v, Tensor) else v
+    sh = getattr(data, "sharding", None)
+    if sh is None or not hasattr(sh, "spec"):
+        return None
+    try:
+        mesh = sh.mesh
+        return {
+            "mesh_axes": list(mesh.axis_names),
+            "mesh_shape": [int(s) for s in mesh.devices.shape],
+            "spec": [list(p) if isinstance(p, (tuple, list)) else p
+                     for p in sh.spec],
+        }
+    except Exception:
+        return None
 
 
 def _to_arrays(state_dict):
@@ -21,29 +55,140 @@ def _to_arrays(state_dict):
         state_dict, is_leaf=lambda v: isinstance(v, Tensor))
 
 
+class _MetaLeaf:
+    """Opaque wrapper: not a registered pytree node, so tree flattening
+    treats each per-leaf meta dict (or None) as a single leaf instead of
+    shredding the dict into scalars."""
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+
+def _sharding_tree(state_dict):
+    return jax.tree_util.tree_map(
+        lambda v: _MetaLeaf(_leaf_sharding_meta(v)), state_dict,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+class AsyncSaveHandle:
+    """Future-like handle for a background checkpoint write."""
+
+    def __init__(self, thread: threading.Thread):
+        self._thread = thread
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint write still in progress")
+        if self._error is not None:
+            raise self._error
+
+
+_pending_lock = threading.Lock()
+_pending: Dict[str, AsyncSaveHandle] = {}
+
+
+def wait_all_async_saves():
+    """Block until every in-flight async checkpoint write has finished."""
+    with _pending_lock:
+        handles = list(_pending.values())
+    for h in handles:
+        h.wait()
+
+
+def _write_checkpoint(path: str, arrays, meta):
+    import shutil
+
+    import orbax.checkpoint as ocp
+    tmp, old = path + ".tmp", path + ".old"
+    for leftover in (tmp, old):  # residue of an earlier crashed save
+        if os.path.exists(leftover):
+            shutil.rmtree(leftover)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(tmp, arrays, force=True)
+    with open(os.path.join(tmp, "sharding_meta.json"), "w") as f:
+        json.dump(meta, f)
+    # crash-safe publish: the previous complete checkpoint is moved aside
+    # (rename, not delete) before the new one is renamed in, so a kill at
+    # any instant leaves either `path` or `path + ".old"` complete —
+    # load_state_dict falls back to ".old" if `path` is missing.
+    if os.path.exists(path):
+        os.replace(path, old)
+    os.replace(tmp, path)
+    if os.path.exists(old):
+        shutil.rmtree(old)
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False):
-    """Single-controller save: arrays are host-gathered and written once;
-    load_state_dict reshards onto the target tensors' (possibly different)
-    mesh layout. Multi-host owner-writes-its-shard saving would pass the
-    jax.Arrays straight to orbax with per-leaf shardings instead — not
-    needed in this single-controller deployment."""
-    import orbax.checkpoint as ocp
-    arrays = _to_arrays(state_dict)
+    """Save `state_dict` to `path`. With async_save=True the device->host
+    snapshot happens now (cheap) and the write runs in a background thread;
+    returns an AsyncSaveHandle. A second save to the same path waits for
+    the first (ordering is preserved per-path)."""
+    arrays = _to_arrays(state_dict)  # snapshot: values at call time
+    # per-leaf meta, aligned with the flatten order of `arrays`' leaves
+    # (same structure, every leaf mapped — None kept for unsharded leaves)
+    flat = [m.v for m in jax.tree_util.tree_leaves(_sharding_tree(state_dict))]
+    meta = {"leaf_shardings": flat}
     path = os.path.abspath(path)
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, arrays, force=True)
+
+    # a save (sync or async) to a path with an in-flight write must wait:
+    # both would otherwise race on the same tmp dir and publish rename
+    with _pending_lock:
+        prev = _pending.get(path)
+    if prev is not None:
+        prev.wait()
+
+    if not async_save:
+        _write_checkpoint(path, arrays, meta)
+        return None
+
+    handle_box = {}
+
+    def run():
+        try:
+            _write_checkpoint(path, arrays, meta)
+        except BaseException as e:  # surfaced on wait()
+            handle_box["h"]._error = e
+        finally:
+            with _pending_lock:
+                _pending.pop(path, None)
+
+    thread = threading.Thread(target=run, name=f"ckpt-save:{path}",
+                              daemon=True)
+    handle = AsyncSaveHandle(thread)
+    handle_box["h"] = handle
+    with _pending_lock:
+        _pending[path] = handle
+    thread.start()
+    return handle
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0):
     """Fills `state_dict`'s tensors in place, resharding saved arrays onto
-    each tensor's current sharding."""
+    each tensor's current sharding. Waits for any in-flight async save to
+    `path` first."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
+    with _pending_lock:
+        prev = _pending.get(path)
+    if prev is not None:
+        prev.wait()
+    if not os.path.exists(path) and os.path.isdir(path + ".old"):
+        # a save crashed between moving the old checkpoint aside and
+        # publishing the new one: the ".old" copy is the newest complete one
+        path = path + ".old"
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(path)
+    if isinstance(restored, dict):
+        restored.pop("sharding_meta.json", None)
 
     def fill(target, saved):
         """Recursively fill Tensor leaves in place; returns the new value for
@@ -60,6 +205,12 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             for k in target:
                 if k in saved:
                     target[k] = fill(target[k], saved[k])
+            for k in saved:
+                # structure the target hasn't materialized yet (e.g. an
+                # optimizer's lazily-created moment dicts before step 1)
+                # is adopted wholesale
+                if k not in target:
+                    target[k] = _adopt(saved[k])
             return target
         if isinstance(target, (list, tuple)) and isinstance(saved, (list, tuple)):
             if len(target) != len(saved):
@@ -75,3 +226,27 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
 
     fill(state_dict, restored)
     return state_dict
+
+
+def _adopt(saved):
+    """Convert restored host values to Tensor-leaved structures."""
+    if isinstance(saved, dict):
+        return {k: _adopt(v) for k, v in saved.items()}
+    if isinstance(saved, (list, tuple)):
+        return type(saved)(_adopt(v) for v in saved)
+    if isinstance(saved, np.ndarray):
+        return Tensor._from_data(jax.numpy.asarray(saved))
+    return saved
+
+
+def load_sharding_meta(path: str):
+    """The per-leaf sharding metadata recorded at save time (or None).
+    Entries align with the save-time tree_leaves order of the state dict."""
+    path = os.path.abspath(path)
+    if not os.path.exists(path) and os.path.isdir(path + ".old"):
+        path = path + ".old"
+    p = os.path.join(path, "sharding_meta.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)

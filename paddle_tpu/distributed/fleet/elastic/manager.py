@@ -118,4 +118,13 @@ class ElasticManager:
         import sys
         print(f"[elastic] rank {self.rank}: peer rank {dead_rank} missed "
               f"heartbeats; exiting for checkpoint-restart", file=sys.stderr)
+        # drain in-flight async checkpoint writes so the restart resumes
+        # from the newest complete save (writes are atomic tmp+rename, so
+        # even a hard kill can't corrupt — this just avoids losing the
+        # latest round)
+        try:
+            from ...checkpoint.save_load import wait_all_async_saves
+            wait_all_async_saves()
+        except Exception:
+            pass
         os._exit(1)

@@ -340,6 +340,11 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
+        self._mp_pool = None
         self._iterable = isinstance(dataset, IterableDataset)
         if not self._iterable:
             if batch_sampler is not None:
@@ -352,6 +357,17 @@ class DataLoader:
     def __iter__(self):
         if self._iterable:
             return _IterableLoaderIter(self)
+        if self.num_workers > 0 and self.use_shared_memory:
+            # PROCESS workers + shared-memory batch transport (reference
+            # parity for Python-heavy __getitem__ that threads can't speed
+            # up). Children must stay jax-free: use numpy-producing datasets
+            # here (TensorDataset slices jax arrays — keep it on threads).
+            from .multiprocess import MultiprocessLoaderIter, _WorkerPool
+            if self.persistent_workers:
+                if self._mp_pool is None or self._mp_pool.closed:
+                    self._mp_pool = _WorkerPool(self)
+                return MultiprocessLoaderIter(self, pool=self._mp_pool)
+            return MultiprocessLoaderIter(self)
         return _DataLoaderIter(self)
 
     def __len__(self):

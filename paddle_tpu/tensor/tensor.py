@@ -20,6 +20,14 @@ from ..framework import dtype as dtype_mod
 from ..framework import place as place_mod
 
 
+# auto-generated tensor names go through unique_name so that
+# utils.unique_name.guard() makes naming reproducible (reference parity:
+# optimizer accumulator keys are parameter names, which must be stable
+# across a checkpoint-resume process restart)
+from ..utils.unique_name import generate as _gen_name  # no import cycle:
+# unique_name only needs contextlib
+
+
 def _is_tensor(x):
     return isinstance(x, Tensor)
 
@@ -57,8 +65,7 @@ class Tensor:
         self._hooks = []
         self._inplace_version = 0
         if name is None:
-            Tensor._counter += 1
-            name = f"generated_tensor_{Tensor._counter}"
+            name = _gen_name("generated_tensor")
         self.name = name
 
     # -- construction ------------------------------------------------------
@@ -73,8 +80,7 @@ class Tensor:
         t.persistable = False
         t._hooks = []
         t._inplace_version = 0
-        Tensor._counter += 1
-        t.name = f"generated_tensor_{Tensor._counter}"
+        t.name = _gen_name("generated_tensor")
         return t
 
     # -- metadata ----------------------------------------------------------
