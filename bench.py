@@ -68,6 +68,48 @@ def run_config(config, batch, seq, dev):
     return mfu, tok_s, dt, float(jax.device_get(loss))
 
 
+HBM_BW = {  # per-chip HBM bandwidth, bytes/s
+    "v5e": 819e9, "v5litepod": 819e9, "v5 lite": 819e9,
+    "v5p": 2765e9, "v4": 1228e9, "v6e": 1640e9, "cpu": 50e9,
+}
+
+
+def run_decode(config, batch, dev, prompt_len=128, new_tokens=128):
+    """Warm greedy-generation latency: returns (ms_per_step, tok_s,
+    floor_ms). The whole continuation is ONE device dispatch (lax.scan), so
+    per-step time is on-chip cost, not tunnel round-trips. floor_ms is the
+    weight-read bound: decode is HBM-bound, every step streams all params
+    once (KV cache traffic is comparatively small at this context)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import (count_params, greedy_generate,
+                                         init_llama_params)
+    params = init_llama_params(config, seed=0)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, config.vocab_size,
+                         (batch, prompt_len)).astype(np.int32)
+
+    def timed(n_new):
+        greedy_generate(params, prompt, config, n_new)  # compile
+        reps = 3 if dev.platform != "cpu" else 1
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            greedy_generate(params, prompt, config, n_new)
+        return (time.perf_counter() - t0) / reps
+
+    # subtract the prefill+first-token pass (max_new_tokens=1 stops there)
+    # so ms_per_step is the decode-scan cost the floor applies to
+    t_prefill = timed(1)
+    dt = timed(new_tokens) - t_prefill
+    n_steps = new_tokens - 1
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    bw = next((v for k, v in HBM_BW.items() if k in kind), HBM_BW["cpu"])
+    itemsize = jnp.dtype(config.dtype).itemsize
+    bytes_per_step = count_params(config) * itemsize  # weights read per token
+    floor_ms = bytes_per_step / bw * 1e3
+    del params
+    return dt / n_steps * 1e3, batch * n_steps / dt, floor_ms
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -115,6 +157,22 @@ def main():
             "layers": config_hd64.num_hidden_layers,
             "head_dim": config_hd64.head_dim,
         }
+
+    # KV-cache greedy decode (whole continuation = one dispatch). ms/step is
+    # bounded below by streaming all bf16 weights from HBM once per step
+    # (weight_floor_ms); tok/s scales with batch at near-constant step time.
+    decode = {}
+    for name, cfg in [("flagship", config)] + (
+            [("hd64", config_hd64)] if config_hd64 is not None else []):
+        for b in (1, 8):
+            mspt, tok_s_d, floor = run_decode(cfg, b, dev)
+            decode[f"{name}_b{b}"] = {
+                "ms_per_step": round(mspt, 2),
+                "tokens_per_sec": round(tok_s_d, 1),
+                "weight_floor_ms": round(floor, 2),
+                "x_of_floor": round(mspt / floor, 2),
+            }
+    detail["decode"] = decode
 
     print(json.dumps({
         "metric": "llama_train_mfu",

@@ -77,11 +77,29 @@ class TrainStep:
                 for k in self.trainable_keys}
             buffers = {k: jax.device_put(v, NamedSharding(mesh, P()))
                        for k, v in buffers.items()}
+            # the stage-1 vs stage-2 distinction (ZeRO): stage 1 keeps grads
+            # replicated (one all-reduce, update gathers from sharded
+            # states); stage 2/3 constrain grads onto the sharding axis, so
+            # XLA lowers the grad sum to a reduce-scatter (half the grad
+            # traffic — the reference's stage-2 win) and each rank updates
+            # only its shard
+            self.grad_shardings = {}
+            for k in self.trainable_keys:
+                p = self.param_objs[k]
+                lvl = getattr(p, "sharding_level", None)
+                os_spec = getattr(p, "opt_state_pspec", None)
+                if lvl in ("os_g", "p_g_os") and os_spec is not None:
+                    self.grad_shardings[k] = NamedSharding(mesh, os_spec)
+                elif lvl == "os":
+                    self.grad_shardings[k] = NamedSharding(mesh, P())
+        else:
+            self.grad_shardings = {}
         self.params = params
         self.buffers = buffers
         self.opt_states = opt_states
 
         param_shardings_ref = getattr(self, "param_shardings", None)
+        grad_shardings_ref = self.grad_shardings
         clip = optimizer._grad_clip
         clip_norm = getattr(clip, "clip_norm", None) if clip is not None else None
         update_rule = optimizer._update
@@ -113,6 +131,12 @@ class TrainStep:
             (loss, new_buffers), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(train_params, frozen_params,
                                             buffers, batch, rng)
+            if grad_shardings_ref:
+                grads = {
+                    k: jax.lax.with_sharding_constraint(
+                        g, grad_shardings_ref[k])
+                    if k in grad_shardings_ref else g
+                    for k, g in grads.items()}
             if clip_norm is not None:
                 gnorm = jnp.sqrt(sum(
                     jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -141,7 +165,9 @@ class TrainStep:
         donate_args = (0, 1, 2) if donate else ()
         self._compiled = jax.jit(step_fn, donate_argnums=donate_args)
 
-    def __call__(self, *inputs, labels=None):
+    def _prepare(self, inputs, labels):
+        """Shared __call__/compiled_hlo preamble: the batch pytree and the
+        param split, exactly as the compiled step consumes them."""
         if labels is None:
             *inputs, labels = inputs
             labels = [labels]
@@ -154,8 +180,12 @@ class TrainStep:
         train_params = {k: self.params[k] for k in self.trainable_keys}
         frozen = {k: v for k, v in self.params.items()
                   if k not in set(self.trainable_keys)}
-        self._rng, sub = jax.random.split(self._rng)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        return batch, train_params, frozen, lr
+
+    def __call__(self, *inputs, labels=None):
+        batch, train_params, frozen, lr = self._prepare(list(inputs), labels)
+        self._rng, sub = jax.random.split(self._rng)
         new_p, new_s, new_b, loss = self._compiled(
             train_params, self.opt_states, self.buffers, frozen, batch, sub, lr)
         self.params.update(new_p)
@@ -163,6 +193,15 @@ class TrainStep:
         self.buffers = new_b
         self._step_count += 1
         return Tensor._from_data(loss)
+
+    def compiled_hlo(self, *inputs, labels=None) -> str:
+        """Post-SPMD-partitioning HLO of the step (for inspecting which
+        collectives XLA emitted — e.g. ZeRO stage-2's grad reduce-scatter)."""
+        batch, train_params, frozen, lr = self._prepare(list(inputs), labels)
+        lowered = self._compiled.lower(train_params, self.opt_states,
+                                       self.buffers, frozen, batch,
+                                       self._rng, lr)
+        return lowered.compile().as_text()
 
     def _place_batch(self, x):
         arr = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))

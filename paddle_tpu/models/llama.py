@@ -394,8 +394,13 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
     cos = lax.dynamic_slice_in_dim(cos_all, pos, 1, 0)
     sin = lax.dynamic_slice_in_dim(sin_all, pos, 1, 0)
 
-    def layer_step(h, xs):
-        p, k_cache, v_cache = xs
+    def layer_step(carry, xs):
+        # full stacked caches ride the CARRY (in-place loop state, buffer
+        # aliased across iterations), NOT xs/ys: a ys cache would be copied
+        # wholesale every layer of every token (~full-cache HBM traffic per
+        # step — measured 2.5x decode slowdown at b8)
+        h, kc, vc = carry
+        p, layer = xs
         hd = c.head_dim
         nh = p["q_proj"].shape[-1] // hd
         nkv = p["k_proj"].shape[-1] // hd
@@ -407,10 +412,12 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
         k = apply_rope(k, cos, sin)
 
         zero = jnp.zeros((), jnp.int32)
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (zero, pos, zero, zero))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (zero, pos, zero, zero))
+        kc = lax.dynamic_update_slice(
+            kc, k.astype(kc.dtype)[None], (layer, zero, pos, zero, zero))
+        vc = lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype)[None], (layer, zero, pos, zero, zero))
+        k_cache = lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
+        v_cache = lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
         # grouped-query scores against the unrepeated cache: no [B,T,NH,HD]
         # head-repeat temporaries in the decode hot loop
         rep = nh // nkv
@@ -429,10 +436,12 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
         x2 = fused_rms_norm(h[:, None], p["post_norm"], c.rms_norm_eps)[:, 0]
         gated = jax.nn.silu(x2 @ p["gate_proj"]) * (x2 @ p["up_proj"])
         h = h + gated @ p["down_proj"]
-        return h, (k_cache, v_cache)
+        return (h, kc, vc), None
 
-    h, (new_k, new_v) = lax.scan(layer_step, h,
-                                 (params["layers"], cache["k"], cache["v"]))
+    n_layers = cache["k"].shape[0]
+    (h, new_k, new_v), _ = lax.scan(
+        layer_step, (h, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)))
     logits = llama_logits(params, h[:, None], config)[:, 0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v,
                                         "pos": pos + 1}

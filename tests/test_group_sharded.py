@@ -99,3 +99,40 @@ def test_save_group_sharded_model(tmp_path):
     save_group_sharded_model(model, str(tmp_path), optimizer=opt)
     assert (tmp_path / "model.pdparams").exists()
     assert (tmp_path / "model.pdopt").exists()
+
+
+def test_stage2_grads_reduce_scattered_vs_stage1():
+    """The stage-1 vs stage-2 distinction, observable in the compiled HLO:
+    stage 1 all-reduces FULL-shape grads once over the whole mesh; stage 2
+    constrains grads onto the 'sharding' axis, so the partitioner reduces
+    shard-sized grad pieces over the sharding groups (reduce-scatter
+    traffic — each rank only materializes its grad shard)."""
+    import re
+
+    def hlo_for(level):
+        model, opt = _make_model_and_opt()
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+        # sharding subdivides data parallelism (reference ZeRO): batch is
+        # split over dp AND sharding ranks
+        step = TrainStep(model, _loss_fn, opt, mesh=_mesh(),
+                         batch_spec=P(("dp", "sharding")))
+        x, y = _batch()
+        return step.compiled_hlo(x, labels=y)
+
+    def shard_shape_collectives(hlo):
+        # Linear(16, HIDDEN) weight grad is [HIDDEN,16]; its 4-way shard is
+        # [HIDDEN/4,16]. Count collectives on shard-sized operands.
+        return [ln for ln in hlo.splitlines()
+                if re.search(r"all-reduce\(|reduce-scatter\(", ln)
+                and f"f32[{HIDDEN // 4},16]" in ln]
+
+    hlo1, hlo2 = hlo_for("os"), hlo_for("os_g")
+    assert not shard_shape_collectives(hlo1), \
+        "stage 1 must not reduce shard-sized grads"
+    assert shard_shape_collectives(hlo2), \
+        "stage 2 must reduce shard-sized grad pieces (reduce-scatter)"
+    # stage 1 still all-reduces the full-shape grad somewhere
+    full = [ln for ln in hlo1.splitlines()
+            if re.search(r"all-reduce\(|reduce-scatter\(", ln)
+            and f"f32[{HIDDEN},16]" in ln]
+    assert full, "stage 1 should all-reduce full-shape grads"
