@@ -8,10 +8,12 @@ init_server/init_worker) over the framework RPC layer. Dense training belongs
 on the SPMD collective path; PS covers the huge-sparse-embedding case where
 tables exceed device memory and live host-side.
 """
-from .service import (create_dense_table, create_sparse_table, pull_dense,
-                      pull_sparse, push_dense, push_sparse, stat)
+from .embedding import SparseEmbedding
+from .service import (create_dense_table, create_sparse_table, drop_table,
+                      load_table, pull_dense, pull_sparse, push_dense,
+                      push_sparse, save_table, stat)
 from .ps import PSClient, PSServer
 
-__all__ = ["PSServer", "PSClient", "create_dense_table",
-           "create_sparse_table", "pull_dense", "push_dense", "pull_sparse",
-           "push_sparse", "stat"]
+__all__ = ["PSServer", "PSClient", "SparseEmbedding", "create_dense_table",
+           "create_sparse_table", "drop_table", "load_table", "pull_dense",
+           "push_dense", "pull_sparse", "push_sparse", "save_table", "stat"]

@@ -1,0 +1,72 @@
+"""PS-backed sparse embedding layer (ref: paddle.static.nn.sparse_embedding
++ the distributed lookup_table op wired to the PS pull/push accessors).
+
+The huge table lives host-side on the parameter servers; the device only
+ever sees the pulled rows for the current batch. Forward pulls rows (an rpc
+per shard) and enters them into the autograd graph through a PyLayer whose
+backward pushes the row gradients back to the servers — the optimizer for
+these rows is the TABLE's accessor (server-side), not the device optimizer,
+exactly the reference's split."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...autograd.py_layer import PyLayer
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor
+
+
+class _PSLookup(PyLayer):
+    @staticmethod
+    def forward(ctx, anchor, ids_np, rows_np, client, table, lr):
+        ctx.ids = ids_np
+        ctx.client = client
+        ctx.table = table
+        ctx.lr = lr
+        import jax.numpy as jnp
+        return Tensor._from_data(jnp.asarray(rows_np))
+
+    @staticmethod
+    def backward(ctx, d_rows):
+        grads = np.asarray(d_rows._data, np.float32)
+        ctx.client.push_sparse(ctx.table, ctx.ids, grads, lr=ctx.lr)
+        # anchor grad: zeros (it exists only to attach this node to the
+        # graph — sparse rows are updated server-side, not through it)
+        import jax.numpy as jnp
+        return Tensor._from_data(jnp.zeros((1,), jnp.float32))
+
+
+class SparseEmbedding(Layer):
+    """paddle-style Layer over a PS sparse table.
+
+    emb = SparseEmbedding(client, "user_emb", dim=16)
+    out = emb(ids)            # [.., dim] Tensor, differentiable
+    loss.backward()           # row grads pushed to the table's accessor
+    """
+
+    def __init__(self, client, table_name, emb_dim, init_std=0.01,
+                 accessor=None, entry_threshold=0, lr=None):
+        super().__init__()
+        self.client = client
+        self.table = table_name
+        self.dim = int(emb_dim)
+        self.lr = lr
+        client.create_sparse_table(table_name, emb_dim, init_std=init_std,
+                                   accessor=accessor,
+                                   entry_threshold=entry_threshold)
+        # trainable scalar anchor: backward only visits nodes reachable from
+        # a leaf with stop_gradient=False, and ids are integers
+        from ... import zeros
+        self._anchor = zeros([1])
+        self._anchor.stop_gradient = False
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        shape = ids_np.shape
+        flat = ids_np.reshape(-1)
+        rows = self.client.pull_sparse(self.table, flat,
+                                       training=self.training)
+        out = _PSLookup.apply(self._anchor, flat, rows, self.client,
+                              self.table, self.lr)  # [N, dim]
+        return out.reshape(list(shape) + [self.dim])

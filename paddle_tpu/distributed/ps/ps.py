@@ -1,6 +1,19 @@
 """PS roles over the rpc layer (ref: python/paddle/distributed/ps/,
-fleet PS mode: fleet.init_server / run_server / init_worker)."""
+fleet PS mode: fleet.init_server / run_server / init_worker).
+
+Sharding (ref: the brpc PS hash partition): a logical table is split over N
+servers by ``key % N``; each shard lives as an independent physical table
+named ``{table}#{shard}`` on its server. The client scatters pulls/pushes by
+shard, issues the per-server rpcs concurrently, and reassembles results in
+the caller's id order. Duplicate ids within a push are merged (grads summed)
+client-side before the accessor applies — the reference's gradient merge.
+"""
 from __future__ import annotations
+
+import queue as _queue
+import threading
+
+import numpy as np
 
 from .. import rpc as rpc_mod
 from . import service
@@ -20,43 +33,182 @@ class PSServer:
         rpc_mod.shutdown()
 
 
-class PSClient:
-    """Worker-side handle (ref: fleet init_worker + pull/push APIs)."""
+def _merge_duplicates(ids, grads):
+    """Sum grads of duplicate ids; returns (unique_ids, merged_grads)."""
+    ids = np.asarray(ids, np.int64)
+    grads = np.asarray(grads, np.float32)
+    uniq, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((len(uniq),) + grads.shape[1:], np.float32)
+    np.add.at(merged, inv, grads)
+    return uniq, merged
 
-    def __init__(self, worker_name, server_name="ps_server:0", rank=None,
-                 world_size=None, master_endpoint=None):
-        self.server = server_name
+
+class PSClient:
+    """Worker-side handle (ref: fleet init_worker + pull/push APIs).
+
+    servers: explicit server-name list, or num_servers addressing
+    ``ps_server:0..n-1``. Sparse tables shard key % num_servers.
+    async_push=True applies pushes from a background thread (async-PS /
+    geo-SGD flavor); barrier() drains it.
+    """
+
+    def __init__(self, worker_name, server_name=None, servers=None,
+                 num_servers=None, rank=None, world_size=None,
+                 master_endpoint=None, async_push=False):
+        if servers is None:
+            if num_servers is not None:
+                servers = [f"ps_server:{i}" for i in range(num_servers)]
+            else:
+                servers = [server_name or "ps_server:0"]
+        self.servers = list(servers)
+        self.server = self.servers[0]  # legacy single-server attribute
         if rank is not None or rpc_mod.rpc._state["server"] is None:
             rpc_mod.init_rpc(worker_name, rank=rank, world_size=world_size,
                              master_endpoint=master_endpoint)
+        self._push_q = None
+        self._push_thread = None
+        self._push_err = None
+        if async_push:
+            self._push_q = _queue.Queue(maxsize=64)
+            self._push_thread = threading.Thread(target=self._push_loop,
+                                                 daemon=True)
+            self._push_thread.start()
 
-    # dense ---------------------------------------------------------------
-    def create_dense_table(self, name, shape, init="zeros"):
-        return rpc_mod.rpc_sync(self.server, service.create_dense_table,
-                                args=(name, shape, init))
+    # -- sharding helpers --------------------------------------------------
+
+    def _shard_name(self, name, s):
+        return f"{name}#{s}" if len(self.servers) > 1 else name
+
+    # -- dense -------------------------------------------------------------
+
+    def create_dense_table(self, name, shape, init="zeros", accessor=None):
+        # dense tables are not sharded (dense training belongs on the SPMD
+        # collective path; PS-dense exists for API parity / tiny models)
+        return rpc_mod.rpc_sync(self.servers[0], service.create_dense_table,
+                                args=(name, shape, init, 0, accessor))
 
     def pull_dense(self, name):
-        return rpc_mod.rpc_sync(self.server, service.pull_dense, args=(name,))
+        return rpc_mod.rpc_sync(self.servers[0], service.pull_dense,
+                                args=(name,))
 
-    def push_dense(self, name, grad, lr=0.01):
-        return rpc_mod.rpc_sync(self.server, service.push_dense,
+    def push_dense(self, name, grad, lr=None):
+        return rpc_mod.rpc_sync(self.servers[0], service.push_dense,
                                 args=(name, grad, lr))
 
-    # sparse --------------------------------------------------------------
-    def create_sparse_table(self, name, emb_dim, init_std=0.01):
-        return rpc_mod.rpc_sync(self.server, service.create_sparse_table,
-                                args=(name, emb_dim, init_std))
+    # -- sparse ------------------------------------------------------------
 
-    def pull_sparse(self, name, ids):
-        return rpc_mod.rpc_sync(self.server, service.pull_sparse,
-                                args=(name, list(map(int, ids))))
+    def create_sparse_table(self, name, emb_dim, init_std=0.01,
+                            accessor=None, entry_threshold=0):
+        futs = [rpc_mod.rpc_async(
+                    srv, service.create_sparse_table,
+                    args=(self._shard_name(name, s), emb_dim, init_std,
+                          s, accessor, entry_threshold))
+                for s, srv in enumerate(self.servers)]
+        return all(f.result() for f in futs)
 
-    def push_sparse(self, name, ids, grads, lr=0.01):
-        return rpc_mod.rpc_sync(self.server, service.push_sparse,
-                                args=(name, list(map(int, ids)), grads, lr))
+    def pull_sparse(self, name, ids, training=True):
+        ids = np.asarray(ids, np.int64)
+        if len(ids) == 0:  # server returns the dim-correct empty array
+            return np.asarray(rpc_mod.rpc_sync(
+                self.servers[0], service.pull_sparse,
+                args=(self._shard_name(name, 0), [], training)), np.float32)
+        n = len(self.servers)
+        shard = ids % n
+        futs, parts = [], []
+        for s in range(n):
+            pos = np.nonzero(shard == s)[0]
+            parts.append(pos)
+            if len(pos) == 0:
+                futs.append(None)
+                continue
+            futs.append(rpc_mod.rpc_async(
+                self.servers[s], service.pull_sparse,
+                args=(self._shard_name(name, s), ids[pos].tolist(),
+                      training)))
+        rows = None
+        for pos, fut in zip(parts, futs):
+            if fut is None:
+                continue
+            part = np.asarray(fut.result(), np.float32)
+            if rows is None:
+                rows = np.zeros((len(ids), part.shape[1]), np.float32)
+            rows[pos] = part
+        return rows
+
+    def push_sparse(self, name, ids, grads, lr=None):
+        uniq, merged = _merge_duplicates(ids, grads)
+        if self._push_q is not None:
+            self._raise_pending()
+            self._push_q.put((name, uniq, merged, lr))
+            return True
+        return self._push_now(name, uniq, merged, lr)
+
+    def _push_now(self, name, uniq, merged, lr):
+        n = len(self.servers)
+        futs = []
+        for s, srv in enumerate(self.servers):
+            sel = uniq % n == s
+            if not sel.any():
+                continue
+            futs.append(rpc_mod.rpc_async(
+                srv, service.push_sparse,
+                args=(self._shard_name(name, s), uniq[sel].tolist(),
+                      merged[sel], lr)))
+        return all(f.result() for f in futs)
+
+    def _push_loop(self):
+        while True:
+            item = self._push_q.get()
+            if item is None:
+                self._push_q.task_done()
+                return
+            try:
+                self._push_now(*item)
+            except BaseException as e:  # surfaced at the next push/barrier
+                self._push_err = RuntimeError(f"async push failed: {e}")
+            finally:
+                self._push_q.task_done()
+
+    def _raise_pending(self):
+        if self._push_err is not None:
+            err, self._push_err = self._push_err, None
+            raise err
+
+    def barrier(self):
+        """Drain in-flight async pushes (ref: fleet barrier_worker)."""
+        if self._push_q is not None:
+            self._push_q.join()
+        self._raise_pending()
+        return True
+
+    # -- persistence (ref: fleet.save_persistables PS mode) ----------------
+
+    def save_sparse_table(self, name, dirname):
+        self.barrier()
+        futs = [rpc_mod.rpc_async(
+                    srv, service.save_table,
+                    args=(self._shard_name(name, s),
+                          f"{dirname}/{name}.shard{s}"))
+                for s, srv in enumerate(self.servers)]
+        return all(f.result() for f in futs)
+
+    def load_sparse_table(self, name, dirname):
+        futs = [rpc_mod.rpc_async(
+                    srv, service.load_table,
+                    args=(self._shard_name(name, s),
+                          f"{dirname}/{name}.shard{s}"))
+                for s, srv in enumerate(self.servers)]
+        return all(f.result() for f in futs)
 
     def stat(self):
-        return rpc_mod.rpc_sync(self.server, service.stat)
+        if len(self.servers) == 1:  # legacy flat shape
+            return rpc_mod.rpc_sync(self.servers[0], service.stat)
+        return {srv: rpc_mod.rpc_sync(srv, service.stat)
+                for srv in self.servers}
 
     def stop(self):
+        if self._push_q is not None:
+            self._push_q.put(None)
+            self._push_thread.join(timeout=10)
+            self._raise_pending()  # a failed final push must not vanish
         rpc_mod.shutdown()

@@ -3,10 +3,25 @@
 Module-level functions pickle by reference, so an rpc call from a worker
 binds to THIS module's state on the server side (the table registry below
 lives in the server process only), mirroring how the reference's table
-accessors live in the brpc server (ref: paddle/fluid/distributed/ps/table/).
+accessors live in the brpc server (ref: paddle/fluid/distributed/ps/table/
+memory_sparse_table.cc + accessor/ctr_*_accessor.cc).
+
+Reference feature map implemented here:
+- sparse tables: create-on-miss rows, per-row optimizer state (accessor),
+  show-count entry threshold (rows only materialize after `entry_threshold`
+  pulls — the reference's frequency-gated feature admission)
+- accessors: 'sgd', 'adagrad', 'adam' — the update runs server-side on push,
+  as the reference's accessors do
+- dense tables with the same accessor choices
+- save/load of whole tables (model persistence for PS mode)
+
+Sharding across servers is the CLIENT's job (key % num_servers — the
+reference's hash partition); each shard is an independent table here.
 """
 from __future__ import annotations
 
+import os
+import pickle
 import threading
 
 import numpy as np
@@ -15,7 +30,53 @@ _TABLES = {}
 _LOCK = threading.Lock()
 
 
-def create_dense_table(name, shape, init="zeros", seed=0):
+# -- accessors (server-side optimizers) -------------------------------------
+
+def _accessor_state(kind, shape):
+    if kind == "sgd":
+        return {}
+    if kind == "adagrad":
+        return {"g2": np.zeros(shape, np.float32)}
+    if kind == "adam":
+        return {"m": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32), "t": 0}
+    raise ValueError(f"unknown accessor '{kind}'")
+
+
+def _accessor_apply(acc, w, state, grad):
+    kind, lr = acc["type"], acc["lr"]
+    if kind == "sgd":
+        w -= lr * grad
+        return
+    if kind == "adagrad":
+        state["g2"] += grad * grad
+        w -= lr * grad / (np.sqrt(state["g2"]) + acc.get("eps", 1e-8))
+        return
+    if kind == "adam":
+        b1, b2 = acc.get("beta1", 0.9), acc.get("beta2", 0.999)
+        eps = acc.get("eps", 1e-8)
+        state["t"] += 1
+        state["m"][:] = b1 * state["m"] + (1 - b1) * grad
+        state["v"][:] = b2 * state["v"] + (1 - b2) * grad * grad
+        mhat = state["m"] / (1 - b1 ** state["t"])
+        vhat = state["v"] / (1 - b2 ** state["t"])
+        w -= lr * mhat / (np.sqrt(vhat) + eps)
+
+
+def _norm_accessor(accessor):
+    if accessor is None:
+        return {"type": "sgd", "lr": 0.01}
+    if isinstance(accessor, str):
+        return {"type": accessor, "lr": 0.01}
+    acc = dict(accessor)
+    acc.setdefault("type", "sgd")
+    acc.setdefault("lr", 0.01)
+    return acc
+
+
+# -- dense tables -----------------------------------------------------------
+
+def create_dense_table(name, shape, init="zeros", seed=0, accessor=None):
     with _LOCK:
         if name in _TABLES:
             return False
@@ -24,7 +85,9 @@ def create_dense_table(name, shape, init="zeros", seed=0):
         else:
             rng = np.random.RandomState(seed)
             data = (rng.standard_normal(shape) * 0.01).astype(np.float32)
-        _TABLES[name] = {"kind": "dense", "data": data}
+        acc = _norm_accessor(accessor)
+        _TABLES[name] = {"kind": "dense", "data": data, "accessor": acc,
+                         "state": _accessor_state(acc["type"], data.shape)}
     return True
 
 
@@ -32,48 +95,124 @@ def pull_dense(name):
     return _TABLES[name]["data"]
 
 
-def push_dense(name, grad, lr=0.01):
-    """SGD-apply a dense gradient on the server (async-PS semantics)."""
+def push_dense(name, grad, lr=None):
+    """Apply a dense gradient through the table's accessor (async-PS
+    semantics: workers push whenever, server serializes applies)."""
+    t = _TABLES[name]
     with _LOCK:
-        _TABLES[name]["data"] -= lr * np.asarray(grad, np.float32)
+        acc = dict(t["accessor"])
+        if lr is not None:  # per-push lr override (legacy arg)
+            acc["lr"] = lr
+        _accessor_apply(acc, t["data"], t["state"], np.asarray(grad, np.float32))
     return True
 
 
-def create_sparse_table(name, emb_dim, init_std=0.01, seed=0):
+# -- sparse tables ----------------------------------------------------------
+
+def create_sparse_table(name, emb_dim, init_std=0.01, seed=0, accessor=None,
+                        entry_threshold=0):
     with _LOCK:
         if name in _TABLES:
             return False
         _TABLES[name] = {"kind": "sparse", "dim": int(emb_dim),
                          "rows": {}, "std": init_std,
-                         "rng": np.random.RandomState(seed)}
+                         "rng": np.random.RandomState(seed),
+                         "accessor": _norm_accessor(accessor),
+                         "entry_threshold": int(entry_threshold),
+                         "counts": {}}
     return True
 
 
-def pull_sparse(name, ids):
-    """Fetch rows for ids; unseen ids are lazily initialized (the reference's
-    accessor 'create on miss' behavior)."""
+def pull_sparse(name, ids, training=True):
+    """Fetch rows for ids. Unseen ids below the entry threshold return zeros
+    (not yet admitted — the reference's frequency gate); once an id has been
+    shown `entry_threshold` times it materializes create-on-miss. Eval pulls
+    (training=False) never mutate the table: unknown ids return zeros
+    instead of allocating rows."""
     t = _TABLES[name]
+    thr = t["entry_threshold"]
     with _LOCK:
         out = np.empty((len(ids), t["dim"]), np.float32)
         for i, key in enumerate(ids):
-            row = t["rows"].get(int(key))
+            key = int(key)
+            if thr > 0 and training:
+                c = t["counts"].get(key, 0) + 1
+                t["counts"][key] = c
+                if c < thr:
+                    out[i] = 0.0
+                    continue
+            row = t["rows"].get(key)
             if row is None:
-                row = (t["rng"].standard_normal(t["dim"])
-                       * t["std"]).astype(np.float32)
-                t["rows"][int(key)] = row
-            out[i] = row
+                if not training or (thr > 0 and
+                                    t["counts"].get(key, 0) < thr):
+                    out[i] = 0.0
+                    continue
+                row = {"w": (t["rng"].standard_normal(t["dim"])
+                             * t["std"]).astype(np.float32),
+                       "state": _accessor_state(t["accessor"]["type"],
+                                                (t["dim"],))}
+                t["rows"][key] = row
+            out[i] = row["w"]
     return out
 
 
-def push_sparse(name, ids, grads, lr=0.01):
+def push_sparse(name, ids, grads, lr=None):
+    """Accessor-apply per-row grads. Ids must be unique per call (the client
+    merges duplicates); unadmitted/unknown rows are skipped."""
     t = _TABLES[name]
     grads = np.asarray(grads, np.float32)
     with _LOCK:
+        acc = dict(t["accessor"])
+        if lr is not None:
+            acc["lr"] = lr
         for key, g in zip(ids, grads):
             row = t["rows"].get(int(key))
             if row is not None:
-                row -= lr * g
+                _accessor_apply(acc, row["w"], row["state"], g)
     return True
+
+
+# -- persistence (ref: fleet.save_persistables PS mode) ---------------------
+
+def save_table(name, path):
+    t = _TABLES[name]
+    # snapshot under the lock, serialize/write OUTSIDE it: a multi-GB pickle
+    # must not stall every concurrent pull/push on this server
+    with _LOCK:
+        blob = dict(t)
+        blob.pop("rng", None)
+        if t["kind"] == "sparse":
+            blob["rows"] = {k: {"w": r["w"].copy(),
+                                "state": {sk: (sv.copy()
+                                               if isinstance(sv, np.ndarray)
+                                               else sv)
+                                          for sk, sv in r["state"].items()}}
+                            for k, r in t["rows"].items()}
+            blob["counts"] = dict(t["counts"])
+        else:
+            blob["data"] = t["data"].copy()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    return True
+
+
+def load_table(name, path, overwrite=True):
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    blob["rng"] = np.random.RandomState(0)
+    with _LOCK:
+        if name in _TABLES and not overwrite:
+            return False
+        if blob["kind"] == "dense":
+            blob.pop("rng")
+        _TABLES[name] = blob
+    return True
+
+
+def drop_table(name):
+    with _LOCK:
+        return _TABLES.pop(name, None) is not None
 
 
 def stat():

@@ -33,4 +33,5 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, mse_loss,
                    multi_label_soft_margin_loss, multi_margin_loss,
                    dice_loss, npair_loss, rnnt_loss,
                    adaptive_log_softmax_with_loss)
-from .attention import scaled_dot_product_attention, sdp_kernel
+from .attention import (flash_attention, flash_attn_unpadded,
+                        scaled_dot_product_attention, sdp_kernel)

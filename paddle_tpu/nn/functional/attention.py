@@ -88,3 +88,67 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if return_softmax:
         return out, None
     return out, None
+
+
+def _varlen_attention(q, k, v, cu_q, cu_k, max_q, max_k, scale, causal):
+    """Packed varlen attention core: q [total_q, H, D], k/v [total_k, Hkv, D],
+    cu_* are [B+1] cumulative sequence offsets. Returns [total_q, H, D].
+
+    TPU shape strategy: scatter the packed tokens into a padded [B, max, H, D]
+    batch (static shapes for XLA), run masked attention with fp32 logits, and
+    gather the valid rows back to the packed layout. Fully-padded rows never
+    reach the output gather, so no gradient flows through them. O(B*max_q*
+    max_k) logits — the flash-kernel segment-mask route is the upgrade path
+    for long packed batches."""
+    B = cu_q.shape[0] - 1
+    lens_q = cu_q[1:] - cu_q[:-1]
+    lens_k = cu_k[1:] - cu_k[:-1]
+    iq = jnp.arange(max_q)
+    ik = jnp.arange(max_k)
+    idx_q = jnp.clip(cu_q[:-1, None] + iq[None], 0, q.shape[0] - 1)
+    idx_k = jnp.clip(cu_k[:-1, None] + ik[None], 0, k.shape[0] - 1)
+    valid_q = iq[None] < lens_q[:, None]                      # [B, max_q]
+    valid_k = ik[None] < lens_k[:, None]                      # [B, max_k]
+    qp = jnp.take(q, idx_q, axis=0)                           # [B,max_q,H,D]
+    kp = jnp.take(k, idx_k, axis=0)
+    vp = jnp.take(v, idx_k, axis=0)
+    mask = valid_q[:, None, :, None] & valid_k[:, None, None, :]
+    if causal:
+        # per-sequence top-left causal (reference semantics): query position
+        # i within its sequence attends key positions <= i
+        mask = mask & (iq[:, None] >= ik[None, :])[None, None]
+    out = _xla_sdpa(qp, kp, vp, attn_mask=mask, scale=scale)  # [B,max_q,H,D]
+    t = jnp.arange(q.shape[0])
+    seg = jnp.searchsorted(cu_q, t, side="right") - 1
+    src = seg * max_q + (t - cu_q[seg])
+    flat = out.reshape(B * max_q, *out.shape[2:])
+    return jnp.take(flat, src, axis=0).astype(q.dtype)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (unpadded) attention over packed sequences (ref:
+    python/paddle/nn/functional/flash_attention.py flash_attn_unpadded).
+
+    query: [total_q, num_heads, head_dim] — all sequences concatenated;
+    cu_seqlens_q/k: [batch+1] int32 cumulative offsets (cu[0]=0,
+    cu[-1]=total). Returns (out [total_q, H, D], softmax=None)."""
+    max_q, max_k = int(max_seqlen_q), int(max_seqlen_k)
+
+    def f(q, k, v, cq, ck):
+        if scale is None:
+            s = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+        else:
+            s = scale
+        return _varlen_attention(q, k, v, cq.astype(jnp.int32),
+                                 ck.astype(jnp.int32), max_q, max_k, s,
+                                 causal)
+
+    out = _run_op("flash_attn_unpadded", f,
+                  (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+    if return_softmax:
+        return out, None
+    return out, None

@@ -127,6 +127,104 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
     lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
+# whole-KV-in-VMEM ceiling: above this the forward streams KV tiles through
+# a third grid dimension instead. Empirical (v5e, 16MB scoped vmem): the
+# resident kernel's scoped stack is ~2x(K+V) (double buffering) + ~1.3MB, so
+# K+V beyond ~3MB (S=8192 at D=128 bf16 measured 17.33M > 16M) must stream.
+STREAM_KV_BYTES = 3 * 2 ** 20
+
+
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
+                       *, block_k, causal, scale, kv_len, n_k):
+    """Streaming variant: grid (BH, n_q, n_k); one KV tile per step, online
+    stats in VMEM scratch persisted across the innermost (sequential) k
+    steps. Removes the whole-KV VMEM residency ceiling (S beyond ~12k at
+    D=128); fully-above-diagonal causal tiles skip compute (DMA still
+    happens — acceptable, the stream is bandwidth-shaped anyway)."""
+    import numpy as np
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bq_i, bk_i = np.int32(bq), np.int32(block_k)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    start = ki * bk_i
+    needed = start < np.int32(kv_len)
+    if causal:
+        last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
+        needed = jnp.logical_and(needed, start <= last_q)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        cols = start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        ok = cols < np.int32(kv_len)
+        if causal:
+            rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
+                                                    (bq, block_k), 0)
+            ok = ok & (rows >= cols)
+        s = jnp.where(ok, s, -1e30)
+        m = m_s[:, :1]
+        l = l_s[:, :1]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == np.int32(n_k - 1))
+    def _finalize():
+        m = m_s[:, :1]
+        l = l_s[:, :1]
+        o_ref[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
+
+
+def _flash_fwd_stream(qp, kp, vp, causal, scale, block_q, block_k, sk,
+                      out_dtype):
+    bh, sp, d = qp.shape
+    skp = kp.shape[1]
+    n_k = skp // block_k
+    kernel = functools.partial(_fwd_kernel_stream, block_k=block_k,
+                               causal=causal, scale=scale, kv_len=sk,
+                               n_k=n_k)
+    with _mosaic_ctx():
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, sp // block_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qp.shape, out_dtype),
+                jax.ShapeDtypeStruct((bh, 1, sp), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qp, kp, vp)
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     """q, k, v: [BH, S, D] (same head count). Returns (o, lse).
 
@@ -141,6 +239,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     kp, _ = _pad_rows(k, block_k)
     vp, _ = _pad_rows(v, block_k)
     sp, skp = qp.shape[1], kp.shape[1]
+    if 2 * skp * d * k.dtype.itemsize > STREAM_KV_BYTES:
+        o, lse = _flash_fwd_stream(qp, kp, vp, causal, scale, block_q,
+                                   block_k, sk, q.dtype)
+        return o[:, :s], lse.reshape(bh, sp)[:, :s]
     grid = (bh, sp // block_q)
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
                                scale=scale, seq_k=skp, kv_len=sk)

@@ -87,57 +87,61 @@ def pipeline_apply_interleave(stage_fn: Callable, num_stages: int,
     chunk params. Activations rotate one device per tick over ICI; a wrap
     from the last device back to device 0 advances the virtual slot.
 
-    Scheduling note: in this one-program formulation every tick applies all V
-    resident chunks (inactive slots are masked, costing FLOPs), so prefer the
-    plain `pipeline_apply` schedule when layers fit one chunk per stage — its
-    bubble (S-1)/(M+S-1) is already 1F1B-equivalent. Interleave matters here
-    for weight-placement parity and when per-chunk memory forces V > 1.
+    Schedule: each device runs EXACTLY ONE chunk per tick, following the
+    reference's grouped round-robin order (groups of S microbatches cycle
+    through the V resident chunks). That order is systolic: every
+    producer->consumer edge — including the S-1 -> 0 wrap that advances the
+    virtual slot — is exactly one tick apart, so a single rotating register
+    carries all activations and no slot buffer is needed. Per-device work is
+    the true V*M chunk applications (not V* masked extras) and the bubble is
+    (S-1)/(V*M + S-1), the reference interleave's improvement over plain
+    1F1B's (S-1)/(M + S-1). Requires M % S == 0 (same constraint the
+    reference enforces for its interleaved scheduler).
 
     stage_fn(chunk_params, h) -> h. x_mb: [M, ...]; output [M, ...] valid on
     the last device (slot V-1 exits there).
     """
     S, V, M = num_stages, num_virtual, num_microbatches
-    D = V * S
-    T = M + D - 1
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved pipeline needs num_microbatches ({M}) divisible "
+            f"by num_stages ({S})")
+    T = V * M + S - 1
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def run(params_local, x_mb):
         # shard_map hands this device its [V, ...] chunk stack
         params_chunks = params_local
         stage = lax.axis_index(axis_name)
-        h0 = jnp.zeros((V,) + x_mb.shape[1:], x_mb.dtype)
+        h0 = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
         out0 = jnp.zeros_like(x_mb)
 
         def tick(carry, t):
-            h_buf, outputs = carry
-            outs = []
-            for v in range(V):
-                hop = v * S + stage              # global hop index this slot
-                mb = t - hop
-                active = (mb >= 0) & (mb < M)
-                fresh = x_mb[jnp.clip(t, 0, M - 1)]
-                x_in = jnp.where((stage == 0) & (v == 0), fresh, h_buf[v])
-                chunk_params = jax.tree_util.tree_map(
-                    lambda a, _v=v: a[_v], params_chunks)
-                out = body(chunk_params, x_in)
-                out = jnp.where(active, out, jnp.zeros_like(out))
-                # final hop D-1 exits on device S-1, slot V-1
-                write = active & (stage == S - 1) & (v == V - 1)
-                idx = jnp.clip(mb, 0, M - 1)
-                outputs = outputs.at[idx].set(
-                    jnp.where(write, out, outputs[idx]))
-                outs.append(out)
-            out_stack = jnp.stack(outs)          # [V, ...]
+            h, outputs = carry
+            # microstep j -> (chunk slot v, microbatch m): groups of S
+            # microbatches cycle through the V chunks (reference
+            # get_model_chunk_id order)
+            j = t - stage
+            active = (j >= 0) & (j < V * M)
+            jc = jnp.clip(j, 0, V * M - 1)
+            g, r = jc // (V * S), jc % (V * S)
+            v, i = r // S, r % S
+            m = g * S + i
+            fresh = x_mb[m]
+            x_in = jnp.where((stage == 0) & (v == 0), fresh, h)
+            chunk_params = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+                params_chunks)
+            out = body(chunk_params, x_in)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            # final hop (slot V-1) exits on device S-1
+            write = active & (stage == S - 1) & (v == V - 1)
+            outputs = outputs.at[m].set(jnp.where(write, out, outputs[m]))
             if S > 1:
-                perm = [(i, (i + 1) % S) for i in range(S)]
-                rotated = lax.ppermute(out_stack, axis_name, perm)
+                perm = [(i_, (i_ + 1) % S) for i_ in range(S)]
+                h_next = lax.ppermute(out, axis_name, perm)
             else:
-                rotated = out_stack
-            # wrap S-1 -> 0 advances the slot: device 0 receives hop v*S
-            # output into slot v+1; other devices keep the same slot
-            shifted = jnp.concatenate(
-                [jnp.zeros_like(rotated[:1]), rotated[:-1]], axis=0)
-            h_next = jnp.where(stage == 0, shifted, rotated)
+                h_next = out
             return (h_next, outputs), None
 
         (_, outputs), _ = lax.scan(tick, (h0, out0), jnp.arange(T))

@@ -1,0 +1,134 @@
+"""Sharded parameter-server sparse tables: accessors, entry threshold,
+gradient merge, persistence, and the PS-backed SparseEmbedding layer
+(ref: paddle/fluid/distributed/ps/table + python/paddle/distributed/ps)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.ps import PSClient, SparseEmbedding, service
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture()
+def ps_world():
+    """One process hosting 2 logical servers + 1 worker (the rpc world is
+    in-process; shard tables stay distinct via the #shard suffix)."""
+    port = _free_port()
+    rpc.init_rpc("trainer0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    # the single rpc name serves both logical servers in-process
+    client = PSClient("trainer0", servers=["trainer0", "trainer0"])
+    saved = dict(service._TABLES)
+    yield client
+    service._TABLES.clear()
+    service._TABLES.update(saved)
+    rpc.shutdown()
+
+
+def test_sharded_pull_push_roundtrip(ps_world):
+    client = ps_world
+    client.create_sparse_table("emb", 4, accessor={"type": "sgd", "lr": 1.0})
+    ids = np.array([0, 1, 2, 3, 7, 10], np.int64)
+    rows0 = client.pull_sparse("emb", ids)
+    assert rows0.shape == (6, 4)
+    # shards are distinct tables: keys landed by parity
+    names = set(service._TABLES)
+    assert "emb#0" in names and "emb#1" in names
+    even = service._TABLES["emb#0"]["rows"]
+    assert set(even) == {0, 2, 10}
+    # push unit grads; sgd lr=1.0 -> rows drop by exactly the grad
+    g = np.ones((6, 4), np.float32)
+    client.push_sparse("emb", ids, g)
+    rows1 = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(rows1, rows0 - 1.0, atol=1e-6)
+
+
+def test_duplicate_ids_merge_before_apply(ps_world):
+    """Duplicate ids in one push must be summed THEN applied once (with a
+    nonlinear accessor, applying twice would differ)."""
+    client = ps_world
+    client.create_sparse_table("dup", 2,
+                               accessor={"type": "adagrad", "lr": 0.5})
+    base = client.pull_sparse("dup", [4])  # materialize the row
+    client.push_sparse("dup", [4, 4], np.array([[1., 1.], [1., 1.]]))
+    got = client.pull_sparse("dup", [4])[0]
+    # merged grad = 2 -> g2 = 4, update = .5 * 2/2 = .5 (one apply)
+    np.testing.assert_allclose(got, base[0] - 0.5, atol=1e-5)
+
+
+def test_adam_accessor_matches_reference_math(ps_world):
+    client = ps_world
+    client.create_sparse_table(
+        "adam_t", 3, accessor={"type": "adam", "lr": 0.1,
+                               "beta1": 0.9, "beta2": 0.999})
+    w0 = client.pull_sparse("adam_t", [6])[0].copy()
+    g = np.array([0.3, -0.2, 0.05], np.float32)
+    client.push_sparse("adam_t", [6], g[None])
+    got = client.pull_sparse("adam_t", [6])[0]
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = w0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_entry_threshold_gates_admission(ps_world):
+    """Rows appear only after `entry_threshold` training pulls (frequency-
+    gated feature admission); before that pulls return zeros."""
+    client = ps_world
+    client.create_sparse_table("gated", 4, entry_threshold=3)
+    for _ in range(2):
+        rows = client.pull_sparse("gated", [8])
+        np.testing.assert_allclose(rows, 0.0)
+    rows = client.pull_sparse("gated", [8])  # 3rd show: admitted
+    assert np.abs(rows).sum() > 0
+    # eval pulls don't count as shows
+    client.create_sparse_table("gated2", 4, entry_threshold=1)
+    rows = client.pull_sparse("gated2", [1], training=False)
+    np.testing.assert_allclose(rows, 0.0)
+
+
+def test_save_load_roundtrip(ps_world, tmp_path):
+    client = ps_world
+    client.create_sparse_table("persist", 4)
+    before = client.pull_sparse("persist", [1, 2, 3])
+    assert client.save_sparse_table("persist", str(tmp_path))
+    # mutate, then restore
+    client.push_sparse("persist", [1, 2, 3], np.ones((3, 4)), lr=1.0)
+    assert client.load_sparse_table("persist", str(tmp_path))
+    after = client.pull_sparse("persist", [1, 2, 3])
+    np.testing.assert_allclose(after, before, atol=1e-6)
+
+
+def test_sparse_embedding_layer_trains(ps_world):
+    """End-to-end: PS-backed embedding + device-side dense head; embedding
+    rows must move toward reducing the loss via the table accessor."""
+    client = ps_world
+    emb = SparseEmbedding(client, "layer_emb", 8,
+                          accessor={"type": "sgd", "lr": 0.1})
+    ids = paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64))
+    target = paddle.to_tensor(np.zeros((2, 2, 8), np.float32))
+
+    losses = []
+    for _ in range(10):
+        out = emb(ids)
+        assert out.shape == [2, 2, 8]
+        loss = ((out - target) ** 2).sum()
+        loss.backward()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+    # duplicate id 1 appears twice per batch: merge path exercised
+    st = client.stat()
+    total_rows = sum(n for tables in st.values()
+                     for kind, n in tables.values() if kind == "sparse")
+    assert total_rows >= 3
