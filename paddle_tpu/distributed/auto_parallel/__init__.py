@@ -1,2 +1,3 @@
 from .api import (Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn,
                   get_mesh, reshard, shard_layer, shard_tensor)
+from .engine import Engine, Strategy

@@ -1,0 +1,222 @@
+"""Auto-parallel Engine — the user-facing semi-auto training orchestrator
+(ref: python/paddle/distributed/auto_parallel/static/engine.py, used as
+``from paddle.distributed.fleet import auto; auto.Engine(...)``).
+
+The reference Engine runs completion -> partition -> reshard graph passes
+plus a cost model to turn a single-card program into a distributed one. On
+TPU those passes ARE the GSPMD partitioner: the user (or shard_layer rules)
+annotates placements, the Engine builds one compiled SPMD train step over
+the mesh, and XLA completes/partitions/reshards. What remains for the Engine
+is exactly what users see: fit/evaluate/predict loops, dataloader plumbing,
+metrics, and save/load."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...jit.train_step import TrainStep
+from ...tensor.tensor import Tensor
+from .api import ProcessMesh
+
+
+class Strategy:
+    """auto.Strategy (ref: auto_parallel/strategy.py) — knobs the TPU path
+    honors; unknown reference fields accepted as attributes for parity."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.dp_degree = 1
+        self.mp_degree = 1
+        self.seed = None
+        self.gradient_merge = _Toggle()
+        self.recompute = _Toggle()
+        self.amp = _Toggle()
+
+
+class _Toggle:
+    def __init__(self):
+        self.enable = False
+
+
+class Engine:
+    """engine = Engine(model, loss, optimizer, metrics, strategy)
+    engine.fit(train_dataset, epochs=2, batch_size=32)
+    engine.evaluate(valid_dataset); engine.predict(test_dataset)
+
+    `mesh` (or a ProcessMesh via strategy degrees) activates SPMD: the train
+    step compiles once over the mesh with the batch dp-sharded and any
+    param placements (shard_tensor/shard_layer / group_sharded) honored."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None, mesh: Optional[ProcessMesh] = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else [])
+        self.strategy = strategy or Strategy()
+        self._mesh = mesh or self._mesh_from_strategy()
+        self._train_step = None
+        self.history = {"loss": []}
+
+    def _mesh_from_strategy(self):
+        dp = getattr(self.strategy, "dp_degree", 1) or 1
+        mp = getattr(self.strategy, "mp_degree", 1) or 1
+        if dp * mp <= 1:
+            return None
+        import jax
+        devs = jax.devices()
+        if len(devs) < dp * mp:
+            devs = jax.devices("cpu")
+        arr = np.array(devs[:dp * mp]).reshape(dp, mp)
+        from jax.sharding import Mesh
+        return ProcessMesh(Mesh(arr, ("dp", "mp")))
+
+    # -- loops -------------------------------------------------------------
+
+    def _loader(self, data, batch_size, shuffle):
+        from ...io import DataLoader, Dataset
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=self._mesh is not None)
+        raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from jax.sharding import PartitionSpec as P
+            mesh = self._mesh.mesh if self._mesh is not None else None
+            bspec = None
+            if mesh is not None:
+                # batch shards over every data-like axis present
+                axes = [a for a in ("dp", "sharding") if a in mesh.axis_names]
+                bspec = P(tuple(axes)) if axes else None
+            self._train_step = TrainStep(self.model, self.loss,
+                                         self.optimizer, mesh=mesh,
+                                         batch_spec=bspec)
+        return self._train_step
+
+    def _place_eval(self, t):
+        """Eager eval with mesh-sharded params needs inputs on the same
+        device set: replicate them over the mesh."""
+        if self._mesh is None or not isinstance(t, Tensor):
+            return t
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return Tensor._from_data(
+            jax.device_put(t._data, NamedSharding(self._mesh.mesh, P())),
+            stop_gradient=t.stop_gradient)
+
+    def fit(self, train_data=None, train_sample_split=None, batch_size=1,
+            epochs=1, steps_per_epoch=None, log_freq=10, save_dir=None,
+            save_freq=1, valid_data=None, valid_freq=1, shuffle=True,
+            callbacks=None, verbose=1):
+        loader = self._loader(train_data, batch_size, shuffle)
+        step_fn = self._ensure_train_step()
+        for epoch in range(epochs):
+            self.model.train()
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+                loss = step_fn(*xs, labels=y)
+                self.history["loss"].append(float(loss.numpy()))
+                if verbose and step % log_freq == 0:
+                    print(f"[auto.Engine] epoch {epoch} step {step} "
+                          f"loss {float(loss.numpy()):.5f}")
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch{epoch}")
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                self.evaluate(valid_data, batch_size=batch_size,
+                              verbose=verbose)
+        step_fn.sync_to_model()
+        return self.history
+
+    def evaluate(self, valid_data=None, valid_sample_split=None, batch_size=1,
+                 steps=None, log_freq=10, callbacks=None, verbose=1):
+        loader = self._loader(valid_data, batch_size, shuffle=False)
+        self.model.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        from ...autograd import no_grad
+        losses, n = [], 0
+        for m in self.metrics:
+            m.reset()
+        with no_grad():
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+                xs = [self._place_eval(x) for x in xs]
+                y = self._place_eval(y)
+                out = self.model(*xs)
+                if self.loss is not None:
+                    losses.append(float(self.loss(out, y).numpy()))
+                for m in self.metrics:
+                    m.update(*_metric_args(m, out, y))
+                n += 1
+        result = {"eval_loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            result[m.name() if callable(getattr(m, "name", None)) else
+                   getattr(m, "_name", "metric")] = m.accumulate()
+        if verbose:
+            print(f"[auto.Engine] eval: {result}")
+        return result
+
+    def predict(self, test_data=None, test_sample_split=None, batch_size=1,
+                steps=None, callbacks=None, verbose=0):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        self.model.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        from ...autograd import no_grad
+        outs = []
+        with no_grad():
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                xs = batch if isinstance(batch, (list, tuple)) else (batch,)
+                # sample_split: how many leading elements are model inputs
+                # (default: all but a trailing label when the batch has one)
+                n_in = test_sample_split or (len(xs) - 1 if len(xs) > 1
+                                             else len(xs))
+                out = self.model(*[self._place_eval(x) for x in xs[:n_in]])
+                outs.append(out.numpy())
+        return outs
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path, training=True):
+        from ...distributed.checkpoint import save_state_dict
+        state = {"model": self.model.state_dict()}
+        if training and self.optimizer is not None:
+            if self._train_step is not None:
+                self._train_step.sync_to_model()
+            state["opt"] = self.optimizer.state_dict()
+        save_state_dict(state, path)
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...distributed.checkpoint import load_state_dict
+        state = {"model": self.model.state_dict()}
+        if load_optimizer and self.optimizer is not None:
+            state["opt"] = self.optimizer.state_dict()
+        load_state_dict(state, path)
+        self.model.set_state_dict(state["model"])
+        if load_optimizer and self.optimizer is not None and "opt" in state:
+            self.optimizer.set_state_dict(state["opt"])
+        self._train_step = None  # recompile with restored values
+
+
+def _metric_args(metric, out, label):
+    compute = getattr(metric, "compute", None)
+    if compute is not None:
+        try:
+            res = compute(out, label)
+            return res if isinstance(res, tuple) else (res,)
+        except Exception:
+            pass
+    return (out, label)

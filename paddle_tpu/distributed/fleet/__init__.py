@@ -19,3 +19,7 @@ from .recompute.recompute import (recompute, recompute_hybrid,
 
 def get_hybrid_communicate_group_global():
     return get_hybrid_communicate_group()
+
+
+# reference import path: `from paddle.distributed.fleet import auto`
+from .. import auto_parallel as auto  # noqa: E402

@@ -63,6 +63,20 @@ class Normal(Distribution):
                        + jnp.zeros(self._batch_shape, s.dtype),
                        (self.scale,), {})
 
+    def cdf(self, value):
+        def f(l, s, v):
+            return 0.5 * (1.0 + jax.scipy.special.erf(
+                (v - l) / (s * math.sqrt(2.0))))
+        return _run_op("normal_cdf", f,
+                       (self.loc, self.scale, param(value)), {})
+
+    def icdf(self, q):
+        def f(l, s, p):
+            return l + s * math.sqrt(2.0) * jax.scipy.special.erfinv(
+                2.0 * p - 1.0)
+        return _run_op("normal_icdf", f,
+                       (self.loc, self.scale, param(q)), {})
+
 
 class LogNormal(Distribution):
     def __init__(self, loc, scale, name=None):
@@ -536,23 +550,21 @@ class ContinuousBernoulli(Distribution):
 
 
 class Categorical(Distribution):
-    """Categorical over the last axis of ``logits`` (softmax-normalized).
+    """Categorical over the last axis (ref: distribution/categorical.py).
 
-    The reference's legacy Categorical normalizes raw weights by their sum;
-    pass probabilities via ``probs=`` for that behavior.
+    Reference semantics: ``logits`` are UNNORMALIZED NON-NEGATIVE weights,
+    normalized by their sum (NOT softmax) — `Categorical([0.5, 0.5, 0.0])`
+    never samples class 2. ``probs=`` is an alias for the same weights.
     """
 
     def __init__(self, logits=None, probs=None, name=None):
         if (logits is None) == (probs is None):
             raise ValueError("pass exactly one of logits / probs")
-        if probs is not None:
-            self.probs_param = param(probs)
-            self.logits = _run_op(
-                "log", lambda p: jnp.log(p / p.sum(-1, keepdims=True)),
-                (self.probs_param,), {})
-        else:
-            self.logits = param(logits)
-            self.probs_param = _run_op("softmax", jax.nn.softmax, (self.logits,), {})
+        w = param(probs if probs is not None else logits)
+        self.probs_param = _run_op(
+            "normalize_weights", lambda p: p / p.sum(-1, keepdims=True),
+            (w,), {})
+        self.logits = _run_op("log", jnp.log, (self.probs_param,), {})
         shape = tuple(self.logits._data.shape)
         super().__init__(shape[:-1])
         self._num_events = shape[-1]
@@ -568,18 +580,20 @@ class Categorical(Distribution):
         return Tensor._from_data(data)
 
     def log_prob(self, value):
-        def f(lg, v):
-            logp = jax.nn.log_softmax(lg)
+        # self.logits are already normalized log-probs (log_softmax would
+        # be an identity plus a wasted logsumexp)
+        def f(logp, v):
             logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
             return jnp.take_along_axis(
                 logp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
         return _run_op("categorical_log_prob", f, (self.logits, param(value)), {})
 
     def entropy(self):
-        def f(lg):
-            logp = jax.nn.log_softmax(lg)
-            return -(jnp.exp(logp) * logp).sum(-1)
-        return _run_op("categorical_entropy", f, (self.logits,), {})
+        def f(p):
+            # 0 * log(0) -> 0, not NaN (zero-probability classes)
+            return -jnp.sum(jnp.where(p > 0, p * jnp.log(
+                jnp.maximum(p, 1e-38)), 0.0), -1)
+        return _run_op("categorical_entropy", f, (self.probs_param,), {})
 
 
 class Multinomial(Distribution):

@@ -9,7 +9,8 @@ from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax,
                                Softplus, Softshrink, Softsign, Swish, Tanh,
                                Tanhshrink, ThresholdedReLU)
-from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
+from .layer.common import (AlphaDropout, FeatureAlphaDropout,
+                           Threshold, Bilinear, ChannelShuffle,
                            CosineSimilarity, Dropout, Dropout2D, Dropout3D,
                            Embedding, Flatten, Fold, Identity, Linear, Pad1D,
                            Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
@@ -35,7 +36,8 @@ from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
 from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
                             AdaptiveAvgPool3D, AdaptiveMaxPool2D, AvgPool1D,
                             AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
-                            MaxPool3D)
+                            MaxPool3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+                            LPPool1D, LPPool2D)
 from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
                         SimpleRNNCell)
 from .layer.transformer import (MultiHeadAttention, Transformer,

@@ -13,14 +13,18 @@ from .activation import (relu, relu6, relu_, gelu, silu, swish, sigmoid,
 from .common import (linear, dropout, dropout2d, dropout3d, embedding,
                      one_hot, pad, interpolate, upsample, unfold, fold,
                      pixel_shuffle, cosine_similarity, pairwise_distance,
-                     label_smooth, bilinear, alpha_dropout, sequence_mask)
+                     label_smooth, bilinear, alpha_dropout, sequence_mask,
+                     threshold, zeropad2d,
+                     feature_alpha_dropout)
 from .vision import (affine_grid, grid_sample, pixel_unshuffle,
                      channel_shuffle, temporal_shift)
 from .conv import conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose
 from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
                       max_pool2d, max_pool3d, adaptive_avg_pool1d,
                       adaptive_avg_pool2d, adaptive_avg_pool3d,
-                      adaptive_max_pool2d, global_avg_pool2d)
+                      adaptive_max_pool2d, global_avg_pool2d,
+                      max_unpool1d, max_unpool2d, max_unpool3d,
+                      lp_pool1d, lp_pool2d)
 from .norm import (layer_norm, batch_norm, instance_norm, group_norm,
                    rms_norm, local_response_norm, normalize)
 from .loss import (cross_entropy, softmax_with_cross_entropy, mse_loss,

@@ -56,7 +56,8 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     key = random_mod.next_key()
     def f(a):
         keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
-        a_coef = (1.0 - p + p * alpha_p ** 2) ** -0.5
+        # var after masking = (1-p)*(1 + p*alpha_p^2): normalize back to 1
+        a_coef = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
         b_coef = -a_coef * p * alpha_p
         return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
     return _run_op("alpha_dropout", f, (x,), {})
@@ -216,3 +217,43 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         rng = jnp.arange(m)
         return (rng[None, :] < lens.astype(jnp.int64)[..., None]).astype(nd)
     return _run_op("sequence_mask", f, (x,), {})
+
+
+def threshold(x, threshold=1.0, value=0.0, name=None):
+    """x where x > threshold else value (ref: activation.py thresholded
+    relu generalization used by nn.Threshold)."""
+    def f(a):
+        return jnp.where(a > threshold, a, jnp.asarray(value, a.dtype))
+    return _run_op("threshold", f, (x,), {})
+
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W (ref: common.py zeropad2d; padding is [l, r, t, b])."""
+    l, r, t, b = (int(v) for v in padding)
+    def f(a):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(a, cfg)
+    return _run_op("zeropad2d", f, (x,), {})
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout zeroing whole channels (dim 1), SELU-compatible
+    statistics (ref: common.py feature_alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = random_mod.next_key()
+
+    def f(a):
+        mshape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, mshape)
+        a_coef = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        b_coef = -a_coef * p * alpha_p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return _run_op("feature_alpha_dropout", f, (x,), {})

@@ -58,18 +58,36 @@ def _pool(x, kernel, stride, padding, n, reducer, init, name,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     df = "NLC" if data_format == "NLC" else "NCW"
+    if return_mask:
+        if df == "NLC":
+            raise NotImplementedError(
+                "max_pool1d(return_mask=True) requires NCL data_format")
+        return _max_pool_mask(x, kernel_size, stride, padding, 1, df,
+                              "max_pool1d")
     return _pool(x, kernel_size, stride, padding, 1, "max", None, "max_pool1d",
                  data_format="NLC" if df == "NLC" else "NCHW")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        if data_format != "NCHW":
+            raise NotImplementedError(
+                "max_pool2d(return_mask=True) requires NCHW data_format")
+        return _max_pool_mask(x, kernel_size, stride, padding, 2, data_format,
+                              "max_pool2d")
     return _pool(x, kernel_size, stride, padding, 2, "max", None, "max_pool2d",
                  ceil_mode=ceil_mode, data_format=data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        if data_format != "NCDHW":
+            raise NotImplementedError(
+                "max_pool3d(return_mask=True) requires NCDHW data_format")
+        return _max_pool_mask(x, kernel_size, stride, padding, 3, data_format,
+                              "max_pool3d")
     return _pool(x, kernel_size, stride, padding, 3, "max", None, "max_pool3d",
                  data_format=data_format)
 
@@ -162,3 +180,151 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def global_avg_pool2d(x, data_format="NCHW", name=None):
     axes = (2, 3) if data_format == "NCHW" else (1, 2)
     return _run_op("global_avg_pool2d", lambda a: a.mean(axes, keepdims=True), (x,), {})
+
+
+# -- max pool with argmax mask + unpooling (ref: pooling.py max_pool2d
+# return_mask=True + max_unpool{1,2,3}d; the reference's mask is the
+# flattened spatial index of each window's max) ----------------------------
+
+def _resolve_str_pad(pad, spatial, kernel, stride):
+    """'SAME'/'VALID' -> numeric per-dim pads (XLA convention: out =
+    ceil(in/stride), total pad split low-first)."""
+    if pad == "VALID":
+        return [(0, 0)] * len(spatial)
+    out = []
+    for i, sz in enumerate(spatial):
+        o = -(-sz // stride[i])
+        total = max((o - 1) * stride[i] + kernel[i] - sz, 0)
+        out.append((total // 2, total - total // 2))
+    return out
+
+
+def _max_pool_mask(x, kernel, stride, padding, n, data_format, name):
+    """Channel-first pooling returning (out, mask). Window patches are
+    extracted with conv_general_dilated_patches; out = max over the patch
+    axis (differentiable, grads route to the argmax) and mask = the
+    reference's flattened spatial index of each window's max (int32,
+    lowest index on ties)."""
+    kernel_t = _tuple(kernel, n)
+    stride_t = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_spec(padding, n)
+
+    def f(a):
+        spatial = a.shape[2:]
+        c = a.shape[1]
+        pads = list(pad) if not isinstance(pad, str) else \
+            _resolve_str_pad(pad, spatial, kernel_t, stride_t)
+        neg = jnp.asarray(-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                          else jnp.iinfo(a.dtype).min, a.dtype)
+        ap = jnp.pad(a, [(0, 0), (0, 0)] + pads, constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            ap, kernel_t, stride_t, "VALID")
+        # patches: [N, C*K, *out_spatial], features ordered (c, k0, k1, ...)
+        out_sp = patches.shape[2:]
+        k_sz = int(np.prod(kernel_t))
+        patches = patches.reshape((a.shape[0], c, k_sz) + out_sp)
+        out = patches.max(axis=2)
+        local = jnp.argmax(patches, axis=2).astype(jnp.int32)  # [N,C,*out]
+        # local kernel offset -> flat index in the UNPADDED input
+        coords = []
+        rem = local
+        for d in range(n - 1, -1, -1):
+            coords.insert(0, rem % kernel_t[d])
+            rem = rem // kernel_t[d]
+        flat = jnp.zeros_like(local)
+        for d in range(n):
+            grid_shape = [1] * local.ndim
+            grid_shape[2 + d] = out_sp[d]
+            start = jnp.arange(out_sp[d], dtype=jnp.int32).reshape(grid_shape)
+            pos = start * stride_t[d] + coords[d] - pads[d][0]
+            pos = jnp.clip(pos, 0, spatial[d] - 1)
+            flat = flat * spatial[d] + pos
+        return out, flat
+
+    return _run_op(name, f, (x,), {})
+
+
+def _max_unpool(x, indices, out_spatial, name):
+    """Scatter pooled values back to their argmax positions (zeros
+    elsewhere)."""
+    def f(a, idx):
+        nb, c = a.shape[:2]
+        size = int(np.prod(out_spatial))
+        flat = jnp.zeros((nb, c, size), a.dtype)
+        ii = jnp.arange(nb)[:, None, None]
+        jj = jnp.arange(c)[None, :, None]
+        flat = flat.at[ii, jj, idx.reshape(nb, c, -1)].set(
+            a.reshape(nb, c, -1))
+        return flat.reshape((nb, c) + tuple(out_spatial))
+
+    return _run_op(name, f, (x, indices), {})
+
+
+def _unpool_out_size(in_sz, kernel, stride, padding, output_size, n):
+    if output_size is not None:
+        out = tuple(int(s) for s in output_size[-n:])
+        return out
+    if isinstance(padding, str):
+        raise ValueError(
+            "max_unpool with string padding needs an explicit output_size "
+            "(the padded input size is ambiguous)")
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_spec(padding, n)
+    return tuple((in_sz[i] - 1) * stride[i] - 2 * pad[i][0] + kernel[i]
+                 for i in range(n))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    in_sz = tuple(int(s) for s in x.shape[2:])
+    out = _unpool_out_size(in_sz, kernel_size, stride, padding, output_size, 1)
+    return _max_unpool(x, indices, out, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    in_sz = tuple(int(s) for s in x.shape[2:])
+    out = _unpool_out_size(in_sz, kernel_size, stride, padding, output_size, 2)
+    return _max_unpool(x, indices, out, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    in_sz = tuple(int(s) for s in x.shape[2:])
+    out = _unpool_out_size(in_sz, kernel_size, stride, padding, output_size, 3)
+    return _max_unpool(x, indices, out, "max_unpool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    "lp_pool1d", chan_last=data_format == "NLC")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    "lp_pool2d", chan_last=data_format == "NHWC")
+
+
+def _lp_pool(x, norm_type, kernel, stride, padding, n, name,
+             chan_last=False):
+    """(sum |x|^p)^(1/p) over windows (p=inf would be max_pool)."""
+    p = float(norm_type)
+    kernel_t = _tuple(kernel, n)
+    stride_t = _tuple(stride if stride is not None else kernel, n)
+    pad = _pad_spec(padding, n)
+
+    def f(a):
+        if chan_last:  # pool spatial dims via the channel-first core
+            a = jnp.moveaxis(a, -1, 1)
+        dims = (1, 1) + kernel_t
+        strides = (1, 1) + stride_t
+        pads = ([(0, 0), (0, 0)] + list(pad)) if not isinstance(pad, str) else pad
+        s = jax.lax.reduce_window(jnp.abs(a.astype(jnp.float32)) ** p, 0.0,
+                                  jax.lax.add, dims, strides, pads)
+        out = (s ** (1.0 / p)).astype(a.dtype)
+        return jnp.moveaxis(out, 1, -1) if chan_last else out
+
+    return _run_op(name, f, (x,), {})

@@ -287,3 +287,23 @@ class PairwiseDistance(Layer):
     def forward(self, x, y):
         return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
                                    keepdim=self.keepdim)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+class Threshold(Layer):
+    """out = x if x > threshold else value (ref: nn.Threshold)."""
+
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self.threshold, self.value = threshold, value
+
+    def forward(self, x):
+        return F.threshold(x, self.threshold, self.value)

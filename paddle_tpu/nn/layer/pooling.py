@@ -10,9 +10,11 @@ class MaxPool1D(Layer):
                  ceil_mode=False, name=None):
         super().__init__()
         self.k, self.s, self.p = kernel_size, stride, padding
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.max_pool1d(x, self.k, self.s, self.p)
+        return F.max_pool1d(x, self.k, self.s, self.p,
+                            return_mask=self.return_mask)
 
 
 class MaxPool2D(Layer):
@@ -22,9 +24,11 @@ class MaxPool2D(Layer):
         self.k, self.s, self.p = kernel_size, stride, padding
         self.ceil_mode = ceil_mode
         self.data_format = data_format
+        self.return_mask = return_mask
 
     def forward(self, x):
         return F.max_pool2d(x, self.k, self.s, self.p,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
@@ -34,9 +38,11 @@ class MaxPool3D(Layer):
                  ceil_mode=False, data_format="NCDHW", name=None):
         super().__init__()
         self.k, self.s, self.p = kernel_size, stride, padding
+        self.return_mask = return_mask
 
     def forward(self, x):
-        return F.max_pool3d(x, self.k, self.s, self.p)
+        return F.max_pool3d(x, self.k, self.s, self.p,
+                            return_mask=self.return_mask)
 
 
 class AvgPool1D(Layer):
@@ -111,3 +117,65 @@ class AdaptiveMaxPool2D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, self.k, self.s, self.p,
+                              output_size=self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.k, self.s, self.p,
+                              output_size=self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.k, self.s, self.p,
+                              output_size=self.output_size)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.norm_type, self.k = norm_type, kernel_size
+        self.s, self.p = stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool1d(x, self.norm_type, self.k, self.s, self.p,
+                           data_format=self.data_format)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.norm_type, self.k = norm_type, kernel_size
+        self.s, self.p = stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.k, self.s, self.p,
+                           data_format=self.data_format)

@@ -334,3 +334,23 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
     def f(a):
         return jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim)
     return _run_op("matrix_norm", f, (x,), {})
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of [N, D] rows: upper-triangle (i<j)
+    of cdist, flattened row-major (ref: linalg.py pdist)."""
+    def f(a):
+        n = a.shape[0]
+        # select the i<j pairs FIRST: computing the full [N,N] matrix and
+        # masking afterwards sends NaN (d sqrt(0) on the diagonal) through
+        # the vjp even though the diagonal is discarded
+        iu, ju = jnp.triu_indices(n, k=1)
+        diff = jnp.abs(a[iu] - a[ju])                    # [M, D]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 1e-30))
+        if p == float("inf"):
+            return diff.max(-1)
+        if p == 0.0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        return jnp.maximum((diff ** p).sum(-1), 1e-30) ** (1.0 / p)
+    return _run_op("pdist", f, (x,), {})

@@ -519,3 +519,37 @@ def combinations(x, r=2, with_replacement=False, name=None):
     def f(a):
         return a[jnp.asarray(idx)]
     return _run_op("combinations", f, (x,), {})
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand one axis into the given shape (ref: manipulation.py unflatten;
+    one -1 entry is inferred)."""
+    def f(a):
+        ax = axis % a.ndim
+        tgt = list(shape)
+        if -1 in tgt:
+            known = int(np.prod([s for s in tgt if s != -1]))
+            tgt[tgt.index(-1)] = a.shape[ax] // known
+        return a.reshape(a.shape[:ax] + tuple(tgt) + a.shape[ax + 1:])
+    return _run_op("unflatten", f, (x,), {})
+
+
+def view_as(x, other, name=None):
+    """Reshape to another tensor's shape (zero-copy under XLA)."""
+    tgt = tuple(other.shape)
+    return _run_op("view_as", lambda a: a.reshape(tgt), (x,), {})
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (ref: manipulation.py as_strided). XLA has no aliasing
+    views, so this materializes the gather: element [i0, i1, ...] reads
+    flat[offset + sum(i_k * stride_k)] of the CONTIGUOUS input."""
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def f(a):
+        flat = a.reshape(-1)
+        grids = jnp.meshgrid(*[jnp.arange(n) for n in shape], indexing="ij")
+        lin = sum(g * st for g, st in zip(grids, stride)) + offset
+        return jnp.take(flat, lin.reshape(-1), axis=0).reshape(shape)
+    return _run_op("as_strided", f, (x,), {})

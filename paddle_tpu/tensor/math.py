@@ -542,3 +542,26 @@ def copysign_(x, y, name=None):
     x._data = jnp.copysign(x._data, y._data if isinstance(y, Tensor) else y)
     x._grad_node = None
     return x
+
+
+logaddexp2 = _binary("logaddexp2", lambda a, b: jnp.logaddexp2(a, b))
+
+
+def sgn(x, name=None):
+    """Sign for real; x/|x| for complex (ref: math.py sgn)."""
+    def f(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(mag, 1e-300))
+        return jnp.sign(a)
+    return _run_op("sgn", f, (x,), {})
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (ref: math.py multigammaln)."""
+    def f(a):
+        const = 0.25 * p * (p - 1) * np.log(np.pi)
+        i = jnp.arange(p, dtype=jnp.float32)
+        return const + jnp.sum(
+            jax.scipy.special.gammaln(a[..., None] - i / 2.0), axis=-1)
+    return _run_op("multigammaln", f, (x,), {})

@@ -1,0 +1,167 @@
+"""Op-tail coverage: newly added tensor ops, pooling mask/unpool, and
+distribution edge cases (VERDICT r1 item 10 / SURVEY §4 OpTest row)."""
+import numpy as np
+import pytest
+import scipy.special as sps
+import scipy.spatial.distance as ssd
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState(7)
+
+
+def _fd_grad(fn, x, eps=1e-4):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_pdist_matches_scipy_and_grads():
+    x = R.randn(5, 3).astype(np.float64)
+    for p in (1.0, 2.0, 3.0, float("inf")):
+        got = paddle.pdist(paddle.to_tensor(x), p=p).numpy()
+        ref = ssd.pdist(x, "minkowski", p=p) if p != float("inf") \
+            else ssd.pdist(x, "chebyshev")
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+    t = paddle.to_tensor(x.astype(np.float32)); t.stop_gradient = False
+    loss = paddle.pdist(t).sum(); loss.backward()
+    fd = _fd_grad(lambda a: ssd.pdist(a, "minkowski", p=2).sum(), x)
+    np.testing.assert_allclose(t.grad.numpy(), fd, rtol=1e-3, atol=1e-4)
+
+
+def test_logaddexp2_multigammaln_sgn():
+    a, b = R.randn(4), R.randn(4)
+    np.testing.assert_allclose(
+        paddle.logaddexp2(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        np.logaddexp2(a, b), rtol=1e-6)
+    x = R.uniform(2.0, 5.0, (6,))
+    np.testing.assert_allclose(
+        paddle.multigammaln(paddle.to_tensor(x), 3).numpy(),
+        sps.multigammaln(x, 3), rtol=1e-5)
+    v = np.array([-2.0, 0.0, 3.5])
+    np.testing.assert_allclose(paddle.sgn(paddle.to_tensor(v)).numpy(),
+                               np.sign(v))
+
+
+def test_unflatten_view_as_as_strided():
+    x = paddle.to_tensor(np.arange(24.0, dtype="float32"))
+    assert paddle.unflatten(x.reshape([4, 6]), 1, [2, -1]).shape == [4, 2, 3]
+    assert paddle.view_as(x, paddle.ones([4, 6])).shape == [4, 6]
+    got = paddle.as_strided(x, [3, 4], [1, 3]).numpy()
+    ref = np.lib.stride_tricks.as_strided(
+        np.arange(24.0, dtype="float32"), (3, 4), (4, 12))
+    np.testing.assert_allclose(got, ref)
+
+
+def test_max_pool_mask_and_unpool_roundtrip():
+    x = R.randn(2, 3, 8, 8).astype(np.float32)
+    t = paddle.to_tensor(x)
+    out, mask = F.max_pool2d(t, 2, return_mask=True)
+    # mask agrees with a numpy argmax per window
+    for n in range(2):
+        for c in range(3):
+            for i in range(4):
+                for j in range(4):
+                    win = x[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                    fi = int(mask.numpy()[n, c, i, j])
+                    assert x[n, c].ravel()[fi] == win.max()
+    rec = F.max_unpool2d(out, mask, 2)
+    assert rec.shape == [2, 3, 8, 8]
+    # unpooled holds the max at its original position, zeros elsewhere
+    nz = rec.numpy() != 0
+    assert nz.sum() == 2 * 3 * 16
+    np.testing.assert_allclose(rec.numpy()[nz],
+                               np.sort(out.numpy().ravel())[
+                                   np.argsort(np.argsort(rec.numpy()[nz]))],
+                               rtol=1e-6)
+
+
+def test_max_unpool_gradient_routes_to_max_positions():
+    x = R.randn(1, 1, 4, 4).astype(np.float32)
+    t = paddle.to_tensor(x); t.stop_gradient = False
+    out, mask = F.max_pool2d(t, 2, return_mask=True)
+    rec = F.max_unpool2d(out, mask, 2)
+    rec.sum().backward()
+    g = t.grad.numpy()[0, 0]
+    # exactly the 4 max positions get gradient 1
+    assert (g == 1).sum() == 4 and (g != 0).sum() == 4
+
+
+def test_lp_pool_values():
+    x = np.abs(R.randn(1, 1, 4, 4)).astype(np.float32)
+    got = F.lp_pool2d(paddle.to_tensor(x), 2, 2).numpy()[0, 0]
+    for i in range(2):
+        for j in range(2):
+            win = x[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+            np.testing.assert_allclose(got[i, j],
+                                       np.sqrt((win ** 2).sum()), rtol=1e-5)
+
+
+def test_threshold_zeropad_feature_alpha_dropout():
+    x = paddle.to_tensor(np.array([[-1.0, 0.5, 2.0]], np.float32))
+    np.testing.assert_allclose(
+        F.threshold(x, 1.0, -7.0).numpy(), [[-7.0, -7.0, 2.0]])
+    im = paddle.ones([1, 1, 2, 2])
+    z = F.zeropad2d(im, [1, 0, 0, 2])
+    assert z.shape == [1, 1, 4, 3] and float(z.numpy().sum()) == 4.0
+    paddle.seed(11)
+    fad = F.feature_alpha_dropout(paddle.ones([2, 8, 4]), p=0.5)
+    arr = fad.numpy()
+    # whole channels share one fate: within-channel variance is zero
+    assert np.allclose(arr.std(axis=-1), 0.0, atol=1e-6)
+    # statistics preserved approximately (mean near 1 for unit input)
+    assert abs(arr.mean() - 1.0) < 0.6
+
+
+# -- distribution edge cases (ref: test/distribution/*) ---------------------
+
+def test_distribution_edge_cases():
+    from paddle_tpu.distribution import (Bernoulli, Categorical, Normal,
+                                         Uniform)
+    # Normal: cdf extremes saturate without NaN
+    n = Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    big = n.cdf(paddle.to_tensor(50.0)).numpy()
+    small = n.cdf(paddle.to_tensor(-50.0)).numpy()
+    assert big == pytest.approx(1.0, abs=1e-6)
+    assert small == pytest.approx(0.0, abs=1e-6)
+    # log_prob far in the tail is finite
+    assert np.isfinite(n.log_prob(paddle.to_tensor(40.0)).numpy())
+
+    # Categorical with a zero-probability class: sampled never, log_prob -inf
+    probs = paddle.to_tensor(np.array([0.5, 0.5, 0.0], np.float32))
+    c = Categorical(probs)
+    paddle.seed(5)
+    s = c.sample([512]).numpy()
+    assert (s == 2).sum() == 0
+    lp = c.log_prob(paddle.to_tensor(np.array([2], np.int64))).numpy()
+    assert np.isneginf(lp) or lp < -20
+
+    # Bernoulli p=0 / p=1 degenerate
+    b0 = Bernoulli(paddle.to_tensor(0.0))
+    b1 = Bernoulli(paddle.to_tensor(1.0))
+    paddle.seed(6)
+    assert b0.sample([64]).numpy().sum() == 0
+    assert b1.sample([64]).numpy().sum() == 64
+
+    # Uniform: log_prob outside support
+    u = Uniform(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    out = u.log_prob(paddle.to_tensor(2.0)).numpy()
+    assert np.isneginf(out) or out < -20
+
+
+def test_entropy_kl_consistency():
+    from paddle_tpu.distribution import Normal, kl_divergence
+    a = Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    b = Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+    np.testing.assert_allclose(kl_divergence(a, b).numpy(), 0.0, atol=1e-6)
+    c = Normal(paddle.to_tensor(1.0), paddle.to_tensor(2.0))
+    kl = kl_divergence(a, c).numpy()
+    ref = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, ref, rtol=1e-5)
