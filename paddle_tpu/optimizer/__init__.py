@@ -2,4 +2,4 @@
 from . import lr
 from .optimizer import Optimizer
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
-                         Momentum, RMSProp)
+                         LarsMomentum, Momentum, RMSProp)

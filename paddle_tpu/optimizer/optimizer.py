@@ -111,7 +111,7 @@ class Optimizer:
             compute_p = master
         g = g.astype(compute_p.dtype)
         # per-parameter learning rate from ParamAttr
-        lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+        lr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
         new_p, new_state = self._update(compute_p, g, state, lr, wd, group)
         if master is not None:
             self._master_weights[key] = new_p

@@ -261,3 +261,48 @@ class Adadelta(Optimizer):
         asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * upd * upd
         return (_f32(p) - lr * upd).astype(p.dtype), \
             {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (ref: incubate LarsMomentumOptimizer / lars_momentum op):
+    layer-wise adaptive rate — local_lr = lr * lars_coeff * ||w|| /
+    (||g|| + lars_weight_decay * ||w||), then momentum on the scaled grad.
+    Used for large-batch vision training (the reference's ResNet configs)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _create_accumulators(self, p):
+        return {"velocity": jnp.zeros(p._data.shape, jnp.float32)}
+
+    def _apply_one(self, p, g, lr, wd, group):
+        # excluded params (by name substring, reference semantics: BN/bias)
+        # get plain momentum: no lars decay, no adaptive scaling
+        if any(tok in p.name for tok in self._exclude):
+            group = dict(group or {}, lars_excluded=True)
+        super()._apply_one(p, g, lr, wd, group)
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32, p32 = _f32(g), _f32(p)
+        if (group or {}).get("lars_excluded"):
+            v = self._momentum * state["velocity"] + lr * g32
+            return (p32 - v).astype(p.dtype), {"velocity": v}
+        wnorm = jnp.sqrt(jnp.sum(p32 * p32))
+        gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+        lars_wd = self._lars_wd
+        local = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            lr * self._lars_coeff * wnorm
+            / (gnorm + lars_wd * wnorm + self._eps),
+            lr)
+        v = self._momentum * state["velocity"] + local * (g32 + lars_wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}

@@ -31,7 +31,9 @@ import time
 from typing import Optional
 
 _CSRC = os.path.join(os.path.dirname(__file__), os.pardir, "csrc")
-_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libpd_runtime.so"))
+# PD_RUNTIME_LIB overrides the lib path (sanitizer builds, system installs)
+_LIB_PATH = os.environ.get("PD_RUNTIME_LIB") or os.path.abspath(
+    os.path.join(_CSRC, "libpd_runtime.so"))
 
 _lib = None
 _load_attempted = False

@@ -1,0 +1,81 @@
+"""AddressSanitizer pass over the native runtime (SURVEY §5.2: the
+reference runs ASan/TSan CI jobs on its C++ core; here the whole
+allocator/queue/store surface runs under ASan in a subprocess)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CSRC = os.path.join(REPO, "paddle_tpu", "csrc")
+
+_DRIVER = r"""
+import ctypes, os, threading
+import paddle_tpu
+from paddle_tpu import runtime as rt
+assert rt.available(), rt.load_error()
+
+# allocator: roundtrip, reuse, double-free must be a guarded no-op
+a = rt.HostAllocator()
+bufs = [a.alloc(4096) for _ in range(8)]
+for b in bufs:
+    a.free(b)
+a.free(bufs[0])  # double free: no-op, no ASan report
+big = a.alloc(1 << 20); a.free(big)
+
+# blocking queue hammered from threads (races would light up ASan)
+q = rt.BlockingQueue(capacity=4)
+out = []
+def prod():
+    for i in range(200):
+        q.push(("x" * 100, i), timeout=-1.0)
+def cons():
+    for _ in range(200):
+        out.append(q.pop(timeout=-1.0))
+ts = [threading.Thread(target=prod), threading.Thread(target=cons)]
+[t.start() for t in ts]; [t.join() for t in ts]
+assert len(out) == 200
+q.close()
+
+# tcp store: concurrent set/add/get
+srv = rt.TCPStoreServer()
+st = rt.TCPStore("127.0.0.1", srv.port)
+def worker(k):
+    for i in range(50):
+        st.add("ctr", 1)
+        st.set(f"k{k}:{i}", b"v" * 200)
+ws = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+[t.start() for t in ws]; [t.join() for t in ws]
+assert st.add("ctr", 0) == 200
+srv.stop()
+print("ASAN_DRIVER_OK")
+"""
+
+
+def test_native_runtime_clean_under_asan(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    libasan = subprocess.run(["g++", "-print-file-name=libasan.so"],
+                             capture_output=True, text=True).stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        pytest.skip("no libasan")
+    r = subprocess.run(["make", "-C", CSRC, "asan"], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": libasan,
+        "PD_RUNTIME_LIB": os.path.join(CSRC, "libpd_runtime_asan.so"),
+        # CPython/jax are not ASan-built: suppress their leak/interceptor
+        # noise; we're after heap corruption in OUR .so
+        "ASAN_OPTIONS": "detect_leaks=0:detect_odr_violation=0:"
+                        "verify_asan_link_order=0:abort_on_error=1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    p = subprocess.run([sys.executable, "-c", _DRIVER], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=420)
+    assert "ASAN_DRIVER_OK" in p.stdout, (p.stdout[-2000:], p.stderr[-4000:])
+    assert "ERROR: AddressSanitizer" not in p.stderr, p.stderr[-4000:]
+    assert p.returncode == 0

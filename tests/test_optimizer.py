@@ -198,3 +198,44 @@ def test_linear_lr_and_multiplicative():
         seq.append(m())
         m.step()
     assert seq == [1.0, 0.5, 0.25]
+
+
+def test_lars_momentum_adaptive_rate():
+    """LARS: layerwise lr scales with ||w||/||g||; a huge-gradient layer
+    steps proportionally to the weight norm, not the raw gradient."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.optimizer import LarsMomentum
+
+    paddle.seed(0)
+    w = paddle.to_tensor(np.ones(4, np.float32)); w.stop_gradient = False
+    opt = LarsMomentum(learning_rate=0.1, momentum=0.0, lars_coeff=0.01,
+                       lars_weight_decay=0.0, parameters=[w])
+    loss = (w * paddle.to_tensor(np.full(4, 1000.0, np.float32))).sum()
+    loss.backward()
+    w_before = w.numpy().copy()
+    opt.step()
+    step = w_before - w.numpy()
+    # local_lr = 0.1 * 0.01 * ||w|| / ||g||; update = local_lr * g
+    wn, gn = np.sqrt(4.0), np.sqrt(4 * 1000.0 ** 2)
+    expected = 0.1 * 0.01 * wn / gn * 1000.0
+    np.testing.assert_allclose(step, expected, rtol=1e-4)
+
+
+def test_lars_exclude_from_weight_decay():
+    """Excluded params (name substring) get plain momentum: no lars decay,
+    no adaptive scaling (reference: BN/bias exclusion)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.optimizer import LarsMomentum
+
+    w = paddle.to_tensor(np.ones(4, np.float32)); w.stop_gradient = False
+    w.name = "batch_norm_0.w_0"
+    opt = LarsMomentum(learning_rate=0.1, momentum=0.0, lars_coeff=0.01,
+                       lars_weight_decay=0.5, parameters=[w],
+                       exclude_from_weight_decay=["batch_norm"])
+    (w * 2.0).sum().backward()
+    before = w.numpy().copy()
+    opt.step()
+    # plain sgd step: lr * g = 0.1 * 2
+    np.testing.assert_allclose(before - w.numpy(), 0.2, rtol=1e-5)

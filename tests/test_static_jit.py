@@ -153,3 +153,56 @@ def test_onnx_export_contract(tmp_path):
     loaded = paddle.jit.load(prefix)
     out = loaded(paddle.ones([1, 4]))
     assert list(out.shape) == [1, 2]
+
+
+def test_predictor_clone_pool_and_config_surface(tmp_path):
+    """Predictor.clone / PredictorPool share the loaded model; Config
+    accessors + summary (ref: paddle_infer Config/Predictor API)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import (Config, PredictorPool, create_predictor,
+                                      get_num_bytes_of_data_type, get_version)
+
+    paddle.seed(0)
+    paddle.enable_static()
+    main = Program()
+    with program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        y = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))(x)
+    paddle.disable_static()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [y], program=main)
+
+    cfg = Config()
+    cfg.set_model(prefix + ".pdmodel")
+    cfg.disable_gpu()
+    cfg.enable_memory_optim()
+    assert "model_prefix" in cfg.summary() and "XLA" in cfg.summary()
+    assert cfg.prog_file().endswith(".pdmodel")
+
+    pred = create_predictor(cfg)
+    name = pred.get_input_names()[0]
+    xin = np.random.RandomState(0).randn(2, 4).astype("float32")
+    pred.get_input_handle(name).copy_from_cpu(xin)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+
+    clone = pred.clone()
+    assert clone._model is pred._model  # weights + executables shared
+    clone.get_input_handle(name).copy_from_cpu(xin)
+    clone.run()
+    out2 = clone.get_output_handle(clone.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+    pool = PredictorPool(cfg, 3)
+    outs = []
+    for i in range(3):
+        p = pool.retrieve(i)
+        p.get_input_handle(name).copy_from_cpu(xin)
+        p.run()
+        outs.append(p.get_output_handle(p.get_output_names()[0]).copy_to_cpu())
+    np.testing.assert_allclose(outs[0], outs[2], rtol=1e-6)
+
+    assert get_num_bytes_of_data_type("float32") == 4
+    assert isinstance(get_version(), str)
