@@ -40,7 +40,11 @@ def run_config(config, batch, seq, dev):
     from paddle_tpu.models.llama import (ParallelConfig, build_train_step,
                                          train_flops_per_token)
     on_tpu = dev.platform != "cpu"
-    parallel = ParallelConfig(remat=True, use_flash=on_tpu)
+    # save_attn: keep flash-attention outputs across the remat boundary
+    # (skips recomputing attention in backward; measured +0.004 MFU, and
+    # 'dots'/no-remat exceed memory at this shape)
+    parallel = ParallelConfig(remat=True, remat_policy="save_attn",
+                              use_flash=on_tpu)
     step, params, opt = build_train_step(config, parallel, lr=1e-4)
 
     rng = np.random.RandomState(0)

@@ -29,7 +29,7 @@ class Strategy:
         self.dp_degree = 1
         self.mp_degree = 1
         self.seed = None
-        self.gradient_merge = _Toggle()
+        self.gradient_merge = _GradientMerge()
         self.recompute = _Toggle()
         self.amp = _Toggle()
 
@@ -37,6 +37,12 @@ class Strategy:
 class _Toggle:
     def __init__(self):
         self.enable = False
+
+
+class _GradientMerge(_Toggle):
+    def __init__(self):
+        super().__init__()
+        self.k_steps = 1  # accumulation count
 
 
 class Engine:
@@ -75,6 +81,12 @@ class Engine:
 
     # -- loops -------------------------------------------------------------
 
+    def _grad_accum(self):
+        gm = getattr(self.strategy, "gradient_merge", None)
+        if gm is not None and getattr(gm, "enable", False):
+            return int(getattr(gm, "k_steps", 1))
+        return 1
+
     def _loader(self, data, batch_size, shuffle):
         from ...io import DataLoader, Dataset
         if data is None:
@@ -82,8 +94,11 @@ class Engine:
         if isinstance(data, DataLoader):
             return data
         if isinstance(data, Dataset):
+            # a ragged final batch breaks SPMD batch sharding and
+            # gradient-merge microbatch splitting: drop it when either is on
+            drop = self._mesh is not None or self._grad_accum() > 1
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              drop_last=self._mesh is not None)
+                              drop_last=drop)
         raise TypeError(f"expected Dataset or DataLoader, got {type(data)}")
 
     def _ensure_train_step(self):
@@ -97,7 +112,8 @@ class Engine:
                 bspec = P(tuple(axes)) if axes else None
             self._train_step = TrainStep(self.model, self.loss,
                                          self.optimizer, mesh=mesh,
-                                         batch_spec=bspec)
+                                         batch_spec=bspec,
+                                         grad_accum=self._grad_accum())
         return self._train_step
 
     def _place_eval(self, t):

@@ -39,6 +39,7 @@ class TrainStep:
         self.optimizer = optimizer
         self.mesh = mesh
         self.batch_spec = batch_spec
+        self.grad_accum = int(grad_accum)
         self._step_count = 0
         self._rng = jax.random.PRNGKey(rng_seed)
 
@@ -126,11 +127,53 @@ class TrainStep:
                     return run()
             return run()
 
+        accum = int(grad_accum)
+
+        def accum_loss_grads(train_params, frozen_params, buffers, batch,
+                             rng):
+            """Gradient merge (ref: GradientMergeOptimizer / pipeline
+            accumulate_steps): split the batch into `accum` microbatches on
+            axis 0 and lax.scan them, summing grads in the carry (O(1) grad
+            memory) and applying ONE optimizer update for the mean."""
+            if accum <= 1:
+                return jax.value_and_grad(compute_loss, has_aux=True)(
+                    train_params, frozen_params, buffers, batch, rng)
+
+            def split(a):
+                if a.ndim == 0 or a.shape[0] % accum:
+                    raise ValueError(
+                        f"grad_accum={accum} must divide batch dim "
+                        f"{a.shape[:1]}")
+                # STRIDED split (row i of microbatch m is global row
+                # m + i*accum): under a dp-sharded batch each microbatch
+                # keeps rows on every dp shard; a contiguous split would
+                # park whole microbatches on one shard and force XLA to
+                # reshard every scan step
+                a = a.reshape((a.shape[0] // accum, accum) + a.shape[1:])
+                return jnp.swapaxes(a, 0, 1)
+
+            mb = jax.tree_util.tree_map(split, batch)
+            rngs = jax.random.split(rng, accum)
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, train_params)
+
+            def body(carry, xs):
+                bufs, gsum, lsum = carry
+                batch_i, rng_i = xs
+                (l, new_bufs), g = jax.value_and_grad(
+                    compute_loss, has_aux=True)(train_params, frozen_params,
+                                                bufs, batch_i, rng_i)
+                gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                return (new_bufs, gsum, lsum + l), None
+
+            (new_buffers, gsum, lsum), _ = jax.lax.scan(
+                body, (buffers, g0, jnp.zeros((), jnp.float32)), (mb, rngs))
+            grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
+            return (lsum / accum, new_buffers), grads
+
         def step_fn(train_params, opt_states, buffers, frozen_params, batch,
                     rng, lr):
-            (loss, new_buffers), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(train_params, frozen_params,
-                                            buffers, batch, rng)
+            (loss, new_buffers), grads = accum_loss_grads(
+                train_params, frozen_params, buffers, batch, rng)
             if grad_shardings_ref:
                 grads = {
                     k: jax.lax.with_sharding_constraint(

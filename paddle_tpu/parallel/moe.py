@@ -69,24 +69,21 @@ def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
     E/ep experts; tokens route via lax.all_to_all, mirroring the reference's
     global_scatter/global_gather."""
     n = lax.axis_size(axis_name)
-    T, D = x.shape
+    T, D = x.shape  # T = this device's LOCAL tokens
     e_local = num_experts // n
     capacity = int(capacity_factor * T * k / num_experts + 1)
     combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E,C,D]
-    # send each expert block to its owner: [E,C,D] -> [n, e_local, C, D]
-    blocks = expert_in.reshape(n, e_local, capacity, D)
-    recv = lax.all_to_all(blocks, axis_name, split_axis=0, concat_axis=2,
-                          tiled=False)  # [n, e_local, n*C? ] -> careful
-    # recv: [n, e_local, C, D] where leading axis enumerates source devices;
-    # concat sources along capacity: [e_local, n*C, D]
-    recv = jnp.moveaxis(recv, 0, 1).reshape(e_local, n * capacity, D)
-    out_local = jax.vmap(expert_fn)(expert_params_local, recv)  # [e_local, n*C, D]
-    # return to sources
-    back = out_local.reshape(e_local, n, capacity, -1)
-    back = jnp.moveaxis(back, 1, 0)  # [n, e_local, C, D]
-    expert_out = lax.all_to_all(back, axis_name, split_axis=0, concat_axis=0,
-                                tiled=False)
-    expert_out = expert_out.reshape(num_experts, capacity, -1)
+    # tiled all_to_all: expert axis (owner-major: expert e lives on device
+    # e // e_local) splits into n chunks of e_local experts, received chunks
+    # concatenate along capacity -> each owner holds its experts' slots from
+    # EVERY source device: [e_local, n*C, D]
+    recv = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+    out_local = jax.vmap(expert_fn)(expert_params_local, recv)
+    # inverse exchange: capacity splits back per source, experts concat back
+    # to the full [E, C, D'] on each source device
+    expert_out = lax.all_to_all(out_local, axis_name, split_axis=1,
+                                concat_axis=0, tiled=True)
     out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype), expert_out)
     return out, aux

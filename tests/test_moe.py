@@ -1,0 +1,126 @@
+"""Expert-parallel MoE: gating/dispatch correctness, ep equivalence, and the
+ERNIE-MoE config-ladder model (BASELINE config 5 — EP composition)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.parallel.moe import (moe_dispatch_combine,
+                                     moe_shard_map_dispatch, top_k_gating)
+
+
+def _dense_moe_ref(x, logits, ws, k):
+    """Uncapacitated dense reference: top-k softmax-weighted experts."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    T, E = probs.shape
+    gates = np.zeros((T, E), np.float32)
+    rem = np.asarray(probs).copy()
+    for _ in range(k):
+        idx = rem.argmax(-1)
+        gates[np.arange(T), idx] = np.asarray(probs)[np.arange(T), idx]
+        rem[np.arange(T), idx] = 0
+    outs = np.stack([np.asarray(x) @ np.asarray(w) for w in ws])  # [E,T,D]
+    return np.einsum("te,etd->td", gates, outs)
+
+
+def test_gating_respects_capacity_and_topk():
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    combine, dispatch, aux = top_k_gating(logits, k=2, capacity=3)
+    d = np.asarray(dispatch)
+    # each token goes to at most k experts, one slot each
+    assert (d.sum(axis=(1, 2)) <= 2 + 1e-6).all()
+    # no expert slot is double-booked, and capacity is respected
+    assert (d.sum(axis=0) <= 1 + 1e-6).all()
+    assert float(aux) > 0
+
+
+def test_dispatch_combine_matches_dense_when_uncapacitated():
+    rng = np.random.RandomState(1)
+    T, D, E = 16, 8, 4
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    ws = [rng.randn(D, D).astype(np.float32) for _ in range(E)]
+    stacked = jnp.stack([jnp.asarray(w) for w in ws])
+    out, aux = moe_dispatch_combine(
+        x, logits, lambda w, t: t @ w, stacked, E, k=2,
+        capacity_factor=8.0)  # capacity >= T: nothing dropped
+    ref = _dense_moe_ref(x, logits, ws, k=2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_shard_map_alltoall_matches_einsum_path():
+    """The explicit all-to-all (global_scatter/gather analog) and the GSPMD
+    einsum path must agree: same math, different schedule."""
+    rng = np.random.RandomState(2)
+    T, D, E = 16, 8, 4
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    stacked = jnp.stack([jnp.asarray(rng.randn(D, D).astype(np.float32))
+                         for _ in range(E)])
+    out_ref, _ = moe_dispatch_combine(x, logits, lambda w, t: t @ w,
+                                      stacked, E, k=2, capacity_factor=8.0)
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("ep",))
+    from jax.experimental.shard_map import shard_map
+
+    def run(xl, ll, wl):
+        out, aux = moe_shard_map_dispatch(xl, ll, lambda w, t: t @ w, wl, E,
+                                          axis_name="ep", k=2,
+                                          capacity_factor=8.0)
+        return out
+
+    # tokens are sharded over 'ep' as well (each device dispatches its
+    # local tokens to the expert owners), mirroring global_scatter
+    out_sm = shard_map(
+        run, mesh=mesh,
+        in_specs=(P("ep"), P("ep"), P("ep")), out_specs=P("ep"),
+        check_rep=False)(x, logits, stacked)
+    np.testing.assert_allclose(np.asarray(out_sm), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ernie_moe_ep2_matches_serial():
+    """Config-ladder #5: ERNIE-MoE trains, and ep=2 sharded losses match the
+    single-device run (SPMD correctness for expert parallelism)."""
+    from paddle_tpu.models.ernie_moe import build_train_step, ernie_moe_tiny
+
+    cfg = ernie_moe_tiny()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int32)
+
+    step1, p1, o1 = build_train_step(cfg, ep_degree=1, lr=1e-3)
+    ref = []
+    for _ in range(3):
+        p1, o1, loss, lm = step1(p1, o1, ids, labels)
+        ref.append(float(jax.device_get(loss)))
+
+    step2, p2, o2 = build_train_step(cfg, ep_degree=2, lr=1e-3)
+    got = []
+    for _ in range(3):
+        p2, o2, loss, lm = step2(p2, o2, ids, labels)
+        got.append(float(jax.device_get(loss)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+    assert ref[-1] < ref[0]  # actually training
+
+
+def test_ernie_moe_ep_dp_composition():
+    """EP x DP on a 2x2 mesh matches serial (the reference pairs EP with
+    data parallelism in its ERNIE configs)."""
+    from paddle_tpu.models.ernie_moe import build_train_step, ernie_moe_tiny
+
+    cfg = ernie_moe_tiny()
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int32)
+
+    step1, p1, o1 = build_train_step(cfg, ep_degree=1, lr=1e-3)
+    p1, o1, l1, _ = step1(p1, o1, ids, labels)
+
+    step4, p4, o4 = build_train_step(cfg, ep_degree=2, dp_degree=2, lr=1e-3)
+    p4, o4, l4, _ = step4(p4, o4, ids, labels)
+    np.testing.assert_allclose(float(jax.device_get(l4)),
+                               float(jax.device_get(l1)), rtol=2e-4)
