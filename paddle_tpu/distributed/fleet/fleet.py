@@ -24,9 +24,13 @@ from .topology import (HybridCommunicateGroup, set_hybrid_communicate_group,
 
 class Fleet:
     def __init__(self):
+        import threading
         self._strategy: Optional[DistributedStrategy] = None
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._is_initialized = False
+        self._ps_server = None
+        self._ps_client = None
+        self._ps_stop = threading.Event()
 
     def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
         self._strategy = strategy or DistributedStrategy()
@@ -64,6 +68,67 @@ class Fleet:
         return HybridParallelOptimizer(optimizer, self._hcg,
                                        strategy or self._strategy)
 
+    # -- parameter-server mode (ref: fleet PS role flow:
+    # fleet.init(is_collective=False) -> init_server/run_server on PSERVER
+    # ranks, init_worker + pull/push on TRAINER ranks; roles/endpoints come
+    # from the PADDLE_* env the launcher sets) ----------------------------
+
+    def _ps_env(self):
+        import os
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        servers = [e for e in eps.split(",") if e]
+        return {
+            "role": os.environ.get("TRAINING_ROLE", "TRAINER").upper(),
+            "server_endpoints": servers,
+            "num_servers": max(len(servers), 1),
+            "server_index": int(os.environ.get("PADDLE_PSERVER_ID", "0")),
+            "trainer_index": int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            "master": os.environ.get("PADDLE_MASTER",
+                                     servers[0] if servers else None),
+            "world_size": int(os.environ.get("PADDLE_WORLD_SIZE", "1")),
+            "rank": int(os.environ.get("PADDLE_RANK", "0")),
+        }
+
+    def is_server(self):
+        return self._ps_env()["role"] == "PSERVER"
+
+    def is_worker(self):
+        return self._ps_env()["role"] == "TRAINER"
+
+    def init_server(self, *args, **kwargs):
+        from ..ps import PSServer
+        env = self._ps_env()
+        self._ps_server = PSServer(server_index=env["server_index"],
+                                   rank=env["rank"],
+                                   world_size=env["world_size"],
+                                   master_endpoint=env["master"])
+        return self._ps_server
+
+    def run_server(self):
+        """Serve table requests until stop_server() (ref: blocking
+        fleet.run_server)."""
+        assert self._ps_server is not None, "call fleet.init_server first"
+        self._ps_stop.wait()
+        self._ps_server.stop()
+
+    def stop_server(self):
+        self._ps_stop.set()
+
+    def init_worker(self, *args, **kwargs):
+        from ..ps import PSClient
+        env = self._ps_env()
+        self._ps_client = PSClient(f"trainer:{env['trainer_index']}",
+                                   num_servers=env["num_servers"],
+                                   rank=env["rank"],
+                                   world_size=env["world_size"],
+                                   master_endpoint=env["master"])
+        return self._ps_client
+
+    def stop_worker(self):
+        if self._ps_client is not None:
+            self._ps_client.stop()
+            self._ps_client = None
+
     # -- worker info (reference API surface) ------------------------------
     def worker_index(self):
         import jax
@@ -98,3 +163,31 @@ def distributed_optimizer(optimizer, strategy=None):
 
 def get_hybrid_communicate_group_():
     return fleet.get_hybrid_communicate_group()
+
+
+def init_server(*args, **kwargs):
+    return fleet.init_server(*args, **kwargs)
+
+
+def run_server():
+    return fleet.run_server()
+
+
+def stop_server():
+    return fleet.stop_server()
+
+
+def init_worker(*args, **kwargs):
+    return fleet.init_worker(*args, **kwargs)
+
+
+def stop_worker():
+    return fleet.stop_worker()
+
+
+def is_server():
+    return fleet.is_server()
+
+
+def is_worker():
+    return fleet.is_worker()

@@ -158,3 +158,67 @@ def test_rpc_two_processes(tmp_path):
     assert procs[0].returncode == 0, outs[0]
     assert procs[1].returncode == 0, outs[1]
     assert "RPC_OK" in outs[0]
+
+
+_PS_SERVER_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+from paddle_tpu.distributed import fleet
+
+assert fleet.is_server()
+print("PS_SERVER_STARTING", flush=True)  # before init: rendezvous blocks
+fleet.init_server()                      # until the trainer joins
+print("PS_SERVER_UP", flush=True)
+fleet.run_server()                       # blocks; parent terminates us
+"""
+
+
+def test_fleet_ps_mode_cross_process(tmp_path):
+    """Reference PS flow: a PSERVER process (init_server/run_server) and a
+    TRAINER in this process (init_worker, table ops, stop_worker), roles and
+    endpoints from the PADDLE_* env the launcher would set."""
+    import time
+    port = _free_port()
+    saved_env = dict(os.environ)
+    env = dict(os.environ)
+    env.update({
+        "REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "TRAINING_ROLE": "PSERVER",
+        "PADDLE_PSERVERS_IP_PORT_LIST": f"127.0.0.1:{port}",
+        "PADDLE_PSERVER_ID": "0",
+        "PADDLE_MASTER": f"127.0.0.1:{port}",
+        "PADDLE_WORLD_SIZE": "2",
+        "PADDLE_RANK": "0",
+        "JAX_PLATFORMS": "cpu",
+    })
+    script = tmp_path / "ps_server.py"
+    script.write_text(_PS_SERVER_SCRIPT)
+    srv = subprocess.Popen([sys.executable, str(script)], env=env,
+                           stdout=subprocess.PIPE, text=True)
+    try:
+        line = srv.stdout.readline()
+        assert "PS_SERVER_STARTING" in line, line
+
+        os.environ.update({
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_PSERVERS_IP_PORT_LIST": f"127.0.0.1:{port}",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_WORLD_SIZE": "2",
+            "PADDLE_RANK": "1",
+            "PADDLE_TRAINER_ID": "0",
+        })
+        from paddle_tpu.distributed import fleet
+        assert fleet.is_worker()
+        client = fleet.init_worker()
+        assert client.create_sparse_table("fleet_emb", 4)
+        rows = client.pull_sparse("fleet_emb", [1, 2, 3])
+        assert rows.shape == (3, 4)
+        client.push_sparse("fleet_emb", [1], np.ones((1, 4)), lr=1.0)
+        rows2 = client.pull_sparse("fleet_emb", [1])
+        np.testing.assert_allclose(rows2[0], rows[0] - 1.0, atol=1e-6)
+        fleet.stop_worker()
+    finally:
+        srv.terminate()
+        srv.wait(timeout=10)
+        os.environ.clear()
+        os.environ.update(saved_env)
