@@ -178,6 +178,42 @@ def main():
             }
     detail["decode"] = decode
 
+    if on_tpu:
+        # long-context: streaming-KV Pallas forward (whole-KV residency
+        # would exceed VMEM ~6k tokens earlier); causal, head_dim=128
+        import jax as _jax
+        from jax import lax as _lax
+        from paddle_tpu.ops import flash_attention as _fa
+        long_seq = {}
+        for s_long in (16384, 32768):
+            bh, d_ = 8, 128
+            rng2 = np.random.RandomState(1)
+            q = jnp.asarray(rng2.randn(bh, s_long, d_).astype(np.float32),
+                            dtype=jnp.bfloat16)
+            k = jnp.asarray(rng2.randn(bh, s_long, d_).astype(np.float32),
+                            dtype=jnp.bfloat16)
+            v = jnp.asarray(rng2.randn(bh, s_long, d_).astype(np.float32),
+                            dtype=jnp.bfloat16)
+            n_chain = 4
+
+            def chain(q, k, v):
+                body = lambda i, acc: _fa._flash_fwd(
+                    acc, k, v, True, 1 / 11.3, 1024, 1024)[0]
+                return _lax.fori_loop(0, n_chain, body, q)
+
+            f = _jax.jit(chain)
+            o = f(q, k, v); _jax.device_get(o[0, 0, 0])
+            t0 = time.perf_counter()
+            o = f(q, k, v)
+            _jax.device_get(o[0, 0, 0])
+            dt_l = (time.perf_counter() - t0) / n_chain
+            fl = 2 * 2 * bh * s_long * s_long * d_ / 2  # causal half
+            long_seq[f"S{s_long}"] = {
+                "ms": round(dt_l * 1e3, 1),
+                "attn_eff": round(fl / dt_l / peak_flops(dev), 3),
+            }
+        detail["long_seq_flash_fwd"] = long_seq
+
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(float(mfu), 4),

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...nn import functional as F
 from ...nn.layer.layers import Layer
@@ -147,48 +148,162 @@ class FusedMultiTransformer(Layer):
         self.ffn2_weights = mk([L, dim_feedforward, embed_dim])
         self.ffn2_biases = mk([L, embed_dim], I.Constant(0.0))
 
-    def forward(self, x, attn_mask=None, caches=None, time_step=None):
+    def gen_cache(self, batch, max_len, dtype=None):
+        """Stacked KV cache for incremental decode (ref: the cache tensors
+        fused_multi_transformer_op fills in place): k/v [L, B, max_len, nh,
+        hd] + a position scalar."""
+        from ... import zeros
+        L, nh, hd = self.num_layers, self.num_heads, self.head_dim
+        shape = [L, batch, max_len, nh, hd]
+        k = zeros(shape, dtype=dtype or "float32")
+        v = zeros(shape, dtype=dtype or "float32")
+        return {"k": k, "v": v, "pos": 0}
+
+    @staticmethod
+    def _block(h, per, nh, hd, act_name, attn_step):
+        """One decoder block, shared by the full-forward and cached paths
+        (attn_step(q, k, v) -> attn supplies the attention variant)."""
+        (ls, lb, qw, qb, lw, lbias, fs_, fb, w1, b1, w2, b2) = per
+
+        def ln(t, s_, b_):
+            t32 = t.astype(jnp.float32)
+            mu = t32.mean(-1, keepdims=True)
+            var = t32.var(-1, keepdims=True)
+            return ((t32 - mu) * jax.lax.rsqrt(var + 1e-5)
+                    * s_ + b_).astype(t.dtype)
+
+        b_, s_len = h.shape[0], h.shape[1]
+        resid = h
+        y = ln(h, ls, lb)
+        qkv = (y @ qw + qb).reshape(b_, s_len, 3, nh, hd)
+        attn = attn_step(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        h = resid + attn.reshape(b_, s_len, nh * hd) @ lw + lbias
+        resid = h
+        y = ln(h, fs_, fb)
+        act = (jax.nn.gelu if act_name == "gelu" else jax.nn.relu)
+        return resid + act(y @ w1 + b1) @ w2 + b2
+
+    def _cached_forward(self, x, caches, attn_mask=None, time_step=None):
+        """Prefill (seq>1) or one decode step (seq==1) against the cache.
+        Same carry-resident cache-in-scan pattern as models/llama.py
+        llama_decode_step: caches ride the scan CARRY and update in place,
+        no per-layer cache copies. Returns (out, new_caches).
+
+        attn_mask: optional bool/additive mask broadcastable to
+        [B, nh, seq, max_len] (e.g. padding); time_step overrides the
+        cache's position (reference API)."""
+        nh, hd = self.num_heads, self.head_dim
+        act_name = self.activation
+        pos = int(time_step) if time_step is not None else int(caches["pos"])
+        s_in = int(x.shape[1])
+        max_len = int(caches["k"].shape[2])
+        if pos + s_in > max_len:
+            raise ValueError(
+                f"KV cache overflow: pos {pos} + seq {s_in} > max_len "
+                f"{max_len} (dynamic_update_slice would silently clamp)")
+        # pos enters as a TRACED operand: every decode step reuses one
+        # compiled executable instead of retracing per position
+        pos_t = Tensor(np.asarray(pos, np.int32))
+
+        f = self._cached_fn()
+        args = (x, caches["k"], caches["v"], pos_t, attn_mask)
+        out, new_k, new_v = _run_op(
+            "fused_multi_transformer_cached", f,
+            args + (self.ln_scales, self.ln_biases, self.qkv_weights,
+                    self.qkv_biases, self.linear_weights, self.linear_biases,
+                    self.ffn_ln_scales, self.ffn_ln_biases,
+                    self.ffn1_weights, self.ffn1_biases, self.ffn2_weights,
+                    self.ffn2_biases), {})
+        return out, {"k": new_k, "v": new_v, "pos": pos + s_in}
+
+    def _cached_fn(self):
+        """The cached-decode kernel, built and jitted ONCE per module:
+        jax.jit's shape-keyed cache makes every same-shape decode step reuse
+        one compiled executable (a fresh closure per call would retrace)."""
+        if getattr(self, "_cached_jit", None) is not None:
+            return self._cached_jit
         nh, hd = self.num_heads, self.head_dim
         act_name = self.activation
 
-        def f(xa, *ws):
-            (ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b,
-             fln_s, fln_b, f1_w, f1_b, f2_w, f2_b) = ws
+        def f(xa, kc, vc, pos_a, mask, *ws):
+            s_len = xa.shape[1]
+            n_layers = kc.shape[0]
 
-            def layer(h, per):
-                (ls, lb, qw, qb, lw, lbias, fs_, fb, w1, b1, w2, b2) = per
-                def ln(t, s_, b_):
-                    t32 = t.astype(jnp.float32)
-                    mu = t32.mean(-1, keepdims=True)
-                    var = t32.var(-1, keepdims=True)
-                    return ((t32 - mu) * jax.lax.rsqrt(var + 1e-5)
-                            * s_ + b_).astype(t.dtype)
-                resid = h
-                y = ln(h, ls, lb)
-                b_, s_len = y.shape[0], y.shape[1]
-                qkv = (y @ qw + qb).reshape(b_, s_len, 3, nh, hd)
-                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            def layer(carry, xs):
+                h, kcc, vcc = carry
+                per, li = xs
+                cell = {}
+
+                def attn_step(q, k, v):
+                    zero = jnp.zeros((), jnp.int32)
+                    kcc2 = jax.lax.dynamic_update_slice(
+                        kcc, k.astype(kcc.dtype)[None],
+                        (li, zero, pos_a.astype(jnp.int32), zero, zero))
+                    vcc2 = jax.lax.dynamic_update_slice(
+                        vcc, v.astype(vcc.dtype)[None],
+                        (li, zero, pos_a.astype(jnp.int32), zero, zero))
+                    cell["k"], cell["v"] = kcc2, vcc2
+                    kl = jax.lax.dynamic_index_in_dim(kcc2, li, 0,
+                                                      keepdims=False)
+                    vl = jax.lax.dynamic_index_in_dim(vcc2, li, 0,
+                                                      keepdims=False)
+                    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+                    kh = jnp.swapaxes(kl, 1, 2).astype(jnp.float32)
+                    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) \
+                        / (hd ** 0.5)
+                    kpos = jnp.arange(kl.shape[1])[None, None, None, :]
+                    qpos = (pos_a + jnp.arange(s_len))[None, None, :, None]
+                    ok = kpos <= qpos
+                    logits = jnp.where(ok, logits, -1e30)
+                    if mask is not None:
+                        if mask.dtype == jnp.bool_:
+                            logits = jnp.where(mask, logits, -1e30)
+                        else:
+                            logits = logits + mask.astype(jnp.float32)
+                    probs = jax.nn.softmax(logits, axis=-1)
+                    attn = jnp.einsum(
+                        "bhqk,bhkd->bhqd", probs,
+                        jnp.swapaxes(vl, 1, 2).astype(jnp.float32))
+                    return jnp.swapaxes(attn, 1, 2).astype(h.dtype)
+
+                h = FusedMultiTransformer._block(h, per, nh, hd, act_name,
+                                                 attn_step)
+                return (h, cell["k"], cell["v"]), None
+
+            idxs = jnp.arange(n_layers, dtype=jnp.int32)
+            (h, new_k, new_v), _ = jax.lax.scan(
+                layer, (xa, kc, vc), (ws, idxs))
+            return h, new_k, new_v
+
+        self._cached_jit = jax.jit(f)
+        return self._cached_jit
+
+    def forward(self, x, attn_mask=None, caches=None, time_step=None):
+        nh, hd = self.num_heads, self.head_dim
+        act_name = self.activation
+        if caches is not None:
+            return self._cached_forward(x, caches, attn_mask=attn_mask,
+                                        time_step=time_step)
+
+        def f(xa, mask, *ws):
+            def attn_step(q, k, v):
                 from ...nn.functional.attention import _xla_sdpa
                 from ...ops._common import interpret_mode
-                if interpret_mode():
-                    attn = _xla_sdpa(q, k, v, is_causal=True)
-                else:
-                    from ...ops.flash_attention import flash_attention_bshd
-                    attn = flash_attention_bshd(q, k, v, causal=True)
-                h = resid + attn.reshape(b_, s_len, nh * hd) @ lw + lbias
-                resid = h
-                y = ln(h, fs_, fb)
-                act = (jax.nn.gelu if act_name == "gelu" else jax.nn.relu)
-                h = resid + act(y @ w1 + b1) @ w2 + b2
-                return h, None
+                if mask is not None or interpret_mode():
+                    return _xla_sdpa(q, k, v, attn_mask=mask, is_causal=True)
+                from ...ops.flash_attention import flash_attention_bshd
+                return flash_attention_bshd(q, k, v, causal=True)
 
-            h, _ = jax.lax.scan(layer, xa,
-                                (ln_s, ln_b, qkv_w, qkv_b, lin_w, lin_b,
-                                 fln_s, fln_b, f1_w, f1_b, f2_w, f2_b))
+            def layer(h, per):
+                return FusedMultiTransformer._block(
+                    h, per, nh, hd, act_name, attn_step), None
+
+            h, _ = jax.lax.scan(layer, xa, ws)
             return h
 
         return _run_op("fused_multi_transformer", f,
-                       (x, self.ln_scales, self.ln_biases, self.qkv_weights,
+                       (x, attn_mask,
+                        self.ln_scales, self.ln_biases, self.qkv_weights,
                         self.qkv_biases, self.linear_weights,
                         self.linear_biases, self.ffn_ln_scales,
                         self.ffn_ln_biases, self.ffn1_weights,

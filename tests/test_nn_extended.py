@@ -280,3 +280,63 @@ class TestReviewFixes:
                             reduction="sum")
         loss1.backward()
         assert not np.allclose(x0.grad.numpy(), x1.grad.numpy())
+
+
+def test_fused_multi_transformer_kv_cache_decode():
+    """Cached prefill + per-token decode must match the full causal forward
+    (the reference op's KV-cache contract; north-star inference path)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    m = FusedMultiTransformer(embed_dim=32, num_heads=4, dim_feedforward=64,
+                              num_layers=3)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(2, 10, 32).astype("float32"))
+
+    full = m(x).numpy()
+
+    # prefill 6 tokens, then decode 4 one at a time
+    cache = m.gen_cache(batch=2, max_len=16)
+    out_pre, cache = m(x[:, :6], caches=cache)
+    np.testing.assert_allclose(out_pre.numpy(), full[:, :6], rtol=2e-4,
+                               atol=2e-4)
+    outs = [out_pre.numpy()]
+    for t in range(6, 10):
+        step_out, cache = m(x[:, t:t + 1], caches=cache)
+        outs.append(step_out.numpy())
+    got = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(got, full, rtol=2e-4, atol=2e-4)
+    assert cache["pos"] == 10
+
+
+def test_fused_multi_transformer_cache_overflow_and_mask():
+    import numpy as np
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(2)
+    m = FusedMultiTransformer(embed_dim=16, num_heads=2, dim_feedforward=32,
+                              num_layers=2)
+    rng = np.random.RandomState(0)
+    cache = m.gen_cache(batch=1, max_len=4)
+    x = paddle.to_tensor(rng.randn(1, 4, 16).astype("float32"))
+    _, cache = m(x, caches=cache)
+    with _pytest.raises(ValueError, match="cache overflow"):
+        m(x[:, :1], caches=cache)
+
+    # padding mask: padded batch rows must match the unpadded computation
+    m2 = FusedMultiTransformer(embed_dim=16, num_heads=2, dim_feedforward=32,
+                               num_layers=2)
+    xs = paddle.to_tensor(rng.randn(1, 3, 16).astype("float32"))
+    ref = m2(xs).numpy()
+    cache2 = m2.gen_cache(batch=1, max_len=6)
+    xp = paddle.concat([xs, paddle.zeros([1, 2, 16])], axis=1)  # 2 pad tokens
+    # bool mask [1,1,5,6]: keys 3-4 (pads) masked out for all queries
+    mask = np.ones((1, 1, 5, 6), bool)
+    mask[..., 3:5] = False
+    out, cache2 = m2(xp, caches=cache2,
+                     attn_mask=paddle.to_tensor(mask))
+    np.testing.assert_allclose(out.numpy()[:, :3], ref, rtol=2e-4, atol=2e-4)
