@@ -421,12 +421,125 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
     return dq[:, :s], dk[:, :sk], dv[:, :sk]
 
 
+def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_s, dv_s, *, block_q, causal,
+                           scale, q_len, n_q):
+    """Streaming dK/dV: grid (bh, n_k, n_q); one q/do tile per step, dk/dv
+    accumulate in VMEM scratch (removes the full-q/do residency ceiling)."""
+    import numpy as np
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    bk = k_ref.shape[1]
+    bq_i, bk_i = np.int32(block_q), np.int32(bk)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    needed = (qi + 1) * bq_i > ki * bk_i if causal else qi == qi
+
+    @pl.when(needed)
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        qb = q_ref[0]
+        dob = do_ref[0]
+        lseb = lse_ref[0, 0, :]
+        deltab = delta_ref[0, 0, :]
+        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
+        rows = qi * bq_i + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+        ok = rows < np.int32(q_len)
+        if causal:
+            cols = ki * bk_i + lax.broadcasted_iota(jnp.int32,
+                                                    (block_q, bk), 1)
+            ok = ok & (rows >= cols)
+        s = jnp.where(ok, s, -1e30)
+        p = jnp.exp(s - lseb[:, None])
+        p_lo = p.astype(v.dtype)
+        dv_s[...] = dv_s[...] + jnp.dot(p_lo.T, dob,
+                                        preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(v.dtype)
+        dk_s[...] = dk_s[...] + jnp.dot(ds.T, qb,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == np.int32(n_q - 1))
+    def _finalize():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dq_s, *, block_k, causal, scale, kv_len,
+                          n_k):
+    """Streaming dQ: grid (bh, n_q, n_k); one k/v tile per step, dq
+    accumulates in VMEM scratch (removes the full-KV residency ceiling)."""
+    import numpy as np
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bq_i, bk_i = np.int32(bq), np.int32(block_k)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    start = ki * bk_i
+    needed = start < np.int32(kv_len)
+    if causal:
+        last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
+        needed = jnp.logical_and(needed, start <= last_q)
+
+    @pl.when(needed)
+    def _compute():
+        qb = q_ref[0]
+        dob = do_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        lseb = lse_ref[0, 0, :]
+        deltab = delta_ref[0, 0, :]
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        cols = start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        ok = cols < np.int32(kv_len)
+        if causal:
+            rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
+                                                    (bq, block_k), 0)
+            ok = ok & (rows >= cols)
+        s = jnp.where(ok, s, -1e30)
+        p = jnp.exp(s - lseb[:, None])
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
+        dq_s[...] = dq_s[...] + jnp.dot(ds, kb,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == np.int32(n_k - 1))
+    def _finalize():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
 def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                       block_k, q_len, kv_len):
     """The two backward pallas_calls on already-padded [BH, Sp, D] operands.
-    lse3/delta3: [BH, 1, Sp] f32. Returns padded (dq, dk, dv)."""
+    lse3/delta3: [BH, 1, Sp] f32. Returns padded (dq, dk, dv).
+
+    Each kernel picks resident or streaming per the same VMEM budget as the
+    forward: dkv stages q+do (stream when > STREAM_KV_BYTES), dq stages k+v."""
     bh, sp, d = qp.shape
     skp = kp.shape[1]
+    item = kp.dtype.itemsize
+    if 2 * sp * d * item > STREAM_KV_BYTES:
+        dk, dv = _bwd_dkv_stream_call(qp, kp, vp, dop, lse3, delta3, causal,
+                                      scale, block_q, block_k, q_len)
+    else:
+        dk = dv = None
+    if 2 * skp * d * item > STREAM_KV_BYTES:
+        dq = _bwd_dq_stream_call(qp, kp, vp, dop, lse3, delta3, causal,
+                                 scale, block_q, block_k, kv_len)
+    else:
+        dq = None
+    if dk is not None and dq is not None:
+        return dq, dk, dv
     kv_grid = (bh, skp // block_k)
     with _mosaic_ctx():
         dk, dv = pl.pallas_call(
