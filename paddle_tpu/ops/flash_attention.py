@@ -53,6 +53,32 @@ def _pad_rows(x, multiple):
     return x, n
 
 
+def _mask_scores(s, row0, col0, causal, row_limit=None, col_limit=None):
+    """Trace-time-composed mask for one [R, C] score tile: causal
+    (rows >= cols) and/or row/col validity limits (padding tails). Limits
+    passed as None are elided from the trace entirely — a non-causal
+    unpadded tile pays zero mask work. Shared by all six kernels (resident
+    and streaming, fwd and bwd) so the boundary conditions cannot drift."""
+    import numpy as np
+    if not causal and row_limit is None and col_limit is None:
+        return s
+    r, c = s.shape
+    ok = None
+    cols = (col0 + lax.broadcasted_iota(jnp.int32, (r, c), 1)
+            if (causal or col_limit is not None) else None)
+    rows = (row0 + lax.broadcasted_iota(jnp.int32, (r, c), 0)
+            if (causal or row_limit is not None) else None)
+    if col_limit is not None:
+        ok = cols < np.int32(col_limit)
+    if row_limit is not None:
+        t = rows < np.int32(row_limit)
+        ok = t if ok is None else ok & t
+    if causal:
+        t = rows >= cols
+        ok = t if ok is None else ok & t
+    return jnp.where(ok, s, -1e30)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
                 scale, seq_k, kv_len):
     """seq_k is the PADDED key length (multiple of block_k); kv_len the true
@@ -89,13 +115,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
         v = v_ref[0, pl.ds(j * bk_i, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if masked:
-            cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            ok = cols < np.int32(kv_len) if mask_kv else None
-            if causal:
-                rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
-                                                        (bq, block_k), 0)
-                ok = (rows >= cols) if ok is None else (ok & (rows >= cols))
-            s = jnp.where(ok, s, -1e30)
+            s = _mask_scores(s, qi * bq_i, j * bk_i, causal,
+                             col_limit=kv_len if mask_kv else None)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
@@ -135,12 +156,26 @@ STREAM_KV_BYTES = 3 * 2 ** 20
 
 
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
-                       *, block_k, causal, scale, kv_len, n_k):
+                       *, block_k, causal, scale, kv_len, seq_k, n_k):
     """Streaming variant: grid (BH, n_q, n_k); one KV tile per step, online
     stats in VMEM scratch persisted across the innermost (sequential) k
     steps. Removes the whole-KV VMEM residency ceiling (S beyond ~12k at
-    D=128); fully-above-diagonal causal tiles skip compute (DMA still
-    happens — acceptable, the stream is bandwidth-shaped anyway)."""
+    D=128). Perf notes (profiled on-device at S=16k, D=128, 1024x1024
+    tiles — wall-clock over the tunnel is dispatch-dominated and useless;
+    see bench.py long_seq):
+
+    - seq_k is the PADDED key length, a Python int: when kv_len == seq_k
+      (no padding) the tail compare is elided at trace time, and a
+      non-causal unpadded call runs with no mask work at all.
+    - the causal mask is applied unconditionally on needed tiles: a
+      lax.cond boundary/interior split measured 0.34 eff vs 0.55 for the
+      plain where() — Mosaic branches defeat the pipeline.
+    - fully-above-diagonal causal tiles are never DMA'd: the caller clamps
+      the k/v BlockSpec index to the last needed tile, so Mosaic sees an
+      unchanged block index and skips the copy (see _kv_clamp_map;
+      profiled 0.55 -> 0.60 eff).
+    - finalize at a dynamic last-needed index measured slightly SLOWER
+      than writing at n_k - 1; keep the static finalize."""
     import numpy as np
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -154,6 +189,7 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
     start = ki * bk_i
+    mask_kv = kv_len != seq_k
     needed = start < np.int32(kv_len)
     if causal:
         last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
@@ -165,13 +201,8 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         k = k_ref[0]
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        cols = start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        ok = cols < np.int32(kv_len)
-        if causal:
-            rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
-                                                    (bq, block_k), 0)
-            ok = ok & (rows >= cols)
-        s = jnp.where(ok, s, -1e30)
+        s = _mask_scores(s, qi * bq_i, start, causal,
+                         col_limit=kv_len if mask_kv else None)
         m = m_s[:, :1]
         l = l_s[:, :1]
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
@@ -191,6 +222,22 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
+def _kv_clamp_map(block_q, block_k, causal):
+    """k/v BlockSpec index map for (bh, n_q, n_k) streaming grids: under
+    causal, clamp the k tile index to the last tile this q tile attends to,
+    so fully-above-diagonal steps present an UNCHANGED block index and
+    Mosaic's pipeline skips their DMA entirely (the compute is already
+    gated in-kernel). ~2x bandwidth saved on causal streams."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def _map(b, i, j):
+        jmax = ((i + 1) * block_q - 1) // block_k
+        return (b, jnp.minimum(j, jmax), 0)
+
+    return _map
+
+
 def _flash_fwd_stream(qp, kp, vp, causal, scale, block_q, block_k, sk,
                       out_dtype):
     bh, sp, d = qp.shape
@@ -198,15 +245,16 @@ def _flash_fwd_stream(qp, kp, vp, causal, scale, block_q, block_k, sk,
     n_k = skp // block_k
     kernel = functools.partial(_fwd_kernel_stream, block_k=block_k,
                                causal=causal, scale=scale, kv_len=sk,
-                               n_k=n_k)
+                               seq_k=skp, n_k=n_k)
+    kv_map = _kv_clamp_map(block_q, block_k, causal)
     with _mosaic_ctx():
         return pl.pallas_call(
             kernel,
             grid=(bh, sp // block_q, n_k),
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_k, d), kv_map),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -295,13 +343,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         deltab = delta_ref[0, 0, pl.ds(i * bq_i, block_q)]
         s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
         if masked:
-            rows = i * bq_i + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-            ok = rows < np.int32(q_len) if mask_q else None
-            if causal:
-                cols = ki * bk_i + lax.broadcasted_iota(jnp.int32,
-                                                        (block_q, bk), 1)
-                ok = (rows >= cols) if ok is None else (ok & (rows >= cols))
-            s = jnp.where(ok, s, -1e30)
+            s = _mask_scores(s, i * bq_i, ki * bk_i, causal,
+                             row_limit=q_len if mask_q else None)
         p = jnp.exp(s - lseb[:, None])                    # [BQ, BK] f32
         p_lo = p.astype(v.dtype)
         dv = dv + jnp.dot(p_lo.T, dob, preferred_element_type=jnp.float32)
@@ -360,13 +403,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         vb = v_ref[0, pl.ds(j * bk_i, block_k), :]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
         if masked:
-            cols = j * bk_i + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            ok = cols < np.int32(kv_len) if mask_kv else None
-            if causal:
-                rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
-                                                        (bq, block_k), 0)
-                ok = (rows >= cols) if ok is None else (ok & (rows >= cols))
-            s = jnp.where(ok, s, -1e30)
+            s = _mask_scores(s, qi * bq_i, j * bk_i, causal,
+                             col_limit=kv_len if mask_kv else None)
         p = jnp.exp(s - lseb[:, None])
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
@@ -423,14 +461,19 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
 
 def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                            dk_ref, dv_ref, dk_s, dv_s, *, block_q, causal,
-                           scale, q_len, n_q):
+                           scale, q_len, seq_q, n_q):
     """Streaming dK/dV: grid (bh, n_k, n_q); one q/do tile per step, dk/dv
-    accumulate in VMEM scratch (removes the full-q/do residency ceiling)."""
+    accumulate in VMEM scratch (removes the full-q/do residency ceiling).
+    seq_q is the padded (static) query length: the q-padding compare is
+    elided at trace time when q_len == seq_q; the causal mask is applied
+    unconditionally on needed tiles (lax.cond splits measured ~40% slower
+    — see _fwd_kernel_stream)."""
     import numpy as np
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     bk = k_ref.shape[1]
     bq_i, bk_i = np.int32(block_q), np.int32(bk)
+    mask_q = q_len != seq_q
 
     @pl.when(qi == 0)
     def _init():
@@ -448,13 +491,8 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lseb = lse_ref[0, 0, :]
         deltab = delta_ref[0, 0, :]
         s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
-        rows = qi * bq_i + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
-        ok = rows < np.int32(q_len)
-        if causal:
-            cols = ki * bk_i + lax.broadcasted_iota(jnp.int32,
-                                                    (block_q, bk), 1)
-            ok = ok & (rows >= cols)
-        s = jnp.where(ok, s, -1e30)
+        s = _mask_scores(s, qi * bq_i, ki * bk_i, causal,
+                         row_limit=q_len if mask_q else None)
         p = jnp.exp(s - lseb[:, None])
         p_lo = p.astype(v.dtype)
         dv_s[...] = dv_s[...] + jnp.dot(p_lo.T, dob,
@@ -472,14 +510,17 @@ def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dq_ref, dq_s, *, block_k, causal, scale, kv_len,
-                          n_k):
+                          seq_k, n_k):
     """Streaming dQ: grid (bh, n_q, n_k); one k/v tile per step, dq
-    accumulates in VMEM scratch (removes the full-KV residency ceiling)."""
+    accumulates in VMEM scratch (removes the full-KV residency ceiling).
+    seq_k is the padded (static) key length — kv-tail compare elided at
+    trace time when there is no padding (see _fwd_kernel_stream)."""
     import numpy as np
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     bq = q_ref.shape[1]
     bq_i, bk_i = np.int32(bq), np.int32(block_k)
+    mask_kv = kv_len != seq_k
 
     @pl.when(ki == 0)
     def _init():
@@ -500,13 +541,8 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lseb = lse_ref[0, 0, :]
         deltab = delta_ref[0, 0, :]
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        cols = start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-        ok = cols < np.int32(kv_len)
-        if causal:
-            rows = qi * bq_i + lax.broadcasted_iota(jnp.int32,
-                                                    (bq, block_k), 0)
-            ok = ok & (rows >= cols)
-        s = jnp.where(ok, s, -1e30)
+        s = _mask_scores(s, qi * bq_i, start, causal,
+                         col_limit=kv_len if mask_kv else None)
         p = jnp.exp(s - lseb[:, None])
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
@@ -518,13 +554,99 @@ def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
 
 
+def _bwd_dkv_stream_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
+                         block_q, block_k, q_len):
+    """Streaming dK/dV pallas_call: grid (bh, n_k, n_q), q/do tiles stream
+    through the innermost axis, dk/dv accumulate in VMEM scratch. Under
+    causal, q tiles fully above the diagonal are skipped AND their DMA is
+    elided by clamping the q-side block index (mirror of _kv_clamp_map with
+    max: the first needed q tile for k tile j is (j*block_k)//block_q)."""
+    bh, sp, d = qp.shape
+    skp = kp.shape[1]
+    n_q = sp // block_q
+    if causal:
+        def q_map(b, j, i):
+            imin = (j * block_k) // block_q
+            return (b, jnp.maximum(i, imin), 0)
+
+        def stat_map(b, j, i):
+            imin = (j * block_k) // block_q
+            return (b, 0, jnp.maximum(i, imin))
+    else:
+        q_map = lambda b, j, i: (b, i, 0)
+        stat_map = lambda b, j, i: (b, 0, i)
+    kernel = functools.partial(_bwd_dkv_kernel_stream, block_q=block_q,
+                               causal=causal, scale=scale, q_len=q_len,
+                               seq_q=sp, n_q=n_q)
+    with _mosaic_ctx():
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, skp // block_k, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), q_map),                   # q
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, d), q_map),                   # do
+                pl.BlockSpec((1, 1, block_q), stat_map),                # lse
+                pl.BlockSpec((1, 1, block_q), stat_map),                # delta
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, lse3, delta3)
+
+
+def _bwd_dq_stream_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
+                        block_q, block_k, kv_len):
+    """Streaming dQ pallas_call: grid (bh, n_q, n_k), k/v tiles stream
+    through the innermost axis, dq accumulates in VMEM scratch; causal
+    above-diagonal k tiles skip DMA via the clamped index map."""
+    bh, sp, d = qp.shape
+    skp = kp.shape[1]
+    n_k = skp // block_k
+    kv_map = _kv_clamp_map(block_q, block_k, causal)
+    kernel = functools.partial(_bwd_dq_kernel_stream, block_k=block_k,
+                               causal=causal, scale=scale, kv_len=kv_len,
+                               seq_k=skp, n_k=n_k)
+    with _mosaic_ctx():
+        return pl.pallas_call(
+            kernel,
+            grid=(bh, sp // block_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, lse3, delta3)
+
+
 def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                       block_k, q_len, kv_len):
     """The two backward pallas_calls on already-padded [BH, Sp, D] operands.
     lse3/delta3: [BH, 1, Sp] f32. Returns padded (dq, dk, dv).
 
-    Each kernel picks resident or streaming per the same VMEM budget as the
-    forward: dkv stages q+do (stream when > STREAM_KV_BYTES), dq stages k+v."""
+    Each kernel picks resident or streaming PER SIDE, by the same VMEM
+    budget as the forward: the dkv kernel stages q+do residently (stream
+    when > STREAM_KV_BYTES), the dq kernel stages k+v. Mixed lengths
+    (e.g. short q, long KV) stream only the over-budget side — a side
+    that streamed is never recomputed residently."""
     bh, sp, d = qp.shape
     skp = kp.shape[1]
     item = kp.dtype.itemsize
@@ -538,50 +660,53 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                                  scale, block_q, block_k, kv_len)
     else:
         dq = None
-    if dk is not None and dq is not None:
-        return dq, dk, dv
-    kv_grid = (bh, skp // block_k)
     with _mosaic_ctx():
-        dk, dv = pl.pallas_call(
-            functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
-                              scale=scale, seq_q=sp, q_len=q_len),
-            grid=kv_grid,
-            in_specs=[
-                pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # q
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-                pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # do
-                pl.BlockSpec((1, 1, sp), lambda b, j: (b, 0, 0)),     # lse
-                pl.BlockSpec((1, 1, sp), lambda b, j: (b, 0, 0)),     # delta
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct(kp.shape, kp.dtype),
-                jax.ShapeDtypeStruct(vp.shape, vp.dtype),
-            ],
-            interpret=_interpret(),
-        )(qp, kp, vp, dop, lse3, delta3)
+        if dk is None:
+            kv_grid = (bh, skp // block_k)
+            dk, dv = pl.pallas_call(
+                functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                                  causal=causal, scale=scale, seq_q=sp,
+                                  q_len=q_len),
+                grid=kv_grid,
+                in_specs=[
+                    pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # q
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, sp, d), lambda b, j: (b, 0, 0)),     # do
+                    pl.BlockSpec((1, 1, sp), lambda b, j: (b, 0, 0)),     # lse
+                    pl.BlockSpec((1, 1, sp), lambda b, j: (b, 0, 0)),   # delta
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                    pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+                ],
+                out_shape=[
+                    jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                    jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+                ],
+                interpret=_interpret(),
+            )(qp, kp, vp, dop, lse3, delta3)
 
-        q_grid = (bh, sp // block_q)
-        dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
-                              scale=scale, seq_k=skp, kv_len=kv_len),
-            grid=q_grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
-            interpret=_interpret(),
-        )(qp, kp, vp, dop, lse3, delta3)
+        if dq is None:
+            q_grid = (bh, sp // block_q)
+            dq = pl.pallas_call(
+                functools.partial(_bwd_dq_kernel, block_k=block_k,
+                                  causal=causal, scale=scale, seq_k=skp,
+                                  kv_len=kv_len),
+                grid=q_grid,
+                in_specs=[
+                    pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                    pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
+                    pl.BlockSpec((1, skp, d), lambda b, i: (b, 0, 0)),
+                    pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+                    pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+                    pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+                ],
+                out_specs=pl.BlockSpec((1, block_q, d),
+                                       lambda b, i: (b, i, 0)),
+                out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
+                interpret=_interpret(),
+            )(qp, kp, vp, dop, lse3, delta3)
     return dq, dk, dv
 
 

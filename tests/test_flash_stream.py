@@ -52,6 +52,85 @@ def test_stream_fwd_cross_lengths(force_stream):
                                rtol=2e-4, atol=2e-4)
 
 
+def _ref_grads(q, k, v, causal, scale):
+    def loss(q, k, v):
+        return (_ref_sdpa(q, k, v, causal, scale) ** 2).sum()
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def _flash_grads(q, k, v, causal, scale):
+    def loss(q, k, v):
+        return (fa._flash_attention(q, k, v, causal, scale, 128, 128)
+                .astype(jnp.float32) ** 2).sum()
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [256, 320])  # 320: ragged (pads to 384)
+def test_stream_bwd_matches_reference(force_stream, causal, s):
+    """Both sides over budget -> both grads streamed (the round-2 NameError
+    path: _bwd_dkv_stream_call/_bwd_dq_stream_call)."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
+    got = _flash_grads(q, k, v, causal, 0.125)
+    ref = _ref_grads(q, k, v, causal, 0.125)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
+
+
+@pytest.mark.parametrize("sq,sk", [(128, 512), (512, 128)])
+def test_stream_bwd_mixed_sides(monkeypatch, sq, sk):
+    """Only ONE side over budget (cross-attention, unequal lengths): the
+    streamed side must be used as-is and only the other side computed
+    residently — the round-2 bug recomputed BOTH residently."""
+    monkeypatch.setattr(fa, "STREAM_KV_BYTES", 2 * 256 * 64 * 4)  # 256 rows f32
+    calls = {"dkv_stream": 0, "dq_stream": 0}
+    orig_dkv, orig_dq = fa._bwd_dkv_stream_call, fa._bwd_dq_stream_call
+
+    def spy_dkv(*a, **kw):
+        calls["dkv_stream"] += 1
+        return orig_dkv(*a, **kw)
+
+    def spy_dq(*a, **kw):
+        calls["dq_stream"] += 1
+        return orig_dq(*a, **kw)
+
+    monkeypatch.setattr(fa, "_bwd_dkv_stream_call", spy_dkv)
+    monkeypatch.setattr(fa, "_bwd_dq_stream_call", spy_dq)
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, sq, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, sk, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, sk, 64).astype(np.float32))
+    with jax.disable_jit():  # keep the spies visible through tracing
+        got = _flash_grads(q, k, v, False, 0.125)
+    ref = _ref_grads(q, k, v, False, 0.125)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
+    if sk > sq:   # long KV: dq must stream, dkv resident
+        assert calls == {"dkv_stream": 0, "dq_stream": 1}
+    else:         # long q: dkv must stream, dq resident
+        assert calls == {"dkv_stream": 1, "dq_stream": 0}
+
+
+def test_stream_bwd_causal_long(force_stream):
+    """Causal streamed backward with the clamped (DMA-skipping) index maps
+    at a multi-tile size."""
+    rng = np.random.RandomState(5)
+    s = 512
+    q = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
+    got = _flash_grads(q, k, v, True, 0.125)
+    ref = _ref_grads(q, k, v, True, 0.125)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
+
+
 def test_stream_matches_resident_kernel(force_stream):
     """Streamed output must closely match the resident kernel (same online
     softmax, same tiles)."""
