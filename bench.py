@@ -268,7 +268,7 @@ def main():
                 # bwd does ~2.5x the fwd FLOPs (5 matmuls vs 2)
                 "bwd_eff": round(2.5 * fl / (ms_b / 1e3) / peak_flops(dev), 3),
             }
-        detail["long_seq_flash_attn"] = long_seq
+        detail["long_seq_flash_fwd"] = long_seq
 
     print(json.dumps({
         "metric": "llama_train_mfu",

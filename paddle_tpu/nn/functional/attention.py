@@ -135,8 +135,29 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
     query: [total_q, num_heads, head_dim] — all sequences concatenated;
     cu_seqlens_q/k: [batch+1] int32 cumulative offsets (cu[0]=0,
-    cu[-1]=total). Returns (out [total_q, H, D], softmax=None)."""
+    cu[-1]=total). Returns (out [total_q, H, D], softmax=None).
+
+    On TPU (and within the segment-code limits) this runs the Pallas
+    streaming flash kernels directly on the PACKED layout — O(total * D)
+    memory, no [B, max_q, max_k] logits; elsewhere it falls back to the
+    padded-batch XLA path (_varlen_attention)."""
     max_q, max_k = int(max_seqlen_q), int(max_seqlen_k)
+
+    n_seqs = cu_seqlens_q.shape[0] - 1
+    use_kernel = (_use_pallas(query) and dropout == 0.0
+                  and n_seqs < 1024 and max(max_q, max_k) < (1 << 20))
+    if use_kernel:
+        from ...ops.flash_varlen import flash_varlen_attention
+        self_attn = cu_seqlens_q is cu_seqlens_k
+
+        def fk(q, k, v, cq, ck):
+            s = (1.0 / float(q.shape[-1]) ** 0.5) if scale is None else scale
+            return flash_varlen_attention(q, k, v, cq, ck, s, causal,
+                                          self_attn=self_attn)
+
+        out = _run_op("flash_attn_unpadded", fk,
+                      (query, key, value, cu_seqlens_q, cu_seqlens_k), {})
+        return out, None
 
     def f(q, k, v, cq, ck):
         if scale is None:

@@ -238,6 +238,24 @@ def _kv_clamp_map(block_q, block_k, causal):
     return _map
 
 
+def _q_clamp_map(block_q, block_k, causal, stat=False):
+    """q-side (and lse/delta when stat=True) BlockSpec index map for
+    (bh, n_k, n_q) streaming dK/dV grids: under causal, clamp the q tile
+    index UP to the first tile at/below the diagonal for this k tile, so
+    fully-above-diagonal steps re-present the same block index and skip
+    their DMA (dual of _kv_clamp_map)."""
+    if not causal:
+        return ((lambda b, j, i: (b, 0, i)) if stat
+                else (lambda b, j, i: (b, i, 0)))
+
+    def _map(b, j, i):
+        imin = (j * block_k) // block_q
+        i = jnp.maximum(i, imin)
+        return (b, 0, i) if stat else (b, i, 0)
+
+    return _map
+
+
 def _flash_fwd_stream(qp, kp, vp, causal, scale, block_q, block_k, sk,
                       out_dtype):
     bh, sp, d = qp.shape
@@ -564,17 +582,8 @@ def _bwd_dkv_stream_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
     bh, sp, d = qp.shape
     skp = kp.shape[1]
     n_q = sp // block_q
-    if causal:
-        def q_map(b, j, i):
-            imin = (j * block_k) // block_q
-            return (b, jnp.maximum(i, imin), 0)
-
-        def stat_map(b, j, i):
-            imin = (j * block_k) // block_q
-            return (b, 0, jnp.maximum(i, imin))
-    else:
-        q_map = lambda b, j, i: (b, i, 0)
-        stat_map = lambda b, j, i: (b, 0, i)
+    q_map = _q_clamp_map(block_q, block_k, causal)
+    stat_map = _q_clamp_map(block_q, block_k, causal, stat=True)
     kernel = functools.partial(_bwd_dkv_kernel_stream, block_q=block_q,
                                causal=causal, scale=scale, q_len=q_len,
                                seq_q=sp, n_q=n_q)

@@ -1,0 +1,428 @@
+"""Varlen (packed-sequence) flash attention as Pallas TPU kernels.
+
+Ref: the reference's flash_attn_unpadded (python/paddle/nn/functional/
+flash_attention.py + its FA2 varlen_fwd CUDA binding): packed sequences
+[total_tokens, H, D] with cu_seqlens offsets, no cross-sequence attention.
+
+TPU-native design — NOT the CUDA ragged-batch route. The packed stream is
+treated as ONE long sequence per head, run through the streaming-KV flash
+kernels (see flash_attention.py), and sequence isolation is enforced by a
+per-token i32 CODE = segment_id << 20 | position:
+
+- same-segment test: (code_a ^ code_b) < 2**20  (XOR clears equal high
+  bits; any segment difference sets a bit >= 2**20)
+- intra-segment causal: the code order IS (segment, position) lex order,
+  so same_seg & (code_q >= code_k) masks exactly pos_q >= pos_k.
+
+One i32 array per side replaces separate segment-id and position arrays —
+half the mask DMA and two vector compares per tile. Padding rows carry
+code PAD_CODE (a reserved segment) so they match nothing real; their
+outputs/grads are sliced off and their upstream cotangents are zero, so
+no masking epilogue is needed (see _flash_varlen_bwd).
+
+Layouts follow the in-tree TPU convention to avoid in-kernel relayouts:
+q-side codes are lane-replicated [T, 128] (a q tile reads [block_q, 128]
+sublane-major), kv-side codes are sublane-replicated [8, T] (a kv tile
+reads [1, block_k] lane-major); the [block_q, block_k] mask is then a
+tile+broadcast compare with no transposes.
+
+Limits (checked by the public wrapper, which falls back to the padded-
+batch XLA path): < 1024 sequences per pack, < 2**20 tokens per sequence.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_mode as _interpret
+from ._common import mosaic_trace_ctx as _mosaic_ctx
+from .flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, _fit_block,
+                              _kv_clamp_map, _pad_rows, _q_clamp_map)
+
+
+def _ck_from(kv_map):
+    """kv-side code BlockSpec map from the k/v map (codes are [8, T]; drop
+    the leading bh index, keep the — possibly clamped — tile index)."""
+    return lambda b, i, j: (0, kv_map(b, i, j)[1])
+
+
+def _cq_from(q_map):
+    """q-side code BlockSpec map from the q map (codes are [T, 128])."""
+    return lambda b, j, i: (q_map(b, j, i)[1], 0)
+
+POS_BITS = 20
+SEG_LIMIT = 1 << 10          # max sequences per pack (i32 headroom)
+POS_LIMIT = 1 << POS_BITS    # max tokens per sequence
+PAD_CODE = SEG_LIMIT << POS_BITS
+
+
+def _segs_overlap(cq_ref, ck_ref, block_q, block_k):
+    """Tile-level liveness: segments are contiguous runs of the packed
+    stream, so the [BQ, BK] tile contains ANY same-segment pair iff the
+    q tile's segment range intersects the k tile's. Four scalar loads +
+    two compares per grid step; tiles that fail skip all compute (their
+    DMA still runs — data-dependent DMA skipping would need scalar
+    prefetch, a later optimization)."""
+    seg_q0 = cq_ref[0, 0] >> POS_BITS
+    seg_q1 = cq_ref[block_q - 1, 0] >> POS_BITS
+    seg_k0 = ck_ref[0, 0] >> POS_BITS
+    seg_k1 = ck_ref[0, block_k - 1] >> POS_BITS
+    return jnp.logical_and(seg_q0 <= seg_k1, seg_k0 <= seg_q1)
+
+
+def _tile_mask(s, cq_ref, ck_ref, causal):
+    """Mask one [BQ, BK] score tile from the packed codes.
+
+    cq_ref block: [block_q, 128] (lane-replicated); ck_ref block:
+    [8, block_k] (sublane-replicated)."""
+    bq, bk = s.shape
+    cq = cq_ref[...]                        # [BQ, 128]
+    ck = ck_ref[:1, :]                      # [1, BK]
+    cqt = jnp.tile(cq, (1, bk // 128))      # [BQ, BK] lane-replicated
+    same = (cqt ^ ck) < POS_LIMIT
+    ok = same & (cqt >= ck) if causal else same
+    return jnp.where(ok, s, -1e30)
+
+
+def _fwd_kernel_varlen(q_ref, k_ref, v_ref, cq_ref, ck_ref, o_ref, lse_ref,
+                       m_s, l_s, acc_s, *, block_k, causal, scale, n_k,
+                       self_attn):
+    """Streaming forward over the packed stream: grid (H, n_q, n_k), same
+    online-softmax scratch scheme as flash_attention._fwd_kernel_stream.
+    With self_attn+causal the caller clamps k/v (and ck) DMA above the
+    global diagonal — valid because identical packing makes global order
+    agree with (segment, position) order."""
+    import numpy as np
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bq_i, bk_i = np.int32(bq), np.int32(block_k)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
+        l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+        acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+    needed = _segs_overlap(cq_ref, ck_ref, bq, block_k)
+    if causal and self_attn:
+        needed = jnp.logical_and(
+            needed, ki * bk_i <= (qi + np.int32(1)) * bq_i - np.int32(1))
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _tile_mask(s, cq_ref, ck_ref, causal)
+        m = m_s[:, :1]
+        l = l_s[:, :1]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_s[...] = acc_s[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ki == np.int32(n_k - 1))
+    def _finalize():
+        m = m_s[:, :1]
+        l = l_s[:, :1]
+        o_ref[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
+
+
+def _bwd_dkv_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           cq_ref, ck_ref, dk_ref, dv_ref, dk_s, dv_s, *,
+                           block_q, causal, scale, n_q, self_attn):
+    """Streaming dK/dV: grid (H, n_k, n_q); mirrors
+    flash_attention._bwd_dkv_kernel_stream with the code mask. Padding q
+    rows need no mask: their do (and hence delta) are zero-padded, so
+    their contributions to dk/dv vanish identically."""
+    import numpy as np
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    bk = k_ref.shape[1]
+    bq_i, bk_i = np.int32(block_q), np.int32(bk)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    needed = _segs_overlap(cq_ref, ck_ref, block_q, bk)
+    if causal and self_attn:
+        needed = jnp.logical_and(
+            needed, (qi + np.int32(1)) * bq_i > ki * bk_i)
+
+    @pl.when(needed)
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        qb = q_ref[0]
+        dob = do_ref[0]
+        lseb = lse_ref[0, 0, :]
+        deltab = delta_ref[0, 0, :]
+        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
+        s = _tile_mask(s, cq_ref, ck_ref, causal)
+        p = jnp.exp(s - lseb[:, None])
+        p_lo = p.astype(v.dtype)
+        dv_s[...] = dv_s[...] + jnp.dot(p_lo.T, dob,
+                                        preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(v.dtype)
+        dk_s[...] = dk_s[...] + jnp.dot(ds.T, qb,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(qi == np.int32(n_q - 1))
+    def _finalize():
+        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          cq_ref, ck_ref, dq_ref, dq_s, *, block_k, causal,
+                          scale, n_k, self_attn):
+    """Streaming dQ: grid (H, n_q, n_k); mirrors
+    flash_attention._bwd_dq_kernel_stream with the code mask."""
+    import numpy as np
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bq_i, bk_i = np.int32(bq), np.int32(block_k)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    needed = _segs_overlap(cq_ref, ck_ref, bq, block_k)
+    if causal and self_attn:
+        needed = jnp.logical_and(
+            needed, ki * bk_i <= (qi + np.int32(1)) * bq_i - np.int32(1))
+
+    @pl.when(needed)
+    def _compute():
+        qb = q_ref[0]
+        dob = do_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        lseb = lse_ref[0, 0, :]
+        deltab = delta_ref[0, 0, :]
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        s = _tile_mask(s, cq_ref, ck_ref, causal)
+        p = jnp.exp(s - lseb[:, None])
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
+        dq_s[...] = dq_s[...] + jnp.dot(ds, kb,
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == np.int32(n_k - 1))
+    def _finalize():
+        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+def _expand_codes(code, t):
+    """[T] i32 -> (q-side [T, 128] lane-replicated,
+                   kv-side [8, T] sublane-replicated), padded to t rows
+    with PAD_CODE."""
+    n = code.shape[0]
+    if t != n:
+        code = jnp.pad(code, (0, t - n), constant_values=PAD_CODE)
+    qside = jax.lax.broadcast_in_dim(code, (t, 128), (0,))
+    kvside = jax.lax.broadcast_in_dim(code, (8, t), (1,))
+    return qside.astype(jnp.int32), kvside.astype(jnp.int32)
+
+
+def _codes_from_cu(cu, total):
+    """cu [B+1] i32 cumulative offsets -> packed [total] codes."""
+    t = jnp.arange(total, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu, t, side="right").astype(jnp.int32) - 1
+    pos = t - cu[seg]
+    return (seg << POS_BITS) | pos
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_varlen(q, k, v, code_q, code_k, causal, scale, block_q, block_k,
+                  self_attn):
+    o, _ = _flash_varlen_fwd_impl(q, k, v, code_q, code_k, causal, scale,
+                                  block_q, block_k, self_attn)
+    return o
+
+
+def _flash_varlen_fwd_impl(q, k, v, code_q, code_k, causal, scale, block_q,
+                           block_k, self_attn):
+    """q/k/v: [H, T, D] packed; code_q/k: [T] i32. Returns (o, lse)."""
+    h, t, d = q.shape
+    tk = k.shape[1]
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, tk)
+    qp, _ = _pad_rows(q, block_q)
+    kp, _ = _pad_rows(k, block_k)
+    vp, _ = _pad_rows(v, block_k)
+    tp, tkp = qp.shape[1], kp.shape[1]
+    cq2d, _ = _expand_codes(code_q, tp)
+    _, ck2d = _expand_codes(code_k, tkp)
+    n_k = tkp // block_k
+    kv_map = _kv_clamp_map(block_q, block_k, causal and self_attn)
+    ck_map = _ck_from(kv_map)
+    kernel = functools.partial(_fwd_kernel_varlen, block_k=block_k,
+                               causal=causal, scale=scale, n_k=n_k,
+                               self_attn=self_attn)
+    with _mosaic_ctx():
+        o, lse = pl.pallas_call(
+            kernel,
+            grid=(h, tp // block_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((block_q, 128), lambda b, i, j: (i, 0)),
+                pl.BlockSpec((8, block_k), ck_map),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                jax.ShapeDtypeStruct((h, 1, tp), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, 128), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qp, kp, vp, cq2d, ck2d)
+    return o[:, :t], lse.reshape(h, tp)[:, :t]
+
+
+def _flash_varlen_fwd(q, k, v, code_q, code_k, causal, scale, block_q,
+                      block_k, self_attn):
+    o, lse = _flash_varlen_fwd_impl(q, k, v, code_q, code_k, causal, scale,
+                                    block_q, block_k, self_attn)
+    return o, (q, k, v, code_q, code_k, o, lse)
+
+
+def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn, res, do):
+    q, k, v, code_q, code_k, o, lse = res
+    h, t, d = q.shape
+    tk = k.shape[1]
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, tk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qp, _ = _pad_rows(q, block_q)
+    dop, _ = _pad_rows(do, block_q)
+    kp, _ = _pad_rows(k, block_k)
+    vp, _ = _pad_rows(v, block_k)
+    tp, tkp = qp.shape[1], kp.shape[1]
+    lse3, _ = _pad_rows(lse.reshape(h, t, 1), block_q)
+    delta3, _ = _pad_rows(delta.reshape(h, t, 1), block_q)
+    lse3 = lse3.reshape(h, 1, tp)
+    delta3 = delta3.reshape(h, 1, tp)
+    cq2d, _ = _expand_codes(code_q, tp)
+    _, ck2d = _expand_codes(code_k, tkp)
+    n_q, n_k = tp // block_q, tkp // block_k
+    cc = causal and self_attn
+
+    # dK/dV: grid (h, n_k, n_q); q-side DMA clamped below the diagonal
+    q_map = _q_clamp_map(block_q, block_k, cc)
+    stat_map = _q_clamp_map(block_q, block_k, cc, stat=True)
+    cq_map = _cq_from(q_map)
+    with _mosaic_ctx():
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel_varlen, block_q=block_q,
+                              causal=causal, scale=scale, n_q=n_q,
+                              self_attn=self_attn),
+            grid=(h, n_k, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), q_map),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, d), q_map),
+                pl.BlockSpec((1, 1, block_q), stat_map),
+                pl.BlockSpec((1, 1, block_q), stat_map),
+                pl.BlockSpec((block_q, 128), cq_map),
+                pl.BlockSpec((8, block_k), lambda b, j, i: (0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                jax.ShapeDtypeStruct(vp.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
+
+        kv_map = _kv_clamp_map(block_q, block_k, cc)
+        ck_map = _ck_from(kv_map)
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel_varlen, block_k=block_k,
+                              causal=causal, scale=scale, n_k=n_k,
+                              self_attn=self_attn),
+            grid=(h, n_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_k, d), kv_map),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((block_q, 128), lambda b, i, j: (i, 0)),
+                pl.BlockSpec((8, block_k), ck_map),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
+    return dq[:, :t], dk[:, :tk], dv[:, :tk], None, None
+
+
+_flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
+
+
+def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
+                           causal, self_attn=None,
+                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Kernel-backed packed varlen attention.
+
+    q: [total_q, H, D]; k/v: [total_k, Hkv, D] (GQA repeats kv heads);
+    cu_seqlens_*: [B+1] i32 cumulative offsets. Returns [total_q, H, D].
+    self_attn=True (auto-detected from object identity of the cu arrays)
+    additionally skips DMA/compute of above-diagonal tiles under causal.
+    """
+    if self_attn is None:
+        self_attn = cu_seqlens_q is cu_seqlens_k
+    tq, h, d = q.shape
+    tk = k.shape[0]
+    hkv = k.shape[1]
+    if hkv != h:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    cu_q = cu_seqlens_q.astype(jnp.int32)
+    code_q = _codes_from_cu(cu_q, tq)
+    if self_attn:
+        code_k = code_q
+    else:
+        code_k = _codes_from_cu(cu_seqlens_k.astype(jnp.int32), tk)
+    qh = q.transpose(1, 0, 2)
+    kh = k.transpose(1, 0, 2)
+    vh = v.transpose(1, 0, 2)
+    o = _flash_varlen(qh, kh, vh, code_q, code_k, causal, float(scale),
+                      block_q, block_k, bool(self_attn))
+    return o.transpose(1, 0, 2)

@@ -104,6 +104,32 @@ def test_unpadded_backward_no_cross_sequence_leak():
     np.testing.assert_allclose(gq[0, 0, 0], fd, rtol=2e-2, atol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_unpadded_kernel_branch(monkeypatch, causal):
+    """The TPU kernel branch of flash_attn_unpadded (routing, limits gate,
+    self_attn identity detection, Tensor/_run_op integration), forced on
+    under CPU interpret mode."""
+    import paddle_tpu.nn.functional.attention as A
+    monkeypatch.setattr(A, "_use_pallas", lambda q: True)
+    rng = np.random.RandomState(4)
+    lens = [70, 58]
+    q, cu = _packed(lens, H, rng)
+    k, _ = _packed(lens, H, rng)
+    v, _ = _packed(lens, H, rng)
+    qt = paddle.to_tensor(q); qt.stop_gradient = False
+    cut = paddle.to_tensor(cu)
+    out, _ = F.flash_attn_unpadded(
+        qt, paddle.to_tensor(k), paddle.to_tensor(v), cut, cut,
+        max_seqlen_q=max(lens), max_seqlen_k=max(lens), causal=causal)
+    ref = _dense_ref(q, k, v, cu, cu, causal)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+    # backward through the kernel branch (custom_vjp + None cotangents for
+    # the integer cu args)
+    loss = (out ** 2).sum()
+    loss.backward()
+    assert np.isfinite(qt.grad.numpy()).all()
+
+
 def test_unpadded_gqa_heads():
     """Hkv < H: kv heads broadcast over query-head groups."""
     rng = np.random.RandomState(3)
