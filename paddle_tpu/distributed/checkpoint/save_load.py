@@ -139,16 +139,10 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     path = os.path.abspath(path)
 
     # a save (sync or async) to a path with an in-flight write must wait:
-    # both would otherwise race on the same tmp dir and publish rename
-    with _pending_lock:
-        prev = _pending.get(path)
-    if prev is not None:
-        prev.wait()
-
-    if not async_save:
-        _write_checkpoint(path, arrays, meta)
-        return None
-
+    # both would otherwise race on the same tmp dir and publish rename.
+    # Every save (sync too) registers a handle, and the free slot is
+    # RESERVED under the same lock hold that found it free — a bare
+    # check-then-register would let two concurrent saves both pass.
     handle_box = {}
 
     def run():
@@ -164,9 +158,20 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                               daemon=True)
     handle = AsyncSaveHandle(thread)
     handle_box["h"] = handle
-    with _pending_lock:
-        _pending[path] = handle
-    thread.start()
+    while True:
+        with _pending_lock:
+            prev = _pending.get(path)
+            if prev is None:
+                # register AND start under one lock hold: a registered
+                # handle must be joinable (started) before any concurrent
+                # saver can observe it and wait() on it
+                _pending[path] = handle
+                thread.start()
+                break
+        prev.wait()
+    if not async_save:
+        handle.wait()
+        return None
     return handle
 
 

@@ -25,7 +25,13 @@ def init_parallel_env():
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if coord and nprocs > 1:
-        port = os.environ.get("MASTER_PORT", "8476")
+        # the jax coordination service needs its OWN port: MASTER_PORT is
+        # the launch controller's TCPStore (already bound on rank 0's
+        # node). Default to store port + 1; override with
+        # PADDLE_JAX_COORD_PORT.
+        port = os.environ.get("PADDLE_JAX_COORD_PORT")
+        if port is None:
+            port = str(int(os.environ.get("MASTER_PORT", "8475")) + 1)
         jax.distributed.initialize(f"{coord}:{port}", num_processes=nprocs,
                                    process_id=proc_id)
     _initialized = True

@@ -8,6 +8,15 @@ XLA emits the ICI collectives when sharded computations reference them.
 Axis order matters for locality exactly like NCCL ring order did: mp (heaviest
 traffic) is innermost so it maps to adjacent ICI neighbors, dp outermost.
 An optional ep degree (expert parallel) reuses the sharding×sep×mp submesh.
+
+Multi-host (DCN vs ICI): ``jax.devices()`` enumerates process-major, so the
+OUTERMOST axes of the [dp, pp, sharding, sep, mp] order land across hosts —
+dp's once-per-step gradient all-reduce rides the slow DCN link, while mp/sep
+(per-layer collectives) stay on intra-host ICI. This is the same
+dp-outer-over-nodes placement the reference's HybridCommunicateGroup
+produces with its rank-ordered NCCL subgroups. Proven end-to-end by
+tests/test_multihost.py (two jax.distributed processes, dp over hosts,
+mp within, loss equal to serial).
 """
 from __future__ import annotations
 

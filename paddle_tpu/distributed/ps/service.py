@@ -17,17 +17,123 @@ Reference feature map implemented here:
 
 Sharding across servers is the CLIENT's job (key % num_servers — the
 reference's hash partition); each shard is an independent table here.
+
+Disk tier (ref: the reference's SSD/disk-backed sparse tables,
+ssd_sparse_table.cc): a sparse table created with ``max_mem_rows=N`` keeps
+at most N hot rows in memory (LRU by access order) and spills the cold
+tail to an append-only pickle log with an in-memory key->offset index;
+a pull/push of a spilled key promotes the row back (evicting others).
+save_table merges both tiers, so persistence sees the full table.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import tempfile
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
 _TABLES = {}
 _LOCK = threading.Lock()
+
+
+# -- disk spill tier ---------------------------------------------------------
+
+class _SpillLog:
+    """Append-only row store: offsets index a pickle per row. Updated rows
+    re-append (the old record becomes garbage); save_table compacts by
+    rewriting through the normal save path.
+
+    Own lock: drop_table/load_table close() outside the registry _LOCK
+    while an RPC thread that already fetched the table dict may still be
+    about to read — all file ops and close() serialize here, and ops on a
+    closed log degrade to misses instead of ValueError on a closed file."""
+
+    def __init__(self, path=None):
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="pd_ps_spill_",
+                                        suffix=".log")
+            os.close(fd)
+        self.path = path
+        self._f = open(path, "a+b")
+        self._lock = threading.Lock()
+        self._closed = False
+        self.index = {}
+
+    def put(self, key, row):
+        with self._lock:
+            if self._closed:
+                return
+            self._f.seek(0, os.SEEK_END)
+            off = self._f.tell()
+            pickle.dump(row, self._f, protocol=pickle.HIGHEST_PROTOCOL)
+            self._f.flush()
+            self.index[key] = off
+
+    def _get_locked(self, key):
+        off = self.index.get(key)
+        if off is None or self._closed:
+            return None
+        self._f.seek(off)
+        return pickle.load(self._f)
+
+    def get(self, key):
+        with self._lock:
+            return self._get_locked(key)
+
+    def pop(self, key):
+        with self._lock:
+            row = self._get_locked(key)
+            self.index.pop(key, None)
+            return row
+
+    def keys(self):
+        with self._lock:
+            return list(self.index.keys())
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            try:
+                self._f.close()
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def _evict_if_needed(t):
+    """Spill the least-recently-used ~1/8 of rows once over budget (batch
+    eviction amortizes the append cost). Caller holds _LOCK."""
+    cap = t.get("max_mem_rows") or 0
+    if cap <= 0 or len(t["rows"]) <= cap:
+        return
+    n_evict = max(1, cap // 8)
+    spill = t["spill"]
+    for _ in range(n_evict):
+        if not t["rows"]:
+            break
+        key, row = t["rows"].popitem(last=False)   # LRU front
+        spill.put(key, row)
+
+
+def _get_row(t, key):
+    """Row lookup through both tiers; promotes a spilled row. Caller holds
+    _LOCK. Returns None if absent everywhere."""
+    row = t["rows"].get(key)
+    if row is not None:
+        if t.get("max_mem_rows"):
+            t["rows"].move_to_end(key)
+        return row
+    spill = t.get("spill")
+    if spill is not None:
+        row = spill.pop(key)
+        if row is not None:
+            t["rows"][key] = row
+            _evict_if_needed(t)
+            return row
+    return None
 
 
 # -- accessors (server-side optimizers) -------------------------------------
@@ -92,7 +198,11 @@ def create_dense_table(name, shape, init="zeros", seed=0, accessor=None):
 
 
 def pull_dense(name):
-    return _TABLES[name]["data"]
+    # snapshot under the lock: _accessor_apply mutates the array in place
+    # on push, and a concurrent RPC pull could otherwise serialize a torn
+    # half-updated weight vector
+    with _LOCK:
+        return _TABLES[name]["data"].copy()
 
 
 def push_dense(name, grad, lr=None):
@@ -110,16 +220,22 @@ def push_dense(name, grad, lr=None):
 # -- sparse tables ----------------------------------------------------------
 
 def create_sparse_table(name, emb_dim, init_std=0.01, seed=0, accessor=None,
-                        entry_threshold=0):
+                        entry_threshold=0, max_mem_rows=0, spill_path=None):
+    """max_mem_rows > 0 enables the disk tier: at most that many rows stay
+    in memory (LRU), the rest spill to an on-disk log (spill_path or a
+    tempfile) and promote back on access."""
     with _LOCK:
         if name in _TABLES:
             return False
         _TABLES[name] = {"kind": "sparse", "dim": int(emb_dim),
-                         "rows": {}, "std": init_std,
+                         "rows": OrderedDict(), "std": init_std,
                          "rng": np.random.RandomState(seed),
                          "accessor": _norm_accessor(accessor),
                          "entry_threshold": int(entry_threshold),
-                         "counts": {}}
+                         "counts": {},
+                         "max_mem_rows": int(max_mem_rows),
+                         "spill": (_SpillLog(spill_path)
+                                   if max_mem_rows > 0 else None)}
     return True
 
 
@@ -141,7 +257,7 @@ def pull_sparse(name, ids, training=True):
                 if c < thr:
                     out[i] = 0.0
                     continue
-            row = t["rows"].get(key)
+            row = _get_row(t, key)
             if row is None:
                 if not training or (thr > 0 and
                                     t["counts"].get(key, 0) < thr):
@@ -152,6 +268,7 @@ def pull_sparse(name, ids, training=True):
                        "state": _accessor_state(t["accessor"]["type"],
                                                 (t["dim"],))}
                 t["rows"][key] = row
+                _evict_if_needed(t)
             out[i] = row["w"]
     return out
 
@@ -166,7 +283,7 @@ def push_sparse(name, ids, grads, lr=None):
         if lr is not None:
             acc["lr"] = lr
         for key, g in zip(ids, grads):
-            row = t["rows"].get(int(key))
+            row = _get_row(t, int(key))
             if row is not None:
                 _accessor_apply(acc, row["w"], row["state"], g)
     return True
@@ -178,19 +295,38 @@ def save_table(name, path):
     t = _TABLES[name]
     # snapshot under the lock, serialize/write OUTSIDE it: a multi-GB pickle
     # must not stall every concurrent pull/push on this server
+    def copy_row(r):
+        return {"w": r["w"].copy(),
+                "state": {sk: (sv.copy()
+                               if isinstance(sv, np.ndarray) else sv)
+                          for sk, sv in r["state"].items()}}
+
     with _LOCK:
         blob = dict(t)
         blob.pop("rng", None)
+        blob.pop("spill", None)
         if t["kind"] == "sparse":
-            blob["rows"] = {k: {"w": r["w"].copy(),
-                                "state": {sk: (sv.copy()
-                                               if isinstance(sv, np.ndarray)
-                                               else sv)
-                                          for sk, sv in r["state"].items()}}
-                            for k, r in t["rows"].items()}
+            rows = {k: copy_row(r) for k, r in t["rows"].items()}
+            spill = t.get("spill")
+            blob["rows"] = rows
             blob["counts"] = dict(t["counts"])
         else:
             blob["data"] = t["data"].copy()
+    if t["kind"] == "sparse" and spill is not None:
+        # merge the disk tier OUTSIDE the registry lock: per-row disk
+        # reads must not stall concurrent pulls/pushes (the _SpillLog has
+        # its own lock). A row promoted to memory between the snapshot and
+        # the read is fetched from the hot tier instead.
+        for k in spill.keys():
+            if k in rows:
+                continue
+            row = spill.get(k)
+            if row is None:
+                with _LOCK:
+                    r = t["rows"].get(k)
+                    row = copy_row(r) if r is not None else None
+            if row is not None:
+                rows[k] = row
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "wb") as f:
         pickle.dump(blob, f)
@@ -206,18 +342,39 @@ def load_table(name, path, overwrite=True):
             return False
         if blob["kind"] == "dense":
             blob.pop("rng")
+        else:
+            rows = OrderedDict(blob.get("rows", {}))
+            cap = int(blob.get("max_mem_rows") or 0)
+            blob["rows"] = rows
+            blob["max_mem_rows"] = cap
+            blob["spill"] = _SpillLog() if cap > 0 else None
+            if cap > 0:  # re-spill the cold tail through normal eviction
+                t = blob
+                while len(t["rows"]) > cap:
+                    key, row = t["rows"].popitem(last=False)
+                    t["spill"].put(key, row)
+        old = _TABLES.pop(name, None)
         _TABLES[name] = blob
+    if old is not None and old.get("spill") is not None:
+        old["spill"].close()
     return True
 
 
 def drop_table(name):
     with _LOCK:
-        return _TABLES.pop(name, None) is not None
+        t = _TABLES.pop(name, None)
+    if t is not None and t.get("spill") is not None:
+        t["spill"].close()
+    return t is not None
 
 
 def stat():
     with _LOCK:
-        return {name: (t["kind"],
-                       t["data"].shape if t["kind"] == "dense"
-                       else len(t["rows"]))
-                for name, t in _TABLES.items()}
+        out = {}
+        for name, t in _TABLES.items():
+            if t["kind"] == "dense":
+                out[name] = (t["kind"], t["data"].shape)
+            else:
+                spilled = len(t["spill"].index) if t.get("spill") else 0
+                out[name] = (t["kind"], len(t["rows"]) + spilled)
+        return out

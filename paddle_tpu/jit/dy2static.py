@@ -18,10 +18,20 @@ The transform:
 
   for i in range(n): A  ->  vars = _jst.convert_for_range(n, body_fn, vars)
 
-Loops/branches containing `break`/`continue`/`return` are left untranslated
-(they keep Python semantics eagerly; under tracing jax raises its usual
-concretization error) — the reference handles these with control-flow flag
-rewriting, a documented non-goal here.
+`break`/`continue` on tensor predicates translate via the reference's flag
+rewriting when they appear in the structured form `if <pred>: [stmts...];
+break|continue` directly in the loop body: the escape becomes a loop-carried
+flag, subsequent statements are gated on it, and a rewritten `while` folds
+`and not flag` into its condition (a rewritten `for` runs its full trip
+count with a no-op gated body). A trailing `if <pred>: return a` +
+`return b` becomes a select. Any other tensor-dependent escape raises
+Dy2StaticUnsupportedError with guidance (NOT jax's raw concretization
+error); host-value predicates always keep plain Python semantics.
+
+CAUTION (select semantics): a traced `if` runs BOTH branches and selects
+the outputs. Pure tensor computation is safe; a branch with side effects
+(list.append, print, host I/O, .item()) executes on both paths — the
+transformer warns statically on discarded-value calls in branches.
 """
 from __future__ import annotations
 
@@ -144,6 +154,11 @@ def convert_ifelse(pred, true_fn, false_fn, vs):
     t_out = true_fn(*vs)
     f_out = false_fn(*vs)
     pred_raw = getattr(pred, "_data", pred)
+    if getattr(pred_raw, "ndim", 0) and pred_raw.size == 1:
+        # reference `if` semantics need numel==1; squeezing keeps the
+        # select from broadcasting the pred's [1] shape onto scalar
+        # carries (e.g. the rewritten break/continue flags)
+        pred_raw = pred_raw.reshape(())
     out = []
     for a, b in zip(t_out, f_out):
         if a is UNDEF or b is UNDEF:
@@ -159,10 +174,16 @@ def convert_ifelse(pred, true_fn, false_fn, vs):
 
 
 def convert_while(cond_fn, body_fn, vs):
-    if not _is_traced(cond_fn(*vs)):
-        while _pred_value(cond_fn(*vs)):
-            vs = body_fn(*vs)
-        return vs
+    # python path while the predicate stays concrete; a body can flip the
+    # cond traced mid-loop (e.g. a rewritten break flag fed by a traced
+    # value), in which case fall through to the lax path from the current
+    # carry
+    p = cond_fn(*vs)
+    while not _is_traced(p):
+        if not _pred_value(p):
+            return vs
+        vs = body_fn(*vs)
+        p = cond_fn(*vs)
     if any(v is UNDEF for v in vs):
         raise ValueError(
             "dy2static: every variable assigned in a tensor-dependent while "
@@ -171,7 +192,10 @@ def convert_while(cond_fn, body_fn, vs):
 
     def cond(carry):
         p = cond_fn(*_wrap_vars(flags, carry))
-        return getattr(p, "_data", p)
+        raw = getattr(p, "_data", p)
+        # while_loop needs a SCALAR bool; a size-1 pred (e.g. `x < 3` on a
+        # [1]-shaped tensor) squeezes, anything larger errors in reshape
+        return raw.reshape(()) if getattr(raw, "ndim", 0) else raw
 
     def body(carry):
         outs = body_fn(*_wrap_vars(flags, carry))
@@ -233,6 +257,65 @@ def convert_bool(x):
     return x
 
 
+class Dy2StaticUnsupportedError(RuntimeError):
+    """A tensor-dependent control-flow escape dy2static cannot translate."""
+
+
+def guard_pred(x, ctx):
+    """Wrapped around predicates whose block contains an untranslatable
+    break/continue/return: eager values pass through unchanged; a traced
+    predicate raises a clear framework error instead of jax's raw
+    concretization traceback (ref: dy2static raises its own error types)."""
+    if _is_traced(x):
+        raise Dy2StaticUnsupportedError(
+            f"dy2static: tensor-dependent {ctx} cannot be translated to XLA "
+            "control flow in this form. Translatable forms: `if <pred>: "
+            "[assigns...]; break` / `continue` as direct statements of the "
+            "loop body, and a trailing `if <pred>: return a` + `return b`. "
+            "Otherwise restructure with an explicit flag variable, or keep "
+            "the predicate a host value.")
+    return x
+
+
+def loop_pred(test, brk):
+    """`while test` with a rewritten break: loop while test and not brk."""
+    if _is_traced(test) or _is_traced(brk):
+        from ..tensor.tensor import Tensor
+        t = getattr(test, "_data", test)
+        b = getattr(brk, "_data", brk)
+        return Tensor._from_data(jnp.logical_and(t, jnp.logical_not(b)))
+    return _pred_value(test) and not _pred_value(brk)
+
+
+def not_escaped(*flags):
+    """Gate for loop-body statements after a rewritten break/continue:
+    true while no escape flag is set."""
+    if any(_is_traced(f) for f in flags):
+        from ..tensor.tensor import Tensor
+        acc = None
+        for f in flags:
+            r = getattr(f, "_data", f)
+            acc = r if acc is None else jnp.logical_or(acc, r)
+        return Tensor._from_data(jnp.logical_not(acc))
+    return not any(_pred_value(f) for f in flags)
+
+
+def select_return(pred, a_fn, b_fn):
+    """Trailing `if pred: return a` / `return b` pattern: eager runs one
+    side; traced evaluates both (pure) and selects."""
+    from ..tensor.tensor import Tensor
+    if not _is_traced(pred):
+        return a_fn() if _pred_value(pred) else b_fn()
+    a, b = a_fn(), b_fn()
+    pr = getattr(pred, "_data", pred)
+    ar = getattr(a, "_data", a)
+    br = getattr(b, "_data", b)
+    sel = jnp.where(pr, ar, br)
+    return (Tensor._from_data(sel)
+            if isinstance(a, Tensor) or isinstance(b, Tensor)
+            or _is_traced(sel) else sel)
+
+
 # ---------------------------------------------------------------------------
 # the AST pass
 # ---------------------------------------------------------------------------
@@ -288,18 +371,34 @@ def _assigned(stmts) -> list:
 
 
 def _contains_flow_escape(stmts) -> bool:
-    """break/continue/return anywhere in the block (not in nested defs)."""
+    """Escapes OUT of this block: break/continue not enclosed by a nested
+    loop (those are local to that loop), or return anywhere (not in nested
+    defs)."""
     class V(ast.NodeVisitor):
-        found = False
+        def __init__(self):
+            self.found = False
+            self.loop_depth = 0
 
         def visit_Break(self, n):
-            self.found = True
+            if self.loop_depth == 0:
+                self.found = True
 
         def visit_Continue(self, n):
-            self.found = True
+            if self.loop_depth == 0:
+                self.found = True
 
         def visit_Return(self, n):
             self.found = True
+
+        def visit_For(self, n):
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
+
+        def visit_While(self, n):
+            self.loop_depth += 1
+            self.generic_visit(n)
+            self.loop_depth -= 1
 
         def visit_FunctionDef(self, n):
             pass
@@ -310,6 +409,63 @@ def _contains_flow_escape(stmts) -> bool:
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+def _rewrite_escape_body(body, brk_name, cont_name):
+    """The reference's break/continue flag rewriting (ref: dy2static
+    BreakContinueTransformer), restricted to the structured form: every
+    direct escape is `if <pred>: [stmts...]; break|continue` at loop-body
+    top level (no orelse, no other escapes). The escape becomes a flag
+    assignment and every subsequent statement is gated on the flags — the
+    gated ifs then translate through convert_ifelse like any other.
+
+    Gating rules: with any break present, EVERY statement gates on the
+    persistent break flag — a rewritten `for` keeps looping after a break,
+    so even statements textually before the escape must be skipped in
+    later iterations (in the breaking iteration itself the flag is still
+    unset when they run, preserving order). The continue flag resets each
+    iteration and gates only statements AFTER its setting point.
+
+    Returns (new_body, used_break, used_continue), or None when the body
+    doesn't fit the structured form (caller falls back to guard_pred)."""
+    def escape_kind(s):
+        if not (isinstance(s, ast.If) and _contains_flow_escape([s])):
+            return None
+        if s.orelse or not s.body:
+            return False
+        last = s.body[-1]
+        if not isinstance(last, (ast.Break, ast.Continue)) or \
+                _contains_flow_escape(s.body[:-1]):
+            return False
+        return ast.Break if isinstance(last, ast.Break) else ast.Continue
+
+    kinds = [escape_kind(s) for s in body]
+    if any(k is False for k in kinds):
+        return None
+    if any(k is None and _contains_flow_escape([s])
+           for k, s in zip(kinds, body)):
+        return None  # bare break/continue, return, or non-if escape
+    used_brk = any(k is ast.Break for k in kinds)
+    used_cont = any(k is ast.Continue for k in kinds)
+
+    out = []
+    cont_seen = False
+    for s, kind in zip(body, kinds):
+        if kind is not None:
+            flag = brk_name if kind is ast.Break else cont_name
+            s = ast.If(test=s.test, body=s.body[:-1] + [
+                ast.Assign(targets=[_name(flag, ast.Store())],
+                           value=ast.Constant(value=True))], orelse=[])
+        gates = ([brk_name] if used_brk else []) + \
+                ([cont_name] if cont_seen else [])
+        if gates:
+            s = ast.If(test=_call_jst("not_escaped",
+                                      [_name(f, ast.Load()) for f in gates]),
+                       body=[s], orelse=[])
+        out.append(s)
+        if kind is ast.Continue:
+            cont_seen = True
+    return out, used_brk, used_cont
 
 
 def _name(id_, ctx):
@@ -363,15 +519,63 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
         self.counter += 1
         return self.counter
 
+    # -- shared escape handling -------------------------------------------
+    def _rewrite_loop_escapes(self, node):
+        """Flag-rewrite structured break/continue in a loop body. Returns
+        (init_stmts, used_break) and mutates node.body; on the
+        unstructured form wraps nothing (caller guards) and returns None."""
+        uid = self._uid()
+        brk, cont = f"__dy2st_brk_{uid}", f"__dy2st_cont_{uid}"
+        res = _rewrite_escape_body(node.body, brk, cont)
+        if res is None:
+            return None
+        new_body, used_brk, used_cont = res
+        inits = []
+        if used_brk:
+            inits.append(ast.Assign(targets=[_name(brk, ast.Store())],
+                                    value=ast.Constant(value=False)))
+        if used_cont:
+            # reset each iteration: continue only skips the current pass
+            new_body = [ast.Assign(targets=[_name(cont, ast.Store())],
+                                   value=ast.Constant(value=False))] + new_body
+            inits.append(ast.Assign(targets=[_name(cont, ast.Store())],
+                                    value=ast.Constant(value=False)))
+        node.body = new_body
+        return inits, used_brk, brk
+
     # -- if ---------------------------------------------------------------
     def visit_If(self, node):
         self.generic_visit(node)
         if _contains_flow_escape(node.body) or _contains_flow_escape(node.orelse):
-            return node  # python semantics preserved; traced pred will raise
+            # python semantics preserved eagerly; under trace the guard
+            # raises the framework's error instead of jax's concretization
+            self.counter += 1
+            node.test = _call_jst("guard_pred", [
+                node.test,
+                ast.Constant(value="`if` containing break/continue/return")])
+            return node
         assigned = _assigned(node.body + node.orelse)
         if not assigned:
-            # branch with no bindings (e.g. only side-effect calls): keep
+            # branch with no bindings (only side-effect calls): keep python
+            # semantics; guard so a traced pred gets the framework error,
+            # not jax's raw concretization traceback
+            self.counter += 1
+            node.test = _call_jst("guard_pred", [
+                node.test,
+                ast.Constant(value="`if` whose branches bind no variables "
+                                   "(side effects only)")])
             return node
+        for stmt in node.body + node.orelse:
+            # this if WILL translate to select semantics when the pred is
+            # traced: warn about statement-level calls with discarded
+            # values (append/print/IO) — both branches would run them
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+                warnings.warn(
+                    "dy2static: an `if` branch contains a call whose result "
+                    "is discarded; under tracing BOTH branches execute "
+                    "(select semantics), so side effects run on both paths",
+                    stacklevel=2)
+                break
         uid = self._uid()
         tname, fname = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
         true_fn = _make_fn(tname, assigned, node.body, assigned)
@@ -387,8 +591,22 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
 
     # -- while ------------------------------------------------------------
     def visit_While(self, node):
+        inits = []
+        if not node.orelse and _contains_flow_escape(node.body):
+            rewritten = self._rewrite_loop_escapes(node)
+            if rewritten is not None:
+                inits, used_brk, brk = rewritten
+                if used_brk:
+                    node.test = _call_jst("loop_pred",
+                                          [node.test,
+                                           _name(brk, ast.Load())])
         self.generic_visit(node)
         if node.orelse or _contains_flow_escape(node.body):
+            self.counter += 1
+            node.test = _call_jst("guard_pred", [
+                node.test,
+                ast.Constant(value="while loop with break/continue/return "
+                                   "in an untranslatable position")])
             return node
         loop_vars = _assigned(node.body)  # cond reads non-assigned names
         if not loop_vars:                 # via closure; only stores carry
@@ -409,17 +627,57 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             _name(bname, ast.Load()),
             _prevals_tuple(loop_vars),
         ])
-        return [cond_fn, body_fn, _assign_tuple(loop_vars, call)]
+        return inits + [cond_fn, body_fn, _assign_tuple(loop_vars, call)]
 
     # -- for i in range(...) ----------------------------------------------
     def visit_For(self, node):
+        is_range = (not node.orelse
+                    and isinstance(node.target, ast.Name)
+                    and isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords)
+        inits, post = [], []
+        if is_range and _contains_flow_escape(node.body):
+            # flag-rewrite: the fori_loop runs the full trip count and the
+            # gated body is a no-op after a break (the carry is unchanged).
+            # ONLY for range() loops — a non-range loop keeps its python
+            # break, which a rewrite would silently remove.
+            #
+            # The loop variable stays readable after the loop: a capture
+            # (inserted BEFORE the rewrite so it gets break-gated) carries
+            # the index of the last non-broken iteration, and the target
+            # is rebound from it after the loop.
+            tgt = node.target.id
+            ivis = f"__dy2st_ivis_{self.counter + 1}"
+            node.body = [ast.Assign(targets=[_name(ivis, ast.Store())],
+                                    value=_name(tgt, ast.Load()))] + node.body
+            rewritten = self._rewrite_loop_escapes(node)
+            if rewritten is not None:
+                inits, _, _ = rewritten
+                # pre-bind for the zero-trip case (python would leave the
+                # target unbound; we bind it to the range start)
+                start = (node.iter.args[0] if len(node.iter.args) >= 2
+                         else ast.Constant(value=0))
+                import copy as _copy
+                inits.append(ast.Assign(targets=[_name(ivis, ast.Store())],
+                                        value=_copy.deepcopy(start)))
+                post = [ast.Assign(targets=[_name(tgt, ast.Store())],
+                                   value=_name(ivis, ast.Load()))]
+            else:
+                node.body = node.body[1:]  # undo the capture
         self.generic_visit(node)
-        if (node.orelse or _contains_flow_escape(node.body)
-                or not isinstance(node.target, ast.Name)
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
-                or node.iter.keywords):
+        if not is_range or _contains_flow_escape(node.body):
+            if is_range and _contains_flow_escape(node.body):
+                # traced range() bounds would raise jax's raw conversion
+                # error; guard each bound for the framework error instead
+                self.counter += 1
+                node.iter.args = [
+                    _call_jst("guard_pred", [
+                        a, ast.Constant(value="for-range bound in a loop "
+                                              "with an untranslatable "
+                                              "break/continue/return")])
+                    for a in node.iter.args]
             return node
         assigned = [n for n in _assigned(node.body) if n != node.target.id]
         if not assigned:
@@ -440,7 +698,45 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             _name(bname, ast.Load()),
             _prevals_tuple(assigned),
         ])
-        return [body_fn, _assign_tuple(assigned, call)]
+        return inits + [body_fn, _assign_tuple(assigned, call)] + post
+
+    # -- trailing `if p: return a` / `return b` ----------------------------
+    def visit_FunctionDef(self, node):
+        new_body = []
+        i = 0
+        while i < len(node.body):
+            s = node.body[i]
+            nxt = node.body[i + 1] if i + 1 < len(node.body) else None
+            if (isinstance(s, ast.If) and len(s.body) == 1
+                    and isinstance(s.body[0], ast.Return)
+                    and s.body[0].value is not None):
+                a_val = s.body[0].value
+                b_val = None
+                consumed = 1
+                if (len(s.orelse) == 1 and isinstance(s.orelse[0], ast.Return)
+                        and s.orelse[0].value is not None):
+                    b_val = s.orelse[0].value
+                elif (not s.orelse and isinstance(nxt, ast.Return)
+                      and nxt.value is not None):
+                    b_val = nxt.value
+                    consumed = 2
+                if b_val is not None:
+                    self.counter += 1
+                    lam = lambda v: ast.Lambda(
+                        args=ast.arguments(posonlyargs=[], args=[],
+                                           vararg=None, kwonlyargs=[],
+                                           kw_defaults=[], kwarg=None,
+                                           defaults=[]),
+                        body=v)
+                    new_body.append(ast.Return(value=_call_jst(
+                        "select_return", [s.test, lam(a_val), lam(b_val)])))
+                    i += consumed
+                    continue
+            new_body.append(s)
+            i += 1
+        node.body = new_body
+        self.generic_visit(node)
+        return node
 
 
 # ---------------------------------------------------------------------------
@@ -453,6 +749,10 @@ _JST_NS = types.SimpleNamespace(
     convert_for_range=convert_for_range,
     convert_bool=convert_bool,
     preval=preval,
+    guard_pred=guard_pred,
+    loop_pred=loop_pred,
+    not_escaped=not_escaped,
+    select_return=select_return,
 )
 
 

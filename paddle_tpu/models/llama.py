@@ -84,6 +84,9 @@ class ParallelConfig:
     # recomputes only cheap elementwise ops (jax checkpoint_policies) —
     # trades a little memory for most of the recompute FLOPs back.
     remat_policy: str = "full"
+    # lax.scan unroll over the layer stack: >1 amortizes while-loop step
+    # overhead (checkpoint granularity stays per-layer)
+    scan_unroll: int = 1
     zero_stage: int = 3  # what 'sharding' shards: 1=os, 2=os+g, 3=os+g+p
     use_flash: Optional[bool] = None  # None = auto (TPU yes, CPU no)
 
@@ -282,7 +285,8 @@ def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
     if layer_slice is not None:
         layer_params = jax.tree_util.tree_map(lambda a: a[layer_slice],
                                               layer_params)
-    h, _ = lax.scan(scan_body, h, layer_params)
+    h, _ = lax.scan(scan_body, h, layer_params,
+                    unroll=parallel.scan_unroll)
     return h
 
 
@@ -486,11 +490,8 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
             f"greedy_generate: max_len={max_len} < prompt {plen} + "
             f"max_new_tokens {max_new_tokens}; the cache would overflow")
     frozen = _freeze_config(config)
-    # bucket the scan length (next power of two) so nearby max_new_tokens
-    # values share one compiled executable; extra steps run after the last
-    # wanted token (sequential scan), so slicing the output is safe
     n_cont = max_new_tokens - 1
-    bucket = 1 << (n_cont - 1).bit_length() if n_cont > 0 else 0
+    bucket = generate_scan_bucket(max_new_tokens)
     cache = init_kv_cache(config, b, max(max_len, plen + 1 + bucket))
     logits, cache = _jitted_prefill(frozen)(params, cache,
                                             jnp.asarray(prompt))
@@ -500,6 +501,17 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     toks, cache = _jitted_generate(frozen, bucket)(params, cache, first)
     return np.concatenate([np.asarray(first), np.asarray(toks)[:, :n_cont]],
                           axis=1)
+
+
+def generate_scan_bucket(max_new_tokens: int) -> int:
+    """Number of decode-scan steps greedy_generate compiles for: the
+    continuation length (max_new_tokens - 1, the first token comes from
+    prefill) rounded UP to a power of two, so nearby values share one
+    executable; extra steps run past the last wanted token (sequential
+    scan) and the output is sliced. Benchmarks divide the scan's device
+    time by this."""
+    n_cont = max_new_tokens - 1
+    return 1 << (n_cont - 1).bit_length() if n_cont > 0 else 0
 
 
 def _freeze_config(config):

@@ -260,8 +260,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     if attn_fn is None:
-        from ..nn.functional.attention import _xla_sdpa
-        out = _xla_sdpa(qg, kg, vg, is_causal=causal, scale=scale)
+        # default to the Pallas flash kernel: the gathered sequence is the
+        # FULL S — exactly the regime where XLA sdpa's [B, H, S, S] HBM
+        # logits negate Ulysses' memory point (runs interpreted off-TPU)
+        from ..ops.flash_attention import flash_attention_bshd
+        s = scale if scale is not None else 1.0 / (D ** 0.5)
+        out = flash_attention_bshd(qg, kg, vg, causal=causal, scale=s)
     else:
         out = attn_fn(qg, kg, vg)
     return gather_heads(out)

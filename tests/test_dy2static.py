@@ -200,3 +200,183 @@ def test_multi_output_grad_single_sweep():
     y2 = (h * 2.0).sum()
     g = paddle.grad([y1, y2], [x])
     np.testing.assert_allclose(g[0].numpy(), 3 * 2 * np.array([2.0, 3.0]))
+
+
+# -- break/continue/return flag rewriting (round 3) -------------------------
+
+def test_while_break_on_tensor_pred():
+    def fn(x):
+        acc = x * 0.0
+        k = x * 0.0
+        while k < 100.0:          # tensor predicate
+            acc = acc + 2.0
+            if acc > 5.0:
+                break
+            k = k + 1.0
+        return acc + k
+
+    _compare(fn, np.array([0.0], np.float32))
+
+
+def test_while_continue_on_tensor_pred():
+    def fn(x):
+        acc = x * 0.0
+        k = x * 0.0
+        while k < 6.0:
+            k = k + 1.0
+            if k > 3.0:
+                continue
+            acc = acc + k         # only for k <= 3
+        return acc
+
+    _compare(fn, np.array([0.0], np.float32))
+
+
+def test_while_break_with_pre_assigns():
+    def fn(x):
+        best = x * 0.0
+        k = x * 0.0
+        while k < 10.0:
+            k = k + 1.0
+            if k * k > 9.0:
+                best = k          # assignment before the break translates
+                break
+        return best + k
+
+    _compare(fn, np.array([0.0], np.float32))
+
+
+def test_for_break_on_tensor_pred():
+    def fn(x):
+        acc = x * 0.0
+        n = paddle.to_tensor(8)
+        for i in range(n):
+            acc = acc + 1.0
+            if acc > 3.0:
+                break
+        return acc
+
+    _compare(fn, np.array([0.0], np.float32))
+
+
+def test_tail_return_select():
+    def fn(x):
+        s = x.sum()
+        if s > 0.0:
+            return s * 2.0
+        return s - 1.0
+
+    _compare(fn, np.array([1.0, 2.0], np.float32))
+    _compare(fn, np.array([-1.0, -2.0], np.float32))
+
+
+def test_unstructured_escape_raises_framework_error():
+    from paddle_tpu.jit.dy2static import Dy2StaticUnsupportedError
+
+    def fn(x):
+        acc = x * 0.0
+        k = x * 0.0
+        while k < 5.0:
+            if k > 2.0:
+                acc = acc + 1.0
+                break
+            else:                 # orelse on the escape if: unstructured
+                acc = acc + 2.0
+            k = k + 1.0
+        return acc
+
+    st = paddle.jit.to_static(fn)
+    with pytest.raises(Dy2StaticUnsupportedError, match="dy2static"):
+        st(paddle.to_tensor(np.array([0.0], np.float32)))
+    # eager (host predicate) still runs fine through the same transform
+    def fn2(x, flag):
+        acc = x * 0.0
+        k = 0
+        while k < 5:
+            if flag:              # host predicate: python semantics
+                break
+            k += 1
+        return acc + k
+    out = paddle.jit.to_static(fn2)(
+        paddle.to_tensor(np.array([0.0], np.float32)), False)
+    np.testing.assert_allclose(out.numpy(), [5.0])
+
+
+def test_both_branch_side_effect_warns():
+    import warnings as _w
+    from paddle_tpu.jit.dy2static import Dy2StaticUnsupportedError
+
+    def fn(x):
+        log = []
+        if x.sum() > 0:
+            log.append("pos")
+            y = x * 2.0      # binds -> translates to select semantics
+        else:
+            log.append("neg")
+            y = x * 3.0
+        return y
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        st = paddle.jit.to_static(fn)
+        out = st(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    assert any("BOTH branches" in str(r.message) for r in rec)
+
+    def pure_side_effect(x):
+        log = []
+        if x.sum() > 0:       # binds nothing: python semantics kept,
+            log.append("pos")  # traced pred -> framework error (no warn)
+        return x
+
+    with _w.catch_warnings(record=True) as rec2:
+        _w.simplefilter("always")
+        st2 = paddle.jit.to_static(pure_side_effect)
+        with pytest.raises(Dy2StaticUnsupportedError, match="side effects"):
+            st2(paddle.to_tensor(np.array([1.0], np.float32)))
+    assert not any("BOTH branches" in str(r.message) for r in rec2)
+
+
+def test_non_range_for_with_break_keeps_python_semantics():
+    def fn(x):
+        acc = x * 0.0
+        for v in [1.0, 2.0, 3.0, 4.0]:
+            acc = acc + v
+            if v > 2.0:
+                break
+        return acc
+
+    st = paddle.jit.to_static(fn)
+    out = st(paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [6.0])
+
+
+def test_for_break_loop_var_readable_after_loop():
+    """The loop variable survives a translated break loop (the value at the
+    last executed iteration), matching python semantics."""
+    def fn(x, flag):
+        acc = x * 0.0
+        for i in range(5):
+            acc = acc + 1.0
+            if flag:
+                break
+        return acc + i
+
+    # host flag=False: full loop, i ends at 4
+    st = paddle.jit.to_static(fn)
+    out = st(paddle.to_tensor(np.array([0.0], np.float32)), False)
+    np.testing.assert_allclose(out.numpy(), [9.0])
+
+    # tensor flag: break on first iteration, i stays 0
+    def fn2(x):
+        acc = x * 0.0
+        for i in range(5):
+            acc = acc + 1.0
+            if acc > 2.0:
+                break
+        return acc + i
+
+    eager = fn2(paddle.to_tensor(np.array([0.0], np.float32)))
+    out2 = paddle.jit.to_static(fn2)(
+        paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(out2.numpy(), eager.numpy())

@@ -92,6 +92,57 @@ def test_stage3_params_are_sharded():
     assert found
 
 
+def test_stage3_params_allgathered_in_hlo():
+    """Stage 3 (p_g_os), observable in the compiled HLO: parameters are
+    STORED shard-sized ([HIDDEN/4, ...] between steps) and the program
+    all-gathers the shard to the full shape before use — the same
+    per-layer gather/free the reference's stage 3 hand-schedules on NCCL
+    streams. Stage 2 must show neither (full params stored, no param
+    all-gather)."""
+    import re
+
+    def build(level):
+        model, opt = _make_model_and_opt()
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+        return TrainStep(model, _loss_fn, opt, mesh=_mesh(),
+                         batch_spec=P(("dp", "sharding")))
+
+    def param_allgathers(hlo):
+        # stage-3 signature: a stored param SHARD is all-gathered and the
+        # gathered value feeds a dot (the forward/backward matmuls) — the
+        # per-layer gather-before-use. Stage 2 stores params full, so its
+        # dots consume %param inputs directly (its update-side gathers of
+        # new param shards don't feed dots).
+        return [ln for ln in hlo.splitlines()
+                if re.search(r"dot\([^)]*%all-gather", ln)]
+
+    x, y = _batch()
+    step3 = build("p_g_os")
+    hlo3 = step3.compiled_hlo(x, labels=y)
+    step2 = build("os_g")
+    hlo2 = step2.compiled_hlo(x, labels=y)
+
+    # stored param arrays are shard-sized under stage 3: the [16, HIDDEN]
+    # weight's addressable shard is [16, HIDDEN/4] (largest dim sharded)
+    shard_sized = 0
+    for k in step3.trainable_keys:
+        arr = step3.params[k]
+        spec = arr.sharding.spec
+        if any(ax == "sharding" for ax in spec if ax):
+            shard = arr.addressable_shards[0].data
+            assert shard.size == arr.size // 4, (arr.shape, shard.shape)
+            shard_sized += 1
+        full2 = step2.params[k]
+        assert all(ax != "sharding" for ax in (full2.sharding.spec or ())
+                   if ax)
+    assert shard_sized > 0
+
+    assert param_allgathers(hlo3), \
+        "stage 3 must all-gather param shards before use"
+    assert not param_allgathers(hlo2), \
+        "stage 2 must not all-gather params (they are stored full)"
+
+
 def test_save_group_sharded_model(tmp_path):
     from paddle_tpu.distributed.sharding import save_group_sharded_model
     model, opt = _make_model_and_opt()

@@ -132,3 +132,42 @@ def test_sparse_embedding_layer_trains(ps_world):
     total_rows = sum(n for tables in st.values()
                      for kind, n in tables.values() if kind == "sparse")
     assert total_rows >= 3
+
+
+def test_disk_spill_tier(tmp_path):
+    """max_mem_rows caps the hot tier; cold rows spill to disk, survive
+    there with their optimizer state, and promote back on access with
+    identical values (ref: the reference's SSD sparse tables)."""
+    service.create_sparse_table("spill_t", 4, accessor={"type": "sgd",
+                                                        "lr": 1.0},
+                                max_mem_rows=8,
+                                spill_path=str(tmp_path / "spill.log"))
+    try:
+        # touch 32 ids: only <=8 stay in memory
+        ids = list(range(32))
+        first = service.pull_sparse("spill_t", ids)
+        t = service._TABLES["spill_t"]
+        assert len(t["rows"]) <= 8
+        assert len(t["spill"].index) >= 24
+        # stat counts BOTH tiers
+        kind, n = service.stat()["spill_t"]
+        assert (kind, n) == ("sparse", 32)
+        # push to a SPILLED id: promoted, grad applied (w -= lr*g)
+        victim = ids[0]
+        assert victim not in t["rows"]
+        g = np.ones((1, 4), np.float32)
+        service.push_sparse("spill_t", [victim], g)
+        got = service.pull_sparse("spill_t", [victim])
+        np.testing.assert_allclose(got[0], first[0] - 1.0, rtol=1e-6)
+        # pulls of spilled rows return the same values as when created
+        again = service.pull_sparse("spill_t", ids[1:])
+        np.testing.assert_allclose(again, first[1:], rtol=1e-6)
+        # save merges both tiers; load with the cap re-spills the tail
+        service.save_table("spill_t", str(tmp_path / "table.pkl"))
+        service.load_table("spill_t2", str(tmp_path / "table.pkl"))
+        restored = service.pull_sparse("spill_t2", ids[1:])
+        np.testing.assert_allclose(restored, first[1:], rtol=1e-6)
+        assert len(service._TABLES["spill_t2"]["rows"]) <= 8
+    finally:
+        service.drop_table("spill_t")
+        service.drop_table("spill_t2")
