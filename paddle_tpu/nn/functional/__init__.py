@@ -15,14 +15,15 @@ from .common import (linear, dropout, dropout2d, dropout3d, embedding,
                      pixel_shuffle, cosine_similarity, pairwise_distance,
                      label_smooth, bilinear, alpha_dropout, sequence_mask,
                      threshold, zeropad2d,
-                     feature_alpha_dropout)
+                     feature_alpha_dropout, gather_tree,
+                     sparse_attention)
 from .vision import (affine_grid, grid_sample, pixel_unshuffle,
                      channel_shuffle, temporal_shift)
 from .conv import conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose, conv3d_transpose
 from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
                       max_pool2d, max_pool3d, adaptive_avg_pool1d,
                       adaptive_avg_pool2d, adaptive_avg_pool3d,
-                      adaptive_max_pool2d, global_avg_pool2d,
+                      adaptive_max_pool1d, adaptive_max_pool2d, global_avg_pool2d,
                       max_unpool1d, max_unpool2d, max_unpool3d,
                       lp_pool1d, lp_pool2d)
 from .norm import (layer_norm, batch_norm, instance_norm, group_norm,
@@ -36,6 +37,7 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, mse_loss,
                    poisson_nll_loss, gaussian_nll_loss, soft_margin_loss,
                    multi_label_soft_margin_loss, multi_margin_loss,
                    dice_loss, npair_loss, rnnt_loss,
-                   adaptive_log_softmax_with_loss)
+                   adaptive_log_softmax_with_loss, hsigmoid_loss,
+                   triplet_margin_with_distance_loss)
 from .attention import (flash_attention, flash_attn_unpadded,
                         scaled_dot_product_attention, sdp_kernel)

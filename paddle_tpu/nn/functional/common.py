@@ -257,3 +257,72 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
         b_coef = -a_coef * p * alpha_p
         return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
     return _run_op("feature_alpha_dropout", f, (x,), {})
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrack (ref: paddle.nn.functional.gather_tree):
+    ids/parents [max_time, batch, beam]; walking parent pointers from the
+    last step yields the full sequence per surviving beam."""
+    def f(ids_, par_):
+        t, b, k = ids_.shape
+        from jax import lax
+
+        def step(beam_idx, inputs):
+            id_t, par_t = inputs                 # [B, K] each
+            out = jnp.take_along_axis(id_t, beam_idx, axis=1)
+            nxt = jnp.take_along_axis(par_t, beam_idx, axis=1)
+            return nxt.astype(beam_idx.dtype), out
+
+        init = jnp.broadcast_to(jnp.arange(k, dtype=ids_.dtype)[None], (b, k))
+        _, outs = lax.scan(step, init, (ids_, par_.astype(ids_.dtype)),
+                           reverse=True)
+        return outs                              # [T, B, K]
+    return _run_op("gather_tree", f, (ids, parents), {})
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a CSR connectivity pattern (ref:
+    sparse_attention.py — a GPU-only custom op there). TPU-native
+    substitution: the CSR pattern densifies into a [B, H, S, S] boolean
+    mask and runs masked sdpa — correct for any pattern; for the LONG-
+    sequence patterns this op exists for, prefer the packed varlen flash
+    kernel (ops/flash_varlen.py) or ring attention, which never build the
+    dense mask. q/k/v: [B, H, S, D]; offsets [B, H, S+1]; columns
+    [B, H, nnz]. Returns [B, H, S, D]."""
+    has_kpm = key_padding_mask is not None
+    has_am = attn_mask is not None
+
+    def f(q, k, v, off, cols, *masks):
+        b, h, s, d = q.shape
+        off = off.astype(jnp.int32)
+        cols = cols.astype(jnp.int32)
+        nnz = cols.shape[-1]
+        # row id of nnz entry j = count of row-end offsets <= j
+        idx = jnp.arange(nnz)
+        rows = (off[..., 1:, None] <= idx[None, None, None, :]).sum(2)
+        dense = jnp.zeros((b, h, s, s), bool)
+        bi = jnp.arange(b)[:, None, None]
+        hi = jnp.arange(h)[None, :, None]
+        valid = idx[None, None, :] < off[..., -1:]
+        dense = dense.at[bi, hi, rows, cols].set(valid)
+        logits = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / jnp.sqrt(float(d))
+        mi = 0
+        if has_kpm:
+            dense = dense & (masks[mi][:, None, None, :] != 0)
+            mi += 1
+        if has_am:
+            am = masks[mi]
+            dense = dense & ((am[:, None] if am.ndim == 3 else am) != 0)
+        logits = jnp.where(dense, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows: reference returns zeros
+        any_ok = dense.any(-1, keepdims=True)
+        p = jnp.where(any_ok, p, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p.astype(v.dtype), v)
+
+    extra = tuple(m for m in (key_padding_mask, attn_mask) if m is not None)
+    return _run_op("sparse_attention", f,
+                   (query, key, value, sparse_csr_offset,
+                    sparse_csr_columns) + extra, {})

@@ -453,3 +453,95 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
     args = (input, label, head_weight) + tail_flat + (
         (head_bias,) if head_bias is not None else ())
     return _run_op("adaptive_log_softmax_with_loss", f, args, {})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """triplet_margin_loss with a caller-supplied distance (ref: loss.py).
+    distance_function operates on Tensors and defaults to pairwise L2."""
+    if distance_function is None:
+        from .common import pairwise_distance
+        distance_function = pairwise_distance
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...tensor.math import minimum
+        d_neg = minimum(d_neg, d_pn)
+
+    def f(dp, dn):
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return _run_op("triplet_margin_dist", f, (d_pos, d_neg), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (ref: loss.py hsigmoid_loss).
+
+    Default tree: the complete binary tree the reference builds without a
+    custom dict — leaf c's path is the binary expansion of c + num_classes
+    walked down from the root; internal node ids are heap indices - 1
+    (root = id 0), num_classes - 1 internal nodes total. Custom trees via
+    path_table [N, L] (internal-node ids, padded with -1) and path_code
+    [N, L] (0/1 branch codes). weight: [num_classes - 1, D]; bias:
+    [num_classes - 1]. Returns [N, 1] (sum of per-node -log sigmoid)."""
+    import numpy as np
+
+    if path_table is None:
+        max_s = int(np.ceil(np.log2(max(num_classes, 2)))) + 1
+
+        def paths(lbl):
+            # leaf heap index = lbl + num_classes; its ancestors are the
+            # proper prefixes code >> s (s >= 1, down to the root 1), the
+            # branch bit at each is (code >> (s-1)) & 1. Walking bottom-up
+            # with a per-level validity mask handles the varying path
+            # lengths of a non-power-of-two class count.
+            code = lbl + num_classes
+            nodes, codes, oks = [], [], []
+            for s in range(1, max_s + 1):
+                pref = code >> s
+                nodes.append(pref - 1)           # node id = heap idx - 1
+                codes.append((code >> (s - 1)) & 1)
+                oks.append(pref > 0)
+            tbl = jnp.stack(nodes, -1)
+            cds = jnp.stack(codes, -1)
+            ok = jnp.stack(oks, -1)
+            return tbl, cds, ok
+
+        def f(x, lbl, w, *b):
+            tbl, cds, ok = paths(lbl.reshape(-1).astype(jnp.int32))
+            wp = jnp.take(w, jnp.clip(tbl, 0, w.shape[0] - 1), axis=0)
+            logits = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                                wp.astype(jnp.float32))
+            if b:
+                logits = logits + jnp.take(b[0], jnp.clip(tbl, 0,
+                                                          b[0].shape[0] - 1))
+            # reference convention (MatrixBitCodeFunctor): per-node
+            # loss = softplus(t) - bit*t: bit 0 -> softplus(t),
+            # bit 1 -> softplus(-t) = -log sigmoid(t)
+            sgn = 1.0 - 2.0 * cds.astype(jnp.float32)
+            lo = jax.nn.softplus(sgn * logits)
+            return jnp.where(ok, lo, 0.0).sum(-1, keepdims=True)
+
+        args = (input, label, weight) + ((bias,) if bias is not None else ())
+        return _run_op("hsigmoid", f, args, {})
+
+    def f(x, lbl, w, tbl, cds, *b):
+        tbl = tbl.astype(jnp.int32)
+        ok = tbl >= 0
+        wp = jnp.take(w, jnp.clip(tbl, 0, w.shape[0] - 1), axis=0)
+        logits = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                            wp.astype(jnp.float32))
+        if b:
+            logits = logits + jnp.take(b[0], jnp.clip(tbl, 0,
+                                                      b[0].shape[0] - 1))
+        sgn = 1.0 - 2.0 * cds.astype(jnp.float32)
+        lo = jax.nn.softplus(sgn * logits)
+        return jnp.where(ok, lo, 0.0).sum(-1, keepdims=True)
+
+    args = (input, label, weight, path_table, path_code) + \
+        ((bias,) if bias is not None else ())
+    return _run_op("hsigmoid_custom", f, args, {})

@@ -328,3 +328,27 @@ def _lp_pool(x, norm_type, kernel, stride, padding, n, name,
         return jnp.moveaxis(out, 1, -1) if chan_last else out
 
     return _run_op(name, f, (x,), {})
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    """[N, C, L] -> [N, C, output_size] (ref: pooling.py)."""
+    out = int(output_size) if not isinstance(output_size, (list, tuple)) \
+        else int(output_size[0])
+
+    if not return_mask:
+        def f(a):
+            L = a.shape[2]
+            cols = [a[:, :, s:e].max(-1) for s, e in _adaptive_edges(L, out)]
+            return jnp.stack(cols, axis=-1)
+        return _run_op("adaptive_max_pool1d", f, (x,), {})
+
+    # one op computes argmax once and derives the max from it
+    def fboth(a):
+        L = a.shape[2]
+        cols = [s + a[:, :, s:e].argmax(-1)
+                for s, e in _adaptive_edges(L, out)]
+        mask = jnp.stack(cols, axis=-1).astype(jnp.int32)
+        return jnp.take_along_axis(a, mask, axis=-1), mask
+
+    res = _run_op("adaptive_max_pool1d_mask", fboth, (x,), {})
+    return res[0], res[1]

@@ -236,3 +236,13 @@ def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
     r, c = np.triu_indices(row, offset, col)
     nd = dtype_mod.convert_dtype(dtype) or np.int64
     return Tensor._from_data(jnp.asarray(np.stack([r, c]), dtype=nd))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    base = x._data if hasattr(x, "_data") else x
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    nd = dtype_mod.convert_dtype(dtype) or base.dtype
+    return Tensor._from_data(
+        jax.random.randint(key, tuple(base.shape), low, high).astype(nd))

@@ -354,3 +354,28 @@ def pdist(x, p=2.0, name=None):
             return (diff != 0).sum(-1).astype(a.dtype)
         return jnp.maximum((diff ** p).sum(-1), 1e-30) ** (1.0 / p)
     return _run_op("pdist", f, (x,), {})
+
+
+inv = inverse  # paddle.linalg.inv alias
+
+
+def cond(x, p=None, name=None):
+    """Condition number (ref: linalg.cond): p in {None/2, 'fro', 'nuc',
+    1, -1, 2, -2, inf, -inf}."""
+    def f(a):
+        af = a.astype(jnp.float32)
+        if p is None or p == 2 or p == -2:
+            s = jnp.linalg.svd(af, compute_uv=False)
+            smax, smin = s.max(-1), s.min(-1)
+            return smax / smin if (p is None or p == 2) else smin / smax
+        if p in ("fro", "nuc"):
+            ainv = jnp.linalg.inv(af)
+            if p == "fro":
+                nrm = lambda m: jnp.sqrt((m * m).sum((-2, -1)))
+            else:
+                nrm = lambda m: jnp.linalg.svd(m, compute_uv=False).sum(-1)
+            return nrm(af) * nrm(ainv)
+        ainv = jnp.linalg.inv(af)
+        return (jnp.linalg.norm(af, ord=p, axis=(-2, -1))
+                * jnp.linalg.norm(ainv, ord=p, axis=(-2, -1)))
+    return _run_op("linalg_cond", f, (x,), {})

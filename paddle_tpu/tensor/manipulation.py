@@ -553,3 +553,62 @@ def as_strided(x, shape, stride, offset=0, name=None):
         lin = sum(g * st for g, st in zip(grids, stride)) + offset
         return jnp.take(flat, lin.reshape(-1), axis=0).reshape(shape)
     return _run_op("as_strided", f, (x,), {})
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis` (ref: Tensor.unfold): returns a view-
+    shaped copy with a trailing window dim of length `size`, windows taken
+    every `step` elements."""
+    axis = int(axis)
+    size = int(size)
+    step = int(step)
+
+    def f(a):
+        ax = axis % a.ndim
+        n = a.shape[ax]
+        n_win = max(0, (n - size) // step + 1)
+        starts = jnp.arange(n_win) * step
+        idx = starts[:, None] + jnp.arange(size)[None]     # [n_win, size]
+        win = jnp.take(a, idx.reshape(-1), axis=ax)
+        shp = a.shape[:ax] + (n_win, size) + a.shape[ax + 1:]
+        win = win.reshape(shp)
+        # window dim goes LAST (reference layout)
+        perm = (list(range(ax + 1)) + list(range(ax + 2, len(shp)))
+                + [ax + 1])
+        return win.transpose(perm)
+    return _run_op("unfold", f, (x,), {})
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (ref: multiplex): out[i] =
+    inputs[index[i]][i]."""
+    def f(idx, *ts):
+        stacked = jnp.stack(ts, axis=0)                   # [n, B, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+    return _run_op("multiplex", f, (index, *inputs), {})
+
+
+def tolist(x, name=None):
+    return np.asarray(x._data if isinstance(x, Tensor) else x).tolist()
+
+
+def shape(x, name=None):
+    """Tensor of the runtime shape (ref: paddle.shape)."""
+    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._from_data(jnp.asarray(np.array(d.shape, np.int32)))
+
+
+def rank(x, name=None):
+    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._from_data(jnp.asarray(np.int32(d.ndim)))
+
+
+def is_empty(x, name=None):
+    d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._from_data(jnp.asarray(d.size == 0))
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static broadcast result shape (list of ints)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -42,6 +42,7 @@ divide = _binary("divide", lambda a, b: jnp.true_divide(a, b))
 floor_divide = _binary("floor_divide", lambda a, b: jnp.floor_divide(a, b))
 mod = _binary("mod", lambda a, b: jnp.mod(a, b))
 remainder = mod
+floor_mod = mod
 pow = _binary("pow", lambda a, b: jnp.power(a, b))
 maximum = _binary("maximum", lambda a, b: jnp.maximum(a, b))
 minimum = _binary("minimum", lambda a, b: jnp.minimum(a, b))

@@ -165,3 +165,114 @@ def test_entropy_kl_consistency():
     kl = kl_divergence(a, c).numpy()
     ref = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
     np.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+
+def test_round3_tensor_op_tail():
+    """Round-3 long-tail additions: unfold/multiplex/shape/rank/is_empty/
+    broadcast_shape/floor_mod/tolist/randint_like."""
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert paddle.broadcast_shape([3, 1], [1, 4]) == [3, 4]
+    np.testing.assert_allclose(
+        paddle.floor_mod(paddle.to_tensor([7.0, -7.0]),
+                         paddle.to_tensor([3.0, 3.0])).numpy(),
+        [1.0, 2.0])  # python-style mod (the reference's floor_mod)
+    assert not bool(paddle.is_empty(x).numpy())
+    assert bool(paddle.is_empty(
+        paddle.to_tensor(np.zeros((0, 3), np.float32))).numpy())
+    assert int(paddle.rank(x).numpy()) == 2
+    np.testing.assert_array_equal(paddle.shape(x).numpy(), [3, 4])
+    assert paddle.tolist(x)[2][3] == 11.0
+
+    u = paddle.unfold(paddle.to_tensor(np.arange(8, dtype=np.float32)),
+                      0, 3, 2)
+    np.testing.assert_array_equal(u.numpy(), [[0, 1, 2], [2, 3, 4],
+                                              [4, 5, 6]])
+    # unfold on a middle axis keeps surrounding dims, window last
+    u2 = paddle.unfold(x, 1, 2, 2)
+    assert u2.shape == [3, 2, 2]
+    np.testing.assert_array_equal(u2.numpy()[0], [[0, 1], [2, 3]])
+
+    m = paddle.multiplex(
+        [paddle.to_tensor(np.full((3, 2), 7, np.float32)),
+         paddle.to_tensor(np.zeros((3, 2), np.float32))],
+        paddle.to_tensor(np.array([[0], [1], [0]], np.int32)))
+    np.testing.assert_array_equal(m.numpy()[:, 0], [7, 0, 7])
+
+    r = paddle.randint_like(x, 5, 10)
+    assert r.shape == [3, 4]
+    assert (np.asarray(r.numpy()) >= 5).all() and (np.asarray(r.numpy()) < 10).all()
+
+
+def test_round3_linalg_tail():
+    a = paddle.to_tensor(np.array([[2.0, 0.0], [0.0, 4.0]], np.float32))
+    np.testing.assert_allclose(paddle.linalg.cond(a).numpy(), 2.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.cond(a, "fro").numpy(),
+                               np.sqrt(20) * np.sqrt(0.25 + 1 / 16),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.cond(a, 1).numpy(), 2.0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.inv(a).numpy(),
+                               [[0.5, 0], [0, 0.25]], rtol=1e-6)
+
+
+def test_round3_functional_tail():
+    import paddle_tpu.nn.functional as F
+    # adaptive_max_pool1d incl. mask
+    xin = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(1, 2, 6))
+    p1, mask = F.adaptive_max_pool1d(xin, 3, return_mask=True)
+    np.testing.assert_array_equal(p1.numpy()[0, 0], [1, 3, 5])
+    np.testing.assert_array_equal(mask.numpy()[0, 0], [1, 3, 5])
+
+    # gather_tree: hand-checked backtrack
+    ids = paddle.to_tensor(np.array([[[2, 5]], [[6, 1]], [[3, 9]]], np.int32))
+    par = paddle.to_tensor(np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int32))
+    out = F.gather_tree(ids, par).numpy()
+    # beam 0 at t=2 came from parent 0 (t=1, id 6) whose parent is 1 (t=0,
+    # id 5); beam 1 came from parent 1 (t=1, id 1) whose parent is 0
+    np.testing.assert_array_equal(out[:, 0, 0], [5, 6, 3])
+    np.testing.assert_array_equal(out[:, 0, 1], [2, 1, 9])
+
+    # triplet_margin_with_distance_loss: custom distance
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    loss = F.triplet_margin_with_distance_loss(
+        x, x, x + 2.0, distance_function=lambda a, b: ((a - b) ** 2).sum(-1),
+        margin=1.0)
+    assert float(loss.numpy()) == 0.0  # d_neg=32 >> d_pos+margin
+
+    # hsigmoid_loss: finite, positive, grads flow, works for non-pow2
+    rng = np.random.RandomState(0)
+    w = paddle.to_tensor(rng.randn(9, 4).astype(np.float32) * 0.1)
+    w.stop_gradient = False
+    xs = paddle.to_tensor(rng.randn(5, 4).astype(np.float32))
+    lbl = paddle.to_tensor(np.array([0, 3, 9, 5, 7], np.int64))
+    hs = F.hsigmoid_loss(xs, lbl, 10, w)
+    assert hs.shape == [5, 1] and (hs.numpy() > 0).all()
+    hs.sum().backward()
+    assert np.abs(w.grad.numpy()).sum() > 0
+    # custom path tables give the same result as the default tree when
+    # they ENCODE the default tree
+    codes = np.array([[(lbl_ + 10) >> s for s in range(1, 5)]
+                      for lbl_ in [0, 3, 9, 5, 7]])
+    tbl = np.where(codes > 0, codes - 1, -1).astype(np.int64)
+    bits = np.array([[((lbl_ + 10) >> (s - 1)) & 1 for s in range(1, 5)]
+                     for lbl_ in [0, 3, 9, 5, 7]]).astype(np.int64)
+    hs2 = F.hsigmoid_loss(xs, lbl, 10, w, path_table=paddle.to_tensor(tbl),
+                          path_code=paddle.to_tensor(bits))
+    np.testing.assert_allclose(hs.numpy(), hs2.numpy(), rtol=1e-5)
+
+    # sparse_attention equals dense attention restricted to the pattern
+    q = paddle.to_tensor(rng.randn(1, 1, 3, 4).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(1, 1, 3, 4).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(1, 1, 3, 4).astype(np.float32))
+    # row 0 -> {0,1}; row 1 -> {1}; row 2 -> {0,2}
+    off = paddle.to_tensor(np.array([[[0, 2, 3, 5]]], np.int32))
+    cols = paddle.to_tensor(np.array([[[0, 1, 1, 0, 2]]], np.int32))
+    got = F.sparse_attention(q, k, v, off, cols).numpy()[0, 0]
+    qn, kn, vn = (t.numpy()[0, 0] for t in (q, k, v))
+    lg = qn @ kn.T / 2.0
+    mask = np.array([[1, 1, 0], [0, 1, 0], [1, 0, 1]], bool)
+    lg = np.where(mask, lg, -1e30)
+    p = np.exp(lg - lg.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got, p @ vn, rtol=1e-4, atol=1e-5)
