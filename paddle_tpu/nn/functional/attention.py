@@ -153,7 +153,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         def fk(q, k, v, cq, ck):
             s = (1.0 / float(q.shape[-1]) ** 0.5) if scale is None else scale
             return flash_varlen_attention(q, k, v, cq, ck, s, causal,
-                                          self_attn=self_attn)
+                                          self_attn=self_attn,
+                                          max_seqlen=max(max_q, max_k))
 
         out = _run_op("flash_attn_unpadded", fk,
                       (query, key, value, cu_seqlens_q, cu_seqlens_k), {})

@@ -40,19 +40,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
-from .flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, _fit_block,
-                              _kv_clamp_map, _pad_rows, _q_clamp_map)
-
-
-def _ck_from(kv_map):
-    """kv-side code BlockSpec map from the k/v map (codes are [8, T]; drop
-    the leading bh index, keep the — possibly clamped — tile index)."""
-    return lambda b, i, j: (0, kv_map(b, i, j)[1])
-
-
-def _cq_from(q_map):
-    """q-side code BlockSpec map from the q map (codes are [T, 128])."""
-    return lambda b, j, i: (q_map(b, j, i)[1], 0)
+from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, _fit_block, \
+    _pad_rows
 
 POS_BITS = 20
 SEG_LIMIT = 1 << 10          # max sequences per pack (i32 headroom)
@@ -60,18 +49,32 @@ POS_LIMIT = 1 << POS_BITS    # max tokens per sequence
 PAD_CODE = SEG_LIMIT << POS_BITS
 
 
-def _segs_overlap(cq_ref, ck_ref, block_q, block_k):
-    """Tile-level liveness: segments are contiguous runs of the packed
-    stream, so the [BQ, BK] tile contains ANY same-segment pair iff the
-    q tile's segment range intersects the k tile's. Four scalar loads +
-    two compares per grid step; tiles that fail skip all compute (their
-    DMA still runs — data-dependent DMA skipping would need scalar
-    prefetch, a later optimization)."""
-    seg_q0 = cq_ref[0, 0] >> POS_BITS
-    seg_q1 = cq_ref[block_q - 1, 0] >> POS_BITS
-    seg_k0 = ck_ref[0, 0] >> POS_BITS
-    seg_k1 = ck_ref[0, block_k - 1] >> POS_BITS
-    return jnp.logical_and(seg_q0 <= seg_k1, seg_k0 <= seg_q1)
+def _live_col_tiles(cu_rows, cu_cols, n_tiles, block_rows, block_cols,
+                    total_rows):
+    """Per ROW tile, the contiguous [lo, hi] range of COLUMN tiles holding
+    any same-segment pair: segments are contiguous runs of the packed
+    stream, so row tile i (rows [i*br, (i+1)*br)) spans segments
+    seg(first_row)..seg(last_row), whose columns occupy
+    cu_cols[seg_first] .. cu_cols[seg_last + 1] - 1 — one contiguous
+    column range. These bounds are SCALAR-PREFETCHED into the kernels'
+    index maps, so tiles outside the range are never DMA'd or computed
+    (splash-attention-style data-dependent scheduling)."""
+    i = jnp.arange(n_tiles)
+    r0 = jnp.clip(i * block_rows, 0, total_rows - 1)
+    r1 = jnp.clip((i + 1) * block_rows - 1, 0, total_rows - 1)
+    seg0 = jnp.searchsorted(cu_rows, r0, side="right").astype(jnp.int32) - 1
+    seg1 = jnp.searchsorted(cu_rows, r1, side="right").astype(jnp.int32) - 1
+    lo = (cu_cols[seg0] // block_cols).astype(jnp.int32)
+    hi = ((jnp.maximum(cu_cols[seg1 + 1], cu_cols[seg1] + 1) - 1)
+          // block_cols).astype(jnp.int32)
+    return lo, jnp.maximum(hi, lo)
+
+
+def _clamped_col(lo, hi, i, j):
+    """Column tile for inner-grid step j of row tile i: lo[i] + j clamped
+    to hi[i] — steps beyond the live range re-present the hi tile, so
+    Mosaic skips their DMA; the kernel gates their compute."""
+    return jnp.minimum(lo[i] + j, hi[i])
 
 
 def _tile_mask(s, cq_ref, ck_ref, causal):
@@ -88,14 +91,15 @@ def _tile_mask(s, cq_ref, ck_ref, causal):
     return jnp.where(ok, s, -1e30)
 
 
-def _fwd_kernel_varlen(q_ref, k_ref, v_ref, cq_ref, ck_ref, o_ref, lse_ref,
-                       m_s, l_s, acc_s, *, block_k, causal, scale, n_k,
-                       self_attn):
+def _fwd_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, cq_ref, ck_ref,
+                       o_ref, lse_ref, m_s, l_s, acc_s, *, block_k, causal,
+                       scale, n_k, self_attn):
     """Streaming forward over the packed stream: grid (H, n_q, n_k), same
     online-softmax scratch scheme as flash_attention._fwd_kernel_stream.
-    With self_attn+causal the caller clamps k/v (and ck) DMA above the
-    global diagonal — valid because identical packing makes global order
-    agree with (segment, position) order."""
+    lo/hi are the scalar-prefetched live k-tile bounds per q tile
+    (_live_col_tiles, with the causal diagonal folded in by the caller):
+    the index maps clamp k DMA into [lo[i], hi[i]] and compute is gated to
+    the live steps — dead tiles cost one scalar compare."""
     import numpy as np
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -108,10 +112,7 @@ def _fwd_kernel_varlen(q_ref, k_ref, v_ref, cq_ref, ck_ref, o_ref, lse_ref,
         l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
-    needed = _segs_overlap(cq_ref, ck_ref, bq, block_k)
-    if causal and self_attn:
-        needed = jnp.logical_and(
-            needed, ki * bk_i <= (qi + np.int32(1)) * bq_i - np.int32(1))
+    needed = ki <= hi_ref[qi] - lo_ref[qi]
 
     @pl.when(needed)
     def _compute():
@@ -139,13 +140,15 @@ def _fwd_kernel_varlen(q_ref, k_ref, v_ref, cq_ref, ck_ref, o_ref, lse_ref,
         lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
-def _bwd_dkv_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           cq_ref, ck_ref, dk_ref, dv_ref, dk_s, dv_s, *,
-                           block_q, causal, scale, n_q, self_attn):
+def _bwd_dkv_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
+                           lse_ref, delta_ref, cq_ref, ck_ref, dk_ref,
+                           dv_ref, dk_s, dv_s, *, block_q, causal, scale,
+                           n_q, self_attn):
     """Streaming dK/dV: grid (H, n_k, n_q); mirrors
-    flash_attention._bwd_dkv_kernel_stream with the code mask. Padding q
-    rows need no mask: their do (and hence delta) are zero-padded, so
-    their contributions to dk/dv vanish identically."""
+    flash_attention._bwd_dkv_kernel_stream with the code mask. lo/hi are
+    the live Q-tile bounds per k tile (causal start folded in by the
+    caller). Padding q rows need no mask: their do (and hence delta) are
+    zero-padded, so their contributions to dk/dv vanish identically."""
     import numpy as np
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -157,10 +160,7 @@ def _bwd_dkv_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
 
-    needed = _segs_overlap(cq_ref, ck_ref, block_q, bk)
-    if causal and self_attn:
-        needed = jnp.logical_and(
-            needed, (qi + np.int32(1)) * bq_i > ki * bk_i)
+    needed = qi <= hi_ref[ki] - lo_ref[ki]
 
     @pl.when(needed)
     def _compute():
@@ -187,11 +187,12 @@ def _bwd_dkv_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          cq_ref, ck_ref, dq_ref, dq_s, *, block_k, causal,
-                          scale, n_k, self_attn):
+def _bwd_dq_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
+                          lse_ref, delta_ref, cq_ref, ck_ref, dq_ref, dq_s,
+                          *, block_k, causal, scale, n_k, self_attn):
     """Streaming dQ: grid (H, n_q, n_k); mirrors
-    flash_attention._bwd_dq_kernel_stream with the code mask."""
+    flash_attention._bwd_dq_kernel_stream with the code mask; lo/hi are
+    the live k-tile bounds per q tile."""
     import numpy as np
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -202,10 +203,7 @@ def _bwd_dq_kernel_varlen(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
 
-    needed = _segs_overlap(cq_ref, ck_ref, bq, block_k)
-    if causal and self_attn:
-        needed = jnp.logical_and(
-            needed, ki * bk_i <= (qi + np.int32(1)) * bq_i - np.int32(1))
+    needed = ki <= hi_ref[qi] - lo_ref[qi]
 
     @pl.when(needed)
     def _compute():
@@ -248,73 +246,121 @@ def _codes_from_cu(cu, total):
     return (seg << POS_BITS) | pos
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash_varlen(q, k, v, code_q, code_k, causal, scale, block_q, block_k,
-                  self_attn):
-    o, _ = _flash_varlen_fwd_impl(q, k, v, code_q, code_k, causal, scale,
-                                  block_q, block_k, self_attn)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_varlen(q, k, v, cu_q, cu_k, causal, scale, block_q, block_k,
+                  self_attn, max_seqlen):
+    o, _ = _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale,
+                                  block_q, block_k, self_attn, max_seqlen)
     return o
 
 
-def _flash_varlen_fwd_impl(q, k, v, code_q, code_k, causal, scale, block_q,
-                           block_k, self_attn):
-    """q/k/v: [H, T, D] packed; code_q/k: [T] i32. Returns (o, lse)."""
+def _inner_steps(n_full, block_rows, block_cols, max_seqlen):
+    """Static bound on the live column-tile span of any row tile: the
+    spanned segments cover at most block_rows + 2*max_seqlen columns
+    (partial first/last segments extend beyond the tile's rows), i.e.
+    that many cols / block_cols tiles plus alignment slack. Shrinking the
+    inner grid to this removes the dead steps entirely — max_seqlen is
+    the same STATIC int the reference's flash_attn_unpadded requires.
+
+    SELF-ATTENTION ONLY: with distinct q/k packings a block_rows-row tile
+    can span up to block_rows segments of up to max_seqlen columns EACH,
+    so no useful static bound exists; callers must pass max_seqlen=None
+    (enforced in the impl/bwd entry points)."""
+    if not max_seqlen:
+        return n_full
+    return min(n_full, (block_rows + 2 * int(max_seqlen)) // block_cols + 3)
+
+
+def _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t, causal, self_attn):
+    """Live k-tile [lo, hi] per q tile, with the causal diagonal folded in
+    for self-attention packing."""
+    lo, hi = _live_col_tiles(cu_q, cu_k, n_q, block_q, block_k, t)
+    if causal and self_attn:
+        # int32 throughout: the package runs with x64 on, and int64 scalar-
+        # prefetch operands break Mosaic's SMEM lowering
+        i = jnp.arange(n_q, dtype=jnp.int32)
+        diag = ((i + 1) * block_q - 1) // block_k
+        hi = jnp.minimum(hi, diag.astype(jnp.int32))
+        hi = jnp.maximum(hi, lo)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
+                           block_k, self_attn, max_seqlen=None):
+    """q/k/v: [H, T, D] packed; cu_*: [B+1] i32 offsets. Returns (o, lse)."""
     h, t, d = q.shape
     tk = k.shape[1]
+    if not self_attn:
+        max_seqlen = None  # the static span bound is unsound cross-attn
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, tk)
     qp, _ = _pad_rows(q, block_q)
     kp, _ = _pad_rows(k, block_k)
     vp, _ = _pad_rows(v, block_k)
     tp, tkp = qp.shape[1], kp.shape[1]
+    code_q = _codes_from_cu(cu_q, t)
+    code_k = code_q if self_attn and tk == t else _codes_from_cu(cu_k, tk)
     cq2d, _ = _expand_codes(code_q, tp)
     _, ck2d = _expand_codes(code_k, tkp)
-    n_k = tkp // block_k
-    kv_map = _kv_clamp_map(block_q, block_k, causal and self_attn)
-    ck_map = _ck_from(kv_map)
+    n_q, n_k = tp // block_q, tkp // block_k
+    lo, hi = _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t, causal,
+                         self_attn)
+    n_k = _inner_steps(n_k, block_q, block_k, max_seqlen)
     kernel = functools.partial(_fwd_kernel_varlen, block_k=block_k,
                                causal=causal, scale=scale, n_k=n_k,
                                self_attn=self_attn)
+    kv_map = lambda b, i, j, lo_, hi_: (b, _clamped_col(lo_, hi_, i, j), 0)
+    ck_map = lambda b, i, j, lo_, hi_: (0, _clamped_col(lo_, hi_, i, j))
     with _mosaic_ctx():
         o, lse = pl.pallas_call(
             kernel,
-            grid=(h, tp // block_q, n_k),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((block_q, 128), lambda b, i, j: (i, 0)),
-                pl.BlockSpec((8, block_k), ck_map),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            ],
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(h, n_q, n_k),
+                in_specs=[
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
+                    pl.BlockSpec((1, block_k, d), kv_map),
+                    pl.BlockSpec((1, block_k, d), kv_map),
+                    pl.BlockSpec((block_q, 128),
+                                 lambda b, i, j, lo_, hi_: (i, 0)),
+                    pl.BlockSpec((8, block_k), ck_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
+                    pl.BlockSpec((1, 1, block_q),
+                                 lambda b, i, j, lo_, hi_: (b, 0, i)),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((block_q, 128), jnp.float32),
+                    pltpu.VMEM((block_q, 128), jnp.float32),
+                    pltpu.VMEM((block_q, d), jnp.float32),
+                ],
+            ),
             out_shape=[
                 jax.ShapeDtypeStruct(qp.shape, q.dtype),
                 jax.ShapeDtypeStruct((h, 1, tp), jnp.float32),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((block_q, 128), jnp.float32),
-                pltpu.VMEM((block_q, 128), jnp.float32),
-                pltpu.VMEM((block_q, d), jnp.float32),
-            ],
             interpret=_interpret(),
-        )(qp, kp, vp, cq2d, ck2d)
+        )(lo, hi, qp, kp, vp, cq2d, ck2d)
     return o[:, :t], lse.reshape(h, tp)[:, :t]
 
 
-def _flash_varlen_fwd(q, k, v, code_q, code_k, causal, scale, block_q,
-                      block_k, self_attn):
-    o, lse = _flash_varlen_fwd_impl(q, k, v, code_q, code_k, causal, scale,
-                                    block_q, block_k, self_attn)
-    return o, (q, k, v, code_q, code_k, o, lse)
+def _flash_varlen_fwd(q, k, v, cu_q, cu_k, causal, scale, block_q,
+                      block_k, self_attn, max_seqlen):
+    o, lse = _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale,
+                                    block_q, block_k, self_attn, max_seqlen)
+    return o, (q, k, v, cu_q, cu_k, o, lse)
 
 
-def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn, res, do):
-    q, k, v, code_q, code_k, o, lse = res
+def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
+                      max_seqlen, res, do):
+    q, k, v, cu_q, cu_k, o, lse = res
     h, t, d = q.shape
     tk = k.shape[1]
+    if not self_attn:
+        max_seqlen = None  # see _inner_steps: bound unsound cross-attn
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, tk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -327,68 +373,100 @@ def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn, res, do):
     delta3, _ = _pad_rows(delta.reshape(h, t, 1), block_q)
     lse3 = lse3.reshape(h, 1, tp)
     delta3 = delta3.reshape(h, 1, tp)
+    code_q = _codes_from_cu(cu_q, t)
+    code_k = code_q if self_attn and tk == t else _codes_from_cu(cu_k, tk)
     cq2d, _ = _expand_codes(code_q, tp)
     _, ck2d = _expand_codes(code_k, tkp)
     n_q, n_k = tp // block_q, tkp // block_k
+    n_q_inner = _inner_steps(n_q, block_k, block_q, max_seqlen)
+    n_k_inner = _inner_steps(n_k, block_q, block_k, max_seqlen)
     cc = causal and self_attn
 
-    # dK/dV: grid (h, n_k, n_q); q-side DMA clamped below the diagonal
-    q_map = _q_clamp_map(block_q, block_k, cc)
-    stat_map = _q_clamp_map(block_q, block_k, cc, stat=True)
-    cq_map = _cq_from(q_map)
+    # dK/dV: grid (h, n_k, n_q) — live Q-tile bounds per k tile; under
+    # causal self-attention the live range STARTS at the diagonal
+    lo_q, hi_q = _live_col_tiles(cu_k, cu_q, n_k, block_k, block_q, tk)
+    if cc:
+        j = jnp.arange(n_k, dtype=jnp.int32)
+        lo_q = jnp.maximum(lo_q, ((j * block_k) // block_q).astype(jnp.int32))
+        hi_q = jnp.maximum(hi_q, lo_q)
+    lo_q = lo_q.astype(jnp.int32)
+    hi_q = hi_q.astype(jnp.int32)
+    q_map = lambda b, j, i, lo_, hi_: (b, _clamped_col(lo_, hi_, j, i), 0)
+    stat_map = lambda b, j, i, lo_, hi_: (b, 0, _clamped_col(lo_, hi_, j, i))
+    cq_map = lambda b, j, i, lo_, hi_: (_clamped_col(lo_, hi_, j, i), 0)
     with _mosaic_ctx():
         dk, dv = pl.pallas_call(
             functools.partial(_bwd_dkv_kernel_varlen, block_q=block_q,
-                              causal=causal, scale=scale, n_q=n_q,
+                              causal=causal, scale=scale, n_q=n_q_inner,
                               self_attn=self_attn),
-            grid=(h, n_k, n_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), q_map),
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_q, d), q_map),
-                pl.BlockSpec((1, 1, block_q), stat_map),
-                pl.BlockSpec((1, 1, block_q), stat_map),
-                pl.BlockSpec((block_q, 128), cq_map),
-                pl.BlockSpec((8, block_k), lambda b, j, i: (0, j)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            ],
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(h, n_k, n_q_inner),
+                in_specs=[
+                    pl.BlockSpec((1, block_q, d), q_map),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
+                    pl.BlockSpec((1, block_q, d), q_map),
+                    pl.BlockSpec((1, 1, block_q), stat_map),
+                    pl.BlockSpec((1, 1, block_q), stat_map),
+                    pl.BlockSpec((block_q, 128), cq_map),
+                    pl.BlockSpec((8, block_k),
+                                 lambda b, j, i, lo_, hi_: (0, j)),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((block_k, d), jnp.float32),
+                    pltpu.VMEM((block_k, d), jnp.float32),
+                ],
+            ),
             out_shape=[
                 jax.ShapeDtypeStruct(kp.shape, k.dtype),
                 jax.ShapeDtypeStruct(vp.shape, v.dtype),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((block_k, d), jnp.float32),
-                pltpu.VMEM((block_k, d), jnp.float32),
-            ],
             interpret=_interpret(),
-        )(qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
+        )(lo_q, hi_q, qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
 
-        kv_map = _kv_clamp_map(block_q, block_k, cc)
-        ck_map = _ck_from(kv_map)
+        lo_k, hi_k = _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t,
+                                 causal, self_attn)
+        kv_map = lambda b, i, j, lo_, hi_: (b, _clamped_col(lo_, hi_, i, j),
+                                            0)
+        ck_map = lambda b, i, j, lo_, hi_: (0, _clamped_col(lo_, hi_, i, j))
         dq = pl.pallas_call(
             functools.partial(_bwd_dq_kernel_varlen, block_k=block_k,
-                              causal=causal, scale=scale, n_k=n_k,
+                              causal=causal, scale=scale, n_k=n_k_inner,
                               self_attn=self_attn),
-            grid=(h, n_q, n_k),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-                pl.BlockSpec((block_q, 128), lambda b, i, j: (i, 0)),
-                pl.BlockSpec((8, block_k), ck_map),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(h, n_q, n_k_inner),
+                in_specs=[
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
+                    pl.BlockSpec((1, block_k, d), kv_map),
+                    pl.BlockSpec((1, block_k, d), kv_map),
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
+                    pl.BlockSpec((1, 1, block_q),
+                                 lambda b, i, j, lo_, hi_: (b, 0, i)),
+                    pl.BlockSpec((1, 1, block_q),
+                                 lambda b, i, j, lo_, hi_: (b, 0, i)),
+                    pl.BlockSpec((block_q, 128),
+                                 lambda b, i, j, lo_, hi_: (i, 0)),
+                    pl.BlockSpec((8, block_k), ck_map),
+                ],
+                out_specs=pl.BlockSpec((1, block_q, d),
+                                       lambda b, i, j, lo_, hi_: (b, i, 0)),
+                scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            ),
             out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
-            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
             interpret=_interpret(),
-        )(qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
+        )(lo_k, hi_k, qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
     return dq[:, :t], dk[:, :tk], dv[:, :tk], None, None
 
 
@@ -397,7 +475,8 @@ _flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
 
 def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
                            causal, self_attn=None,
-                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                           max_seqlen=None):
     """Kernel-backed packed varlen attention.
 
     q: [total_q, H, D]; k/v: [total_k, Hkv, D] (GQA repeats kv heads);
@@ -415,14 +494,11 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     cu_q = cu_seqlens_q.astype(jnp.int32)
-    code_q = _codes_from_cu(cu_q, tq)
-    if self_attn:
-        code_k = code_q
-    else:
-        code_k = _codes_from_cu(cu_seqlens_k.astype(jnp.int32), tk)
+    cu_k = cu_q if self_attn else cu_seqlens_k.astype(jnp.int32)
     qh = q.transpose(1, 0, 2)
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)
-    o = _flash_varlen(qh, kh, vh, code_q, code_k, causal, float(scale),
-                      block_q, block_k, bool(self_attn))
+    o = _flash_varlen(qh, kh, vh, cu_q, cu_k, causal, float(scale),
+                      block_q, block_k, bool(self_attn),
+                      int(max_seqlen) if max_seqlen else None)
     return o.transpose(1, 0, 2)

@@ -121,3 +121,49 @@ def test_varlen_kernel_gqa_and_cross_packing():
     vrep = jnp.repeat(v, 2, axis=1)
     ref = _dense_ref(q, krep, vrep, cu_q, cu_k, False, SCALE)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_varlen_kernel_max_seqlen_grid_shrink(causal):
+    """max_seqlen shrinks the inner grid to the provable live span; results
+    must be identical to the full-grid run."""
+    rng = np.random.RandomState(7)
+    lens = [130, 126, 250, 70, 64, 128]
+    q, cu = _packed(lens, 2, rng)
+    k, _ = _packed(lens, 2, rng)
+    v, _ = _packed(lens, 2, rng)
+    full = flash_varlen_attention(q, k, v, cu, cu, SCALE, causal,
+                                  self_attn=True, block_q=128, block_k=128)
+    shrunk = flash_varlen_attention(q, k, v, cu, cu, SCALE, causal,
+                                    self_attn=True, block_q=128,
+                                    block_k=128, max_seqlen=max(lens))
+    np.testing.assert_allclose(np.asarray(shrunk), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+
+    def loss(q, k, v):
+        o = flash_varlen_attention(q, k, v, cu, cu, SCALE, causal,
+                                   self_attn=True, block_q=128,
+                                   block_k=128, max_seqlen=max(lens))
+        return (o ** 2).sum()
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref = _dense_ref(q, k, v, cu, cu, causal, SCALE)
+    np.testing.assert_allclose(np.asarray(full), ref, rtol=2e-4, atol=2e-4)
+    assert all(np.isfinite(np.asarray(x)).all() for x in g)
+
+
+def test_varlen_cross_attn_ignores_max_seqlen():
+    """Regression: the static grid-shrink bound is unsound for cross-
+    attention (a q tile can span many long k segments); max_seqlen must be
+    ignored there. lens_q=[8]*16 vs lens_k=[96]*16 at block 128 truncated
+    attention to 5 of 12 live k tiles before the fix."""
+    rng = np.random.RandomState(11)
+    lens_q, lens_k = [8] * 16, [96] * 16
+    q, cu_q = _packed(lens_q, 2, rng)
+    k, cu_k = _packed(lens_k, 2, rng)
+    v, _ = _packed(lens_k, 2, rng)
+    out = flash_varlen_attention(q, k, v, cu_q, cu_k, SCALE, False,
+                                 self_attn=False, block_q=128, block_k=128,
+                                 max_seqlen=max(max(lens_q), max(lens_k)))
+    ref = _dense_ref(q, k, v, cu_q, cu_k, False, SCALE)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
