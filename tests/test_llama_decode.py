@@ -115,3 +115,25 @@ def test_generate_scan_matches_eager_loop():
 
     out = greedy_generate(params, prompt, config, max_new_tokens=N)
     assert out[0].tolist() == toks
+
+
+def test_chunked_ce_loss_matches_dense():
+    """chunked_ce_loss (memory-saving fused head+CE) must match the dense
+    masked_ce_loss path (same math, different accumulation order)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import chunked_ce_loss, masked_ce_loss
+    rng = np.random.RandomState(0)
+    b, s, d, v = 2, 64, 16, 50
+    x = jnp.asarray(rng.randn(b, s, d).astype(np.float32))
+    head = jnp.asarray(rng.randn(d, v).astype(np.float32))
+    labels = rng.randint(0, v, (b, s)).astype(np.int32)
+    labels[0, :10] = -100  # ignore region
+    labels = jnp.asarray(labels)
+    dense = masked_ce_loss((x @ head).astype(jnp.float32), labels)
+    chunked = chunked_ce_loss(x, head, labels, n_chunks=8)
+    np.testing.assert_allclose(float(chunked), float(dense), rtol=1e-5)
+    # non-divisible sequence: padded with ignored labels, same result
+    dense_odd = masked_ce_loss((x[:, :63] @ head).astype(jnp.float32),
+                               labels[:, :63])
+    odd = chunked_ce_loss(x[:, :63], head, labels[:, :63], n_chunks=8)
+    np.testing.assert_allclose(float(odd), float(dense_odd), rtol=1e-5)
