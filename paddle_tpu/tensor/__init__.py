@@ -21,7 +21,7 @@ def _attach(name, fn):
 
 # attach every public op as a method (paddle parity: tensor.add(y) etc.)
 _METHOD_SOURCES = [math, manipulation, linalg, search]
-_SKIP = {"where"}  # tensor.where has cond-first signature confusion; keep functional
+_SKIP = {"where"}  # attached explicitly below (cond-first signature)
 for _mod in _METHOD_SOURCES:
     for _name in dir(_mod):
         if _name.startswith("_") or _name in _SKIP:
@@ -63,3 +63,106 @@ Tensor.__invert__ = lambda s: math.logical_not(s)
 Tensor.__and__ = lambda s, o: math.bitwise_and(s, o)
 Tensor.__or__ = lambda s, o: math.bitwise_or(s, o)
 Tensor.__xor__ = lambda s, o: math.bitwise_xor(s, o)
+
+
+# -- method-surface completion (reference Tensor method parity) --------------
+# creation.py isn't a method source (its free functions construct tensors);
+# the tensor-first subset attaches explicitly.
+Tensor.diag = creation.diag
+Tensor.tril = creation.tril
+Tensor.triu = creation.triu
+Tensor.multinomial = creation.multinomial
+
+
+# Tensor.where: cond is already the first parameter of math.where, and the
+# one-argument form (nonzero indices) must keep working
+Tensor.where = math.where
+
+
+def _inplace_rebind(x, new_data):
+    """Shared in-place protocol (mirrors Tensor.__setitem__): refuse writes
+    into a grad-requiring leaf (they would orphan x.grad), drop the graph
+    edge for non-leaves, and bump _inplace_version so any earlier consumer
+    of the old value raises at backward instead of silently using stale
+    residuals (autograd.engine.GradNode.check_versions)."""
+    from ..autograd import engine as _engine
+    if (_engine.is_grad_enabled() and not x.stop_gradient
+            and x._grad_node is None):
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an "
+            "in-place operation; detach() it or wrap the write in "
+            "no_grad()")
+    x._data = new_data
+    x._grad_node = None
+    x._inplace_version += 1
+    return x
+
+
+def _unsqueeze_(x, axis):
+    return _inplace_rebind(x, manipulation.unsqueeze(x.detach(), axis)._data)
+
+
+def _flatten_(x, start_axis=0, stop_axis=-1):
+    return _inplace_rebind(
+        x, manipulation.flatten(x.detach(), start_axis, stop_axis)._data)
+
+
+def _scatter_(x, index, updates, overwrite=True):
+    return _inplace_rebind(
+        x, manipulation.scatter(x.detach(), index, updates,
+                                overwrite=overwrite)._data)
+
+
+def _fill_key(seed):
+    from ..framework import random as _random
+    import jax as _jax
+    # nonzero seed: deterministic fill (reference semantics); 0 = stream
+    return (_jax.random.PRNGKey(seed) if seed else _random.next_key())
+
+
+def _uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    import jax as _jax
+    d = _jax.random.uniform(_fill_key(seed), tuple(x._data.shape),
+                            dtype=x._data.dtype, minval=min, maxval=max)
+    return _inplace_rebind(x, d)
+
+
+def _normal_(x, mean=0.0, std=1.0, name=None):
+    import jax as _jax
+    d = (_jax.random.normal(_fill_key(0), tuple(x._data.shape),
+                            dtype=x._data.dtype) * std + mean)
+    return _inplace_rebind(x, d)
+
+
+def _bernoulli_(x, p=0.5, name=None):
+    import jax as _jax
+    d = (_jax.random.uniform(_fill_key(0), tuple(x._data.shape))
+         < p).astype(x._data.dtype)
+    return _inplace_rebind(x, d)
+
+
+def _exponential_(x, lam=1.0, name=None):
+    import jax as _jax
+    d = _jax.random.exponential(_fill_key(0), tuple(x._data.shape),
+                                dtype=x._data.dtype) / lam
+    return _inplace_rebind(x, d)
+
+
+Tensor.unsqueeze_ = _unsqueeze_
+Tensor.flatten_ = _flatten_
+Tensor.scatter_ = _scatter_
+Tensor.uniform_ = _uniform_
+Tensor.normal_ = _normal_
+Tensor.bernoulli_ = _bernoulli_
+Tensor.exponential_ = _exponential_
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference paddle.add_n)."""
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+Tensor.add_n = staticmethod(add_n)

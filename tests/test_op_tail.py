@@ -276,3 +276,69 @@ def test_round3_functional_tail():
     p = np.exp(lg - lg.max(-1, keepdims=True))
     p /= p.sum(-1, keepdims=True)
     np.testing.assert_allclose(got, p @ vn, rtol=1e-4, atol=1e-5)
+
+
+def test_round3_tensor_method_surface():
+    """Method-form parity (reference math_op_patch): tril/triu/diag/where/
+    in-place random fills/add_n attach to Tensor."""
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.tril().numpy()[0, 1] == 0 and x.triu().numpy()[1, 0] == 0
+    np.testing.assert_array_equal(
+        paddle.to_tensor(np.array([1.0, 2.0])).diag().numpy(),
+        np.diag([1.0, 2.0]))
+    c = paddle.to_tensor(np.array([True, False]))
+    np.testing.assert_array_equal(
+        c.where(paddle.to_tensor([1.0, 1.0]),
+                paddle.to_tensor([2.0, 2.0])).numpy(), [1.0, 2.0])
+    paddle.seed(0)
+    y = paddle.to_tensor(np.zeros((64,), np.float32))
+    y.uniform_(0.0, 1.0)
+    assert (y.numpy() >= 0).all() and (y.numpy() <= 1).all() \
+        and y.numpy().std() > 0
+    z = paddle.to_tensor(np.zeros((256,), np.float32))
+    z.normal_(5.0, 0.1)
+    assert abs(z.numpy().mean() - 5.0) < 0.1
+    w = paddle.to_tensor(np.zeros((8,), np.float32))
+    w.bernoulli_(1.0)
+    assert (w.numpy() == 1).all()
+    e = paddle.to_tensor(np.zeros((512,), np.float32))
+    e.exponential_(2.0)
+    np.testing.assert_allclose(e.numpy().mean(), 0.5, rtol=0.5)
+    u = paddle.to_tensor(np.zeros((2,), np.float32))
+    u.unsqueeze_(0)
+    assert u.shape == [1, 2]
+    f = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+    f.flatten_()
+    assert f.shape == [4]
+    s = paddle.add_n([x, x, x])
+    np.testing.assert_allclose(s.numpy(),
+                               3 * np.arange(6).reshape(2, 3))
+
+
+def test_inplace_methods_respect_autograd_protocol():
+    """In-place fills/reshapes follow the same contract as __setitem__:
+    leaf-requiring-grad refuses, and earlier consumers of the old value
+    raise at backward (version check) instead of silently using stale
+    residuals."""
+    import pytest as _pytest
+    # leaf with grad: refuse
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    x.stop_gradient = False
+    with _pytest.raises(RuntimeError, match="leaf Tensor"):
+        x.normal_()
+    # version check: consumer recorded before the in-place write raises
+    a = paddle.to_tensor(np.ones((3,), np.float32))
+    a.stop_gradient = False
+    t = a * 2.0            # non-leaf
+    y = t * t              # consumer of t's OLD value
+    t.uniform_()           # in-place rewrite of t
+    with _pytest.raises(RuntimeError, match="in-place"):
+        y.sum().backward()
+    # deterministic seed
+    u1 = paddle.to_tensor(np.zeros((8,), np.float32)); u1.uniform_(seed=7)
+    u2 = paddle.to_tensor(np.zeros((8,), np.float32)); u2.uniform_(seed=7)
+    np.testing.assert_array_equal(u1.numpy(), u2.numpy())
+    # one-arg where (nonzero indices) still works on the method
+    c = paddle.to_tensor(np.array([0.0, 3.0, 0.0, 5.0]))
+    nz = (c != 0.0).where()
+    assert [int(v) for v in np.asarray(nz[0].numpy()).ravel()] == [1, 3]
