@@ -174,7 +174,8 @@ def jnp_ravel_first(o):
     return jnp.ravel(leaf)[0]
 
 
-def run_decode(config, batch, dev, prompt_len=128, new_tokens=128):
+def run_decode(config, batch, dev, prompt_len=128, new_tokens=128,
+               quantize=False):
     """Warm greedy-generation decode cost. Returns
     (ms_per_step, tok_s, floor_ms, measured_floor_ms).
 
@@ -185,11 +186,15 @@ def run_decode(config, batch, dev, prompt_len=128, new_tokens=128):
     bound against the DATASHEET bandwidth; measured_floor_ms against the
     achievable bandwidth from measured_hbm_bw — decode is HBM-bound, every
     step streams all params once (KV-cache traffic is comparatively small
-    at this context length)."""
+    at this context length). quantize=True runs weight-only int8 (halved
+    weight stream; floors computed against the int8 bytes)."""
     import jax.numpy as jnp
     from paddle_tpu.models.llama import (count_params, generate_scan_bucket,
-                                         greedy_generate, init_llama_params)
+                                         greedy_generate, init_llama_params,
+                                         quantize_llama_int8)
     params = init_llama_params(config, seed=0)
+    if quantize:
+        params = quantize_llama_int8(params)
     rng = np.random.RandomState(0)
     prompt = rng.randint(0, config.vocab_size,
                          (batch, prompt_len)).astype(np.int32)
@@ -200,6 +205,11 @@ def run_decode(config, batch, dev, prompt_len=128, new_tokens=128):
         lambda: greedy_generate(params, prompt, config, new_tokens),
         "jit_generate_scan(", reps=3)
     if scan_ms is None:  # off-TPU: wall-clock with prefill subtraction
+        if dev.platform != "cpu":
+            print("WARNING: no jit_generate_scan device span; decode "
+                  "timing falling back to dispatch-inflated wall-clock",
+                  file=sys.stderr)
+
         def timed(n_new):
             greedy_generate(params, prompt, config, n_new)
             t0 = time.perf_counter()
@@ -210,7 +220,7 @@ def run_decode(config, batch, dev, prompt_len=128, new_tokens=128):
 
     kind = getattr(dev, "device_kind", "cpu").lower()
     bw = next((v for k, v in HBM_BW.items() if k in kind), HBM_BW["cpu"])
-    itemsize = jnp.dtype(config.dtype).itemsize
+    itemsize = 1 if quantize else jnp.dtype(config.dtype).itemsize
     streamed = count_params(config)
     if not config.tie_word_embeddings:
         # the INPUT embedding table is read via a b-row gather per step,
@@ -278,10 +288,16 @@ def main():
     # bounded below by streaming all bf16 weights from HBM once per step
     # (weight_floor_ms); tok/s scales with batch at near-constant step time.
     decode = {}
-    for name, cfg in [("flagship", config)] + (
-            [("hd64", config_hd64)] if config_hd64 is not None else []):
+    variants = [("flagship", config, False)] + (
+        [("hd64", config_hd64, False)] if config_hd64 is not None else [])
+    if on_tpu:
+        # weight-only int8 (quantize_llama_int8): halves the weight stream
+        # — decode lands BELOW the bf16 floor
+        variants.append(("flagship_int8", config, True))
+    for name, cfg, quant in variants:
         for b in (1, 8):
-            mspt, tok_s_d, floor, mfloor = run_decode(cfg, b, dev)
+            mspt, tok_s_d, floor, mfloor = run_decode(cfg, b, dev,
+                                                      quantize=quant)
             decode[f"{name}_b{b}"] = {
                 "ms_per_step": round(mspt, 2),
                 "tokens_per_sec": round(tok_s_d, 1),

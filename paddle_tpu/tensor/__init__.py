@@ -92,8 +92,15 @@ def _inplace_rebind(x, new_data):
             "a leaf Tensor that requires grad is being used in an "
             "in-place operation; detach() it or wrap the write in "
             "no_grad()")
+    had_node = x._grad_node is not None
     x._data = new_data
     x._grad_node = None
+    if had_node:
+        # the rewritten value is disconnected from the graph: without this
+        # a former non-leaf would masquerade as a grad-requiring leaf (a
+        # second fill would spuriously raise, and backward would
+        # accumulate .grad into a non-leaf)
+        x.stop_gradient = True
     x._inplace_version += 1
     return x
 

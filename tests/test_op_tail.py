@@ -342,3 +342,15 @@ def test_inplace_methods_respect_autograd_protocol():
     c = paddle.to_tensor(np.array([0.0, 3.0, 0.0, 5.0]))
     nz = (c != 0.0).where()
     assert [int(v) for v in np.asarray(nz[0].numpy()).ravel()] == [1, 3]
+
+
+def test_inplace_fill_on_nonleaf_detaches():
+    """A second in-place fill on a former non-leaf must not raise: the
+    first fill disconnects it from the graph (stop_gradient True), same
+    net effect as detach + fill."""
+    a = paddle.to_tensor(np.ones((3,), np.float32))
+    a.stop_gradient = False
+    t = a * 2.0
+    t.uniform_()
+    t.normal_()          # second fill: no spurious leaf error
+    assert t.stop_gradient
