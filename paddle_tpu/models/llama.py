@@ -222,13 +222,11 @@ def quantize_llama_int8(params):
     doubles decode throughput — BELOW the bf16 weight floor, which is the
     point. Training/prefill accuracy paths should keep the float params."""
     names = {"q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
-             "up_proj", "down_proj", "lm_head"}
+             "up_proj", "down_proj"}
 
     def quant(w):
-        f = w.astype(jnp.float32)
-        sc = jnp.max(jnp.abs(f), axis=-2, keepdims=True) / 127.0
-        sc = jnp.maximum(sc, 1e-8)
-        wi = jnp.clip(jnp.round(f / sc), -127, 127).astype(jnp.int8)
+        from ..nn.quant import absmax_intq
+        wi, sc = absmax_intq(w, axis=-2)
         return {"w": wi, "s": jnp.squeeze(sc, -2).astype(w.dtype)}
 
     out = dict(params)

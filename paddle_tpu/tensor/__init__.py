@@ -105,19 +105,47 @@ def _inplace_rebind(x, new_data):
     return x
 
 
+def _inplace_taped(x, fn):
+    """Rebind x to the TAPED output of fn over x (shape ops, scatter):
+    grad flow through the new value is preserved — unlike the random
+    fills, the result still depends on x. Same leaf guard, alias trick,
+    and version bump as __setitem__: the op consumes an ALIAS (fresh
+    object carrying the pre-write node/version) so the recorded input is
+    not the rebound tensor itself (which would make the node its own
+    consumer), and earlier consumers of x raise at backward."""
+    from ..autograd import engine as _engine
+    if (_engine.is_grad_enabled() and not x.stop_gradient
+            and x._grad_node is None):
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an "
+            "in-place operation; detach() it or wrap the write in "
+            "no_grad()")
+    alias = Tensor._from_data(x._data, node=x._grad_node,
+                              out_index=x._out_index,
+                              stop_gradient=x.stop_gradient)
+    alias._inplace_version = x._inplace_version
+    out = fn(alias)
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    x._inplace_version += 1
+    return x
+
+
 def _unsqueeze_(x, axis):
-    return _inplace_rebind(x, manipulation.unsqueeze(x.detach(), axis)._data)
+    return _inplace_taped(x, lambda a: manipulation.unsqueeze(a, axis))
 
 
 def _flatten_(x, start_axis=0, stop_axis=-1):
-    return _inplace_rebind(
-        x, manipulation.flatten(x.detach(), start_axis, stop_axis)._data)
+    return _inplace_taped(
+        x, lambda a: manipulation.flatten(a, start_axis, stop_axis))
 
 
 def _scatter_(x, index, updates, overwrite=True):
-    return _inplace_rebind(
-        x, manipulation.scatter(x.detach(), index, updates,
-                                overwrite=overwrite)._data)
+    return _inplace_taped(
+        x, lambda a: manipulation.scatter(a, index, updates,
+                                          overwrite=overwrite))
 
 
 def _fill_key(seed):

@@ -354,3 +354,37 @@ def test_inplace_fill_on_nonleaf_detaches():
     t.uniform_()
     t.normal_()          # second fill: no spurious leaf error
     assert t.stop_gradient
+
+
+def test_weight_only_quant_ops():
+    """paddle.nn.quant weight_quantize/dequantize/weight_only_linear parity
+    (int8 and packed int4)."""
+    from paddle_tpu.nn import quant as Q
+    rng = np.random.RandomState(0)
+    w = rng.randn(10, 6).astype(np.float32)
+    x = rng.randn(4, 10).astype(np.float32)
+
+    for algo, dt, tol in (("weight_only_int8", "int8", 2e-2),
+                          ("weight_only_int4", "int4", 2e-1)):
+        qw, sc = Q.weight_quantize(paddle.to_tensor(w), algo=algo)
+        if algo == "weight_only_int8":
+            assert qw.shape == [10, 6]
+        else:
+            assert qw.shape == [5, 6]  # two nibbles per byte along IN
+        back = Q.weight_dequantize(qw, sc, algo=algo).numpy()
+        np.testing.assert_allclose(back, w, atol=np.abs(w).max() * tol)
+        y = Q.weight_only_linear(paddle.to_tensor(x), qw,
+                                 bias=paddle.to_tensor(
+                                     np.ones(6, np.float32)),
+                                 weight_scale=sc, weight_dtype=dt).numpy()
+        np.testing.assert_allclose(y, x @ back + 1.0, rtol=1e-4, atol=1e-4)
+
+    # grads flow through the activation
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    qw, sc = Q.weight_quantize(paddle.to_tensor(w))
+    out = Q.weight_only_linear(xt, qw, weight_scale=sc)
+    out.sum().backward()
+    deq = Q.weight_dequantize(qw, sc).numpy()
+    np.testing.assert_allclose(xt.grad.numpy(),
+                               np.tile(deq.sum(-1), (4, 1)), rtol=1e-4)
