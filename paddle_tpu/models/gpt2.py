@@ -152,3 +152,53 @@ def build_gpt2_train_step(config: GPT2Config, mesh=None, lr=3e-4,
     step = TrainStep(model, lambda out, lbl: gpt2_loss(out, lbl), opt,
                      mesh=mesh, batch_spec=P("dp") if mesh is not None else None)
     return model, opt, step
+
+
+def gpt2_generate(model: GPT2ForCausalLM, input_ids, max_new_tokens=16,
+                  top_k=1, temperature=1.0, seed=0):
+    """Eager sampling loop (greedy when top_k=1) by re-forward per token —
+    the dygraph-style demo path; the optimized single-dispatch KV-cache
+    decode lives on the Llama flagship (models/llama.greedy_generate).
+    Returns the generated continuation [B, max_new_tokens]."""
+    import numpy as np
+
+    from ..tensor.creation import to_tensor
+
+    from ..autograd import no_grad
+
+    rng = np.random.RandomState(seed)
+    ids = np.asarray(input_ids.numpy() if hasattr(input_ids, "numpy")
+                     else input_ids)
+    if ids.shape[1] + max_new_tokens > model.config.max_position:
+        raise ValueError(
+            f"generation would exceed max_position "
+            f"({ids.shape[1]} + {max_new_tokens} > "
+            f"{model.config.max_position}); the position-embedding gather "
+            "would silently clamp beyond it")
+    was_training = model.training
+    model.eval()   # dropout off: greedy must be deterministic
+    try:
+        out = []
+        with no_grad():   # no vjp tape for inference re-forwards
+            for _ in range(max_new_tokens):
+                logits = model(to_tensor(ids.astype(np.int64)))
+                last = np.asarray(logits.numpy())[:, -1].astype(np.float64)
+                if top_k <= 1:
+                    nxt = last.argmax(-1)
+                else:
+                    k = min(top_k, last.shape[-1])
+                    nxt = np.empty(last.shape[0], np.int64)
+                    for b in range(last.shape[0]):
+                        cand = (np.argpartition(-last[b], k - 1)[:k]
+                                if k < last.shape[-1]
+                                else np.arange(last.shape[-1]))
+                        z = last[b, cand] / max(temperature, 1e-6)
+                        p = np.exp(z - z.max())
+                        p /= p.sum()
+                        nxt[b] = rng.choice(cand, p=p)
+                out.append(nxt)
+                ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    finally:
+        if was_training:
+            model.train()
+    return np.stack(out, axis=1)

@@ -128,7 +128,11 @@ def _inplace_taped(x, fn):
     x._data = out._data
     x._grad_node = out._grad_node
     x._out_index = out._out_index
-    x.stop_gradient = out.stop_gradient
+    if _engine.is_grad_enabled():
+        x.stop_gradient = out.stop_gradient
+    # under no_grad, keep x's flag: flipping a leaf PARAM to
+    # stop_gradient=True here would silently freeze it for later training
+    # (no_grad is the documented escape hatch for in-place param edits)
     x._inplace_version += 1
     return x
 

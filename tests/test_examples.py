@@ -1,0 +1,39 @@
+"""The examples/ scripts must run end to end (CPU, tiny shapes)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+EX = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(script, *args):
+    env = dict(os.environ)
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    repo = os.path.abspath(os.path.join(EX, ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run([sys.executable, os.path.join(EX, script), *args],
+                       capture_output=True, text=True, timeout=420,
+                       cwd=os.path.join(EX, ".."), env=env)
+    assert p.returncode == 0, f"{script} failed:\n{p.stdout}\n{p.stderr}"
+    return p.stdout
+
+
+def test_train_llama_single():
+    out = _run("train_llama.py", "--steps", "3")
+    assert "step 2: loss" in out
+
+
+def test_train_llama_hybrid():
+    out = _run("train_llama.py", "--steps", "2", "--dp", "2", "--mp", "2")
+    assert "step 1: loss" in out
+
+
+def test_serve_int8():
+    assert "continuation:" in _run("serve_int8.py")
+
+
+def test_dygraph_train():
+    out = _run("dygraph_train.py")
+    assert "step 15: loss" in out

@@ -136,3 +136,52 @@ def test_localsgd_and_dgc():
     # sparse exchanges still optimize
     assert losses[-1] < losses[0]
     assert not np.allclose(net2.weight.numpy(), w0)
+
+
+def test_gpt2_generate():
+    from paddle_tpu.models.gpt2 import (GPT2ForCausalLM, gpt2_generate,
+                                        gpt2_tiny)
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    model = GPT2ForCausalLM(gpt2_tiny())
+    prompt = np.array([[1, 2, 3]], np.int64)
+    greedy = gpt2_generate(model, prompt, max_new_tokens=4)
+    assert greedy.shape == (1, 4)
+    again = gpt2_generate(model, prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(greedy, again)   # greedy deterministic
+    sampled = gpt2_generate(model, prompt, max_new_tokens=4, top_k=5, seed=1)
+    assert sampled.shape == (1, 4)
+
+
+def test_gpt2_generate_guards():
+    import pytest
+    from paddle_tpu.models.gpt2 import (GPT2ForCausalLM, gpt2_generate,
+                                        gpt2_tiny)
+    import paddle_tpu as paddle
+    paddle.seed(1)
+    cfg = gpt2_tiny()
+    model = GPT2ForCausalLM(cfg)
+    prompt = np.array([[1, 2]], np.int64)
+    # full-vocab top_k samples without crashing
+    s = gpt2_generate(model, prompt, max_new_tokens=2,
+                      top_k=cfg.vocab_size, seed=2)
+    assert s.shape == (1, 2)
+    with pytest.raises(ValueError, match="max_position"):
+        gpt2_generate(model, prompt,
+                      max_new_tokens=cfg.max_position)
+    assert model.training  # mode restored
+
+
+def test_inplace_param_edit_under_no_grad_keeps_trainable():
+    """no_grad in-place edits on a leaf param must not freeze it."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    layer = nn.Linear(4, 4)
+    with paddle.no_grad():
+        layer.weight.unsqueeze_(0)
+        layer.weight.flatten_(0, 1)
+    assert not layer.weight.stop_gradient
+    out = layer(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    out.sum().backward()
+    assert layer.weight.grad is not None
