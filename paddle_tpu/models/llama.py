@@ -561,6 +561,91 @@ def generate_scan(params, cache, first_token, num_tokens,
     return toks.T, cache
 
 
+def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """One sampling step on [B, vocab] fp32 logits (ref: the reference's
+    sampling decode — paddle top_k/top_p generation). top_k=0 disables the
+    k cut; top_p=1.0 disables the nucleus cut; both compose (k first, then
+    p over the surviving mass, reference order). Runs INSIDE jit (all
+    branches static)."""
+    z = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k < z.shape[-1]:
+        kth = jnp.sort(z, axis=-1)[:, -top_k][:, None]
+        z = jnp.where(z < kth, -jnp.inf, z)
+    # nucleus cut, traced-top_p-safe: keep the smallest prefix with mass
+    # >= top_p (the token crossing the threshold stays — reference
+    # semantics); top_p >= 1.0 keeps everything (cut lands on -inf tail)
+    sorted_z = jnp.sort(z, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_z, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cut = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+    cut = jnp.minimum(cut, z.shape[-1] - 1)
+    thresh = jnp.take_along_axis(sorted_z, cut, axis=-1)
+    z = jnp.where(z < thresh, -jnp.inf, z)
+    return jax.random.categorical(key, z, axis=-1).astype(jnp.int32)
+
+
+def sample_scan(params, cache, first_logits, num_tokens, config, key,
+                temperature=1.0, top_k=0, top_p=1.0):
+    """Sampling counterpart of generate_scan: the whole continuation is one
+    device dispatch; the PRNG key splits per step inside the scan."""
+    def step(carry, _):
+        cache, tok, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = llama_decode_step(params, cache, tok, config)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p)[:, None]
+        return (cache, nxt, key), nxt[:, 0]
+
+    key, sub = jax.random.split(key)
+    first = sample_logits(first_logits, sub, temperature, top_k,
+                          top_p)[:, None]
+    (cache, _, _), toks = lax.scan(step, (cache, first, key),
+                                   None, length=num_tokens - 1)
+    return jnp.concatenate([first, toks.T], axis=1), cache
+
+
+def sample_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
+                    temperature=1.0, top_k=0, top_p=1.0, seed=0,
+                    max_len=None):
+    """Sampling generation with the same one-dispatch structure as
+    greedy_generate (prefill fills the cache, the continuation is a single
+    compiled scan). Deterministic for a fixed seed."""
+    prompt = np.asarray(prompt_ids)
+    b, plen = prompt.shape
+    if plen == 0:
+        raise ValueError("sample_generate: prompt must be non-empty")
+    if max_new_tokens <= 0:
+        return np.zeros((b, 0), np.int32)
+    max_len = max_len or (plen + max_new_tokens)
+    if max_len < plen + max_new_tokens:
+        raise ValueError(
+            f"sample_generate: max_len={max_len} < prompt {plen} + "
+            f"max_new_tokens {max_new_tokens}; the cache would overflow")
+    frozen = _freeze_config(config)
+    bucket = generate_scan_bucket(max_new_tokens + 1)  # all sampled steps
+    cache = init_kv_cache(config, b, max(max_len, plen + 1 + bucket))
+    logits, cache = _jitted_prefill(frozen)(params, cache,
+                                            jnp.asarray(prompt))
+    key = jax.random.PRNGKey(seed)
+    # temperature/top_p ride as TRACED scalars (shape-neutral): varying
+    # them per request reuses one compiled scan; only top_k is static
+    # (it sizes the sort cut)
+    toks, _ = _jitted_sample(frozen, bucket, int(top_k))(
+        params, cache, logits, key, jnp.float32(temperature),
+        jnp.float32(top_p))
+    return np.asarray(toks)[:, :max_new_tokens]
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_sample(frozen, num_tokens, top_k):
+    config = LlamaConfig(*frozen)
+
+    def sample_scan_fn(params, cache, first_logits, key, temperature, top_p):
+        return sample_scan(params, cache, first_logits, num_tokens, config,
+                           key, temperature, top_k, top_p)
+    sample_scan_fn.__name__ = "sample_scan"
+    return jax.jit(sample_scan_fn, donate_argnums=(1,))
+
+
 def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
                     max_len=None):
     """Greedy decoding: one batched prefill pass fills the KV cache (one

@@ -120,6 +120,7 @@ def _inplace_taped(x, fn):
             "a leaf Tensor that requires grad is being used in an "
             "in-place operation; detach() it or wrap the write in "
             "no_grad()")
+    had_node = x._grad_node is not None
     alias = Tensor._from_data(x._data, node=x._grad_node,
                               out_index=x._out_index,
                               stop_gradient=x.stop_gradient)
@@ -128,11 +129,15 @@ def _inplace_taped(x, fn):
     x._data = out._data
     x._grad_node = out._grad_node
     x._out_index = out._out_index
-    if _engine.is_grad_enabled():
+    if had_node or _engine.is_grad_enabled():
+        # adopt the taped flag; for a FORMER NON-LEAF under no_grad this
+        # sets stop_gradient=True (its node is gone — leaving the flag
+        # would create a masquerading leaf, the hazard __setitem__'s
+        # had_node logic documents)
         x.stop_gradient = out.stop_gradient
-    # under no_grad, keep x's flag: flipping a leaf PARAM to
-    # stop_gradient=True here would silently freeze it for later training
-    # (no_grad is the documented escape hatch for in-place param edits)
+    # under no_grad a LEAF param keeps its flag: flipping it would
+    # silently freeze the param for later training (no_grad is the
+    # documented escape hatch for in-place param edits)
     x._inplace_version += 1
     return x
 

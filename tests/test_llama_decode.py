@@ -173,3 +173,47 @@ def test_weight_only_int8_decode():
     toks_f = greedy_generate(params, prompt, config, 8)
     # greedy paths usually agree at tiny scale; require first tokens equal
     np.testing.assert_array_equal(toks[:, 0], toks_f[:, 0])
+
+
+def test_sample_generate():
+    """Sampling decode: one-dispatch scan; top_k=1 equals greedy; fixed
+    seed deterministic; different seeds diverge at high temperature."""
+    from paddle_tpu.models.llama import (init_llama_params, sample_generate,
+                                         llama_tiny)
+    config = llama_tiny(vocab=64, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=64)
+    params = init_llama_params(config, seed=0)
+    prompt = np.array([[3, 1, 4]], np.int32)
+
+    greedy_like = sample_generate(params, prompt, config, 8, top_k=1)
+    ref = greedy_generate(params, prompt, config, 8)
+    np.testing.assert_array_equal(greedy_like, ref)
+
+    s1 = sample_generate(params, prompt, config, 8, temperature=2.0,
+                         top_k=16, seed=7)
+    s2 = sample_generate(params, prompt, config, 8, temperature=2.0,
+                         top_k=16, seed=7)
+    np.testing.assert_array_equal(s1, s2)
+    s3 = sample_generate(params, prompt, config, 8, temperature=2.0,
+                         top_k=16, seed=8)
+    assert not np.array_equal(s1, s3)  # different seed, high temp
+
+    # top_p nucleus keeps output in-vocab and runs the composed path
+    s4 = sample_generate(params, prompt, config, 8, temperature=1.5,
+                         top_k=32, top_p=0.9, seed=3)
+    assert s4.shape == (1, 8) and (s4 >= 0).all() and (s4 < 64).all()
+
+
+def test_sample_logits_filters():
+    from paddle_tpu.models.llama import sample_logits
+    import jax
+    logits = jnp.asarray(np.log(np.array([[0.5, 0.3, 0.15, 0.05]],
+                                         np.float32)))
+    # top_k=2: only tokens 0/1 can appear
+    draws = [int(sample_logits(logits, jax.random.PRNGKey(i), 1.0, 2, 1.0)[0])
+             for i in range(40)]
+    assert set(draws) <= {0, 1} and len(set(draws)) == 2
+    # top_p=0.6: prefix mass {0.5} < 0.6, cut token 1 stays -> {0, 1}
+    draws_p = [int(sample_logits(logits, jax.random.PRNGKey(i), 1.0, 0, 0.6)[0])
+               for i in range(40)]
+    assert set(draws_p) <= {0, 1} and len(set(draws_p)) == 2
