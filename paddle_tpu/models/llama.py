@@ -609,22 +609,12 @@ def sample_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     """Sampling generation with the same one-dispatch structure as
     greedy_generate (prefill fills the cache, the continuation is a single
     compiled scan). Deterministic for a fixed seed."""
-    prompt = np.asarray(prompt_ids)
-    b, plen = prompt.shape
-    if plen == 0:
-        raise ValueError("sample_generate: prompt must be non-empty")
-    if max_new_tokens <= 0:
-        return np.zeros((b, 0), np.int32)
-    max_len = max_len or (plen + max_new_tokens)
-    if max_len < plen + max_new_tokens:
-        raise ValueError(
-            f"sample_generate: max_len={max_len} < prompt {plen} + "
-            f"max_new_tokens {max_new_tokens}; the cache would overflow")
-    frozen = _freeze_config(config)
     bucket = generate_scan_bucket(max_new_tokens + 1)  # all sampled steps
-    cache = init_kv_cache(config, b, max(max_len, plen + 1 + bucket))
-    logits, cache = _jitted_prefill(frozen)(params, cache,
-                                            jnp.asarray(prompt))
+    prompt, logits, cache, frozen = _prefill_for_generate(
+        params, prompt_ids, config, max_new_tokens, max_len,
+        1 + bucket, "sample_generate")
+    if logits is None:
+        return np.zeros((prompt.shape[0], 0), np.int32)
     key = jax.random.PRNGKey(seed)
     # temperature/top_p ride as TRACED scalars (shape-neutral): varying
     # them per request reuses one compiled scan; only top_k is static
@@ -646,6 +636,28 @@ def _jitted_sample(frozen, num_tokens, top_k):
     return jax.jit(sample_scan_fn, donate_argnums=(1,))
 
 
+def _prefill_for_generate(params, prompt_ids, config, max_new_tokens,
+                          max_len, extra_len, caller):
+    """Shared generation preamble: validation, cache sizing, prefill.
+    Returns (prompt, logits, cache, frozen) or a [B, 0] early result."""
+    prompt = np.asarray(prompt_ids)
+    b, plen = prompt.shape
+    if plen == 0:
+        raise ValueError(f"{caller}: prompt must be non-empty")
+    if max_new_tokens <= 0:
+        return prompt, None, None, None
+    max_len = max_len or (plen + max_new_tokens)
+    if max_len < plen + max_new_tokens:
+        raise ValueError(
+            f"{caller}: max_len={max_len} < prompt {plen} + "
+            f"max_new_tokens {max_new_tokens}; the cache would overflow")
+    frozen = _freeze_config(config)
+    cache = init_kv_cache(config, b, max(max_len, plen + extra_len))
+    logits, cache = _jitted_prefill(frozen)(params, cache,
+                                            jnp.asarray(prompt))
+    return prompt, logits, cache, frozen
+
+
 def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
                     max_len=None):
     """Greedy decoding: one batched prefill pass fills the KV cache (one
@@ -653,23 +665,13 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     a single compiled lax.scan dispatch (generate_scan). num_tokens is
     bucketed to powers of two so sweeping max_new_tokens doesn't recompile
     per value; both jitted wrappers donate the cache for in-place k/v."""
-    prompt = np.asarray(prompt_ids)
-    b, plen = prompt.shape
-    if plen == 0:
-        raise ValueError("greedy_generate: prompt must be non-empty")
-    if max_new_tokens <= 0:
-        return np.zeros((b, 0), np.int32)  # match the prefill/scan dtype
-    max_len = max_len or (plen + max_new_tokens)
-    if max_len < plen + max_new_tokens:
-        raise ValueError(
-            f"greedy_generate: max_len={max_len} < prompt {plen} + "
-            f"max_new_tokens {max_new_tokens}; the cache would overflow")
-    frozen = _freeze_config(config)
     n_cont = max_new_tokens - 1
     bucket = generate_scan_bucket(max_new_tokens)
-    cache = init_kv_cache(config, b, max(max_len, plen + 1 + bucket))
-    logits, cache = _jitted_prefill(frozen)(params, cache,
-                                            jnp.asarray(prompt))
+    prompt, logits, cache, frozen = _prefill_for_generate(
+        params, prompt_ids, config, max_new_tokens, max_len,
+        1 + bucket, "greedy_generate")
+    if logits is None:
+        return np.zeros((prompt.shape[0], 0), np.int32)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
     if max_new_tokens == 1:
         return np.asarray(first)
@@ -982,3 +984,116 @@ def train_flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     n = count_params(config)
     attn = 12 * config.num_hidden_layers * config.hidden_size * seq_len
     return 6.0 * n + attn
+
+
+def beam_search_scan(params, cache, first_logits, num_tokens, config,
+                     num_beams, length_penalty=0.0, eos_token_id=None):
+    """Beam search INSIDE one jit (ref: the reference's BeamSearchDecoder /
+    generation beam_search): beams ride the batch dim (B*K rows), the KV
+    cache is gathered to each step's surviving parents, and the token/
+    parent history is emitted per step and assembled by the gather_tree
+    backtrack at the end. Returns (sequences [B, K, num_tokens], scores
+    [B, K]) sorted best-first per batch row.
+
+    first_logits: [B, V] prefill logits. cache: prefilled for B rows;
+    expanded to B*K here. eos_token_id: finished beams are extended only
+    with EOS at zero extra cost and their score frozen (length_penalty
+    applies as score / (len ** penalty), GNMT-style, at the end)."""
+    b, v = first_logits.shape
+    k = num_beams
+    neg = jnp.float32(-1e9)
+
+    # seed: top-k tokens of the prefill logits start the k beams
+    logp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
+    cum, tok0 = lax.top_k(logp0, k)                      # [B, K] each
+    # expand cache to B*K rows (beam-major within each batch row)
+    def tile(a):
+        return jnp.repeat(a, k, axis=1)
+    cache = {"k": tile(cache["k"]), "v": tile(cache["v"]),
+             "pos": cache["pos"]}
+
+    def step(carry, _):
+        cache, cum, tok, alive_len = carry
+        logits, cache = llama_decode_step(
+            params, cache, tok.reshape(b * k, 1).astype(jnp.int32), config)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(b, k, v)
+        if eos_token_id is not None:
+            finished = tok == eos_token_id                 # [B, K]
+            # finished beams: only EOS continues, at no cost
+            only_eos = jnp.full((v,), neg).at[eos_token_id].set(0.0)
+            logp = jnp.where(finished[..., None], only_eos[None, None], logp)
+            alive_len = alive_len + (~finished)
+        else:
+            alive_len = alive_len + 1
+        total = cum[..., None] + logp                      # [B, K, V]
+        cum, flat = lax.top_k(total.reshape(b, k * v), k)  # [B, K]
+        parent = (flat // v).astype(jnp.int32)             # [B, K]
+        tok = (flat % v).astype(jnp.int32)
+        # gather cache rows to the surviving parents
+        rows = (jnp.arange(b, dtype=jnp.int32)[:, None] * k
+                + parent).reshape(-1)
+        cache = {"k": jnp.take(cache["k"], rows, axis=1),
+                 "v": jnp.take(cache["v"], rows, axis=1),
+                 "pos": cache["pos"]}
+        alive_len = jnp.take_along_axis(alive_len, parent, axis=1)
+        return (cache, cum, tok, alive_len), (tok, parent)
+
+    alive0 = jnp.ones((b, k), jnp.int32)
+    (cache, cum, _, alive_len), (toks, parents) = lax.scan(
+        step, (cache, cum, tok0.astype(jnp.int32), alive0),
+        None, length=num_tokens - 1)
+
+    # assemble: history [T, B, K]; step 0's parents are the identity
+    all_toks = jnp.concatenate([tok0.astype(jnp.int32)[None], toks], 0)
+    id0 = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, None],
+                           (1, b, k))
+    all_parents = jnp.concatenate([id0, parents], 0)
+    from ..nn.functional.common import _gather_tree_impl
+    seqs = _gather_tree_impl(all_toks, all_parents)        # [T, B, K]
+    scores = cum / jnp.maximum(alive_len.astype(jnp.float32),
+                               1.0) ** length_penalty
+    # re-sort: the scan keeps beams ordered by raw cumulative logprob, but
+    # the length penalty can reorder them (short finished vs long alive)
+    order = jnp.argsort(-scores, axis=-1)
+    scores = jnp.take_along_axis(scores, order, axis=-1)
+    seqs = jnp.transpose(seqs, (1, 2, 0))                  # [B, K, T]
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    return seqs, scores
+
+
+def beam_search_generate(params, prompt_ids, config: LlamaConfig,
+                         max_new_tokens, num_beams=4, length_penalty=0.0,
+                         eos_token_id=None, max_len=None):
+    """Beam-search generation: prefill once, then the whole search is a
+    single compiled scan. Returns (sequences [B, num_beams,
+    max_new_tokens], scores [B, num_beams]) best-first."""
+    prompt, logits, cache, frozen = _prefill_for_generate(
+        params, prompt_ids, config, max_new_tokens, max_len, 0,
+        "beam_search_generate")
+    if logits is None:
+        b = prompt.shape[0]
+        return (np.zeros((b, num_beams, 0), np.int32),
+                np.zeros((b, num_beams), np.float32))
+    # NO pow2 bucketing here: beam scores are sums over the emitted
+    # sequence, so extra padded steps would change both scores and which
+    # beams survive — each max_new_tokens compiles exactly
+    seqs, scores = _jitted_beam(frozen, int(max_new_tokens),
+                                int(num_beams), float(length_penalty),
+                                eos_token_id)(params, cache, logits)
+    return np.asarray(seqs), np.asarray(scores)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_beam(frozen, num_tokens, num_beams, length_penalty,
+                 eos_token_id):
+    config = LlamaConfig(*frozen)
+
+    def beam_scan_fn(params, cache, first_logits):
+        return beam_search_scan(params, cache, first_logits, num_tokens,
+                                config, num_beams, length_penalty,
+                                eos_token_id)
+    beam_scan_fn.__name__ = "beam_scan"
+    # no donation: the cache is re-tiled to B*K rows inside the jit, so no
+    # output matches the donated buffer (donating only warns uselessly)
+    return jax.jit(beam_scan_fn)

@@ -259,25 +259,29 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
     return _run_op("feature_alpha_dropout", f, (x,), {})
 
 
+def _gather_tree_impl(ids_, par_):
+    """Raw backtrack on jnp arrays [T, B, K] (shared with the in-jit beam
+    search in models/llama.py)."""
+    t, b, k = ids_.shape
+    from jax import lax
+
+    def step(beam_idx, inputs):
+        id_t, par_t = inputs                 # [B, K] each
+        out = jnp.take_along_axis(id_t, beam_idx, axis=1)
+        nxt = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return nxt.astype(beam_idx.dtype), out
+
+    init = jnp.broadcast_to(jnp.arange(k, dtype=ids_.dtype)[None], (b, k))
+    _, outs = lax.scan(step, init, (ids_, par_.astype(ids_.dtype)),
+                       reverse=True)
+    return outs                              # [T, B, K]
+
+
 def gather_tree(ids, parents, name=None):
     """Beam-search backtrack (ref: paddle.nn.functional.gather_tree):
     ids/parents [max_time, batch, beam]; walking parent pointers from the
     last step yields the full sequence per surviving beam."""
-    def f(ids_, par_):
-        t, b, k = ids_.shape
-        from jax import lax
-
-        def step(beam_idx, inputs):
-            id_t, par_t = inputs                 # [B, K] each
-            out = jnp.take_along_axis(id_t, beam_idx, axis=1)
-            nxt = jnp.take_along_axis(par_t, beam_idx, axis=1)
-            return nxt.astype(beam_idx.dtype), out
-
-        init = jnp.broadcast_to(jnp.arange(k, dtype=ids_.dtype)[None], (b, k))
-        _, outs = lax.scan(step, init, (ids_, par_.astype(ids_.dtype)),
-                           reverse=True)
-        return outs                              # [T, B, K]
-    return _run_op("gather_tree", f, (ids, parents), {})
+    return _run_op("gather_tree", _gather_tree_impl, (ids, parents), {})
 
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,

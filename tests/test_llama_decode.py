@@ -217,3 +217,75 @@ def test_sample_logits_filters():
     draws_p = [int(sample_logits(logits, jax.random.PRNGKey(i), 1.0, 0, 0.6)[0])
                for i in range(40)]
     assert set(draws_p) <= {0, 1} and len(set(draws_p)) == 2
+
+
+def test_beam_search_generate():
+    """Beam search: best beam's score is the true sum of stepwise logprobs
+    along its own sequence, beams are sorted best-first, and beam 0 scores
+    at least as well as greedy."""
+    from paddle_tpu.models.llama import (beam_search_generate,
+                                         init_llama_params, llama_tiny,
+                                         llama_hidden, llama_logits,
+                                         ParallelConfig)
+    import jax
+    config = llama_tiny(vocab=48, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=48)
+    params = init_llama_params(config, seed=0)
+    prompt = np.array([[7, 3]], np.int32)
+    N, K = 5, 3
+    seqs, scores = beam_search_generate(params, prompt, config, N,
+                                        num_beams=K)
+    assert seqs.shape == (1, K, N) and scores.shape == (1, K)
+    assert (np.diff(scores[0]) <= 1e-5).all()  # best-first
+
+    # score of beam 0 == sum of logprobs along its sequence under the model
+    def seq_logprob(toks):
+        ids = np.concatenate([prompt[0], toks])[None]
+        h = llama_hidden(params, jnp.asarray(ids.astype(np.int32)), config,
+                         ParallelConfig(), use_flash=False)
+        logits = np.asarray(llama_logits(params, h, config), np.float32)
+        lp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        total = 0.0
+        for t in range(N):
+            total += float(lp[0, prompt.shape[1] - 1 + t, toks[t]])
+        return total
+    np.testing.assert_allclose(scores[0, 0], seq_logprob(seqs[0, 0]),
+                               rtol=1e-4, atol=1e-4)
+
+    # greedy is a valid beam path: best beam can't score worse
+    greedy = greedy_generate(params, prompt, config, N)
+    assert scores[0, 0] >= seq_logprob(greedy[0]) - 1e-4
+
+
+def test_beam_search_eos():
+    from paddle_tpu.models.llama import (beam_search_generate,
+                                         init_llama_params, llama_tiny)
+    config = llama_tiny(vocab=32, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=48)
+    params = init_llama_params(config, seed=1)
+    prompt = np.array([[1, 2], [3, 4]], np.int32)
+    seqs, scores = beam_search_generate(params, prompt, config, 6,
+                                        num_beams=2, eos_token_id=0,
+                                        length_penalty=0.6)
+    assert seqs.shape == (2, 2, 6) and np.isfinite(scores).all()
+    # after an EOS, a finished beam only emits EOS
+    for b in range(2):
+        for k in range(2):
+            toks = seqs[b, k]
+            if (toks == 0).any():
+                first = int(np.argmax(toks == 0))
+                assert (toks[first:] == 0).all()
+
+
+def test_beam_search_penalty_reorders():
+    """With a length penalty, the returned beams are sorted by the
+    penalty-adjusted score (not raw cumulative logprob)."""
+    from paddle_tpu.models.llama import (beam_search_generate,
+                                         init_llama_params, llama_tiny)
+    config = llama_tiny(vocab=32, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=48)
+    params = init_llama_params(config, seed=2)
+    prompt = np.array([[1, 2]], np.int32)
+    _, scores = beam_search_generate(params, prompt, config, 6, num_beams=3,
+                                     eos_token_id=0, length_penalty=0.9)
+    assert (np.diff(scores[0]) <= 1e-6).all()
