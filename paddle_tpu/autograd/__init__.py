@@ -2,6 +2,7 @@
 from .engine import (backward, grad, no_grad, enable_grad, is_grad_enabled,
                      set_grad_enabled, GradNode)
 from .py_layer import PyLayer, PyLayerContext
+from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
 
-__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+__all__ = ["jacobian", "hessian", "vjp", "jvp", "backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
            "set_grad_enabled", "PyLayer", "PyLayerContext"]

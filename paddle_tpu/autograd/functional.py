@@ -1,0 +1,91 @@
+"""Functional differentiation API (ref: python/paddle/autograd/
+autograd.py — jacobian/hessian, and incubate.autograd vjp/jvp).
+
+TPU-native: these are direct marshals onto jax's transforms — the tape
+engine handles dygraph backward; jacobian/hessian/jvp/vjp are exactly the
+functional transforms XLA was built around, so no graph surgery is
+needed. Functions take and return paddle Tensors; multiple inputs pass as
+a (tuple of) Tensors like the reference."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tensor_cls():
+    # lazy: tensor.tensor imports autograd.engine at module load, so a
+    # top-level import here would be circular
+    from ..tensor.tensor import Tensor
+    return Tensor
+
+
+def _unwrap(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_unwrap(v) for v in x)
+    return x._data if isinstance(x, _tensor_cls()) else jnp.asarray(x)
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_wrap(v) for v in x)
+    return _tensor_cls()._from_data(x)
+
+
+def _fn_on_raw(func):
+    def raw(*args):
+        out = func(*_wrap(args))
+        return _unwrap(out)
+    return raw
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """d func / d xs. xs: Tensor or tuple of Tensors; returns the jacobian
+    pytree mirroring (outputs x inputs) like the reference (single in/out
+    -> a single Tensor)."""
+    single = not isinstance(xs, (list, tuple))
+    args = (xs,) if single else tuple(xs)
+    jac = jax.jacobian(_fn_on_raw(func), argnums=tuple(range(len(args))))(
+        *_unwrap(args))
+    if single:
+        jac = jac[0] if isinstance(jac, tuple) else jac
+    return _wrap(jac)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """d^2 func / d xs^2 for a SCALAR-output func (reference contract)."""
+    single = not isinstance(xs, (list, tuple))
+    args = (xs,) if single else tuple(xs)
+    hes = jax.hessian(_fn_on_raw(func), argnums=tuple(range(len(args))))(
+        *_unwrap(args))
+    if single:
+        hes = hes[0][0] if isinstance(hes, tuple) else hes
+    return _wrap(hes)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result): pull v back through func at xs (ref:
+    incubate.autograd.vjp). v defaults to ones like the output."""
+    single = not isinstance(xs, (list, tuple))
+    args = (xs,) if single else tuple(xs)
+    out, pullback = jax.vjp(_fn_on_raw(func), *_unwrap(args))
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = _unwrap(v)
+    grads = pullback(cot)
+    if single:
+        grads = grads[0]
+    return _wrap(out), _wrap(grads)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result): push v forward through func at xs."""
+    single = not isinstance(xs, (list, tuple))
+    args = (xs,) if single else tuple(xs)
+    raw_args = _unwrap(args)
+    if v is None:
+        tangents = jax.tree_util.tree_map(jnp.ones_like, raw_args)
+    else:
+        tangents = _unwrap((v,) if single else v)
+    out, tang = jax.jvp(_fn_on_raw(func), raw_args, tangents)
+    return _wrap(out), _wrap(tang)

@@ -5,6 +5,7 @@ fleet/topology.py for the axis layout and communication.py for collective
 semantics.
 """
 from . import fleet
+from . import launch
 from . import sharding_utils
 from .communication import (Group, ReduceOp, all_gather, all_reduce,
                             all_to_all_single, alltoall, barrier, broadcast,
@@ -19,3 +20,95 @@ from . import ps
 from . import auto_parallel
 from .auto_parallel.api import (shard_tensor, Shard, Replicate, Partial,
                                 ProcessMesh)
+
+
+class ParallelEnv:
+    """ref: paddle.distributed.ParallelEnv — process-level env view."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        import os
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """ref: paddle.distributed.wait — block until the tensor's pending
+    work is done. Under the XLA model a device_get of one element is the
+    only true barrier (see bench.py notes on block_until_ready)."""
+    import jax
+    data = getattr(tensor, "_data", tensor)
+    jax.device_get(jax.numpy.ravel(data)[0])
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    """ref: all_gather_object — pickle the object, gather the bytes.
+    Single-controller SPMD note: in-process this is the world's view
+    already; with multiple processes the coordination service would carry
+    it. Implemented over the collective API's process-group when one is
+    active, else the local world of size 1."""
+    import pickle
+    n = get_world_size(group)
+    if n == 1:
+        object_list.clear()
+        object_list.append(pickle.loads(pickle.dumps(obj)))
+        return
+    raise NotImplementedError(
+        "all_gather_object across processes requires the TCPStore path: "
+        "use distributed.rpc or gather tensors via all_gather")
+
+
+_SPLIT_LAYERS = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """ref: paddle.distributed.split — run x through a megatron-parallel
+    embedding or linear whose weight is split over the 'mp' mesh axis
+    (the fleet parallel layers; GSPMD shards the weights and derives the
+    collective). In the reference this builds ONE op in the static
+    program; in eager code pass `name` so repeated calls REUSE the same
+    layer (weights cached per name) — without it every call creates a
+    fresh randomly-initialized layer, which is only meaningful under
+    Program capture."""
+    from .fleet.meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    key = (name, tuple(size), operation, axis)
+    layer = _SPLIT_LAYERS.get(key) if name is not None else None
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1])
+        elif operation == "linear":
+            if axis == 0:
+                layer = RowParallelLinear(size[0], size[1],
+                                          input_is_parallel=False)
+            else:
+                layer = ColumnParallelLinear(size[0], size[1],
+                                             gather_output=gather_out)
+        else:
+            raise ValueError(f"split: unknown operation {operation!r}")
+        if name is not None:
+            _SPLIT_LAYERS[key] = layer
+        else:
+            import warnings
+            warnings.warn(
+                "distributed.split without `name` creates a fresh layer "
+                "each call (static-graph semantics); pass name= for eager "
+                "weight reuse", stacklevel=2)
+    return layer(x)

@@ -3,3 +3,51 @@ from . import asp
 from . import distributed
 from . import nn
 from . import optimizer
+
+# segment ops + graph message passing (ref: python/paddle/incubate/tensor/
+# math.py + operators/graph_send_recv.py — these predate paddle.geometric
+# and alias the same implementations)
+from ..geometric import (segment_sum, segment_mean,  # noqa: F401
+                         segment_min, segment_max)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (ref: incubate.softmax_mask_fuse): additive
+    mask broadcast onto [B, H, Sq, Sk] scores; on TPU, XLA fuses the
+    add+softmax chain, so one expression IS the fused kernel."""
+    import jax
+    from ..tensor.tensor import _run_op
+
+    def f(a, m):
+        import jax.numpy as jnp
+        return jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+    return _run_op("softmax_mask_fuse", f, (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked fused softmax (ref: the GPT kernel variant)."""
+    from ..tensor.tensor import _run_op
+
+    def f(a):
+        import jax
+        import jax.numpy as jnp
+        sq, sk = a.shape[-2], a.shape[-1]
+        # bottom-right aligned causal band (supports Sq != Sk, e.g. a
+        # decode step's [*, 1, Sk] scores attend the whole prefix)
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        z = jnp.where(causal, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, axis=-1).astype(a.dtype)
+    return _run_op("softmax_mask_fuse_ut", f, (x,), {})
+
+
+def identity_loss(x, reduction="none"):
+    """ref: incubate.identity_loss (IPU pattern: mark a value as the loss).
+    reduction: 'none'(0)/'sum'(1)/'mean'(2) — int codes accepted."""
+    red = {0: "none", 1: "sum", 2: "mean"}.get(reduction, reduction)
+    if red == "sum":
+        return x.sum()
+    if red == "mean":
+        return x.mean()
+    return x

@@ -392,3 +392,27 @@ class SubsetRandomSampler(Sampler):
 
     def __len__(self):
         return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    """ref: io.ConcatDataset — concatenation of map-style datasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset expects at least one dataset")
+        self._sizes = [len(d) for d in self.datasets]
+
+    def __len__(self):
+        return sum(self._sizes)
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        if idx < 0:
+            raise IndexError("ConcatDataset index out of range")
+        for d, n in zip(self.datasets, self._sizes):
+            if idx < n:
+                return d[idx]
+            idx -= n
+        raise IndexError("ConcatDataset index out of range")

@@ -190,3 +190,18 @@ def set_global_initializer(weight_init, bias_init=None):
     # reference stores globals consulted by create_parameter; simple version:
     from ..layer import layers as _layers
     raise NotImplementedError("set_global_initializer: pass initializers via ParamAttr")
+
+
+def calculate_gain(nonlinearity, param=None):
+    """ref: nn.initializer.calculate_gain."""
+    import math
+    gains = {"sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+             "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+             "conv3d_transpose": 1.0, "tanh": 5.0 / 3,
+             "relu": math.sqrt(2.0), "selu": 3.0 / 4}
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity not in gains:
+        raise ValueError(f"unsupported nonlinearity {nonlinearity!r}")
+    return gains[nonlinearity]

@@ -162,3 +162,51 @@ import contextlib as _contextlib
 def name_scope(prefix=None):
     """Naming-only scope in the reference; no-op here."""
     yield
+
+
+class _Scope:
+    """ref: the C++ Scope — named variable holder. The XLA design keeps
+    arrays inside Program state; this shim provides the find_var/var API
+    over the default program's variable map for user code that pokes
+    scopes directly."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar(name))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set_tensor(self, v):
+        self._value = v
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    global _GLOBAL_SCOPE
+    prev, _GLOBAL_SCOPE = _GLOBAL_SCOPE, scope
+    try:
+        yield
+    finally:
+        _GLOBAL_SCOPE = prev
+
+
+Scope = _Scope

@@ -185,3 +185,111 @@ def test_inplace_param_edit_under_no_grad_keeps_trainable():
     out = layer(paddle.to_tensor(np.ones((2, 4), np.float32)))
     out.sum().backward()
     assert layer.weight.grad is not None
+
+
+def test_api_sweep_round3_gaps():
+    """The namespace-sweep additions exist and behave."""
+    import paddle_tpu as paddle
+    import numpy as np
+
+    # distributed
+    env = paddle.distributed.ParallelEnv()
+    assert env.rank == 0 and env.nranks >= 1
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    assert paddle.distributed.wait(t) is t
+    objs = []
+    paddle.distributed.all_gather_object(objs, {"a": 1})
+    assert objs == [{"a": 1}]
+    assert hasattr(paddle.distributed, "launch")
+
+    # static scope
+    from paddle_tpu import static
+    sc = static.Scope()
+    with static.scope_guard(sc):
+        assert static.global_scope() is sc
+        v = static.global_scope().var("w")
+        v.set_tensor(42)
+        assert static.global_scope().find_var("w").get_tensor() == 42
+    assert static.global_scope() is not sc
+
+    # io.ConcatDataset
+    from paddle_tpu.io import ConcatDataset, Dataset
+
+    class Rng(Dataset):
+        def __init__(self, a, b): self.r = list(range(a, b))
+        def __len__(self): return len(self.r)
+        def __getitem__(self, i): return self.r[i]
+
+    cd = ConcatDataset([Rng(0, 3), Rng(10, 12)])
+    assert len(cd) == 5 and cd[3] == 10 and cd[-1] == 11
+
+    # initializer.calculate_gain
+    import math
+    assert paddle.nn.initializer.calculate_gain("relu") == math.sqrt(2.0)
+    assert abs(paddle.nn.initializer.calculate_gain("leaky_relu", 0.1)
+               - math.sqrt(2 / 1.01)) < 1e-9
+
+    # autograd functional
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    J = paddle.autograd.jacobian(lambda a: a * a, x)
+    np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0]))
+    H = paddle.autograd.hessian(lambda a: (a ** 3).sum(), x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]))
+    out, g = paddle.autograd.vjp(lambda a: a * 3.0, x)
+    np.testing.assert_allclose(g.numpy(), [3.0, 3.0])
+    out, tang = paddle.autograd.jvp(lambda a: a * a,
+                                    paddle.to_tensor(np.array([2.0],
+                                                              np.float32)))
+    np.testing.assert_allclose(tang.numpy(), [4.0])
+
+    # incubate
+    seg = paddle.incubate.segment_sum(
+        paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)),
+        paddle.to_tensor(np.array([0, 0, 1], np.int64)))
+    np.testing.assert_allclose(seg.numpy(), [3.0, 3.0])
+    sm = paddle.incubate.softmax_mask_fuse(
+        paddle.to_tensor(np.zeros((1, 1, 2, 3), np.float32)),
+        paddle.to_tensor(np.array([[[[0.0, 0.0, -1e30]]]], np.float32)))
+    np.testing.assert_allclose(sm.numpy()[0, 0, 0], [0.5, 0.5, 0.0],
+                               atol=1e-6)
+    ut = paddle.incubate.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(np.zeros((1, 1, 3, 3), np.float32)))
+    np.testing.assert_allclose(ut.numpy()[0, 0, 0], [1.0, 0.0, 0.0])
+    il = paddle.incubate.identity_loss(
+        paddle.to_tensor(np.array([2.0, 4.0], np.float32)), reduction="mean")
+    assert float(il.numpy()) == 3.0
+
+
+def test_round3_gap_edge_cases():
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.io import ConcatDataset, Dataset
+
+    class Rng(Dataset):
+        def __init__(self, a, b): self.r = list(range(a, b))
+        def __len__(self): return len(self.r)
+        def __getitem__(self, i): return self.r[i]
+
+    cd = ConcatDataset([Rng(0, 3), Rng(10, 12)])
+    with pytest.raises(IndexError):
+        cd[-6]
+    with pytest.raises(IndexError):
+        cd[5]
+
+    # non-square causal fused softmax (decode-step shape): bottom-right
+    # aligned band — the single query attends the whole prefix
+    ut = paddle.incubate.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(np.zeros((1, 1, 1, 4), np.float32)))
+    assert ut.shape == [1, 1, 1, 4]
+    np.testing.assert_allclose(ut.numpy()[0, 0, 0], [0.25] * 4)
+
+    # distributed.split: named calls reuse weights
+    import warnings as _w
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    a = paddle.distributed.split(x, (8, 4), "linear", axis=1, name="p1")
+    b = paddle.distributed.split(x, (8, 4), "linear", axis=1, name="p1")
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        paddle.distributed.split(x, (8, 4), "linear", axis=1)
+    assert any("fresh layer" in str(r.message) for r in rec)
