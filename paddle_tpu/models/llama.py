@@ -1097,3 +1097,38 @@ def _jitted_beam(frozen, num_tokens, num_beams, length_penalty,
     # no donation: the cache is re-tiled to B*K rows inside the jit, so no
     # output matches the donated buffer (donating only warns uselessly)
     return jax.jit(beam_scan_fn)
+
+
+def generate(params, prompt_ids, config: LlamaConfig, max_new_tokens=64,
+             decode_strategy="greedy_search", temperature=1.0, top_k=0,
+             top_p=1.0, num_beams=4, length_penalty=0.0, eos_token_id=None,
+             seed=0, max_len=None):
+    """Unified generation entry (ref: the reference generate API's
+    decode_strategy dispatch): 'greedy_search' | 'sampling' |
+    'beam_search'. Greedy/sampling return [B, max_new_tokens] token ids;
+    beam search returns the best beam per batch row (use
+    beam_search_generate directly for all beams + scores).
+    eos_token_id is supported by the beam path only (the greedy/sampling
+    scans have a fixed trip count) — passing it elsewhere raises rather
+    than silently generating past EOS."""
+    if eos_token_id is not None and decode_strategy != "beam_search":
+        raise ValueError(
+            "eos_token_id is only supported with "
+            "decode_strategy='beam_search'")
+    if decode_strategy == "greedy_search":
+        return greedy_generate(params, prompt_ids, config, max_new_tokens,
+                               max_len=max_len)
+    if decode_strategy == "sampling":
+        return sample_generate(params, prompt_ids, config, max_new_tokens,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p, seed=seed, max_len=max_len)
+    if decode_strategy == "beam_search":
+        seqs, _ = beam_search_generate(params, prompt_ids, config,
+                                       max_new_tokens, num_beams=num_beams,
+                                       length_penalty=length_penalty,
+                                       eos_token_id=eos_token_id,
+                                       max_len=max_len)
+        return seqs[:, 0]
+    raise ValueError(
+        f"unknown decode_strategy {decode_strategy!r}; expected "
+        "'greedy_search', 'sampling', or 'beam_search'")

@@ -289,3 +289,34 @@ def test_beam_search_penalty_reorders():
     _, scores = beam_search_generate(params, prompt, config, 6, num_beams=3,
                                      eos_token_id=0, length_penalty=0.9)
     assert (np.diff(scores[0]) <= 1e-6).all()
+
+
+def test_unified_generate_dispatch():
+    from paddle_tpu.models.llama import (generate, init_llama_params,
+                                         llama_tiny)
+    import pytest
+    config = llama_tiny(vocab=48, hidden=32, layers=2, heads=4, kv_heads=4,
+                        inter=64, seq=48)
+    params = init_llama_params(config, seed=0)
+    prompt = np.array([[7, 3]], np.int32)
+    g = generate(params, prompt, config, 5)
+    assert np.array_equal(g, greedy_generate(params, prompt, config, 5))
+    s = generate(params, prompt, config, 5, decode_strategy="sampling",
+                 temperature=1.2, top_k=8, seed=4)
+    assert s.shape == (1, 5)
+    b = generate(params, prompt, config, 5, decode_strategy="beam_search",
+                 num_beams=3)
+    assert b.shape == (1, 5)
+    with pytest.raises(ValueError, match="decode_strategy"):
+        generate(params, prompt, config, 5, decode_strategy="nope")
+
+
+def test_unified_generate_eos_guard():
+    from paddle_tpu.models.llama import generate, init_llama_params, llama_tiny
+    import pytest
+    config = llama_tiny(vocab=32, hidden=32, layers=1, heads=2, kv_heads=2,
+                        inter=32, seq=32)
+    params = init_llama_params(config, seed=0)
+    with pytest.raises(ValueError, match="eos_token_id"):
+        generate(params, np.array([[1]], np.int32), config, 4,
+                 decode_strategy="sampling", eos_token_id=0)
