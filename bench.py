@@ -215,7 +215,11 @@ def run_decode(config, batch, dev, prompt_len=128, new_tokens=128,
             t0 = time.perf_counter()
             greedy_generate(params, prompt, config, n_new)
             return time.perf_counter() - t0
-        scan_ms = (timed(new_tokens) - timed(1)) * 1e3
+        # best-of-3 each term, clamped: single-shot jitter can make the
+        # difference negative (ADVICE r3)
+        full = min(timed(new_tokens) for _ in range(3))
+        one = min(timed(1) for _ in range(3))
+        scan_ms = max((full - one) * 1e3, 1e-3)
     mspt = scan_ms / n_steps
 
     kind = getattr(dev, "device_kind", "cpu").lower()

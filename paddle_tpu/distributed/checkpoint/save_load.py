@@ -168,7 +168,20 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                 _pending[path] = handle
                 thread.start()
                 break
-        prev.wait()
+        try:
+            prev.wait()
+        except Exception:
+            # the previous save's owner already receives its failure via
+            # that save's own handle; a poisoned predecessor must not
+            # abort THIS save (ADVICE r3) — its thread has exited, so the
+            # registration slot is free and we proceed
+            pass
+        with _pending_lock:
+            # normally run()'s finally pops the entry before the thread
+            # exits; drop a dead handle that is somehow still registered
+            # so this loop cannot spin on it
+            if _pending.get(path) is prev and prev.done():
+                _pending.pop(path, None)
     if not async_save:
         handle.wait()
         return None

@@ -64,9 +64,19 @@ class DygraphShardingOptimizer:
         from ...meta_parallel.sharding.group_sharded import _shard_spec_for
         self._inner_opt = optimizer
         self._hcg = hcg
+        degree = None
+        if hcg is not None:
+            try:
+                degree = hcg.get_sharding_parallel_world_size()
+            except Exception:
+                degree = None
         for p in optimizer._parameter_list:
             if not p.stop_gradient:
-                p.opt_state_pspec = _shard_spec_for(p)
+                base = getattr(p, "pspec", None)
+                p._pre_gs_pspec = base
+                p.opt_state_pspec = _shard_spec_for(
+                    tuple(p._data.shape), base, degree=degree)
+                p.sharding_level = "os"
         optimizer._sharding_level = "os"
 
     def __getattr__(self, item):

@@ -31,20 +31,42 @@ def _largest_dim(shape):
     return max(range(len(shape)), key=lambda i: shape[i])
 
 
-def _shard_spec_for(param, axis="sharding"):
-    """Shard the largest dim over the sharding axis, composing with an
-    existing mp spec if present."""
-    shape = tuple(param._data.shape)
-    existing = list(getattr(param, "pspec", None) or [None] * len(shape))
+def _shard_spec_for(shape, base_spec, axis="sharding", degree=None):
+    """Shard the largest eligible dim over the sharding axis, composing
+    with an existing (e.g. mp) spec. With a known degree only dims whose
+    size divides evenly are eligible, falling through to the next largest
+    — an uneven shard is silently padded by GSPMD, wasting memory exactly
+    where ZeRO exists to save it. No divisible dim → left unsharded."""
+    shape = tuple(shape)
+    existing = list(base_spec or [None] * len(shape))
     while len(existing) < len(shape):
         existing.append(None)
-    # pick the largest dim not already sharded, divisible by the degree
     candidates = sorted(range(len(shape)), key=lambda i: -shape[i])
     for i in candidates:
-        if existing[i] is None:
-            existing[i] = axis
-            return P(*existing)
+        if existing[i] is not None:
+            continue
+        if degree is not None and shape[i] % degree != 0:
+            continue
+        existing[i] = axis
+        return P(*existing)
     return P(*existing)
+
+
+def mesh_resolved_spec(param, mesh, axis="sharding"):
+    """Placement-time re-derivation of a param's ZeRO spec with the TRUE
+    degree (the mesh is usually unknown at group_sharded_parallel time).
+    Recomputes from the pre-ZeRO base spec so divisibility is enforced
+    against mesh.shape[axis]."""
+    spec = getattr(param, "opt_state_pspec", None)
+    if spec is None or mesh is None or axis not in dict(mesh.shape):
+        return spec
+    if not hasattr(param, "_pre_gs_pspec"):
+        # opt_state_pspec set directly by the user, not by the ZeRO
+        # attach path: honor it verbatim
+        return spec
+    return _shard_spec_for(tuple(param._data.shape),
+                           getattr(param, "_pre_gs_pspec", None),
+                           axis=axis, degree=int(mesh.shape[axis]))
 
 
 def group_sharded_parallel(model: Layer, optimizer, level: str,
@@ -62,7 +84,9 @@ def group_sharded_parallel(model: Layer, optimizer, level: str,
     for p in model.parameters():
         if p.stop_gradient:
             continue
-        spec = _shard_spec_for(p)
+        base = getattr(p, "pspec", None)
+        p._pre_gs_pspec = base  # lets TrainStep re-derive with the mesh degree
+        spec = _shard_spec_for(tuple(p._data.shape), base, degree=degree)
         # stage 1/2: only optimizer state (and grads) shard; stage 3: params too
         p.opt_state_pspec = spec
         if level == "p_g_os":

@@ -137,7 +137,7 @@ def preval(name, local_ns):
     return local_ns.get(name, UNDEF)
 
 
-def convert_ifelse(pred, true_fn, false_fn, vs):
+def convert_ifelse(pred, true_fn, false_fn, vs, warn_calls=False):
     """vs: tuple of pre-values of the variables assigned in either branch.
 
     Concrete predicate: run one branch, plain Python. Traced predicate:
@@ -151,6 +151,14 @@ def convert_ifelse(pred, true_fn, false_fn, vs):
     from ..tensor.tensor import Tensor
     if not _is_traced(pred):
         return true_fn(*vs) if _pred_value(pred) else false_fn(*vs)
+    if warn_calls:
+        # deferred from transform time: only a *traced* predicate reaches
+        # select semantics, so only then is the both-branches hazard real
+        warnings.warn(
+            "dy2static: an `if` branch contains a call whose result "
+            "is discarded; under tracing BOTH branches execute "
+            "(select semantics), so side effects run on both paths",
+            stacklevel=2)
     t_out = true_fn(*vs)
     f_out = false_fn(*vs)
     pred_raw = getattr(pred, "_data", pred)
@@ -565,17 +573,13 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
                 ast.Constant(value="`if` whose branches bind no variables "
                                    "(side effects only)")])
             return node
-        for stmt in node.body + node.orelse:
-            # this if WILL translate to select semantics when the pred is
-            # traced: warn about statement-level calls with discarded
-            # values (append/print/IO) — both branches would run them
-            if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
-                warnings.warn(
-                    "dy2static: an `if` branch contains a call whose result "
-                    "is discarded; under tracing BOTH branches execute "
-                    "(select semantics), so side effects run on both paths",
-                    stacklevel=2)
-                break
+        # statement-level calls with discarded values (append/print/IO)
+        # would run on BOTH paths under select semantics — but only a
+        # traced predicate takes that path, so the warning is emitted
+        # lazily from convert_ifelse, not at transform time
+        has_discarded_call = any(
+            isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)
+            for stmt in node.body + node.orelse)
         uid = self._uid()
         tname, fname = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
         true_fn = _make_fn(tname, assigned, node.body, assigned)
@@ -586,6 +590,7 @@ class _Dy2StaticTransformer(ast.NodeTransformer):
             _name(tname, ast.Load()),
             _name(fname, ast.Load()),
             _prevals_tuple(assigned),
+            ast.Constant(value=has_discarded_call),
         ])
         return [true_fn, false_fn, _assign_tuple(assigned, call)]
 

@@ -55,9 +55,22 @@ class TrainStep:
         self.wd_map = {k: optimizer._weight_decay for k in self.trainable_keys}
 
         if mesh is not None:
-            self.param_shardings = {
-                k: sharding_utils.param_sharding(p, mesh)
-                for k, p in self.param_objs.items()}
+            from ..distributed.fleet.meta_parallel.sharding.group_sharded \
+                import mesh_resolved_spec
+            # ZeRO specs attached by group_sharded_parallel are re-derived
+            # here against the REAL mesh degree (divisibility enforced —
+            # see mesh_resolved_spec); non-ZeRO pspecs pass through.
+            gs_specs = {k: mesh_resolved_spec(p, mesh)
+                        for k, p in self.param_objs.items()
+                        if getattr(p, "opt_state_pspec", None) is not None}
+            self.param_shardings = {}
+            for k, p in self.param_objs.items():
+                if getattr(p, "sharding_level", None) == "p_g_os" \
+                        and gs_specs.get(k) is not None:
+                    self.param_shardings[k] = NamedSharding(mesh, gs_specs[k])
+                else:
+                    self.param_shardings[k] = \
+                        sharding_utils.param_sharding(p, mesh)
             params = {k: jax.device_put(v, self.param_shardings[k])
                       for k, v in params.items()}
             # ZeRO stage 1/2 (group_sharded 'os'/'os_g'): optimizer states
@@ -65,7 +78,7 @@ class TrainStep:
             # replicated — XLA then reduce-scatters grads into the update.
             opt_shardings = {}
             for k in self.trainable_keys:
-                os_spec = getattr(self.param_objs[k], "opt_state_pspec", None)
+                os_spec = gs_specs.get(k)
                 opt_shardings[k] = (NamedSharding(mesh, os_spec)
                                     if os_spec is not None
                                     else self.param_shardings[k])
@@ -88,7 +101,7 @@ class TrainStep:
             for k in self.trainable_keys:
                 p = self.param_objs[k]
                 lvl = getattr(p, "sharding_level", None)
-                os_spec = getattr(p, "opt_state_pspec", None)
+                os_spec = gs_specs.get(k)
                 if lvl in ("os_g", "p_g_os") and os_spec is not None:
                     self.grad_shardings[k] = NamedSharding(mesh, os_spec)
                 elif lvl == "os":

@@ -495,6 +495,25 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
         v = jnp.repeat(v, rep, axis=1)
     cu_q = cu_seqlens_q.astype(jnp.int32)
     cu_k = cu_q if self_attn else cu_seqlens_k.astype(jnp.int32)
+    if max_seqlen and self_attn:
+        # a lying max_seqlen silently shrinks the live-tile span bound
+        # (_inner_steps) below real segments → wrong output. Validate on
+        # the host when cu is concrete (the common eager path); under a
+        # trace fall back to the always-sound full inner grid. Cross-attn
+        # already ignores max_seqlen (span bound unsound there).
+        import jax.core as _jc
+        concrete = not isinstance(cu_q, _jc.Tracer)
+        if concrete:
+            import numpy as _np
+            longest = int(_np.max(_np.diff(_np.asarray(cu_q))))
+            if longest > int(max_seqlen):
+                raise ValueError(
+                    f"flash_varlen_attention: max_seqlen={int(max_seqlen)} "
+                    f"is smaller than the longest packed segment "
+                    f"({longest}); the static live-tile bound would skip "
+                    f"live tiles and produce wrong attention output")
+        else:
+            max_seqlen = None
     qh = q.transpose(1, 0, 2)
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)

@@ -1,4 +1,5 @@
 """Distributed checkpoint: sharded save + reshard-on-load (SURVEY.md §5.4)."""
+import os
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -199,3 +200,27 @@ def test_crash_between_publish_renames_resumable(tmp_path):
     target = {"w": paddle.zeros([2])}
     load_state_dict(target, p)
     np.testing.assert_allclose(target["w"].numpy(), [2.0, 2.0])
+
+
+def test_failed_async_save_does_not_poison_next(tmp_path):
+    """ADVICE r3: a failed earlier async save to the same path must not
+    abort the next save_state_dict call (the failure belongs to the
+    previous handle's owner)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed.checkpoint.save_load as sl
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    sd = {"w": paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))}
+    path = str(tmp_path / "ckpt")
+    h = save_state_dict(sd, path, async_save=True)
+    h._thread.join()
+    # simulate a predecessor that died with an error but is still
+    # registered (worst case: wait() raises AND the slot is occupied)
+    h._error = RuntimeError("injected poison")
+    with sl._pending_lock:
+        sl._pending[os.path.abspath(path)] = h
+    save_state_dict(sd, path, async_save=False)  # must neither raise nor spin
+    tgt = {"w": paddle.to_tensor(np.zeros((2, 3), np.float32))}
+    load_state_dict(tgt, path)
+    np.testing.assert_allclose(np.asarray(tgt["w"]._data),
+                               np.arange(6, dtype=np.float32).reshape(2, 3))

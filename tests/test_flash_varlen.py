@@ -167,3 +167,18 @@ def test_varlen_cross_attn_ignores_max_seqlen():
                                  max_seqlen=max(max(lens_q), max(lens_k)))
     ref = _dense_ref(q, k, v, cu_q, cu_k, False, SCALE)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_max_seqlen_smaller_than_longest_segment_raises():
+    """A lying max_seqlen would silently skip live tiles (ADVICE r3);
+    concrete cu_seqlens must be validated on the host."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from paddle_tpu.ops.flash_varlen import flash_varlen_attention
+    rng = np.random.RandomState(0)
+    cu = jnp.asarray(np.array([0, 300, 400], np.int32))
+    q = jnp.asarray(rng.randn(400, 2, 64).astype(np.float32))
+    with pytest.raises(ValueError, match="max_seqlen"):
+        flash_varlen_attention(q, q, q, cu, cu, scale=0.125, causal=True,
+                               max_seqlen=256)

@@ -187,3 +187,58 @@ def test_stage2_grads_reduce_scattered_vs_stage1():
             if re.search(r"all-reduce\(|reduce-scatter\(", ln)
             and f"f32[{HIDDEN},16]" in ln]
     assert full, "stage 1 should all-reduce full-shape grads"
+
+
+def test_shard_spec_divisibility():
+    """A non-divisible largest dim must fall through to the next largest
+    divisible one; no divisible dim at all -> unsharded (no GSPMD pad)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding.group_sharded \
+        import _shard_spec_for, mesh_resolved_spec
+
+    # largest dim 34 not divisible by 4 -> shard dim 1 (16)
+    assert _shard_spec_for((34, 16), None, degree=4) == P(None, "sharding")
+    # divisible largest dim wins as before
+    assert _shard_spec_for((32, 16), None, degree=4) == P("sharding", None)
+    # nothing divisible -> unsharded
+    assert _shard_spec_for((7, 5), None, degree=4) == P(None, None)
+    # composes with an existing mp spec: dim 0 taken -> next largest free
+    assert _shard_spec_for((64, 32), P("mp", None), degree=4) \
+        == P("mp", "sharding")
+    # no degree (mesh unknown at attach time): largest free dim
+    assert _shard_spec_for((34, 16), None) == P("sharding", None)
+
+    # end-to-end: attach-time guess is corrected at placement time
+    paddle.set_device("cpu")
+    model = nn.Linear(16, 34)  # weight [34,16] transposed storage is [16,34]
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+    mesh = _mesh()  # sharding degree 4
+    for p in model.parameters():
+        spec = mesh_resolved_spec(p, mesh)
+        shape = tuple(p._data.shape)
+        for i, ax in enumerate(spec):
+            if ax == "sharding":
+                assert shape[i] % 4 == 0, (shape, spec)
+
+
+def test_group_sharded_nondivisible_matches_serial():
+    """Stage-3 training with a non-divisible hidden size still matches
+    serial numerics (the uneven dim is simply left unsharded)."""
+    paddle.set_device("cpu")
+
+    def build():
+        paddle.seed(11)
+        m = nn.Sequential(nn.Linear(16, 34), nn.GELU(), nn.Linear(34, 4))
+        o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+        return m, o
+
+    x, y = _batch()
+    m0, o0 = build()
+    ref_step = TrainStep(m0, _loss_fn, o0)
+    ref = [float(ref_step(x, labels=y)) for _ in range(3)]
+
+    m1, o1 = build()
+    m1, o1, _ = group_sharded_parallel(m1, o1, "p_g_os")
+    step = TrainStep(m1, _loss_fn, o1, mesh=_mesh(), batch_spec=P("dp"))
+    got = [float(step(x, labels=y)) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
