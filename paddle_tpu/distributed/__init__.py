@@ -7,7 +7,10 @@ semantics.
 from . import fleet
 from . import launch
 from . import sharding_utils
-from .communication import (Group, ReduceOp, all_gather, all_reduce,
+from . import communication
+from .communication import stream
+from .communication import (Group, P2POp, ReduceOp, all_gather, all_reduce,
+                            batch_isend_irecv, gather,
                             all_to_all_single, alltoall, barrier, broadcast,
                             get_group, irecv, isend, new_group, ppermute,
                             recv, reduce, reduce_scatter, scatter, send)
@@ -19,7 +22,7 @@ from . import rpc
 from . import ps
 from . import auto_parallel
 from .auto_parallel.api import (shard_tensor, Shard, Replicate, Partial,
-                                ProcessMesh)
+                                ProcessMesh, reshard)
 
 
 class ParallelEnv:

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..tensor.tensor import Tensor, _run_op
+from ...tensor.tensor import Tensor, _run_op
 
 
 class ReduceOp:
@@ -152,7 +152,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
                                          tiled=True),
                 (t,), {})
         return t
-    from ..tensor import concat, split
+    from ...tensor import concat, split
     n = group.nranks if group is not None else 1
     stacked = concat(in_tensor_list, axis=0)
     out = alltoall(stacked, group=group)
@@ -218,9 +218,102 @@ def barrier(group=None):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = group.axis_name if group is not None else None
     if ax is not None and _axis_bound(ax) and tensor_list is not None:
-        from ..tensor import stack
+        from ...tensor import stack
         stacked = stack(tensor_list, axis=0)
         def f(s):
             return s[lax.axis_index(ax)]
         return _run_op("scatter", f, (stacked,), {})
     return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """ref: paddle.distributed.gather — collect tensors onto rank dst.
+
+    Single-controller SPMD note: under XLA there is no rank-private
+    result; this lowers to an all_gather and every rank observes the
+    gathered list (a superset of the reference's contract, same values
+    on dst). Outside a bound axis (trivial group) it fills the list with
+    the input."""
+    ax = group.axis_name if group is not None else None
+    n = group.nranks if group is not None else 1
+    if gather_list is None:
+        gather_list = []
+    if ax is not None and _axis_bound(ax):
+        g = _run_op("gather",
+                    lambda a: lax.all_gather(a, ax, axis=0), (tensor,), {})
+        for i in range(n):
+            gather_list.append(g[i])
+    else:
+        for _ in range(max(n, 1)):
+            gather_list.append(tensor)
+    return gather_list
+
+
+class P2POp:
+    """ref: paddle.distributed.P2POp — one half of a batched point-to-point
+    exchange. `op` is ``distributed.isend`` or ``distributed.irecv``; the
+    batch executes as one collective_permute (see batch_isend_irecv)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.isend "
+                             "or paddle.distributed.irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = int(peer)
+        self.group = group
+
+
+class _P2PTask:
+    """Completed-task handle (XLA ordering makes the op synchronous with
+    respect to its consumers)."""
+
+    def wait(self):
+        return None
+
+    def is_completed(self):
+        return True
+
+
+def batch_isend_irecv(p2p_op_list):
+    """ref: paddle.distributed.batch_isend_irecv.
+
+    TPU-native mapping: raw p2p does not exist on a TPU mesh, but a batch
+    of paired isend/irecv IS a permutation of the group axis — exactly
+    ``lax.ppermute`` over ICI. Each isend(t, peer) contributes the
+    uniform shift (peer - rank) mod n; the matching irecv's tensor is
+    filled with the permuted value. Every rank must describe the same
+    global permutation (true for the reference's canonical pipeline /
+    ring uses); unpaired ops raise."""
+    from .. import env as _env
+    if not p2p_op_list:
+        return []
+    sends = [p for p in p2p_op_list if p.op is isend]
+    recvs = [p for p in p2p_op_list if p.op is irecv]
+    if len(sends) != len(recvs):
+        raise ValueError(
+            "batch_isend_irecv on a TPU mesh needs paired isend/irecv "
+            f"(got {len(sends)} sends, {len(recvs)} recvs): the batch must "
+            "form a permutation of the group axis")
+    tasks = []
+    for s in sends:
+        group = s.group or (recvs[0].group if recvs else None)
+        n = group.nranks if group is not None else 1
+        rank = group.rank if group is not None else _env.get_rank()
+        shift = (s.peer - rank) % max(n, 1)
+        # the matching receive comes from rank - shift
+        src = (rank - shift) % max(n, 1)
+        match = next((r for r in recvs if r.peer == src), None)
+        if match is None:
+            raise ValueError(
+                f"isend to peer {s.peer} (shift {shift}) has no matching "
+                f"irecv from {src}; the batch must form a permutation")
+        recvs.remove(match)
+        perm = [(i, (i + shift) % n) for i in range(max(n, 1))]
+        out = ppermute(s.tensor, perm, group=group)
+        match.tensor._data = out._data
+        tasks.append(_P2PTask())
+    return tasks
+
+
+from . import stream  # noqa: E402  (cyclic-safe: stream imports lazily)

@@ -1,0 +1,71 @@
+"""ref: python/paddle/distributed/communication/stream/ — the stream-
+variant collective namespace. The reference schedules these on a chosen
+CUDA stream (use_calc_stream); under XLA, op ordering and overlap are the
+compiler's job, so each function delegates to the plain collective and
+the stream arguments are accepted for API parity."""
+from __future__ import annotations
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    from . import ReduceOp, all_reduce as _impl
+    return _impl(tensor, op=op or ReduceOp.SUM, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_or_tensor_list, tensor=None, group=None, sync_op=True,
+               use_calc_stream=False):
+    from . import all_gather as _impl
+    return _impl(tensor_or_tensor_list, tensor, group=group, sync_op=sync_op)
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True,
+           use_calc_stream=False):
+    from . import ReduceOp, reduce as _impl
+    return _impl(tensor, dst=dst, op=op or ReduceOp.SUM, group=group,
+                 sync_op=sync_op)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list=None, op=None, group=None,
+                   sync_op=True, use_calc_stream=False):
+    from . import ReduceOp, reduce_scatter as _impl
+    return _impl(tensor, tensor_or_tensor_list, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    from . import broadcast as _impl
+    return _impl(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    from . import scatter as _impl
+    return _impl(tensor, tensor_or_tensor_list, src=src, group=group,
+                 sync_op=sync_op)
+
+
+def alltoall(out_tensor_or_list, in_tensor_or_list=None, group=None,
+             sync_op=True, use_calc_stream=False):
+    from . import alltoall as _impl
+    if in_tensor_or_list is None:
+        return _impl(out_tensor_or_list, group=group, sync_op=sync_op)
+    # reference contract: fill the caller's output container in place
+    return _impl(in_tensor_or_list, out_tensor_or_list, group=group,
+                 sync_op=sync_op)
+
+
+def alltoall_single(output, input, output_split_sizes=None,
+                    input_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    from . import all_to_all_single as _impl
+    return _impl(output, input, output_split_sizes, input_split_sizes,
+                 group=group, sync_op=sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    from . import send as _impl
+    return _impl(tensor, dst=dst, group=group, sync_op=sync_op)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    from . import recv as _impl
+    return _impl(tensor, src=src, group=group, sync_op=sync_op)

@@ -6,7 +6,7 @@ from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
                                LogSigmoid, LogSoftmax, Maxout, Mish, PReLU,
-                               ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax,
+                               ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax, Softmax2D,
                                Softplus, Softshrink, Softsign, Swish, Tanh,
                                Tanhshrink, ThresholdedReLU)
 from .layer.common import (AlphaDropout, FeatureAlphaDropout,

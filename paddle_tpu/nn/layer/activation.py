@@ -68,3 +68,18 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dimension of NCHW / CHW inputs
+    (ref: python/paddle/nn/layer/activation.py Softmax2D)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects a 3D (CHW) or 4D (NCHW) input, got "
+                f"{x.ndim}D")
+        return F.softmax(x, axis=-3)

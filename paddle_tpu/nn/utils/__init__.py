@@ -1,0 +1,212 @@
+"""paddle.nn.utils (ref: python/paddle/nn/utils/): hook-based weight_norm /
+spectral_norm reparameterizations, global-norm gradient clipping, and
+parameter <-> flat-vector converters.
+
+TPU-native notes: the reparameterizations recompute the effective weight
+from their auxiliary parameters with TAPED tensor ops in a forward
+pre-hook, so they compose with both the eager autograd tape and the
+functional/jit path (functional_call swaps parameter arrays in place; the
+hook then sees tracers and the recomputation is compiled into the step).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, _run_op
+from ..layer.layers import Layer, Parameter
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "clip_grad_norm_", "parameters_to_vector", "vector_to_parameters",
+]
+
+
+def _norm_except_dim(v, dim):
+    """L2 norm over every axis except `dim` (keepdims, broadcastable
+    against v). dim=None -> norm over everything (scalar shape)."""
+    data = v._data if isinstance(v, Tensor) else v
+    if dim is None:
+        axes = tuple(range(data.ndim))
+    else:
+        axes = tuple(i for i in range(data.ndim) if i != dim)
+
+    def f(a):
+        sq = jnp.sum(jnp.square(a.astype(jnp.float32)), axis=axes,
+                     keepdims=True)
+        return jnp.sqrt(sq).astype(a.dtype)
+
+    if isinstance(v, Tensor):
+        return _run_op("norm_except_dim", f, (v,), {})
+    return f(data)
+
+
+def _compute_weight(g, v, dim):
+    norm = _norm_except_dim(v, dim)
+    return v * (g / norm)
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as direction × magnitude
+    (w = g · v/‖v‖, ref: python/paddle/nn/utils/weight_norm_hook.py).
+
+    Registers ``<name>_g`` (magnitude) and ``<name>_v`` (direction) as the
+    trainable parameters; the effective weight is recomputed in a forward
+    pre-hook. dim=None norms over the whole tensor."""
+    if getattr(layer, "_weight_norm_hooks", None) and \
+            name in layer._weight_norm_hooks:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    g = Parameter(_norm_except_dim(w, dim)._data)
+    v = Parameter(w._data)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(lyr, inputs):
+        object.__setattr__(
+            lyr, name,
+            _compute_weight(lyr._parameters[name + "_g"],
+                            lyr._parameters[name + "_v"], dim))
+
+    hook(layer, None)
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        object.__setattr__(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (handle, dim)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight"):
+    """Undo weight_norm: bakes the current effective weight back into a
+    plain parameter and removes the hook."""
+    hooks = getattr(layer, "_weight_norm_hooks", None)
+    if not hooks or name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    handle, dim = hooks.pop(name)
+    handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    w = _compute_weight(g, v, dim)
+    for attr in (name + "_g", name + "_v"):
+        if attr in layer.__dict__:
+            object.__delattr__(layer, attr)
+    layer.add_parameter(name, Parameter(w._data))
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight",
+                  n_power_iterations: int = 1, eps: float = 1e-12,
+                  dim: int = None):
+    """Hook-based spectral normalization of ``layer.<name>``
+    (ref: python/paddle/nn/utils/spectral_norm_hook.py): the effective
+    weight is w_orig / σ(w_orig), with σ estimated by power iteration on
+    buffers u/v (gradients do not flow through u/v, matching the
+    reference)."""
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is None:
+        # the reference uses dim=1 for Linear (weight stored [in, out]),
+        # 0 otherwise
+        dim = 1 if type(layer).__name__ in ("Linear",) else 0
+    shape = tuple(w._data.shape)
+    h = shape[dim]
+    wsz = 1
+    for i, s in enumerate(shape):
+        if i != dim:
+            wsz *= int(s)
+    orig = Parameter(w._data)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+    layer.register_buffer(
+        name + "_u",
+        Tensor(jax.random.normal(jax.random.PRNGKey(0), (h,), jnp.float32)),
+        persistable=True)
+    layer.register_buffer(
+        name + "_v",
+        Tensor(jax.random.normal(jax.random.PRNGKey(1), (wsz,), jnp.float32)),
+        persistable=True)
+
+    def hook(lyr, inputs):
+        wt = lyr._parameters[name + "_orig"]
+        u = lyr._buffers[name + "_u"]._data
+        v = lyr._buffers[name + "_v"]._data
+        wmat = jnp.moveaxis(wt._data, dim, 0).reshape(h, -1) \
+            .astype(jnp.float32)
+        for _ in range(n_power_iterations):
+            v = wmat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wmat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        lyr._buffers[name + "_u"]._data = u
+        lyr._buffers[name + "_v"]._data = v
+
+        def f(a):
+            wm = jnp.moveaxis(a, dim, 0).reshape(h, -1).astype(jnp.float32)
+            sigma = u @ wm @ v
+            return (a / sigma).astype(a.dtype)
+
+        object.__setattr__(lyr, name, _run_op("spectral_norm", f, (wt,), {}))
+
+    hook(layer, None)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Clip gradients IN PLACE so their global norm is at most max_norm
+    (ref: python/paddle/nn/utils/clip_grad_norm_.py). Returns the
+    pre-clip total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters
+             if p is not None and p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    max_norm = float(max_norm)
+    norm_type = float(norm_type)
+    gdatas = [g._data.astype(jnp.float32) for g in grads]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in gdatas]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in gdatas]))
+        total = total ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of order {norm_type} for gradients is "
+            "non-finite, so it cannot be clipped")
+    clip_coef = max_norm / (total + 1e-6)
+    clip_coef = jnp.minimum(clip_coef, 1.0)
+    for p in parameters:
+        if p is not None and p.grad is not None:
+            p.grad._data = (p.grad._data.astype(jnp.float32)
+                            * clip_coef).astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten and concatenate parameters into one 1-D tensor
+    (ref: python/paddle/nn/utils/transform_parameters.py)."""
+    parts = [jnp.ravel(p._data) for p in parameters]
+    return Tensor(jnp.concatenate(parts))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Slice a flat vector back into the given parameters, in place."""
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = int(p._data.size)
+        chunk = data[offset:offset + n].reshape(p._data.shape) \
+            .astype(p._data.dtype)
+        p._data = chunk
+        offset += n
+    if offset != int(data.size):
+        raise ValueError(
+            f"vector has {int(data.size)} elements but parameters take "
+            f"{offset}")

@@ -30,6 +30,15 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
+# Scores are computed in the LOG2 domain: the callers fold scale·log2(e)
+# into q, so the kernels' softmax uses exp2 directly. The VPU's exp is
+# exp2 plus a multiply pass — folding the multiply into the [S, D] q
+# prep deletes one full [BQ, BK] VPU pass per score tile. lse crosses
+# the kernel boundary in the NATURAL-log domain (ring attention merges
+# partial softmaxes with it).
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
+
 
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
@@ -92,9 +101,13 @@ def _tri_mask_const(block_q, block_k):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
-                scale, seq_k, kv_len, use_tri=False):
+                seq_k, kv_len, use_tri=False):
     """seq_k is the PADDED key length (multiple of block_k); kv_len the true
     one — key positions >= kv_len are masked out so padding never attends.
+
+    The softmax scale is FOLDED INTO Q by the caller (q arrives pre-scaled):
+    the per-tile `s * scale` was a full [BQ, BK] f32 VPU pass per tile, a
+    measurable share of a kernel that is softmax-(VPU-)bound.
 
     The KV loop is split into an unmasked region (blocks fully below the
     causal diagonal and clear of padding) and a masked tail: the mask iota/
@@ -131,7 +144,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * bk_i, block_k), :]
         v = v_ref[0, pl.ds(j * bk_i, block_k), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if masked:
             if use_tri:
                 s = s + tri_ref[...]
@@ -139,8 +152,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
                 s = _mask_scores(s, qi * bq_i, j * bk_i, causal,
                                  col_limit=kv_len if mask_kv else None)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
+        alpha = jnp.exp2(m - m_new)
+        p = jnp.exp2(s - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.dot(p.astype(v.dtype), v,
                                         preferred_element_type=jnp.float32)
@@ -166,7 +179,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
                                   (m, l, acc))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # 2-D store ([1, BQ]); Mosaic fails to legalize 1-D vector stores.
-    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
+    lse_ref[0] = ((m + jnp.log2(jnp.maximum(l, 1e-30))) * _LN2).T
 
 
 # whole-KV-in-VMEM ceiling: above this the forward streams KV tiles through
@@ -176,8 +189,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k, causal,
 STREAM_KV_BYTES = 3 * 2 ** 20
 
 
-def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
-                       *, block_k, causal, scale, kv_len, seq_k, n_k):
+def _fwd_kernel_stream(q_ref, k_ref, v_ref, *rest, block_k, causal, kv_len,
+                       seq_k, n_k, use_tri=False):
     """Streaming variant: grid (BH, n_q, n_k); one KV tile per step, online
     stats in VMEM scratch persisted across the innermost (sequential) k
     steps. Removes the whole-KV VMEM residency ceiling (S beyond ~12k at
@@ -188,9 +201,14 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
     - seq_k is the PADDED key length, a Python int: when kv_len == seq_k
       (no padding) the tail compare is elided at trace time, and a
       non-causal unpadded call runs with no mask work at all.
-    - the causal mask is applied unconditionally on needed tiles: a
-      lax.cond boundary/interior split measured 0.34 eff vs 0.55 for the
-      plain where() — Mosaic branches defeat the pipeline.
+    - use_tri (equal blocks, no kv padding): the only tiles the causal
+      mask BITES are the ki == qi diagonal tiles, so the iota+compare+
+      select (multiple VPU passes on EVERY live tile of a VPU-bound
+      kernel) collapses to one fused multiply-add of a precomputed
+      additive tri tile by a per-step scalar flag. An earlier lax.cond
+      boundary/interior split measured 0.34 eff vs 0.55 for the plain
+      where() — Mosaic branches defeat the pipeline; the scalar-flag
+      multiply keeps the body branch-free.
     - fully-above-diagonal causal tiles are never DMA'd: the caller clamps
       the k/v BlockSpec index to the last needed tile, so Mosaic sees an
       unchanged block index and skips the copy (see _kv_clamp_map;
@@ -198,6 +216,10 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
     - finalize at a dynamic last-needed index measured slightly SLOWER
       than writing at n_k - 1; keep the static finalize."""
     import numpy as np
+    if use_tri:
+        tri_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
+    else:
+        (o_ref, lse_ref, m_s, l_s, acc_s), tri_ref = rest, None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -221,14 +243,19 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        s = _mask_scores(s, qi * bq_i, start, causal,
-                         col_limit=kv_len if mask_kv else None)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if use_tri:
+            # equal blocks: diagonal tile iff ki == qi (bq == bk)
+            diag = (ki == qi).astype(jnp.float32)
+            s = s + tri_ref[...] * diag
+        else:
+            s = _mask_scores(s, qi * bq_i, start, causal,
+                             col_limit=kv_len if mask_kv else None)
         m = m_s[:, :1]
         l = l_s[:, :1]
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
+        alpha = jnp.exp2(m - m_new)
+        p = jnp.exp2(s - m_new)
         l_new = l * alpha + p.sum(axis=-1, keepdims=True)
         acc_s[...] = acc_s[...] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32)
@@ -240,7 +267,7 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s,
         m = m_s[:, :1]
         l = l_s[:, :1]
         o_ref[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
+        lse_ref[0] = ((m + jnp.log2(jnp.maximum(l, 1e-30))) * _LN2).T
 
 
 def _kv_clamp_map(block_q, block_k, causal):
@@ -277,24 +304,31 @@ def _q_clamp_map(block_q, block_k, causal, stat=False):
     return _map
 
 
-def _flash_fwd_stream(qp, kp, vp, causal, scale, block_q, block_k, sk,
+def _flash_fwd_stream(qp, kp, vp, causal, block_q, block_k, sk,
                       out_dtype):
     bh, sp, d = qp.shape
     skp = kp.shape[1]
     n_k = skp // block_k
+    use_tri = causal and sk == skp and block_q == block_k
     kernel = functools.partial(_fwd_kernel_stream, block_k=block_k,
-                               causal=causal, scale=scale, kv_len=sk,
-                               seq_k=skp, n_k=n_k)
+                               causal=causal, kv_len=sk,
+                               seq_k=skp, n_k=n_k, use_tri=use_tri)
     kv_map = _kv_clamp_map(block_q, block_k, causal)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), kv_map),
+        pl.BlockSpec((1, block_k, d), kv_map),
+    ]
+    args = [qp, kp, vp]
+    if use_tri:
+        in_specs.append(pl.BlockSpec((block_q, block_k),
+                                     lambda b, i, j: (0, 0)))
+        args.append(_tri_mask_const(block_q, block_k))
     with _mosaic_ctx():
         return pl.pallas_call(
             kernel,
             grid=(bh, sp // block_q, n_k),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_k, d), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -309,7 +343,7 @@ def _flash_fwd_stream(qp, kp, vp, causal, scale, block_q, block_k, sk,
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
             interpret=_interpret(),
-        )(qp, kp, vp)
+        )(*args)
 
 
 def _small_d_blocks(d, block_q, block_k):
@@ -333,18 +367,24 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     block_q, block_k = _small_d_blocks(d, block_q, block_k)
     block_q = _fit_block(block_q, s)
     block_k = _fit_block(block_k, sk)
+    # fold the softmax scale AND the exp->exp2 conversion into q once
+    # ([S, D] elementwise) instead of per score tile ([BQ, BK] x n_tiles);
+    # scale=None marks q as ALREADY pre-scaled (the custom-vjp path saves
+    # q̃ in its residuals so the backward reuses it)
+    if scale is not None:
+        q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     qp, _ = _pad_rows(q, block_q)
     kp, _ = _pad_rows(k, block_k)
     vp, _ = _pad_rows(v, block_k)
     sp, skp = qp.shape[1], kp.shape[1]
     if 2 * skp * d * k.dtype.itemsize > STREAM_KV_BYTES:
-        o, lse = _flash_fwd_stream(qp, kp, vp, causal, scale, block_q,
+        o, lse = _flash_fwd_stream(qp, kp, vp, causal, block_q,
                                    block_k, sk, q.dtype)
         return o[:, :s], lse.reshape(bh, sp)[:, :s]
     grid = (bh, sp // block_q)
     use_tri = causal and sk == skp and block_q == block_k
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale, seq_k=skp, kv_len=sk,
+                               seq_k=skp, kv_len=sk,
                                use_tri=use_tri)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -374,9 +414,13 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    *rest, block_q, causal, scale, seq_q, q_len,
+                    *rest, block_q, causal, seq_q, q_len,
                     use_tri=False):
     """dK/dV: grid (bh, k_blocks); inner loop over q tiles >= the diagonal.
+
+    q arrives PRE-SCALED (q̃ = scale·q, folded by the caller): with
+    ds̃ = P∘(dP−δ) (no scale), dK = scale·ds̃ᵀ·q = ds̃ᵀ·q̃ exactly — both
+    per-tile scale multiplies vanish. dV = PᵀdO is scale-free anyway.
 
     seq_q is the padded query length (block_q multiple); q rows >= q_len are
     zero padding and get masked so exp(0 - lse_pad) can't contribute.
@@ -404,18 +448,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dob = do_ref[0, pl.ds(i * bq_i, block_q), :]
         lseb = lse_ref[0, 0, pl.ds(i * bq_i, block_q)]    # [BQ] f32
         deltab = delta_ref[0, 0, pl.ds(i * bq_i, block_q)]
-        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32)
         if masked:
             if use_tri:
                 s = s + tri_ref[...]
             else:
                 s = _mask_scores(s, i * bq_i, ki * bk_i, causal,
                                  row_limit=q_len if mask_q else None)
-        p = jnp.exp(s - lseb[:, None])                    # [BQ, BK] f32
+        p = jnp.exp2(s - lseb[:, None])                    # [BQ, BK] f32
         p_lo = p.astype(v.dtype)
         dv = dv + jnp.dot(p_lo.T, dob, preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - deltab[:, None]) * scale).astype(v.dtype)
+        ds = (p * (dp - deltab[:, None])).astype(v.dtype)
         dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -440,7 +484,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         acc_dk, acc_dv = lax.fori_loop(start, nq,
                                        functools.partial(body, masked=False),
                                        (acc_dk, acc_dv))
-    dk_ref[0] = acc_dk.astype(dk_ref.dtype)
+    # q̃ carries an extra log2e (log2-domain scores); undo it on dK only
+    dk_ref[0] = (acc_dk * _LN2).astype(dk_ref.dtype)
     dv_ref[0] = acc_dv.astype(dv_ref.dtype)
 
 
@@ -448,6 +493,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    *rest, block_k, causal, scale, seq_k, kv_len,
                    use_tri=False):
     """dQ: grid (bh, q_blocks); inner loop over k tiles <= the diagonal.
+    q arrives pre-scaled (see _bwd_dkv_kernel): dQ = scale·(ds̃·K), with
+    the single scale multiply applied to the [BQ, D] accumulator at
+    finalize instead of per [BQ, BK] score tile.
     seq_k is padded; key positions >= kv_len are masked out.
     use_tri: see _tri_mask_const."""
     import numpy as np
@@ -473,16 +521,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(j, acc, *, masked):
         kb = k_ref[0, pl.ds(j * bk_i, block_k), :]
         vb = v_ref[0, pl.ds(j * bk_i, block_k), :]
-        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
         if masked:
             if use_tri:
                 s = s + tri_ref[...]
             else:
                 s = _mask_scores(s, qi * bq_i, j * bk_i, causal,
                                  col_limit=kv_len if mask_kv else None)
-        p = jnp.exp(s - lseb[:, None])
+        p = jnp.exp2(s - lseb[:, None])
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
+        ds = (p * (dp - deltab[:, None])).astype(kb.dtype)
         return acc + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
     if causal or mask_kv:
@@ -499,10 +547,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         acc = lax.fori_loop(np.int32(0), nblocks,
                             functools.partial(body, masked=False), acc)
-    dq_ref[0] = acc.astype(dq_ref.dtype)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
 
-def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
+def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                      q_prescaled=False):
     """Pallas FA2 backward: tiles stay in VMEM (the jnp formulation streamed
     [S, BK] intermediates through HBM — bandwidth-bound).
 
@@ -531,202 +580,258 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k):
 
     dq, dk, dv = _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal,
                                    scale, block_q, block_k, q_len=s,
-                                   kv_len=sk)
+                                   kv_len=sk, q_prescaled=q_prescaled)
     return dq[:, :s], dk[:, :sk], dv[:, :sk]
 
 
-def _bwd_dkv_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, dk_s, dv_s, *, block_q, causal,
-                           scale, q_len, seq_q, n_q):
-    """Streaming dK/dV: grid (bh, n_k, n_q); one q/do tile per step, dk/dv
-    accumulate in VMEM scratch (removes the full-q/do residency ceiling).
-    seq_q is the padded (static) query length: the q-padding compare is
-    elided at trace time when q_len == seq_q; the causal mask is applied
-    unconditionally on needed tiles (lax.cond splits measured ~40% slower
-    — see _fwd_kernel_stream)."""
+
+
+def _bwd_fused_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                             dqp_ref, dk_ref, dv_ref, dk_s, dv_s, dq_s, *,
+                             block_q, block_k, causal, q_len, seq_q,
+                             n_q, n_sub, col_tile0=0):
+    """Fused streaming backward: ONE pass per (k-tile, q-tile) computes all
+    five FA2 matmuls (S=QKᵀ, dP=dO·Vᵀ, dV=PᵀdO, dQ+=dS·K, dK+=dSᵀQ).
+
+    The previous split (dK/dV kernel + dQ kernel) recomputed S and dP in
+    both kernels — 7 matmuls per tile pair, capping backward efficiency
+    at 5/7 of forward (measured r3: bwd 0.42-0.43 vs fwd 0.60-0.64).
+
+    Grid (bh, n_kdma, n_q, n_sub): the k/v DMA block (bkdma = n_sub
+    compute tiles) amortizes one fetch over the whole inner sweep, while
+    each compute sub-tile is its own grid step so causal liveness gates
+    at COMPUTE granularity (an unrolled in-kernel sub loop wasted a full
+    dead sub-tile on every diagonal DMA block, ~5% at S=32k, and its n_sub
+    live intermediates blew VMEM past bkdma=2048).
+
+    dK/dV accumulate in VMEM scratch (slot = sub index) across the inner
+    (q, sub) sweep. dQ accumulates over the OUTER kv dim, which scratch
+    cannot span — each (kv-block, q-tile) window accumulates sub
+    contributions in f32 scratch and flushes once, at the last LIVE sub,
+    to a per-kv-block partial (grid-indexed output, the splash-attention
+    pattern); the caller reduces partials with a liveness-masked sum (dead
+    (j, i) slots are never written — their q-side index maps clamp to the
+    first live tile, so they cost neither DMA nor flush)."""
     import numpy as np
     ki = pl.program_id(1)
     qi = pl.program_id(2)
-    bk = k_ref.shape[1]
-    bq_i, bk_i = np.int32(block_q), np.int32(bk)
+    si = pl.program_id(3)
+    bq_i, bk_i = np.int32(block_q), np.int32(block_k)
+    ns_i = np.int32(n_sub)
+    # ABSOLUTE compute-tile column index (col_tile0 = this kv chunk's
+    # offset when the caller chunks long sequences)
+    ct = np.int32(col_tile0) + ki * ns_i + si
     mask_q = q_len != seq_q
 
-    @pl.when(qi == 0)
+    @pl.when(jnp.logical_and(qi == 0, si == 0))
     def _init():
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
 
-    needed = (qi + 1) * bq_i > ki * bk_i if causal else qi == qi
+    if causal:
+        needed = (qi + 1) * bq_i > ct * bk_i
+        # last live sub of this (kv-block, q-tile) window: flush dq there
+        si_last = jnp.clip(
+            ((qi + 1) * bq_i - 1) // bk_i - np.int32(col_tile0)
+            - ki * ns_i, np.int32(0), ns_i - 1)
+    else:
+        needed = si == si
+        si_last = ns_i - 1
 
     @pl.when(needed)
     def _compute():
-        k = k_ref[0]
-        v = v_ref[0]
         qb = q_ref[0]
         dob = do_ref[0]
         lseb = lse_ref[0, 0, :]
         deltab = delta_ref[0, 0, :]
-        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * scale
-        s = _mask_scores(s, qi * bq_i, ki * bk_i, causal,
+        k = k_ref[0, pl.ds(si * bk_i, block_k), :]
+        v = v_ref[0, pl.ds(si * bk_i, block_k), :]
+        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32)
+        # iota mask, not a precomputed tri tile: the bwd kernel is
+        # MXU-bound (VPU has slack) and the 4MB tri constant pushed the
+        # bkdma=4096 configuration over the 16M scoped-VMEM limit
+        s = _mask_scores(s, qi * bq_i, ct * bk_i, causal,
                          row_limit=q_len if mask_q else None)
-        p = jnp.exp(s - lseb[:, None])
+        p = jnp.exp2(s - lseb[:, None])
         p_lo = p.astype(v.dtype)
-        dv_s[...] = dv_s[...] + jnp.dot(p_lo.T, dob,
-                                        preferred_element_type=jnp.float32)
+        sl = pl.ds(si * bk_i, block_k)
+        dv_s[sl, :] = dv_s[sl, :] + jnp.dot(
+            p_lo.T, dob, preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - deltab[:, None]) * scale).astype(v.dtype)
-        dk_s[...] = dk_s[...] + jnp.dot(ds.T, qb,
-                                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None])).astype(v.dtype)
+        dk_s[sl, :] = dk_s[sl, :] + jnp.dot(
+            ds.T, qb, preferred_element_type=jnp.float32)
+        contrib = jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        acc = jnp.where(si == 0, contrib, dq_s[...] + contrib)
+        dq_s[...] = acc
 
-    @pl.when(qi == np.int32(n_q - 1))
+        @pl.when(si == si_last)
+        def _flush_dq():
+            dqp_ref[0, 0] = acc.astype(dqp_ref.dtype)
+
+    @pl.when(jnp.logical_and(qi == np.int32(n_q - 1), si == ns_i - 1))
     def _finalize():
-        dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
+        # q̃ carries an extra log2e (log2-domain scores); undo it on dK
+        dk_ref[0] = (dk_s[...] * _LN2).astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel_stream(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dq_ref, dq_s, *, block_k, causal, scale, kv_len,
-                          seq_k, n_k):
-    """Streaming dQ: grid (bh, n_q, n_k); one k/v tile per step, dq
-    accumulates in VMEM scratch (removes the full-KV residency ceiling).
-    seq_k is the padded (static) key length — kv-tail compare elided at
-    trace time when there is no padding (see _fwd_kernel_stream)."""
-    import numpy as np
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    bq = q_ref.shape[1]
-    bq_i, bk_i = np.int32(bq), np.int32(block_k)
-    mask_kv = kv_len != seq_k
-
-    @pl.when(ki == 0)
-    def _init():
-        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
-
-    start = ki * bk_i
-    needed = start < np.int32(kv_len)
-    if causal:
-        last_q = (qi + np.int32(1)) * bq_i - np.int32(1)
-        needed = jnp.logical_and(needed, start <= last_q)
-
-    @pl.when(needed)
-    def _compute():
-        qb = q_ref[0]
-        dob = do_ref[0]
-        kb = k_ref[0]
-        vb = v_ref[0]
-        lseb = lse_ref[0, 0, :]
-        deltab = delta_ref[0, 0, :]
-        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
-        s = _mask_scores(s, qi * bq_i, start, causal,
-                         col_limit=kv_len if mask_kv else None)
-        p = jnp.exp(s - lseb[:, None])
-        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
-        dq_s[...] = dq_s[...] + jnp.dot(ds, kb,
-                                        preferred_element_type=jnp.float32)
-
-    @pl.when(ki == np.int32(n_k - 1))
-    def _finalize():
-        dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+# k/v DMA block of the fused backward = this multiple of the compute tile
+# (bounded by VMEM: dk/dv scratch 2·bkdma·D f32 + double-buffered k/v
+# DMA windows; one sub-tile of matmul intermediates regardless of mult)
+_BWD_KV_DMA_MULT = 8
 
 
-def _bwd_dkv_stream_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
-                         block_q, block_k, q_len):
-    """Streaming dK/dV pallas_call: grid (bh, n_k, n_q), q/do tiles stream
-    through the innermost axis, dk/dv accumulate in VMEM scratch. Under
-    causal, q tiles fully above the diagonal are skipped AND their DMA is
-    elided by clamping the q-side block index (mirror of _kv_clamp_map with
-    max: the first needed q tile for k tile j is (j*block_k)//block_q)."""
+# upper bound on dq-partial copies per pallas_call: the partial buffer is
+# n_k x full-dq, which would grow quadratically with S — beyond this many
+# kv DMA blocks the kv dimension is chunked at the XLA level instead
+# (fixed partial footprint per chunk, dq accumulated across chunks)
+_BWD_MAX_DQ_PARTIALS = 16
+
+
+def _bwd_fused_stream_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
+                           block_q, block_k, q_len):
+    """Fused backward: dq reduced from per-kv-DMA-block partials by a
+    liveness-masked XLA sum, kv dimension chunked so the partial buffer
+    stays bounded (<= _BWD_MAX_DQ_PARTIALS full-dq copies per chunk
+    regardless of S)."""
+    bh, sp, d = qp.shape
+    skp = kp.shape[1]
+    bkdma = block_k * _BWD_KV_DMA_MULT
+    while skp % bkdma:
+        bkdma -= block_k
+    rows_per_chunk = _BWD_MAX_DQ_PARTIALS * bkdma
+    if skp <= rows_per_chunk:
+        dq32, dk, dv = _bwd_fused_stream_chunk(
+            qp, kp, vp, dop, lse3, delta3, causal, block_q, block_k,
+            q_len, bkdma, col_tile0=0)
+        return (dq32 * scale).astype(qp.dtype), dk, dv
+    dq32 = None
+    dks, dvs = [], []
+    for c0 in range(0, skp, rows_per_chunk):
+        kc = kp[:, c0:c0 + rows_per_chunk]
+        vc = vp[:, c0:c0 + rows_per_chunk]
+        dqc, dkc, dvc = _bwd_fused_stream_chunk(
+            qp, kc, vc, dop, lse3, delta3, causal, block_q, block_k,
+            q_len, bkdma, col_tile0=c0 // block_k)
+        dq32 = dqc if dq32 is None else dq32 + dqc
+        dks.append(dkc)
+        dvs.append(dvc)
+    return ((dq32 * scale).astype(qp.dtype),
+            jnp.concatenate(dks, axis=1), jnp.concatenate(dvs, axis=1))
+
+
+def _bwd_fused_stream_chunk(qp, kp, vp, dop, lse3, delta3, causal,
+                            block_q, block_k, q_len, bkdma, col_tile0):
+    """One fused-backward pallas_call over a kv slice starting at absolute
+    column tile `col_tile0`: grid (bh, n_kdma, n_q, n_sub); returns
+    (dq_chunk f32 unscaled, dk_chunk, dv_chunk)."""
     bh, sp, d = qp.shape
     skp = kp.shape[1]
     n_q = sp // block_q
-    q_map = _q_clamp_map(block_q, block_k, causal)
-    stat_map = _q_clamp_map(block_q, block_k, causal, stat=True)
-    kernel = functools.partial(_bwd_dkv_kernel_stream, block_q=block_q,
-                               causal=causal, scale=scale, q_len=q_len,
-                               seq_q=sp, n_q=n_q)
+    n_k = skp // bkdma
+    n_sub = bkdma // block_k
+    kernel = functools.partial(_bwd_fused_kernel_stream, block_q=block_q,
+                               block_k=block_k, causal=causal,
+                               q_len=q_len, seq_q=sp, n_q=n_q,
+                               n_sub=n_sub, col_tile0=col_tile0)
+    col0_rows = col_tile0 * block_k
+
+    if causal:
+        def _iclamp(j, i):
+            return jnp.maximum(i, (col0_rows + j * bkdma) // block_q)
+    else:
+        def _iclamp(j, i):
+            return i
+    q_map = lambda b, j, i, s_: (b, _iclamp(j, i), 0)
+    stat_map = lambda b, j, i, s_: (b, 0, _iclamp(j, i))
+    dqp_map = lambda b, j, i, s_: (j, b, _iclamp(j, i), 0)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_map),                   # q
+        pl.BlockSpec((1, bkdma, d), lambda b, j, i, s_: (b, j, 0)),
+        pl.BlockSpec((1, bkdma, d), lambda b, j, i, s_: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), q_map),                   # do
+        pl.BlockSpec((1, 1, block_q), stat_map),                # lse
+        pl.BlockSpec((1, 1, block_q), stat_map),                # delta
+    ]
+    args = [qp, kp, vp, dop, lse3, delta3]
     with _mosaic_ctx():
-        return pl.pallas_call(
+        dqp, dk, dv = pl.pallas_call(
             kernel,
-            grid=(bh, skp // block_k, n_q),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), q_map),                   # q
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_q, d), q_map),                   # do
-                pl.BlockSpec((1, 1, block_q), stat_map),                # lse
-                pl.BlockSpec((1, 1, block_q), stat_map),                # delta
-            ],
+            grid=(bh, n_k, n_q, n_sub),
+            in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, 1, block_q, d), dqp_map),
+                pl.BlockSpec((1, bkdma, d), lambda b, j, i, s_: (b, j, 0)),
+                pl.BlockSpec((1, bkdma, d), lambda b, j, i, s_: (b, j, 0)),
             ],
             out_shape=[
+                jax.ShapeDtypeStruct((n_k, bh, sp, d), qp.dtype),
                 jax.ShapeDtypeStruct(kp.shape, kp.dtype),
                 jax.ShapeDtypeStruct(vp.shape, vp.dtype),
             ],
             scratch_shapes=[
-                pltpu.VMEM((block_k, d), jnp.float32),
-                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((bkdma, d), jnp.float32),
+                pltpu.VMEM((bkdma, d), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
             ],
+            # the 16M scoped-VMEM default is a compiler guardrail, not the
+            # hardware (v5e has 128M): bkdma=4096 needs ~19M of windows +
+            # scratch and halves the dq-partial traffic vs bkdma=2048
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=48 * 1024 * 1024),
             interpret=_interpret(),
-        )(qp, kp, vp, dop, lse3, delta3)
+        )(*args)
+    # Σ_j ds̃·K (scale applied by the caller after cross-chunk
+    # accumulation; q was pre-scaled — see _bwd_dkv_kernel docstring).
+    # Under causal clamping the dead (j, i) partial slots were never
+    # written (garbage): mask them out of the sum — the iota/compare
+    # fuses into the reduce.
+    if causal:
+        row_tile = lax.broadcasted_iota(jnp.int32, (n_k, 1, sp, 1), 2) \
+            // block_q
+        imin = ((col0_rows + jnp.arange(n_k, dtype=jnp.int32) * bkdma)
+                // block_q).reshape(n_k, 1, 1, 1)
+        dqp = jnp.where(row_tile >= imin, dqp.astype(jnp.float32), 0.0)
+        dq = jnp.sum(dqp, axis=0)
+    else:
+        dq = jnp.sum(dqp, axis=0, dtype=jnp.float32)
+    return dq, dk, dv
 
 
-def _bwd_dq_stream_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
-                        block_q, block_k, kv_len):
-    """Streaming dQ pallas_call: grid (bh, n_q, n_k), k/v tiles stream
-    through the innermost axis, dq accumulates in VMEM scratch; causal
-    above-diagonal k tiles skip DMA via the clamped index map."""
-    bh, sp, d = qp.shape
-    skp = kp.shape[1]
-    n_k = skp // block_k
-    kv_map = _kv_clamp_map(block_q, block_k, causal)
-    kernel = functools.partial(_bwd_dq_kernel_stream, block_k=block_k,
-                               causal=causal, scale=scale, kv_len=kv_len,
-                               seq_k=skp, n_k=n_k)
-    with _mosaic_ctx():
-        return pl.pallas_call(
-            kernel,
-            grid=(bh, sp // block_q, n_k),
-            in_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_k, d), kv_map),
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
-            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-            interpret=_interpret(),
-        )(qp, kp, vp, dop, lse3, delta3)
 
 
 def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
-                      block_k, q_len, kv_len):
-    """The two backward pallas_calls on already-padded [BH, Sp, D] operands.
+                      block_k, q_len, kv_len, q_prescaled=False):
+    """Backward pallas_calls on already-padded [BH, Sp, D] operands.
     lse3/delta3: [BH, 1, Sp] f32. Returns padded (dq, dk, dv).
 
-    Each kernel picks resident or streaming PER SIDE, by the same VMEM
-    budget as the forward: the dkv kernel stages q+do residently (stream
-    when > STREAM_KV_BYTES), the dq kernel stages k+v. Mixed lengths
-    (e.g. short q, long KV) stream only the over-budget side — a side
-    that streamed is never recomputed residently."""
+    The softmax scale is folded into q here (see _bwd_dkv_kernel): the
+    kernels see q̃ = scale·q and compute dK = ds̃ᵀq̃ exactly; dQ applies
+    the single deferred scale to its accumulator.
+
+    Over the VMEM residency budget on either side, the fused one-pass
+    streaming kernel handles everything; under it, two resident kernels
+    (dK/dV over k tiles, dQ over q tiles) keep the whole opposing side
+    in VMEM."""
     bh, sp, d = qp.shape
     skp = kp.shape[1]
     item = kp.dtype.itemsize
-    if 2 * sp * d * item > STREAM_KV_BYTES:
-        dk, dv = _bwd_dkv_stream_call(qp, kp, vp, dop, lse3, delta3, causal,
-                                      scale, block_q, block_k, q_len)
-    else:
-        dk = dv = None
-    if 2 * skp * d * item > STREAM_KV_BYTES:
-        dq = _bwd_dq_stream_call(qp, kp, vp, dop, lse3, delta3, causal,
-                                 scale, block_q, block_k, kv_len)
-    else:
-        dq = None
+    # log2-domain scores (see module constants): q̃ = scale·log2e·q, lse
+    # converted to the log2 domain; the kernels' dK therefore comes out
+    # log2e too large and is corrected by ·ln2 at finalize
+    if not q_prescaled:
+        qp = (qp.astype(jnp.float32) * (scale * _LOG2E)).astype(qp.dtype)
+    lse3 = lse3 * _LOG2E
+    if (2 * sp * d * item > STREAM_KV_BYTES
+            or 2 * skp * d * item > STREAM_KV_BYTES):
+        # the fused kernel streams both sides and does 5 matmuls per tile
+        # pair (the old split kernels did 7 — see _bwd_fused_kernel_stream)
+        return _bwd_fused_stream_call(qp, kp, vp, dop, lse3, delta3,
+                                      causal, scale, block_q, block_k,
+                                      q_len)
+    dk = dv = None
+    dq = None
     use_tri = causal and block_q == block_k
     tri = _tri_mask_const(block_q, block_k) if use_tri else None
     with _mosaic_ctx():
@@ -748,7 +853,7 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                 args.append(tri)
             dk, dv = pl.pallas_call(
                 functools.partial(_bwd_dkv_kernel, block_q=block_q,
-                                  causal=causal, scale=scale, seq_q=sp,
+                                  causal=causal, seq_q=sp,
                                   q_len=q_len, use_tri=tri_kv),
                 grid=kv_grid,
                 in_specs=in_specs,
@@ -800,14 +905,17 @@ def _flash_attention(q, k, v, causal, scale, block_q, block_k):
 
 
 def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+    # pre-scale once and save q̃ in the residuals: the backward's own
+    # q-prep (another [BH, S, D] multiply + HBM round trip) is skipped
+    qs = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
+    o, lse = _flash_fwd(qs, k, v, causal, None, block_q, block_k)
+    return o, (qs, k, v, o, lse)
 
 
 def _flash_attention_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q,
-                             block_k)
+    qs, k, v, o, lse = res
+    return _flash_bwd_pallas(qs, k, v, o, lse, do, causal, scale, block_q,
+                             block_k, q_prescaled=True)
 
 
 _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)

@@ -144,8 +144,9 @@ def _bwd_dkv_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
                            lse_ref, delta_ref, cq_ref, ck_ref, dk_ref,
                            dv_ref, dk_s, dv_s, *, block_q, causal, scale,
                            n_q, self_attn):
-    """Streaming dK/dV: grid (H, n_k, n_q); mirrors
-    flash_attention._bwd_dkv_kernel_stream with the code mask. lo/hi are
+    """Streaming dK/dV: grid (H, n_k, n_q); same split-kernel FA2
+    shape the dense backward used before its fused rewrite (see
+    flash_attention._bwd_fused_kernel_stream), with the code mask. lo/hi are
     the live Q-tile bounds per k tile (causal start folded in by the
     caller). Padding q rows need no mask: their do (and hence delta) are
     zero-padded, so their contributions to dk/dv vanish identically."""
@@ -190,8 +191,8 @@ def _bwd_dkv_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
 def _bwd_dq_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
                           lse_ref, delta_ref, cq_ref, ck_ref, dq_ref, dq_s,
                           *, block_k, causal, scale, n_k, self_attn):
-    """Streaming dQ: grid (H, n_q, n_k); mirrors
-    flash_attention._bwd_dq_kernel_stream with the code mask; lo/hi are
+    """Streaming dQ: grid (H, n_q, n_k); split-kernel FA2 dQ (cf.
+    flash_attention._bwd_fused_kernel_stream) with the code mask; lo/hi are
     the live k-tile bounds per q tile."""
     import numpy as np
     qi = pl.program_id(1)

@@ -192,6 +192,30 @@ def _exponential_(x, lam=1.0, name=None):
     return _inplace_rebind(x, d)
 
 
+def _geometric_(x, probs, name=None):
+    """ref: Tensor.geometric_ — geometric distribution (number of
+    Bernoulli(probs) trials up to and including the first success,
+    support {1, 2, ...}), via inverse-CDF of a uniform draw."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    p = getattr(probs, "_data", probs)
+    u = _jax.random.uniform(_fill_key(0), tuple(x._data.shape),
+                            dtype=_jnp.float32, minval=1e-7, maxval=1.0)
+    d = _jnp.maximum(_jnp.ceil(_jnp.log1p(-u) / _jnp.log1p(-p)), 1.0)
+    return _inplace_rebind(x, d.astype(x._data.dtype))
+
+
+def _cauchy_(x, loc=0.0, scale=1.0, name=None):
+    """ref: Tensor.cauchy_ — Cauchy(loc, scale) via inverse-CDF."""
+    import jax as _jax
+    import jax.numpy as _jnp
+    u = _jax.random.uniform(_fill_key(0), tuple(x._data.shape),
+                            dtype=_jnp.float32, minval=1e-7,
+                            maxval=1.0 - 1e-7)
+    d = loc + scale * _jnp.tan(_jnp.pi * (u - 0.5))
+    return _inplace_rebind(x, d.astype(x._data.dtype))
+
+
 Tensor.unsqueeze_ = _unsqueeze_
 Tensor.flatten_ = _flatten_
 Tensor.scatter_ = _scatter_
@@ -199,6 +223,8 @@ Tensor.uniform_ = _uniform_
 Tensor.normal_ = _normal_
 Tensor.bernoulli_ = _bernoulli_
 Tensor.exponential_ = _exponential_
+Tensor.geometric_ = _geometric_
+Tensor.cauchy_ = _cauchy_
 
 
 def add_n(inputs, name=None):

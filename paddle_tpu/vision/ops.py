@@ -156,11 +156,330 @@ def generate_proposals(*a, **k):
         "generate_proposals: RPN-specific; compose box_iou/nms/roi_align")
 
 
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (ref: vision.ops.deform_conv2d /
+    paddle/phi/kernels deformable_conv).
+
+    TPU-native lowering: per-tap bilinear GATHER of the input at the
+    offset sample positions (VPU/gather), then one einsum contraction of
+    the [N, Cin, kh·kw, Ho, Wo] sampled stack against the weight — the
+    FLOP-heavy part rides the MXU like an im2col matmul. mask=None is v1;
+    v2 multiplies each sampled tap by its modulation mask.
+
+    x: [N, Cin, H, W]; offset: [N, 2·dg·kh·kw, Ho, Wo] as (dy, dx) pairs;
+    weight: [Cout, Cin/groups, kh, kw]; mask: [N, dg·kh·kw, Ho, Wo]."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    dg = int(deformable_groups)
+
+    def f(xa, off, w, *rest):
+        ma = rest[0] if mask is not None else None
+        ba = (rest[-1] if bias is not None else None)
+        n, cin, h, wdt = xa.shape
+        cout = w.shape[0]
+        ho = (h + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        wo = (wdt + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        off = off.reshape(n, dg, kh * kw, 2, ho, wo)
+        # base sampling grid (input coords, before offsets)
+        by = (jnp.arange(ho) * stride[0] - padding[0]).astype(jnp.float32)
+        bx = (jnp.arange(wo) * stride[1] - padding[1]).astype(jnp.float32)
+        ky = jnp.repeat(jnp.arange(kh) * dilation[0], kw)       # [K]
+        kx = jnp.tile(jnp.arange(kw) * dilation[1], kh)         # [K]
+        py0 = by[None, :, None] + ky[:, None, None]             # [K, ho, 1]
+        px0 = bx[None, None, :] + kx[:, None, None]             # [K, 1, wo]
+        py = py0[None, None] + off[:, :, :, 0]        # [N, dg, K, ho, wo]
+        px = px0[None, None] + off[:, :, :, 1]
+
+        def bilinear(img, sy, sx):
+            # img [N, dg, cpg, H, W]; sy/sx [N, dg, K, ho, wo]
+            inside = (sy > -1.0) & (sy < h) & (sx > -1.0) & (sx < wdt)
+            syc = jnp.clip(sy, 0.0, h - 1)
+            sxc = jnp.clip(sx, 0.0, wdt - 1)
+            y0 = jnp.floor(syc)
+            x0 = jnp.floor(sxc)
+            y1 = jnp.clip(y0 + 1, 0, h - 1)
+            x1 = jnp.clip(x0 + 1, 0, wdt - 1)
+            wy1 = syc - y0
+            wx1 = sxc - x0
+            wy0, wx0 = 1 - wy1, 1 - wx1
+
+            def g(yi, xi):
+                yi = yi.astype(jnp.int32)
+                xi = xi.astype(jnp.int32)
+                # gather per (n, dg): vmap twice
+                def per_nd(im, yy, xx):
+                    # im [cpg, H, W]; yy/xx [K, ho, wo]
+                    return im[:, yy, xx]           # [cpg, K, ho, wo]
+                return jax.vmap(jax.vmap(per_nd))(img, yi, xi)
+            val = (g(y0, x0) * (wy0 * wx0)[:, :, None]
+                   + g(y0, x1) * (wy0 * wx1)[:, :, None]
+                   + g(y1, x0) * (wy1 * wx0)[:, :, None]
+                   + g(y1, x1) * (wy1 * wx1)[:, :, None])
+            return val * inside[:, :, None]
+
+        xg = xa.reshape(n, dg, cin // dg, h, wdt)
+        samp = bilinear(xg, py, px)                # [N, dg, cpg, K, ho, wo]
+        if ma is not None:
+            m = ma.reshape(n, dg, 1, kh * kw, ho, wo)
+            samp = samp * m
+        samp = samp.reshape(n, cin, kh * kw, ho, wo)
+        # grouped contraction on the MXU
+        cpg_w = cin // groups
+        samp = samp.reshape(n, groups, cpg_w, kh * kw, ho, wo)
+        wg = w.reshape(groups, cout // groups, cpg_w, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", samp, wg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(n, cout, ho, wo).astype(xa.dtype)
+        if ba is not None:
+            out = out + ba.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return _run_op("deform_conv2d", f, tuple(args), {})
+
+
 class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "deformable conv has no MXU-friendly lowering; use grid_sample + "
-            "conv2d composition (paddle.nn.functional.grid_sample)")
+    """Layer form of deform_conv2d (ref: vision.ops.DeformConv2D)."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
+                padding=0, dilation=1, deformable_groups=1, groups=1,
+                weight_attr=None, bias_attr=None):
+        from ..nn.layer.layers import Layer
+
+        class _DeformConv2D(Layer):
+            def __init__(self):
+                super().__init__()
+                ks = (kernel_size if isinstance(kernel_size, (tuple, list))
+                      else (kernel_size, kernel_size))
+                self._attrs = dict(stride=stride, padding=padding,
+                                   dilation=dilation,
+                                   deformable_groups=deformable_groups,
+                                   groups=groups)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, ks[0], ks[1]],
+                    attr=weight_attr)
+                self.bias = (None if bias_attr is False else
+                             self.create_parameter([out_channels],
+                                                   attr=bias_attr,
+                                                   is_bias=True))
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(x, offset, self.weight, self.bias,
+                                     mask=mask, **self._attrs)
+
+        return _DeformConv2D()
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (ref: vision.ops.psroi_pool /
+    phi psroi_pool kernel). x: [N, C, H, W] with C = out_c·ph·pw; each
+    output bin (i, j) averages its own channel slice over the bin region.
+
+    TPU-native: the data-dependent bin regions become mask-weighted means
+    over the full H×W grid (static shapes, jit-able) instead of the
+    reference's per-cell scalar loops."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        if c % (ph * pw):
+            raise ValueError(
+                f"psroi_pool needs channels divisible by {ph}x{pw}, got {c}")
+        out_c = c // (ph * pw)
+        r = rois.shape[0]
+        img_idx = jnp.repeat(jnp.arange(n), rois_num, total_repeat_length=r)
+        x1 = jnp.round(rois[:, 0]) * spatial_scale
+        y1 = jnp.round(rois[:, 1]) * spatial_scale
+        x2 = jnp.round(rois[:, 2] + 1.0) * spatial_scale
+        y2 = jnp.round(rois[:, 3] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / ph   # [R]
+        bin_w = rw / pw
+
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        # bin boundaries per (roi, bin-row i): floor/ceil like the ref
+        i = jnp.arange(ph, dtype=jnp.float32)
+        j = jnp.arange(pw, dtype=jnp.float32)
+        hstart = jnp.floor(y1[:, None] + i[None, :] * bin_h[:, None])
+        hend = jnp.ceil(y1[:, None] + (i[None, :] + 1) * bin_h[:, None])
+        wstart = jnp.floor(x1[:, None] + j[None, :] * bin_w[:, None])
+        wend = jnp.ceil(x1[:, None] + (j[None, :] + 1) * bin_w[:, None])
+        hstart = jnp.clip(hstart, 0, h)
+        hend = jnp.clip(hend, 0, h)
+        wstart = jnp.clip(wstart, 0, w)
+        wend = jnp.clip(wend, 0, w)
+        # membership masks: [R, ph, H], [R, pw, W]
+        rowm = ((ys[None, None, :] >= hstart[:, :, None])
+                & (ys[None, None, :] < hend[:, :, None])).astype(jnp.float32)
+        colm = ((xs[None, None, :] >= wstart[:, :, None])
+                & (xs[None, None, :] < wend[:, :, None])).astype(jnp.float32)
+        area = (jnp.einsum("rih,rjw->rij", rowm, colm))
+        feats = feat[img_idx].reshape(r, out_c, ph, pw, h, w)
+        # bin (i, j) of channel c reads slice [c, i, j] — weighted mean
+        sums = jnp.einsum("rcijhw,rih,rjw->rcij", feats, rowm, colm)
+        out = jnp.where(area[:, None] > 0, sums / jnp.maximum(area[:, None],
+                                                              1.0), 0.0)
+        return out.astype(feat.dtype)
+
+    return _run_op("psroi_pool", f, (x, boxes, boxes_num), {})
+
+
+class PSRoIPool:
+    """Layer form of psroi_pool (ref: vision.ops.PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (ref: vision.ops.box_coder /
+    phi box_coder kernel). encode: target [M,4] x priors [N,4] ->
+    [M, N, 4] deltas; decode: target [M, N, 4] deltas + priors -> boxes."""
+    norm = 0.0 if box_normalized else 1.0
+
+    def prior_cwh(p):
+        pw = p[:, 2] - p[:, 0] + norm
+        ph_ = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw * 0.5
+        pcy = p[:, 1] + ph_ * 0.5
+        return pw, ph_, pcx, pcy
+
+    def f(prior, target, *rest):
+        var = rest[0] if rest else None
+        pw, ph_, pcx, pcy = prior_cwh(prior)
+        if var is None:
+            var = jnp.ones((prior.shape[0], 4), jnp.float32)
+        elif var.ndim == 1:
+            var = jnp.broadcast_to(var[None, :], (prior.shape[0], 4))
+        if code_type == "encode_center_size":
+            tw = target[:, 2] - target[:, 0] + norm
+            th = target[:, 3] - target[:, 1] + norm
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+            dy = (tcy[:, None] - pcy[None, :]) / ph_[None, :] / var[None, :, 1]
+            dw = jnp.log(tw[:, None] / pw[None, :]) / var[None, :, 2]
+            dh = jnp.log(th[:, None] / ph_[None, :]) / var[None, :, 3]
+            return jnp.stack([dx, dy, dw, dh], -1)
+        if code_type == "decode_center_size":
+            # target: [M, N, 4] deltas; prior broadcast along `axis`
+            if axis == 0:
+                pw_, ph2, pcx_, pcy_ = (a[None, :] for a in
+                                        (pw, ph_, pcx, pcy))
+                v = var[None, :, :]
+            else:
+                pw_, ph2, pcx_, pcy_ = (a[:, None] for a in
+                                        (pw, ph_, pcx, pcy))
+                v = var[:, None, :]
+            cx = v[..., 0] * target[..., 0] * pw_ + pcx_
+            cy = v[..., 1] * target[..., 1] * ph2 + pcy_
+            bw = jnp.exp(v[..., 2] * target[..., 2]) * pw_
+            bh = jnp.exp(v[..., 3] * target[..., 3]) * ph2
+            return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                              cx + bw * 0.5 - norm,
+                              cy + bh * 0.5 - norm], -1)
+        raise ValueError(f"unknown code_type {code_type!r}")
+
+    args = [prior_box, target_box]
+    if prior_box_var is not None and isinstance(prior_box_var, Tensor):
+        args.append(prior_box_var)
+        return _run_op("box_coder", f, tuple(args), {})
+    if prior_box_var is not None:
+        var = jnp.asarray(np.array(prior_box_var, np.float32))
+        return _run_op("box_coder",
+                       lambda p, t: f(p, t, var), tuple(args), {})
+    return _run_op("box_coder", f, tuple(args), {})
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN pyramid levels by scale (ref:
+    vision.ops.distribute_fpn_proposals). Host-side eager op: the output
+    is a LIST of variable-length per-level tensors plus a restore index —
+    inherently dynamic shapes, which the reference also computes on
+    CPU-side kernels before the static per-level heads run."""
+    rois = np.asarray(getattr(fpn_rois, "_data", fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois = []
+    order = []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        order.append(idx)
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(order.shape[0])
+    restore_ind = Tensor(jnp.asarray(restore.reshape(-1, 1)))
+    if rois_num is not None:
+        num = np.asarray(getattr(rois_num, "_data", rois_num))
+        img_idx = np.repeat(np.arange(num.shape[0]), num)
+        rois_num_per_level = [
+            Tensor(jnp.asarray(np.bincount(
+                img_idx[lvl == level], minlength=num.shape[0])
+                .astype(np.int32)))
+            for level in range(min_level, max_level + 1)]
+        return multi_rois, restore_ind, rois_num_per_level
+    return multi_rois, restore_ind
+
+
+def read_file(filename, name=None):
+    """Read a file's raw bytes as a 1-D uint8 tensor (ref:
+    vision.ops.read_file)."""
+    with open(filename, "rb") as fh:
+        data = fh.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (ref:
+    vision.ops.decode_jpeg; the reference uses nvjpeg — host-side PIL
+    decode here, images then move to device as tensors)."""
+    import io
+
+    from PIL import Image
+    raw = bytes(np.asarray(getattr(x, "_data", x)).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,

@@ -1,0 +1,343 @@
+"""Round-4 API tail (VERDICT r3 Missing #2-#3): nn.utils, Softmax2D,
+distributed gather/P2POp/stream/reshard, vision detection ops,
+Tensor.geometric_/cauchy_."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def setup_module():
+    paddle.set_device("cpu")
+
+
+# -- nn.utils ---------------------------------------------------------------
+
+def test_weight_norm_forward_and_grads():
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    paddle.seed(1)
+    lin = nn.Linear(8, 6)
+    w0 = np.asarray(lin.weight._data).copy()
+    weight_norm(lin, dim=0)
+    assert "weight" not in lin._parameters
+    # weight stored [in, out]; dim=0 magnitude is per-row, keepdims
+    assert tuple(lin.weight_g.shape) == (8, 1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = lin(x)
+    ref = np.asarray(x._data) @ w0 + np.asarray(lin.bias._data)
+    np.testing.assert_allclose(np.asarray(y._data), ref, rtol=1e-5,
+                               atol=1e-5)
+    loss = paddle.mean(y ** 2)
+    loss.backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+    remove_weight_norm(lin)
+    np.testing.assert_allclose(np.asarray(lin.weight._data), w0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_weight_norm_trains_under_train_step():
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.nn.utils import weight_norm
+    from paddle_tpu.optimizer import AdamW
+    paddle.seed(2)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 2))
+    weight_norm(m[0], dim=0)
+    opt = AdamW(learning_rate=5e-2, parameters=m.parameters())
+    step = TrainStep(m, lambda out, label: paddle.mean((out - label) ** 2),
+                     opt)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 2)
+                         .astype(np.float32))
+    losses = [float(step(x, labels=y)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_spectral_norm_fn():
+    from paddle_tpu.nn.utils import spectral_norm
+    paddle.seed(3)
+    lin = nn.Linear(12, 8)
+    spectral_norm(lin, n_power_iterations=3)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 12)
+                         .astype(np.float32))
+    lin(x)
+    lin(x)  # more power iterations sharpen the estimate
+    sigma = np.linalg.svd(np.asarray(lin.weight._data),
+                          compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05, sigma
+
+
+def test_clip_grad_norm_():
+    from paddle_tpu.nn.utils import clip_grad_norm_
+    paddle.seed(4)
+    lin = nn.Linear(6, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 6)
+                         .astype(np.float32))
+    loss = paddle.sum(lin(x) ** 2) * 100.0
+    loss.backward()
+    g = [np.asarray(p.grad._data).copy() for p in lin.parameters()]
+    pre = np.sqrt(sum((a ** 2).sum() for a in g))
+    total = clip_grad_norm_(lin.parameters(), max_norm=1.0)
+    np.testing.assert_allclose(float(total), pre, rtol=1e-5)
+    post = np.sqrt(sum((np.asarray(p.grad._data) ** 2).sum()
+                       for p in lin.parameters()))
+    assert post <= 1.0 + 1e-5
+
+
+def test_parameters_vector_roundtrip():
+    from paddle_tpu.nn.utils import (parameters_to_vector,
+                                     vector_to_parameters)
+    paddle.seed(5)
+    m = nn.Linear(5, 3)
+    vec = parameters_to_vector(m.parameters())
+    assert vec.shape[0] == 5 * 3 + 3
+    vector_to_parameters(vec * 0 + 7.0, m.parameters())
+    for p in m.parameters():
+        assert np.all(np.asarray(p._data) == 7.0)
+
+
+# -- Softmax2D --------------------------------------------------------------
+
+def test_softmax2d():
+    sm = nn.Softmax2D()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 5, 3, 4)
+                         .astype(np.float32))
+    y = sm(x)
+    s = np.asarray(y._data).sum(axis=1)
+    np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-5)
+    with pytest.raises(ValueError):
+        sm(paddle.ones([2, 3]))
+
+
+# -- distributed tail -------------------------------------------------------
+
+def test_gather_and_stream_namespace_trivial_group():
+    import paddle_tpu.distributed as dist
+    t = paddle.ones([4])
+    out = dist.gather(t)
+    assert len(out) == 1
+    r = dist.stream.all_reduce(t, use_calc_stream=True)
+    np.testing.assert_allclose(np.asarray(r._data), np.ones(4))
+    assert dist.reshard is not None
+
+
+def test_batch_isend_irecv_spmd_shift():
+    """P2POp batch = one ppermute: microbatch rotation on a 4-rank axis."""
+    import jax
+    from jax.sharding import Mesh
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    import paddle_tpu.distributed as dist
+
+    devs = np.array(jax.devices("cpu")[:4])
+    mesh = Mesh(devs, ("pp",))
+    group = dist.Group("pp", 4)
+
+    def step(x):
+        t = paddle.to_tensor(x)
+        import jax.numpy as jnp
+        recv_buf = paddle.zeros(list(t.shape), dtype="float32")
+        rank = 0  # same trace on every rank; shift comes from peer-rank
+        ops = [dist.P2POp(dist.isend, t, (rank + 1) % 4, group),
+               dist.P2POp(dist.irecv, recv_buf, (rank - 1) % 4, group)]
+        tasks = dist.batch_isend_irecv(ops)
+        for task in tasks:
+            task.wait()
+        return recv_buf._data
+
+    x = np.arange(4, dtype=np.float32).reshape(4, 1)
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P("pp"),
+                            out_specs=P("pp")))(x)
+    # shift +1: rank r receives rank r-1's value
+    np.testing.assert_allclose(np.asarray(out).ravel(), [3, 0, 1, 2])
+
+
+def test_batch_isend_irecv_unpaired_raises():
+    import paddle_tpu.distributed as dist
+    t = paddle.ones([2])
+    with pytest.raises(ValueError, match="permutation"):
+        dist.batch_isend_irecv([dist.P2POp(dist.isend, t, 1,
+                                           dist.Group("x", 4))])
+
+
+# -- vision detection ops ---------------------------------------------------
+
+def _naive_deform_conv(x, off, w, stride, pad, mask=None):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    ho = (h + 2 * pad - kh) // stride + 1
+    wo = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, cout, ho, wo), np.float32)
+
+    def sample(img, y, x_):
+        if y <= -1 or y >= h or x_ <= -1 or x_ >= wd:
+            return 0.0
+        y0, x0 = int(np.floor(max(y, 0))), int(np.floor(max(x_, 0)))
+        y0 = min(max(y0, 0), h - 1)
+        x0 = min(max(x0, 0), wd - 1)
+        y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, wd - 1)
+        yc, xc = min(max(y, 0), h - 1), min(max(x_, 0), wd - 1)
+        wy1, wx1 = yc - y0, xc - x0
+        return (img[y0, x0] * (1 - wy1) * (1 - wx1)
+                + img[y0, x1] * (1 - wy1) * wx1
+                + img[y1, x0] * wy1 * (1 - wx1)
+                + img[y1, x1] * wy1 * wx1)
+
+    for b in range(n):
+        for ho_i in range(ho):
+            for wo_i in range(wo):
+                for co in range(cout):
+                    acc = 0.0
+                    for ci in range(cin):
+                        for i in range(kh):
+                            for j in range(kw):
+                                k = i * kw + j
+                                dy = off[b, 2 * k, ho_i, wo_i]
+                                dx = off[b, 2 * k + 1, ho_i, wo_i]
+                                py = ho_i * stride - pad + i + dy
+                                px = wo_i * stride - pad + j + dx
+                                v = sample(x[b, ci], py, px)
+                                if mask is not None:
+                                    v *= mask[b, k, ho_i, wo_i]
+                                acc += v * w[co, ci, i, j]
+                    out[b, co, ho_i, wo_i] = acc
+    return out
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_deform_conv2d_matches_naive(with_mask):
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 6, 6).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    off = (rng.randn(1, 18, 6, 6) * 0.7).astype(np.float32)
+    mask = (rng.rand(1, 9, 6, 6).astype(np.float32)
+            if with_mask else None)
+    ref = _naive_deform_conv(x, off, w, 1, 1, mask)
+    got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), padding=1,
+                        mask=None if mask is None
+                        else paddle.to_tensor(mask))
+    np.testing.assert_allclose(np.asarray(got._data), ref, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_zero_offsets_is_conv2d():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.ops import deform_conv2d
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(6, 4, 3, 3).astype(np.float32)
+    off = np.zeros((2, 18, 8, 8), np.float32)
+    got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                        paddle.to_tensor(w), padding=1)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    np.testing.assert_allclose(np.asarray(got._data),
+                               np.asarray(ref._data), rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_layer():
+    from paddle_tpu.vision.ops import DeformConv2D
+    layer = DeformConv2D(3, 5, 3, padding=1)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 3, 6, 6)
+                         .astype(np.float32))
+    off = paddle.zeros([1, 18, 6, 6])
+    y = layer(x, off)
+    assert tuple(y.shape) == (1, 5, 6, 6)
+    loss = paddle.mean(y ** 2)
+    loss.backward()
+    assert layer.weight.grad is not None
+
+
+def test_psroi_pool():
+    from paddle_tpu.vision.ops import PSRoIPool, psroi_pool
+    rng = np.random.RandomState(0)
+    # C = out_c(2) * 2 * 2
+    x = rng.randn(1, 8, 8, 8).astype(np.float32)
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], np.float32)
+    num = np.array([1], np.int32)
+    out = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                     paddle.to_tensor(num), output_size=2)
+    assert tuple(out.shape) == (1, 2, 2, 2)
+    # bin (0,0) of out channel 0 averages input channel 0 over rows 0-3
+    ref = x[0, 0, 0:4, 0:4].mean()
+    np.testing.assert_allclose(np.asarray(out._data)[0, 0, 0, 0], ref,
+                               rtol=1e-4)
+    pool = PSRoIPool(2)
+    out2 = pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                paddle.to_tensor(num))
+    np.testing.assert_allclose(np.asarray(out2._data),
+                               np.asarray(out._data))
+
+
+def test_box_coder_roundtrip():
+    from paddle_tpu.vision.ops import box_coder
+    priors = np.array([[1.0, 1.0, 5.0, 5.0], [2.0, 2.0, 8.0, 10.0]],
+                      np.float32)
+    targets = np.array([[0.0, 0.0, 4.0, 6.0]], np.float32)
+    enc = box_coder(paddle.to_tensor(priors), None,
+                    paddle.to_tensor(targets),
+                    code_type="encode_center_size")
+    assert tuple(enc.shape) == (1, 2, 4)
+    dec = box_coder(paddle.to_tensor(priors), None, enc,
+                    code_type="decode_center_size", axis=0)
+    # decoding the encoding recovers the target against each prior
+    for p in range(2):
+        np.testing.assert_allclose(np.asarray(dec._data)[0, p], targets[0],
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_distribute_fpn_proposals():
+    from paddle_tpu.vision.ops import distribute_fpn_proposals
+    rois = np.array([
+        [0, 0, 10, 10],      # small -> low level
+        [0, 0, 500, 500],    # large -> high level
+        [0, 0, 224, 224],    # refer_scale at refer_level
+    ], np.float32)
+    multi, restore = distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    assert len(multi) == 4
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 3
+    assert multi[0].shape[0] == 1      # the small one at level 2
+    assert multi[-1].shape[0] == 1     # the big one at level 5
+    # restore_ind[i] = position of input row i in concat(multi_rois)
+    cat = np.concatenate([np.asarray(m._data) for m in multi if m.shape[0]])
+    ri = np.asarray(restore._data).ravel()
+    np.testing.assert_allclose(cat[ri], rois)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    import io
+
+    from PIL import Image
+    from paddle_tpu.vision.ops import decode_jpeg, read_file
+    # smooth gradient (random noise doesn't survive lossy JPEG)
+    yy, xx = np.mgrid[0:16, 0:20]
+    arr = np.stack([yy * 8, xx * 8, (yy + xx) * 4], -1).astype(np.uint8)
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    raw = read_file(str(p))
+    assert raw.dtype == paddle.uint8 if hasattr(paddle, "uint8") else True
+    img = decode_jpeg(raw)
+    assert tuple(img.shape) == (3, 16, 20)
+    got = np.asarray(img._data).transpose(1, 2, 0).astype(np.int32)
+    assert np.abs(got - arr.astype(np.int32)).mean() < 12  # lossy codec
+
+
+# -- in-place randoms -------------------------------------------------------
+
+def test_geometric_cauchy_inplace():
+    t = paddle.zeros([4000])
+    t.geometric_(0.5)
+    vals = np.asarray(t._data)
+    assert vals.min() >= 1.0
+    assert abs(vals.mean() - 2.0) < 0.2      # E[Geom(0.5)] = 2
+    t2 = paddle.zeros([4001])
+    t2.cauchy_(loc=1.0, scale=2.0)
+    med = np.median(np.asarray(t2._data))
+    assert abs(med - 1.0) < 0.3              # median of Cauchy = loc

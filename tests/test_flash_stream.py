@@ -68,8 +68,8 @@ def _flash_grads(q, k, v, causal, scale):
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("s", [256, 320])  # 320: ragged (pads to 384)
 def test_stream_bwd_matches_reference(force_stream, causal, s):
-    """Both sides over budget -> both grads streamed (the round-2 NameError
-    path: _bwd_dkv_stream_call/_bwd_dq_stream_call)."""
+    """Both sides over budget -> both grads streamed (now the fused one-pass
+    kernel: _bwd_fused_stream_call)."""
     rng = np.random.RandomState(3)
     q = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
     k = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
@@ -84,22 +84,17 @@ def test_stream_bwd_matches_reference(force_stream, causal, s):
 @pytest.mark.parametrize("sq,sk", [(128, 512), (512, 128)])
 def test_stream_bwd_mixed_sides(monkeypatch, sq, sk):
     """Only ONE side over budget (cross-attention, unequal lengths): the
-    streamed side must be used as-is and only the other side computed
-    residently — the round-2 bug recomputed BOTH residently."""
+    fused one-pass backward must be used (5 matmuls per tile pair), never
+    the resident two-kernel path that recomputes S and dP."""
     monkeypatch.setattr(fa, "STREAM_KV_BYTES", 2 * 256 * 64 * 4)  # 256 rows f32
-    calls = {"dkv_stream": 0, "dq_stream": 0}
-    orig_dkv, orig_dq = fa._bwd_dkv_stream_call, fa._bwd_dq_stream_call
+    calls = {"fused": 0}
+    orig = fa._bwd_fused_stream_call
 
-    def spy_dkv(*a, **kw):
-        calls["dkv_stream"] += 1
-        return orig_dkv(*a, **kw)
+    def spy(*a, **kw):
+        calls["fused"] += 1
+        return orig(*a, **kw)
 
-    def spy_dq(*a, **kw):
-        calls["dq_stream"] += 1
-        return orig_dq(*a, **kw)
-
-    monkeypatch.setattr(fa, "_bwd_dkv_stream_call", spy_dkv)
-    monkeypatch.setattr(fa, "_bwd_dq_stream_call", spy_dq)
+    monkeypatch.setattr(fa, "_bwd_fused_stream_call", spy)
     rng = np.random.RandomState(4)
     q = jnp.asarray(rng.randn(1, sq, 64).astype(np.float32))
     k = jnp.asarray(rng.randn(1, sk, 64).astype(np.float32))
@@ -110,10 +105,7 @@ def test_stream_bwd_mixed_sides(monkeypatch, sq, sk):
     for g, r, name in zip(got, ref, "qkv"):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=2e-3, atol=2e-3, err_msg=name)
-    if sk > sq:   # long KV: dq must stream, dkv resident
-        assert calls == {"dkv_stream": 0, "dq_stream": 1}
-    else:         # long q: dkv must stream, dq resident
-        assert calls == {"dkv_stream": 1, "dq_stream": 0}
+    assert calls == {"fused": 1}
 
 
 def test_stream_bwd_causal_long(force_stream):
@@ -145,3 +137,25 @@ def test_stream_matches_resident_kernel(force_stream):
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(np.asarray(lse_s), np.asarray(lse_r),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_bwd_kv_chunking_matches_unchunked(monkeypatch):
+    """Long-S guard: when n_kdma exceeds _BWD_MAX_DQ_PARTIALS the kv dim is
+    chunked at the XLA level; numerics must be identical to one chunk."""
+    monkeypatch.setattr(fa, "STREAM_KV_BYTES", 2 * 256 * 64 * 4)
+    rng = np.random.RandomState(7)
+    s = 1024
+    q = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
+    got = _flash_grads(q, k, v, True, 0.125)
+    # force chunking: 2 kv DMA blocks per chunk -> multiple chunks
+    monkeypatch.setattr(fa, "_BWD_MAX_DQ_PARTIALS", 1)
+    chunked = _flash_grads(q, k, v, True, 0.125)
+    for a, b, name in zip(chunked, got, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
+    ref = _ref_grads(q, k, v, True, 0.125)
+    for g, r, name in zip(chunked, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
