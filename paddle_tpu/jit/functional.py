@@ -86,23 +86,84 @@ def _is_array(a):
     return isinstance(a, jax.Array) or hasattr(a, "aval")
 
 
+# host-value types whose change must invalidate a cached trace (the SOT
+# tier's guard property: a python flag baked into a trace at trace time
+# silently replays stale without a recheck)
+_GUARD_TYPES = (int, float, bool, str, bytes, type(None))
+
+
+def _layer_host_guard(layer: Layer):
+    """Snapshot of the layer tree's plain-python attribute values (the
+    host values a trace captures as constants). Compared per call; a
+    mismatch forces a retrace — the reference's SOT guards, at attribute
+    granularity."""
+    snap = []
+    stack = [("", layer)]
+    while stack:
+        path, sub = stack.pop()
+        for k, v in vars(sub).items():
+            if k.startswith("_") or k == "training":
+                continue
+            if isinstance(v, _GUARD_TYPES):
+                snap.append((path, k, v))
+            elif isinstance(v, (tuple, list)) and \
+                    all(isinstance(e, _GUARD_TYPES) for e in v):
+                snap.append((path, k, tuple(v)))
+        for name, child in getattr(sub, "_sub_layers", {}).items():
+            stack.append((f"{path}.{name}", child))
+    return tuple(sorted(snap))
+
+
+def _fn_host_guard(fn):
+    """Snapshot of a function's captured host values: closure cells and
+    module globals it names, restricted to plain-python types."""
+    snap = []
+    code = fn.__code__
+    for name, cell in zip(code.co_freevars, fn.__closure__ or ()):
+        try:
+            v = cell.cell_contents
+        except ValueError:  # pragma: no cover - unfilled cell
+            continue
+        if isinstance(v, _GUARD_TYPES):
+            snap.append(("cell", name, v))
+    g = fn.__globals__
+    for name in code.co_names:
+        if name in g and isinstance(g[name], _GUARD_TYPES):
+            snap.append(("global", name, g[name]))
+    return tuple(snap)
+
+
 class TracedLayer:
-    """jit-compiled callable over a Layer (paddle.jit.to_static on a Layer)."""
+    """jit-compiled callable over a Layer (paddle.jit.to_static on a Layer).
+
+    The compiled trace bakes in the layer's python attribute values
+    (dropout rates, flags, sizes); those are re-checked on every call via
+    _layer_host_guard and a change triggers a retrace instead of silently
+    replaying the stale program."""
 
     def __init__(self, layer: Layer, training=False):
         self.layer = layer
         self.training = training
+        self._guard = None
+        self._fn = None
+
+    def _build(self):
+        layer, training = self.layer, self.training
 
         @functools.partial(jax.jit, static_argnums=())
         def _fn(params, buffers, arg_arrays):
             out, new_buf = functional_call(layer, params, arg_arrays,
                                            buffers=buffers,
-                                           training=self.training)
+                                           training=training)
             return out, new_buf
 
-        self._fn = _fn
+        return _fn
 
     def __call__(self, *args):
+        guard = _layer_host_guard(self.layer)
+        if self._fn is None or guard != self._guard:
+            self._fn = self._build()
+            self._guard = guard
         params, buffers = state_arrays(self.layer)
         arg_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
                            for a in args)
@@ -128,13 +189,23 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
         from .dy2static import convert_to_static
         converted = convert_to_static(obj)
+        # trace cache keyed by the function's captured host values (and
+        # the call kwargs): a changed closure/global retraces instead of
+        # replaying stale; unchanged values REUSE the compiled program
+        # (previously every call built a fresh jax.jit and recompiled)
+        jit_cache = {}
 
         @functools.wraps(obj)
         def wrapper(*args, **kwargs):
             arrs = tuple(a._data if isinstance(a, Tensor) else a for a in args)
+            try:
+                key = (_fn_host_guard(obj),
+                       tuple(sorted(kwargs.items())))
+                hash(key)  # sorted() doesn't hash values; probe now
+            except TypeError:  # unhashable/unorderable kwarg: don't cache
+                key = None
 
-            @functools.cache
-            def get_jitted():
+            def build():
                 def fn(arg_arrays):
                     t_args = [Tensor._from_data(a) if _is_array(a) else a
                               for a in arg_arrays]
@@ -145,7 +216,18 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                         is_leaf=lambda x: isinstance(x, Tensor))
                 return jax.jit(fn)
 
-            out = get_jitted()(arrs)
+            if key is None:
+                jitted = build()
+            else:
+                jitted = jit_cache.get(key)
+                if jitted is None:
+                    if len(jit_cache) >= 32:
+                        # a per-call-changing captured value (step counter,
+                        # annealed float) would otherwise grow this without
+                        # bound; evict oldest (dict preserves insert order)
+                        jit_cache.pop(next(iter(jit_cache)))
+                    jitted = jit_cache[key] = build()
+            out = jitted(arrs)
             return jax.tree_util.tree_map(
                 lambda x: Tensor._from_data(x) if _is_array(x) else x, out)
         return wrapper

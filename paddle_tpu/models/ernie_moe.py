@@ -102,11 +102,10 @@ def param_pspecs(config, ep_degree: int, dp_degree: int = 1):
             "final_ln": P(None)}
 
 
-def _layer(p, h, layer_idx, config: ErnieMoEConfig):
+def _attn_and_norm(p, h, config: ErnieMoEConfig):
     c = config
     b, s, hid = h.shape
     nh, hd = c.num_attention_heads, c.head_dim
-
     x = fused_rms_norm(h, p["ln1"], c.layer_norm_eps)
     qkv = (x @ p["qkv"]).reshape(b, s, 3, nh, hd)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -118,28 +117,49 @@ def _layer(p, h, layer_idx, config: ErnieMoEConfig):
         from ..ops.flash_attention import flash_attention_bshd
         attn = flash_attention_bshd(q, k, v, causal=True)
     h = h + attn.reshape(b, s, hid) @ p["o"]
+    return h, fused_rms_norm(h, p["ln2"], c.layer_norm_eps)
 
-    x = fused_rms_norm(h, p["ln2"], c.layer_norm_eps)
-    is_moe = (layer_idx % c.moe_every) == (c.moe_every - 1)
+
+def _moe_ffn(p, x_, config: ErnieMoEConfig):
+    c = config
+    hid = x_.shape[-1]
+    tokens = x_.reshape(-1, hid)
+    logits = tokens.astype(jnp.float32) @ p["gate"]
+
+    def expert_fn(params, toks):
+        w1, w2 = params
+        return jax.nn.gelu(toks @ w1) @ w2
+
+    out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
+                                    (p["e_w1"], p["e_w2"]),
+                                    c.num_experts, k=c.moe_topk,
+                                    capacity_factor=c.capacity_factor)
+    return out.reshape(x_.shape).astype(x_.dtype), aux.astype(jnp.float32)
+
+
+def _dense_ffn(p, x_, config: ErnieMoEConfig):
+    return (jax.nn.gelu(x_ @ p["w1"]) @ p["w2"]).astype(x_.dtype), \
+        jnp.zeros((), jnp.float32)
+
+
+def _layer_static(p, h, is_moe, config: ErnieMoEConfig):
+    """One decoder layer with a STATIC moe/dense choice (no lax.cond)."""
+    h, x = _attn_and_norm(p, h, config)
+    ffn_out, aux = (_moe_ffn if is_moe else _dense_ffn)(p, x, config)
+    return h + ffn_out, aux
+
+
+def _layer(p, h, layer_idx, config: ErnieMoEConfig):
+    c = config
 
     def moe_branch(x_):
-        tokens = x_.reshape(-1, hid)
-        logits = tokens.astype(jnp.float32) @ p["gate"]
-
-        def expert_fn(params, toks):
-            w1, w2 = params
-            return jax.nn.gelu(toks @ w1) @ w2
-
-        out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
-                                        (p["e_w1"], p["e_w2"]),
-                                        c.num_experts, k=c.moe_topk,
-                                        capacity_factor=c.capacity_factor)
-        return out.reshape(x_.shape).astype(x_.dtype), aux.astype(jnp.float32)
+        return _moe_ffn(p, x_, c)
 
     def dense_branch(x_):
-        return (jax.nn.gelu(x_ @ p["w1"]) @ p["w2"]).astype(x_.dtype), \
-            jnp.zeros((), jnp.float32)
+        return _dense_ffn(p, x_, c)
 
+    h, x = _attn_and_norm(p, h, c)
+    is_moe = (layer_idx % c.moe_every) == (c.moe_every - 1)
     # layer_idx is a traced scan counter: lax.cond keeps one compiled body
     ffn_out, aux = lax.cond(is_moe, moe_branch, dense_branch, x)
     return h + ffn_out, aux
@@ -151,14 +171,36 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig):
     h = (jnp.take(params["embed"], ids, axis=0)
          + params["pos"][:s][None]).astype(c.dtype)
 
-    def body(carry, inp):
-        h = carry
-        idx, layer_params = inp
-        h, aux = _layer(layer_params, h, idx, c)
-        return h, aux
+    # remat per scan step: the capacity-bucketed dispatch one-hots are
+    # large and per-layer; recomputing them in the backward trades cheap
+    # FLOPs for the activation memory that OOMed real-sized configs
+    if c.moe_every == 2 and c.num_hidden_layers % 2 == 0:
+        # the moe/dense pattern is STATIC: scan over (dense, moe) layer
+        # PAIRS with both bodies inline — the traced-idx lax.cond was the
+        # single largest span in the profiled step (it blocks fusion
+        # across the ffn boundary and carries both branches)
+        grouped = jax.tree_util.tree_map(
+            lambda a: a.reshape(c.num_hidden_layers // 2, 2, *a.shape[1:]),
+            params["layers"])
 
-    idxs = jnp.arange(c.num_hidden_layers)
-    h, auxes = lax.scan(body, h, (idxs, params["layers"]))
+        def pair_body(h, lp):
+            p0 = jax.tree_util.tree_map(lambda a: a[0], lp)
+            p1 = jax.tree_util.tree_map(lambda a: a[1], lp)
+            h, aux0 = _layer_static(p0, h, False, c)
+            h, aux1 = _layer_static(p1, h, True, c)
+            return h, aux0 + aux1
+
+        h, auxes = lax.scan(jax.checkpoint(pair_body), h, grouped)
+    else:
+        def body(carry, inp):
+            h = carry
+            idx, layer_params = inp
+            h, aux = _layer(layer_params, h, idx, c)
+            return h, aux
+
+        idxs = jnp.arange(c.num_hidden_layers)
+        h, auxes = lax.scan(jax.checkpoint(body), h,
+                            (idxs, params["layers"]))
     x = fused_rms_norm(h, params["final_ln"], c.layer_norm_eps)
     logits = (x @ params["embed"].T).astype(jnp.float32)
     mask = labels != -100

@@ -296,9 +296,18 @@ def _remat_policy(parallel):
         return jax.checkpoint_policies.save_only_these_names("attn_out")
     if parallel.remat_policy == "full":
         return None
+    if parallel.remat_policy == "offload_attn":
+        # keep flash outputs across the remat boundary but park them in
+        # host RAM instead of HBM: frees activation memory for larger
+        # batch/depth at big hidden sizes (the v5e HBM ceiling binds
+        # before compute does at 7B-layer geometry)
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["attn_out"],
+            offload_src="device", offload_dst="pinned_host")
     raise ValueError(
         f"unknown remat_policy {parallel.remat_policy!r}; "
-        "expected 'full', 'dots', or 'save_attn'")
+        "expected 'full', 'dots', 'save_attn', or 'offload_attn'")
 
 
 def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
@@ -420,9 +429,15 @@ def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
 # ---------------------------------------------------------------------------
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
-    """Stacked per-layer cache: k/v of [L, B, max_len, KV, HD]."""
+    """Stacked per-layer cache: k/v of [L, B, KV, max_len, HD].
+
+    Head-major layout: the decode attention contracts over the time dim,
+    and [KV, T, HD] makes each head's [T, HD] panel contiguous — the
+    [B, T, KV, HD] layout forced XLA to TRANSPOSE both cache slices every
+    layer of every step (measured 1.5 ms/step of pure copies at b8 on the
+    hd64 shape, the whole gap between b8 and the weight-stream floor)."""
     c = config
-    shape = (c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
+    shape = (c.num_hidden_layers, batch, c.num_key_value_heads, max_len,
              c.head_dim)
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
             "pos": jnp.zeros((), jnp.int32)}
@@ -434,7 +449,7 @@ def llama_prefill(params, cache, ids, config: LlamaConfig):
     into one compiled call with MXU-sized matmuls."""
     c = config
     b, s = ids.shape
-    max_len = cache["k"].shape[2]
+    max_len = cache["k"].shape[3]
     h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)  # [B, S, H]
     cos_all, sin_all = build_rope_cache(max_len, c.head_dim, base=c.rope_theta)
     cos, sin = cos_all[:s], sin_all[:s]
@@ -450,10 +465,13 @@ def llama_prefill(params, cache, ids, config: LlamaConfig):
         v = _mat(x, p["v_proj"]).reshape(b, s, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        # cache layout is head-major [B, KV, T, HD] (see init_kv_cache)
         k_cache = lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, 0, 0, 0))
+            k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype),
+            (0, 0, 0, 0))
         v_cache = lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, 0, 0, 0))
+            v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
+            (0, 0, 0, 0))
         from ..nn.functional.attention import _xla_sdpa
         attn = _xla_sdpa(q, k, v, is_causal=True)
         attn_out = _mat(attn.reshape(b, s, nh * hd), p["o_proj"])
@@ -474,12 +492,15 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
     """One incremental decode step: ids [B, 1] -> (logits [B, vocab], cache).
 
     jit-stable: cache position is a traced scalar, cache updates are
-    dynamic_update_slice, attention masks positions >= pos+1. The layer loop
-    is a lax.scan over the stacked layer params + cache slices.
+    dynamic_update_slice, attention masks positions >= pos+1. The layer
+    loop is a lax.scan over the stacked layer params + cache slices
+    (measured: an unrolled static-index loop is SLOWER at b8 — the scan's
+    per-iteration xs slicing pipelines the weight stream better than a
+    chain of static slices, 2.57 vs 2.15 ms/step on the hd64 shape).
     """
     c = config
     b = ids.shape[0]
-    max_len = cache["k"].shape[2]
+    max_len = cache["k"].shape[3]
     pos = cache["pos"]
     h = jnp.take(params["embed"], ids[:, 0], axis=0).astype(c.dtype)  # [B, H]
 
@@ -490,9 +511,9 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
 
     def layer_step(carry, xs):
         # full stacked caches ride the CARRY (in-place loop state, buffer
-        # aliased across iterations), NOT xs/ys: a ys cache would be copied
-        # wholesale every layer of every token (~full-cache HBM traffic per
-        # step — measured 2.5x decode slowdown at b8)
+        # aliased across iterations), NOT xs/ys: a ys cache would be
+        # copied wholesale every layer of every token (~full-cache HBM
+        # traffic per step — measured 2.5x decode slowdown at b8)
         h, kc, vc = carry
         p, layer = xs
         hd = c.head_dim
@@ -506,23 +527,33 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
         k = apply_rope(k, cos, sin)
 
         zero = jnp.zeros((), jnp.int32)
+        # head-major cache [L, B, KV, T, HD]: the new [B, 1, KV, HD] k/v
+        # transpose to [B, KV, 1, HD] slivers, and both attention einsums
+        # contract against CONTIGUOUS per-head [T, HD] panels — the
+        # time-major layout transposed ~the whole cache every layer
+        # (pure-copy fusions, the b8 decode-floor gap)
+        layer_i = jnp.asarray(layer, jnp.int32)
         kc = lax.dynamic_update_slice(
-            kc, k.astype(kc.dtype)[None], (layer, zero, pos, zero, zero))
+            kc, k.transpose(0, 2, 1, 3).astype(kc.dtype)[None],
+            (layer_i, zero, zero, pos, zero))
         vc = lax.dynamic_update_slice(
-            vc, v.astype(vc.dtype)[None], (layer, zero, pos, zero, zero))
+            vc, v.transpose(0, 2, 1, 3).astype(vc.dtype)[None],
+            (layer_i, zero, zero, pos, zero))
         k_cache = lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
         v_cache = lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
         # grouped-query scores against the unrepeated cache: no [B,T,NH,HD]
-        # head-repeat temporaries in the decode hot loop
+        # head-repeat temporaries in the decode hot loop (an elementwise
+        # broadcast+reduce VPU formulation measured SLOWER than these
+        # einsums at b8: 2.48 vs 2.15 ms/step on hd64)
         rep = nh // nkv
         qg = q[:, 0].reshape(b, nkv, rep, hd)
-        scores = jnp.einsum("bgrd,btgd->bgrt", qg, k_cache,
+        scores = jnp.einsum("bgrd,bgtd->bgrt", qg, k_cache,
                             preferred_element_type=jnp.float32)
         scores = scores / (hd ** 0.5)
         valid = jnp.arange(max_len)[None, None, None, :] <= pos
         scores = jnp.where(valid, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-        attn = jnp.einsum("bgrt,btgd->bgrd", probs, v_cache,
+        attn = jnp.einsum("bgrt,bgtd->bgrd", probs, v_cache,
                           preferred_element_type=jnp.float32).astype(c.dtype)
         attn_out = _mat(attn.reshape(b, nh * hd), p["o_proj"])
         h = h + attn_out

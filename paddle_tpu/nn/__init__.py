@@ -1,6 +1,7 @@
 """paddle_tpu.nn: module system + layers (ref: python/paddle/nn/)."""
 from . import functional
 from . import initializer
+from . import utils
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    clip_grad_norm_)
 from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,

@@ -163,6 +163,7 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
     pre-clip total norm."""
     if isinstance(parameters, Tensor):
         parameters = [parameters]
+    parameters = list(parameters)  # may be a generator; iterated twice
     grads = [p.grad for p in parameters
              if p is not None and p.grad is not None]
     if not grads:

@@ -91,30 +91,51 @@ def _tile_mask(s, cq_ref, ck_ref, causal):
     return jnp.where(ok, s, -1e30)
 
 
-def _fwd_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, cq_ref, ck_ref,
-                       o_ref, lse_ref, m_s, l_s, acc_s, *, block_k, causal,
-                       scale, n_k, self_attn):
-    """Streaming forward over the packed stream: grid (H, n_q, n_k), same
-    online-softmax scratch scheme as flash_attention._fwd_kernel_stream.
-    lo/hi are the scalar-prefetched live k-tile bounds per q tile
-    (_live_col_tiles, with the causal diagonal folded in by the caller):
-    the index maps clamp k DMA into [lo[i], hi[i]] and compute is gated to
-    the live steps — dead tiles cost one scalar compare."""
-    import numpy as np
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    bq = q_ref.shape[1]
-    bq_i, bk_i = np.int32(bq), np.int32(block_k)
+def _flat_schedule(lo, hi, n_q, n_flat):
+    """Front-packed flat schedule over the LIVE (q-tile, k-tile) pairs.
 
-    @pl.when(ki == 0)
+    The rectangular grid (n_q, per-tile-span-bound) spends one grid step
+    (~1.3 µs of fixed Mosaic cost) on every dead (clamped) slot; on short
+    -sequence packs dead steps outnumber live ones ~30:1 and dominate the
+    kernel (measured: the 16-seq/16k pack ran 1280 steps for ~40 live
+    tiles). Flattening packs the live pairs first: step s works on
+    (qi[s], ki[s]); the dead remainder collapses to a clamped tail that
+    re-presents the last window (no DMA, no compute). All arrays are
+    computed IN-GRAPH from cu, so the schedule is jit-correct for any
+    cu values at the same shapes; n_flat is the same static bound the
+    rectangular grid used (n_q x span bound), so worst-case work is
+    unchanged."""
+    spans = (hi - lo + 1).astype(jnp.int32)
+    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                           jnp.cumsum(spans).astype(jnp.int32)])
+    s = jnp.arange(n_flat, dtype=jnp.int32)
+    qi = jnp.clip(jnp.searchsorted(cum, s, side="right") - 1,
+                  0, n_q - 1).astype(jnp.int32)
+    ki = jnp.clip(lo[qi] + (s - cum[qi]), lo[qi], hi[qi]).astype(jnp.int32)
+    live = (s < cum[n_q]).astype(jnp.int32)
+    first = ((s == cum[qi]) & (live == 1)).astype(jnp.int32)
+    last = ((s == cum[qi + 1] - 1) & (live == 1)).astype(jnp.int32)
+    return qi, ki, first, last, live
+
+
+def _fwd_kernel_varlen(qi_ref, ki_ref, first_ref, last_ref, live_ref,
+                       q_ref, k_ref, v_ref, cq_ref, ck_ref,
+                       o_ref, lse_ref, m_s, l_s, acc_s, *, causal, scale):
+    """Streaming forward over the packed stream: FLAT grid (H, n_flat),
+    one live (q-tile, k-tile) pair per step (_flat_schedule), same
+    online-softmax scratch scheme as flash_attention._fwd_kernel_stream.
+    Init/finalize are driven by the scalar-prefetched first/last flags
+    (a q tile's steps are consecutive in the flat order); masking needs
+    no positional bookkeeping — the segment codes carry it."""
+    s_idx = pl.program_id(1)
+
+    @pl.when(first_ref[s_idx] == 1)
     def _init():
         m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
         l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
         acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
 
-    needed = ki <= hi_ref[qi] - lo_ref[qi]
-
-    @pl.when(needed)
+    @pl.when(live_ref[s_idx] == 1)
     def _compute():
         q = q_ref[0]
         k = k_ref[0]
@@ -132,7 +153,7 @@ def _fwd_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, cq_ref, ck_ref,
         m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
         l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
 
-    @pl.when(ki == np.int32(n_k - 1))
+    @pl.when(last_ref[s_idx] == 1)
     def _finalize():
         m = m_s[:, :1]
         l = l_s[:, :1]
@@ -247,11 +268,13 @@ def _codes_from_cu(cu, total):
     return (seg << POS_BITS) | pos
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _flash_varlen(q, k, v, cu_q, cu_k, causal, scale, block_q, block_k,
-                  self_attn, max_seqlen):
+                  self_attn, max_seqlen, n_flat_hint=None):
     o, _ = _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale,
-                                  block_q, block_k, self_attn, max_seqlen)
+                                  block_q, block_k, self_attn, max_seqlen,
+                                  n_flat_hint)
     return o
 
 
@@ -287,7 +310,8 @@ def _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t, causal, self_attn):
 
 
 def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
-                           block_k, self_attn, max_seqlen=None):
+                           block_k, self_attn, max_seqlen=None,
+                           n_flat_hint=None):
     """q/k/v: [H, T, D] packed; cu_*: [B+1] i32 offsets. Returns (o, lse)."""
     h, t, d = q.shape
     tk = k.shape[1]
@@ -306,32 +330,40 @@ def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
     n_q, n_k = tp // block_q, tkp // block_k
     lo, hi = _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t, causal,
                          self_attn)
-    n_k = _inner_steps(n_k, block_q, block_k, max_seqlen)
-    kernel = functools.partial(_fwd_kernel_varlen, block_k=block_k,
-                               causal=causal, scale=scale, n_k=n_k,
-                               self_attn=self_attn)
-    kv_map = lambda b, i, j, lo_, hi_: (b, _clamped_col(lo_, hi_, i, j), 0)
-    ck_map = lambda b, i, j, lo_, hi_: (0, _clamped_col(lo_, hi_, i, j))
+    n_flat = n_q * _inner_steps(n_k, block_q, block_k, max_seqlen)
+    if n_flat_hint is not None:
+        # live-pair count measured by the wrapper while cu was still
+        # concrete (cu is a tracer HERE — the custom_vjp boundary traces
+        # its array args); the grid's ~1.3 µs fixed cost per step is what
+        # dominates short-sequence packs, and the static bound is ~4x
+        # over-provisioned for them
+        n_flat = min(n_flat, n_flat_hint)
+    qi_a, ki_a, first_a, last_a, live_a = _flat_schedule(lo, hi, n_q, n_flat)
+    kernel = functools.partial(_fwd_kernel_varlen, causal=causal,
+                               scale=scale)
     with _mosaic_ctx():
         o, lse = pl.pallas_call(
             kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(h, n_q, n_k),
+                num_scalar_prefetch=5,
+                grid=(h, n_flat),
                 in_specs=[
                     pl.BlockSpec((1, block_q, d),
-                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
-                    pl.BlockSpec((1, block_k, d), kv_map),
-                    pl.BlockSpec((1, block_k, d), kv_map),
+                                 lambda b, s, qi, ki, f, l, lv: (b, qi[s], 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, s, qi, ki, f, l, lv: (b, ki[s], 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, s, qi, ki, f, l, lv: (b, ki[s], 0)),
                     pl.BlockSpec((block_q, 128),
-                                 lambda b, i, j, lo_, hi_: (i, 0)),
-                    pl.BlockSpec((8, block_k), ck_map),
+                                 lambda b, s, qi, ki, f, l, lv: (qi[s], 0)),
+                    pl.BlockSpec((8, block_k),
+                                 lambda b, s, qi, ki, f, l, lv: (0, ki[s])),
                 ],
                 out_specs=[
                     pl.BlockSpec((1, block_q, d),
-                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
+                                 lambda b, s, qi, ki, f, l, lv: (b, qi[s], 0)),
                     pl.BlockSpec((1, 1, block_q),
-                                 lambda b, i, j, lo_, hi_: (b, 0, i)),
+                                 lambda b, s, qi, ki, f, l, lv: (b, 0, qi[s])),
                 ],
                 scratch_shapes=[
                     pltpu.VMEM((block_q, 128), jnp.float32),
@@ -344,19 +376,20 @@ def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
                 jax.ShapeDtypeStruct((h, 1, tp), jnp.float32),
             ],
             interpret=_interpret(),
-        )(lo, hi, qp, kp, vp, cq2d, ck2d)
+        )(qi_a, ki_a, first_a, last_a, live_a, qp, kp, vp, cq2d, ck2d)
     return o[:, :t], lse.reshape(h, tp)[:, :t]
 
 
 def _flash_varlen_fwd(q, k, v, cu_q, cu_k, causal, scale, block_q,
-                      block_k, self_attn, max_seqlen):
+                      block_k, self_attn, max_seqlen, n_flat_hint=None):
     o, lse = _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale,
-                                    block_q, block_k, self_attn, max_seqlen)
+                                    block_q, block_k, self_attn, max_seqlen,
+                                    n_flat_hint)
     return o, (q, k, v, cu_q, cu_k, o, lse)
 
 
 def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
-                      max_seqlen, res, do):
+                      max_seqlen, n_flat_hint, res, do):
     q, k, v, cu_q, cu_k, o, lse = res
     h, t, d = q.shape
     tk = k.shape[1]
@@ -515,10 +548,40 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
                     f"live tiles and produce wrong attention output")
         else:
             max_seqlen = None
+    n_flat_hint = None
+    if not isinstance(cu_q, jax.core.Tracer) \
+            and not isinstance(cu_k, jax.core.Tracer):
+        # cu concrete here (it becomes a tracer at the custom_vjp
+        # boundary): measure the actual live-pair count so the forward's
+        # flat grid is sized to the work, not the worst-case bound.
+        # Pure NUMPY host mirror of _live_col_tiles/_fwd_bounds — jnp ops
+        # issued during an enclosing trace are staged even on concrete
+        # inputs. Rounded to a power of two so repacked batches reuse
+        # compiled programs.
+        import numpy as np
+        bq2, bk2 = _fit_block(block_q, tq), _fit_block(block_k, tk)
+        n_q = -(-tq // bq2)
+        cuq_np = np.asarray(cu_q)
+        cuk_np = np.asarray(cu_k)
+        i = np.arange(n_q)
+        r0 = np.clip(i * bq2, 0, tq - 1)
+        r1 = np.clip((i + 1) * bq2 - 1, 0, tq - 1)
+        seg0 = np.searchsorted(cuq_np, r0, side="right") - 1
+        seg1 = np.searchsorted(cuq_np, r1, side="right") - 1
+        lo = cuk_np[seg0] // bk2
+        hi = (np.maximum(cuk_np[seg1 + 1], cuk_np[seg1] + 1) - 1) // bk2
+        hi = np.maximum(hi, lo)
+        if causal and self_attn:
+            diag = ((i + 1) * bq2 - 1) // bk2
+            hi = np.maximum(np.minimum(hi, diag), lo)
+        n_live = int(np.sum(hi - lo + 1))
+        n_flat_hint = 8
+        while n_flat_hint < n_live:
+            n_flat_hint *= 2
     qh = q.transpose(1, 0, 2)
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)
     o = _flash_varlen(qh, kh, vh, cu_q, cu_k, causal, float(scale),
                       block_q, block_k, bool(self_attn),
-                      int(max_seqlen) if max_seqlen else None)
+                      int(max_seqlen) if max_seqlen else None, n_flat_hint)
     return o.transpose(1, 0, 2)

@@ -251,36 +251,32 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     return _run_op("deform_conv2d", f, tuple(args), {})
 
 
-class DeformConv2D:
+from ..nn.layer.layers import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
     """Layer form of deform_conv2d (ref: vision.ops.DeformConv2D)."""
 
-    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
-                padding=0, dilation=1, deformable_groups=1, groups=1,
-                weight_attr=None, bias_attr=None):
-        from ..nn.layer.layers import Layer
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size if isinstance(kernel_size, (tuple, list))
+              else (kernel_size, kernel_size))
+        self._attrs = dict(stride=stride, padding=padding,
+                           dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([out_channels], attr=bias_attr,
+                                           is_bias=True))
 
-        class _DeformConv2D(Layer):
-            def __init__(self):
-                super().__init__()
-                ks = (kernel_size if isinstance(kernel_size, (tuple, list))
-                      else (kernel_size, kernel_size))
-                self._attrs = dict(stride=stride, padding=padding,
-                                   dilation=dilation,
-                                   deformable_groups=deformable_groups,
-                                   groups=groups)
-                self.weight = self.create_parameter(
-                    [out_channels, in_channels // groups, ks[0], ks[1]],
-                    attr=weight_attr)
-                self.bias = (None if bias_attr is False else
-                             self.create_parameter([out_channels],
-                                                   attr=bias_attr,
-                                                   is_bias=True))
-
-            def forward(self, x, offset, mask=None):
-                return deform_conv2d(x, offset, self.weight, self.bias,
-                                     mask=mask, **self._attrs)
-
-        return _DeformConv2D()
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._attrs)
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,

@@ -1,0 +1,137 @@
+"""The Fleet collective user path driven END TO END (SURVEY.md §3.3,
+BASELINE config 2): fleet.init(strategy with hybrid_configs) ->
+fleet.distributed_model -> fleet.distributed_optimizer -> train step on a
+virtual mesh, asserting loss equivalence with a serial run — the
+reference's public API call stack, not the functional build_train_step
+path."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, LayerDesc, PipelineLayer, RowParallelLinear,
+    VocabParallelEmbedding)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW
+
+VOCAB, HIDDEN, SEQ = 64, 32, 16
+
+
+class _Block(nn.Layer):
+    """GPT-2-style MLP block with megatron column->row sharding."""
+
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(HIDDEN)
+        self.fc_in = ColumnParallelLinear(HIDDEN, 4 * HIDDEN,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(4 * HIDDEN, HIDDEN,
+                                        input_is_parallel=True)
+
+    def forward(self, x):
+        return x + self.fc_out(F.gelu(self.fc_in(self.ln(x))))
+
+
+class _GPT2Tiny(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.emb = VocabParallelEmbedding(VOCAB, HIDDEN)
+        self.block1 = _Block()
+        self.block2 = _Block()
+        self.head = ColumnParallelLinear(HIDDEN, VOCAB, has_bias=False)
+
+    def forward(self, ids):
+        x = self.emb(ids)
+        x = self.block1(x)
+        x = self.block2(x)
+        return self.head(x)
+
+
+def _loss_fn(logits, labels):
+    return F.cross_entropy(
+        logits.reshape([-1, VOCAB]), labels.reshape([-1])).mean()
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, VOCAB, (4, SEQ)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int64)
+    return paddle.to_tensor(ids), paddle.to_tensor(labels)
+
+
+def _serial_losses(n=3):
+    paddle.set_device("cpu")
+    paddle.seed(42)
+    model = _GPT2Tiny()
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, _loss_fn, opt)
+    ids, labels = _batch()
+    return [float(step(ids, labels=labels)) for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def serial_losses():
+    return _serial_losses()
+
+
+def test_fleet_tp2_public_api_matches_serial(serial_losses):
+    """Config 2 of the ladder: GPT-2-tiny under TP=2 through the public
+    fleet API. The compiled step runs over hcg.mesh with the mp axis
+    bound; param shardings must actually carry 'mp'."""
+    paddle.set_device("cpu")
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 2
+
+    paddle.seed(42)
+    model = fleet.distributed_model(_GPT2Tiny())
+    opt = fleet.distributed_optimizer(
+        AdamW(learning_rate=1e-2, parameters=model.parameters()))
+    step = TrainStep(model, _loss_fn, opt, mesh=hcg.mesh,
+                     batch_spec=P("dp"))
+    # mp shardings REALLY bound (not silently replicated)
+    mp_sharded = [k for k, s in step.param_shardings.items()
+                  if any(ax == "mp" for ax in s.spec if ax)]
+    assert mp_sharded, "no parameter carries the mp axis"
+    ids, labels = _batch()
+    losses = [float(step(ids, labels=labels)) for _ in range(3)]
+    np.testing.assert_allclose(losses, serial_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_fleet_pp2_mp2_train_batch_matches_serial(serial_losses):
+    """mp x pp through the full reference call stack: PipelineLayer ->
+    distributed_model (PipelineParallel) -> distributed_optimizer ->
+    train_batch, loss equal to the serial run."""
+    paddle.set_device("cpu")
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(42)
+    descs = [LayerDesc(VocabParallelEmbedding, VOCAB, HIDDEN),
+             LayerDesc(_Block),
+             LayerDesc(_Block),
+             LayerDesc(ColumnParallelLinear, HIDDEN, VOCAB,
+                       has_bias=False)]
+    pipe = PipelineLayer(descs, num_stages=2, loss_fn=_loss_fn)
+    model = fleet.distributed_model(pipe)
+    opt = fleet.distributed_optimizer(
+        AdamW(learning_rate=1e-2, parameters=model.parameters()))
+    ids, labels = _batch()
+    losses = []
+    for _ in range(3):
+        loss = model.train_batch([ids, labels], opt)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, serial_losses, rtol=2e-4, atol=1e-5)
