@@ -37,6 +37,7 @@ from . import autograd  # noqa: F401
 # Subsystems land incrementally during the build; import what exists.
 import importlib as _importlib
 
+from . import sysconfig  # noqa: F401
 from . import version  # noqa: F401
 from . import utils  # noqa: F401
 

@@ -36,6 +36,12 @@ class DistributedStrategy:
         self.lars = False
         self.dgc = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        # PS async / geo-SGD mode (ref: a_sync + a_sync_configs["k_steps"]:
+        # 0 = fully async PS pushes; k > 0 = geo-SGD with per-k-step delta
+        # sync, served by PSClient.init_geo/geo_step)
+        self.a_sync = False
+        self.a_sync_configs = {"k_steps": 0}
         self.find_unused_parameters = False
         self.fuse_grad_size_in_MB = 32
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}

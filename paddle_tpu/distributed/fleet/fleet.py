@@ -64,9 +64,26 @@ class Fleet:
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        """Compose the strategy's meta-optimizer toggles around the user
+        optimizer (ref: the static-graph meta-optimizer stack applies
+        graph rewrites; here each toggle wraps or re-attaches state on
+        the dygraph optimizer): sharding stage 1 -> DygraphSharding,
+        localsgd/dgc -> their wrappers, then the hybrid wrapper with the
+        mesh-aware grad clip."""
         assert self._is_initialized, "call fleet.init first"
-        return HybridParallelOptimizer(optimizer, self._hcg,
-                                       strategy or self._strategy)
+        strategy = strategy or self._strategy
+        if strategy is not None:
+            if getattr(strategy, "sharding", False) and \
+                    int(strategy.sharding_configs.get("stage", 1)) == 1:
+                from .meta_optimizers.dygraph_optimizer \
+                    .hybrid_parallel_optimizer import DygraphShardingOptimizer
+                DygraphShardingOptimizer(optimizer, self._hcg)
+            if getattr(strategy, "localsgd", False):
+                from .meta_optimizers.localsgd_dgc import LocalSGDOptimizer
+                k = getattr(strategy, "localsgd_configs",
+                            {}).get("k_steps", 1)
+                optimizer = LocalSGDOptimizer(optimizer, k_steps=k)
+        return HybridParallelOptimizer(optimizer, self._hcg, strategy)
 
     # -- parameter-server mode (ref: fleet PS role flow:
     # fleet.init(is_collective=False) -> init_server/run_server on PSERVER

@@ -57,6 +57,12 @@ class LocalSGDOptimizer:
     def clear_grad(self):
         self.inner_optimizer.clear_grad()
 
+    def __getattr__(self, item):
+        # delegate everything else (e.g. _grad_clip, _parameter_list) so
+        # the fleet HybridParallelOptimizer can wrap a LocalSGD-wrapped
+        # optimizer transparently
+        return getattr(self.inner_optimizer, item)
+
 
 class DGCMomentumOptimizer:
     """Deep Gradient Compression (Lin et al. 2018; ref: DGCMomentumOptimizer):

@@ -48,7 +48,21 @@ class PipelineParallel(MetaParallelBase):
         mb = b // n
         return [data[i * mb:(i + 1) * mb] for i in range(n)]
 
+    _overlap_warned = False
+
     def forward_backward_pipeline(self, data, scaler=None):
+        if not PipelineParallel._overlap_warned and \
+                self._hcg is not None and \
+                self._hcg.get_pipe_parallel_world_size() > 1:
+            import warnings
+            warnings.warn(
+                "PipelineParallel.train_batch is running the EAGER "
+                "micro-batch loop: numerically identical to 1F1B but with "
+                "no stage overlap. For the pipelined schedule compile the "
+                "step over the pp mesh (paddle_tpu.parallel.pipeline / "
+                "models.llama.build_train_step with pp>1).",
+                stacklevel=3)
+            PipelineParallel._overlap_warned = True
         x, y = data
         n = self.accumulate_steps
         xs = self._split_micro(x, n)

@@ -33,14 +33,24 @@ class PSServer:
         rpc_mod.shutdown()
 
 
-def _merge_duplicates(ids, grads):
-    """Sum grads of duplicate ids; returns (unique_ids, merged_grads)."""
+def _merge_duplicates(ids, grads, extra=None):
+    """Sum grads (and any extra per-id stat arrays) of duplicate ids;
+    returns (unique_ids, merged_grads, merged_extras)."""
     ids = np.asarray(ids, np.int64)
     grads = np.asarray(grads, np.float32)
     uniq, inv = np.unique(ids, return_inverse=True)
     merged = np.zeros((len(uniq),) + grads.shape[1:], np.float32)
     np.add.at(merged, inv, grads)
-    return uniq, merged
+    outs = []
+    for a in (extra or ()):
+        if a is None:
+            outs.append(None)
+            continue
+        a = np.asarray(a, np.float32)
+        m = np.zeros((len(uniq),), np.float32)
+        np.add.at(m, inv, a)
+        outs.append(m)
+    return uniq, merged, outs
 
 
 class PSClient:
@@ -68,6 +78,7 @@ class PSClient:
         self._push_q = None
         self._push_thread = None
         self._push_err = None
+        self._geo = {}
         if async_push:
             self._push_q = _queue.Queue(maxsize=64)
             self._push_thread = threading.Thread(target=self._push_loop,
@@ -135,15 +146,17 @@ class PSClient:
             rows[pos] = part
         return rows
 
-    def push_sparse(self, name, ids, grads, lr=None):
-        uniq, merged = _merge_duplicates(ids, grads)
+    def push_sparse(self, name, ids, grads, lr=None, shows=None,
+                    clicks=None):
+        uniq, merged, (mshows, mclicks) = _merge_duplicates(
+            ids, grads, (shows, clicks))
         if self._push_q is not None:
             self._raise_pending()
-            self._push_q.put((name, uniq, merged, lr))
+            self._push_q.put((name, uniq, merged, lr, mshows, mclicks))
             return True
-        return self._push_now(name, uniq, merged, lr)
+        return self._push_now(name, uniq, merged, lr, mshows, mclicks)
 
-    def _push_now(self, name, uniq, merged, lr):
+    def _push_now(self, name, uniq, merged, lr, shows=None, clicks=None):
         n = len(self.servers)
         futs = []
         for s, srv in enumerate(self.servers):
@@ -153,8 +166,56 @@ class PSClient:
             futs.append(rpc_mod.rpc_async(
                 srv, service.push_sparse,
                 args=(self._shard_name(name, s), uniq[sel].tolist(),
-                      merged[sel], lr)))
+                      merged[sel], lr,
+                      None if shows is None else shows[sel].tolist(),
+                      None if clicks is None else clicks[sel].tolist())))
         return all(f.result() for f in futs)
+
+    def shrink_sparse_table(self, name, score_threshold=0.0, decay=None):
+        """CTR table maintenance: decay show/click stats on every shard and
+        evict rows scoring below the threshold. Returns total evictions."""
+        self.barrier()
+        futs = [rpc_mod.rpc_async(
+                    srv, service.shrink_sparse_table,
+                    args=(self._shard_name(name, s), score_threshold, decay))
+                for s, srv in enumerate(self.servers)]
+        return sum(f.result() for f in futs)
+
+    # -- geo-SGD mode (ref: GeoCommunicator / fleet a_sync_configs) --------
+
+    def init_geo(self, name, shape, sync_steps=4, init="zeros"):
+        """Register a dense table for geo-SGD: workers train LOCALLY and
+        every `sync_steps` geo_step() calls push their parameter DELTA
+        (local - last_synced) to the server (which sums deltas from all
+        workers) and pull the merged global back."""
+        if int(sync_steps) < 1:
+            raise ValueError(
+                f"init_geo: sync_steps must be >= 1, got {sync_steps}; "
+                "k_steps=0 (fully-async PS) is served by "
+                "PSClient(async_push=True) pushes, not geo-SGD")
+        ok = self.create_dense_table(name, list(shape), init=init,
+                                     accessor={"type": "sum"})
+        w = self.pull_dense(name)
+        self._geo[name] = {"last": w.copy(), "k": int(sync_steps),
+                           "count": 0}
+        return ok, w
+
+    def geo_step(self, name, local_w):
+        """Advance one local step; on every k-th call sync with the server.
+        Returns the weights to continue training from (the merged global
+        on sync steps, local_w otherwise)."""
+        st = self._geo[name]
+        st["count"] += 1
+        if st["count"] % st["k"]:
+            return local_w
+        local_w = np.asarray(local_w, np.float32)
+        delta = local_w - st["last"]
+        # dense tables are not sharded (see create_dense_table)
+        rpc_mod.rpc_sync(self.servers[0], service.push_geo_dense,
+                         args=(name, delta))
+        merged = self.pull_dense(name)
+        st["last"] = merged.copy()
+        return merged
 
     def _push_loop(self):
         while True:

@@ -141,11 +141,20 @@ def _get_row(t, key):
 def _accessor_state(kind, shape):
     if kind == "sgd":
         return {}
+    if kind == "sum":
+        # geo-SGD delta table: push ADDS the worker's delta verbatim
+        return {}
     if kind == "adagrad":
         return {"g2": np.zeros(shape, np.float32)}
     if kind == "adam":
         return {"m": np.zeros(shape, np.float32),
                 "v": np.zeros(shape, np.float32), "t": 0}
+    if kind == "ctr":
+        # ref: accessor/ctr_common_accessor — adagrad-style embedding
+        # update plus per-row show/click statistics for admission,
+        # scoring, and shrink
+        return {"g2": np.zeros(shape, np.float32),
+                "show": 0.0, "click": 0.0}
     raise ValueError(f"unknown accessor '{kind}'")
 
 
@@ -154,7 +163,10 @@ def _accessor_apply(acc, w, state, grad):
     if kind == "sgd":
         w -= lr * grad
         return
-    if kind == "adagrad":
+    if kind == "sum":
+        w += grad
+        return
+    if kind in ("adagrad", "ctr"):
         state["g2"] += grad * grad
         w -= lr * grad / (np.sqrt(state["g2"]) + acc.get("eps", 1e-8))
         return
@@ -167,6 +179,14 @@ def _accessor_apply(acc, w, state, grad):
         mhat = state["m"] / (1 - b1 ** state["t"])
         vhat = state["v"] / (1 - b2 ** state["t"])
         w -= lr * mhat / (np.sqrt(vhat) + eps)
+
+
+def _ctr_score(acc, state):
+    """Row score (ref: CtrCommonAccessor::ShowClickScore): weighted
+    show/click mass; shrink evicts rows whose score decays below the
+    threshold."""
+    return (acc.get("show_coeff", 0.2) * state.get("show", 0.0)
+            + acc.get("click_coeff", 1.0) * state.get("click", 0.0))
 
 
 def _norm_accessor(accessor):
@@ -273,19 +293,76 @@ def pull_sparse(name, ids, training=True):
     return out
 
 
-def push_sparse(name, ids, grads, lr=None):
+def push_sparse(name, ids, grads, lr=None, shows=None, clicks=None):
     """Accessor-apply per-row grads. Ids must be unique per call (the client
-    merges duplicates); unadmitted/unknown rows are skipped."""
+    merges duplicates); unadmitted/unknown rows are skipped. shows/clicks
+    (per-id impression/click increments) feed the CTR accessor's row
+    statistics."""
     t = _TABLES[name]
     grads = np.asarray(grads, np.float32)
     with _LOCK:
         acc = dict(t["accessor"])
         if lr is not None:
             acc["lr"] = lr
-        for key, g in zip(ids, grads):
+        for i, (key, g) in enumerate(zip(ids, grads)):
             row = _get_row(t, int(key))
             if row is not None:
                 _accessor_apply(acc, row["w"], row["state"], g)
+                if shows is not None:
+                    row["state"]["show"] = (row["state"].get("show", 0.0)
+                                            + float(shows[i]))
+                if clicks is not None:
+                    row["state"]["click"] = (row["state"].get("click", 0.0)
+                                             + float(clicks[i]))
+    return True
+
+
+def shrink_sparse_table(name, score_threshold=0.0, decay=None):
+    """CTR table maintenance (ref: MemorySparseTable::Shrink): decay every
+    row's show/click statistics (decay defaults to the accessor's
+    show_click_decay_rate, 0.98), then evict rows whose score falls below
+    score_threshold. Returns the number of evicted rows."""
+    t = _TABLES[name]
+    evicted = 0
+    with _LOCK:
+        acc = t["accessor"]
+        d = decay if decay is not None else acc.get("show_click_decay_rate",
+                                                    0.98)
+        spill = t.get("spill")
+        for key in list(t["rows"].keys()):
+            st = t["rows"][key]["state"]
+            st["show"] = st.get("show", 0.0) * d
+            st["click"] = st.get("click", 0.0) * d
+            if _ctr_score(acc, st) < score_threshold:
+                t["rows"].pop(key, None)
+                t["counts"].pop(key, None)
+                evicted += 1
+        if spill is not None:
+            # cold tier: read WITHOUT promoting (promotion would LRU-churn
+            # ~the whole hot tier and rewrite the append-only log once per
+            # cold row); survivors write back in place of their old record
+            for key in [k for k in spill.keys() if k not in t["rows"]]:
+                row = spill.get(key)
+                if row is None:
+                    continue
+                st = row["state"]
+                st["show"] = st.get("show", 0.0) * d
+                st["click"] = st.get("click", 0.0) * d
+                if _ctr_score(acc, st) < score_threshold:
+                    spill.pop(key)
+                    t["counts"].pop(key, None)
+                    evicted += 1
+                else:
+                    spill.put(key, row)
+    return evicted
+
+
+def push_geo_dense(name, delta):
+    """geo-SGD merge (ref: GeoCommunicator): the worker's parameter DELTA
+    since its last sync is summed into the global dense weights."""
+    t = _TABLES[name]
+    with _LOCK:
+        t["data"] += np.asarray(delta, np.float32)
     return True
 
 

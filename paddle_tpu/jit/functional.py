@@ -116,20 +116,33 @@ def _layer_host_guard(layer: Layer):
 
 def _fn_host_guard(fn):
     """Snapshot of a function's captured host values: closure cells and
-    module globals it names, restricted to plain-python types."""
+    module globals it names. Plain-python values enter the guard key;
+    functions/modules/types are treated as stable; ANY other captured
+    value (list, dict, array, object) makes the function UNCACHEABLE
+    (returns None) — a mutable capture can change without changing
+    identity, and a stale replay is worse than a rebuild."""
+    import types as _t
+    stable = (_t.FunctionType, _t.BuiltinFunctionType, _t.ModuleType, type)
     snap = []
     code = fn.__code__
+
+    def visit(name, v, kind):
+        if isinstance(v, _GUARD_TYPES):
+            snap.append((kind, name, v))
+            return True
+        return isinstance(v, stable)
+
     for name, cell in zip(code.co_freevars, fn.__closure__ or ()):
         try:
             v = cell.cell_contents
         except ValueError:  # pragma: no cover - unfilled cell
             continue
-        if isinstance(v, _GUARD_TYPES):
-            snap.append(("cell", name, v))
+        if not visit(name, v, "cell"):
+            return None
     g = fn.__globals__
     for name in code.co_names:
-        if name in g and isinstance(g[name], _GUARD_TYPES):
-            snap.append(("global", name, g[name]))
+        if name in g and not visit(name, g[name], "global"):
+            return None
     return tuple(snap)
 
 
@@ -198,12 +211,15 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         @functools.wraps(obj)
         def wrapper(*args, **kwargs):
             arrs = tuple(a._data if isinstance(a, Tensor) else a for a in args)
-            try:
-                key = (_fn_host_guard(obj),
-                       tuple(sorted(kwargs.items())))
-                hash(key)  # sorted() doesn't hash values; probe now
-            except TypeError:  # unhashable/unorderable kwarg: don't cache
+            guard = _fn_host_guard(obj)
+            if guard is None:  # mutable capture: never cache (see guard)
                 key = None
+            else:
+                try:
+                    key = (guard, tuple(sorted(kwargs.items())))
+                    hash(key)  # sorted() doesn't hash values; probe now
+                except TypeError:  # unhashable/unorderable kwarg
+                    key = None
 
             def build():
                 def fn(arg_arrays):

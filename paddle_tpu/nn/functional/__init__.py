@@ -28,6 +28,7 @@ from .pooling import (avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
                       lp_pool1d, lp_pool2d)
 from .norm import (layer_norm, batch_norm, instance_norm, group_norm,
                    rms_norm, local_response_norm, normalize)
+from .loss import margin_cross_entropy, class_center_sample  # noqa
 from .loss import (cross_entropy, softmax_with_cross_entropy, mse_loss,
                    l1_loss, nll_loss, binary_cross_entropy,
                    binary_cross_entropy_with_logits, smooth_l1_loss,

@@ -545,3 +545,81 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     args = (input, label, weight, path_table, path_code) + \
         ((bias,) if bias is not None else ())
     return _run_op("hsigmoid_custom", f, args, {})
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (ref: paddle.nn.functional.
+    margin_cross_entropy / phi margin_cross_entropy kernel): the target
+    class logit cos(theta) is replaced by
+    cos(margin1*theta + margin2) - margin3, everything scaled by `scale`.
+
+    Single-controller note: the reference shards classes across model-
+    parallel ranks and allreduces the softmax statistics; under GSPMD a
+    class-sharded logits array composes the same way via constraint
+    specs, so this computes the full formula directly."""
+    import jax.numpy as jnp
+
+    from ...tensor.tensor import Tensor, _run_op
+
+    def f(lg, lb):
+        lgf = lg.astype(jnp.float32)
+        lb_ = lb.reshape(-1)
+        cos = jnp.clip(lgf, -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lb_, lg.shape[-1], dtype=jnp.float32)
+        adj = jnp.where(onehot > 0, target, cos) * scale
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        loss = -jnp.take_along_axis(logp, lb_[:, None], axis=-1)[:, 0]
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss[:, None]
+        return loss_out, jax.nn.softmax(adj, axis=-1)
+
+    import jax
+    if return_softmax:
+        # one multi-output op: loss and softmax share the forward pass
+        return _run_op("margin_cross_entropy", f, (logits, label), {})
+    out = _run_op("margin_cross_entropy", lambda a, b: f(a, b)[0],
+                  (logits, label), {})
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """ref: paddle.nn.functional.class_center_sample (PLSC partial-FC):
+    sample `num_samples` class centers — always including every positive
+    class in `label` — and remap labels into the sampled index space.
+    Returns (remapped_label, sampled_class_center_index).
+
+    Eager host op (like the reference's CPU path): the sampled index set
+    has a data-dependent composition; the OUTPUT shapes are static
+    (num_samples is the cap, padded with negative-class ids)."""
+    import numpy as np
+
+    from ...tensor.tensor import Tensor
+
+    lb = np.asarray(getattr(label, "_data", label)).reshape(-1)
+    pos = np.unique(lb)
+    # fresh negatives every call (the reference samples per step), seeded
+    # from the framework stream so paddle.seed reproduces runs
+    from ...framework import random as _random
+    rng = np.random.default_rng(
+        int(np.asarray(_random.next_key())[-1]))
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos,
+                                assume_unique=True)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    import jax.numpy as jnp
+    return (Tensor(jnp.asarray(remap[lb])),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))

@@ -1,0 +1,13 @@
+"""ref: paddle.sysconfig — include/lib dirs for building native extensions
+against the framework (here: the csrc C-ABI runtime)."""
+import os
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "csrc")

@@ -157,6 +157,16 @@ def _scatter_(x, index, updates, overwrite=True):
                                           overwrite=overwrite))
 
 
+def _masked_fill_(x, mask, value, name=None):
+    return _inplace_taped(
+        x, lambda a: manipulation.masked_fill(a, mask, value))
+
+
+def _index_fill_(x, index, axis, value, name=None):
+    return _inplace_taped(
+        x, lambda a: manipulation.index_fill(a, index, axis, value))
+
+
 def _fill_key(seed):
     from ..framework import random as _random
     import jax as _jax
@@ -219,6 +229,8 @@ def _cauchy_(x, loc=0.0, scale=1.0, name=None):
 Tensor.unsqueeze_ = _unsqueeze_
 Tensor.flatten_ = _flatten_
 Tensor.scatter_ = _scatter_
+Tensor.masked_fill_ = _masked_fill_
+Tensor.index_fill_ = _index_fill_
 Tensor.uniform_ = _uniform_
 Tensor.normal_ = _normal_
 Tensor.bernoulli_ = _bernoulli_

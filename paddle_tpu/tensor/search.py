@@ -99,3 +99,18 @@ def masked_argmax(x, mask, axis=None, keepdim=False):
         neg = jnp.finfo(a.dtype).min if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
         return jnp.argmax(jnp.where(m, a, neg), axis=axis).astype(np.int64)
     return _run_op("masked_argmax", f, (x, mask), {})
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """ref: paddle.isin — elementwise membership of x in test_x."""
+    import jax.numpy as jnp
+
+    from .tensor import _run_op
+
+    def f(a, t):
+        return jnp.isin(a, t, assume_unique=assume_unique, invert=invert)
+
+    from .tensor import Tensor
+    if not isinstance(test_x, Tensor):
+        test_x = Tensor(jnp.asarray(test_x))
+    return _run_op("isin", f, (x, test_x), {})

@@ -7,6 +7,8 @@ import functools
 import warnings
 
 from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
 from .layers_utils import flatten, map_structure, pack_sequence_as  # noqa: F401
 
 

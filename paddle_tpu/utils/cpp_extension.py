@@ -1,0 +1,73 @@
+"""ref: paddle.utils.cpp_extension — build and load custom C++ operators.
+
+The reference compiles pybind/ops against libpaddle; here extensions are
+plain C shared libraries loaded through ctypes (the same C-ABI contract
+as paddle_tpu.runtime's csrc). CUDA sources are rejected — device compute
+belongs in Pallas/XLA kernels on this backend.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+from .. import sysconfig
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False, **kwargs):
+    """Compile `sources` into lib{name}.so and return a ctypes.CDLL."""
+    import ctypes
+    for s in sources:
+        if str(s).endswith((".cu", ".cuh")):
+            raise ValueError(
+                "cpp_extension: CUDA sources are not supported on the TPU "
+                "backend; write device compute as Pallas/XLA kernels and "
+                "keep C++ for host-side runtime work")
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{sysconfig.get_include()}"]
+    for inc in (extra_include_paths or []):
+        cmd.append(f"-I{inc}")
+    cmd += list(extra_cxx_cflags or [])
+    cmd += [str(s) for s in sources] + ["-o", out]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)
+
+
+class CppExtension:
+    """setup()-style extension description (ref: CppExtension). Carries
+    the arguments; build via `load` or standard setuptools."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.args = args
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*a, **k):
+    raise ValueError(
+        "CUDAExtension is not supported on the TPU backend; use "
+        "CppExtension for host code and Pallas kernels for device compute")
+
+
+def setup(**kwargs):
+    """Minimal parity shim: delegates to setuptools.setup."""
+    import setuptools
+    ext = kwargs.pop("ext_modules", None)
+    if ext:
+        mods = []
+        for e in ext:
+            if isinstance(e, CppExtension):
+                mods.append(setuptools.Extension(
+                    kwargs.get("name", "paddle_ext"), e.sources,
+                    include_dirs=[sysconfig.get_include()]))
+            else:
+                mods.append(e)
+        kwargs["ext_modules"] = mods
+    return setuptools.setup(**kwargs)

@@ -1,0 +1,45 @@
+"""ref: paddle.utils.dlpack — tensor exchange via the DLPack protocol.
+
+TPU note: the PJRT TPU client does not export device buffers through
+DLPack (no external-reference support), so export goes through a host
+copy (numpy speaks DLPack natively); imports of host-resident producers
+(numpy, cpu torch) transfer to the current device on first use like any
+other host array.
+"""
+from __future__ import annotations
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule (host-copy export; see module note)."""
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+    data = x._data if isinstance(x, Tensor) else x
+    # np.array (not asarray): the view of a jax buffer is readonly, which
+    # numpy's DLPack exporter refuses to signal
+    return np.array(data).__dlpack__()
+
+
+class _CapsuleWrapper:
+    """Adapts a raw PyCapsule to the object-protocol consumers expect."""
+
+    def __init__(self, cap):
+        self._cap = cap
+
+    def __dlpack__(self, **kwargs):
+        return self._cap
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(capsule):
+    """DLPack capsule (or any __dlpack__-bearing object, e.g. a torch or
+    numpy array) -> Tensor."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+    if not hasattr(capsule, "__dlpack__"):
+        capsule = _CapsuleWrapper(capsule)
+    return Tensor(jnp.asarray(np.from_dlpack(capsule)))

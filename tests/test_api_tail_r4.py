@@ -341,3 +341,86 @@ def test_geometric_cauchy_inplace():
     t2.cauchy_(loc=1.0, scale=2.0)
     med = np.median(np.asarray(t2._data))
     assert abs(med - 1.0) < 0.3              # median of Cauchy = loc
+
+
+# -- round-4 second sweep: PS geo/CTR covered in test_rpc_ps; misc tail ------
+
+def test_isin_and_inplace_fills():
+    t = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64))
+    r = paddle.isin(t, paddle.to_tensor(np.array([2, 4], np.int64)))
+    np.testing.assert_array_equal(np.asarray(r._data),
+                                  [False, True, False, True])
+    r2 = paddle.isin(t, paddle.to_tensor(np.array([2], np.int64)),
+                     invert=True)
+    np.testing.assert_array_equal(np.asarray(r2._data),
+                                  [True, False, True, True])
+    x = paddle.zeros([2, 3])
+    x.masked_fill_(paddle.to_tensor(np.array([[True, False, True]] * 2)),
+                   5.0)
+    np.testing.assert_allclose(np.asarray(x._data),
+                               [[5, 0, 5], [5, 0, 5]])
+    x.index_fill_(paddle.to_tensor(np.array([0], np.int64)), 1, 9.0)
+    np.testing.assert_allclose(np.asarray(x._data)[:, 0], [9, 9])
+
+
+def test_inplace_fill_grads_flow():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    x.stop_gradient = False
+    y = x * 2.0
+    y.masked_fill_(paddle.to_tensor(np.array([[True, False, False]] * 2)),
+                   0.0)
+    loss = paddle.sum(y)
+    loss.backward()
+    # filled positions contribute no grad; others get d(2x)/dx = 2
+    np.testing.assert_allclose(np.asarray(x.grad._data),
+                               [[0, 2, 2], [0, 2, 2]])
+
+
+def test_margin_cross_entropy():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    logits = paddle.to_tensor((rng.rand(6, 10).astype(np.float32) - 0.5)
+                              * 1.8)
+    label = paddle.to_tensor(rng.randint(0, 10, (6,)).astype(np.int64))
+    # margins (1, 0, 0) degenerate to plain CE on scale*logits
+    loss = F.margin_cross_entropy(logits, label, margin1=1.0, margin2=0.0,
+                                  margin3=0.0, scale=4.0)
+    ref = F.cross_entropy(logits * 4.0, label).mean()
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-4)
+    loss_m, sm = F.margin_cross_entropy(logits, label, margin2=0.5,
+                                        scale=4.0, return_softmax=True)
+    assert float(loss_m) > float(loss)  # margin shrinks the target logit
+    np.testing.assert_allclose(np.asarray(sm._data).sum(-1),
+                               np.ones(6), rtol=1e-5)
+
+
+def test_class_center_sample():
+    import paddle_tpu.nn.functional as F
+    label = paddle.to_tensor(np.array([3, 7, 3, 1], np.int64))
+    remap, sampled = F.class_center_sample(label, 20, 8)
+    s = np.asarray(sampled._data)
+    r = np.asarray(remap._data)
+    assert s.shape == (8,) and len(set(s.tolist())) == 8
+    assert {1, 3, 7} <= set(s.tolist())           # positives always kept
+    for i, l in enumerate([3, 7, 3, 1]):
+        assert s[r[i]] == l                       # remap consistency
+
+
+def test_dlpack_roundtrip_and_torch_import():
+    import torch
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    t2 = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_allclose(np.asarray(t2._data), np.arange(6))
+    t3 = paddle.utils.dlpack.from_dlpack(torch.arange(4,
+                                                      dtype=torch.float32))
+    np.testing.assert_allclose(np.asarray(t3._data), [0, 1, 2, 3])
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "ext.cc"
+    src.write_text('extern "C" int mul_ints(int a, int b) { return a * b; }')
+    lib = paddle.utils.cpp_extension.load(
+        "t_ext", [str(src)], build_directory=str(tmp_path))
+    assert lib.mul_ints(6, 7) == 42
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_include())

@@ -135,3 +135,36 @@ def test_fleet_pp2_mp2_train_batch_matches_serial(serial_losses):
         loss = model.train_batch([ids, labels], opt)
         losses.append(float(loss))
     np.testing.assert_allclose(losses, serial_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_distributed_optimizer_honors_strategy_toggles():
+    """The strategy's meta-optimizer toggles compose around the user
+    optimizer: sharding stage 1 attaches ZeRO-1 opt-state specs,
+    localsgd wraps with the k-step parameter-averaging optimizer."""
+    from paddle_tpu.distributed.fleet.meta_optimizers.localsgd_dgc import (
+        LocalSGDOptimizer)
+    paddle.set_device("cpu")
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 2}
+    strategy.sharding = True
+    strategy.sharding_configs = {"stage": 1, "degree": 2}
+    strategy.localsgd = True
+    strategy.localsgd_configs = {"k_steps": 3}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = nn.Linear(8, 16)
+    opt = fleet.distributed_optimizer(
+        AdamW(learning_rate=1e-2, parameters=model.parameters()))
+    assert isinstance(opt._inner_opt, LocalSGDOptimizer)
+    assert opt._inner_opt.k_steps == 3
+    specs = [getattr(p, "opt_state_pspec", None)
+             for p in model.parameters() if not p.stop_gradient]
+    assert any(s is not None for s in specs), "ZeRO-1 specs not attached"
+    # the wrapped stack still trains eagerly
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    loss = paddle.mean(model(x) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()

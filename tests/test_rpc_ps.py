@@ -222,3 +222,75 @@ def test_fleet_ps_mode_cross_process(tmp_path):
         srv.wait(timeout=10)
         os.environ.clear()
         os.environ.update(saved_env)
+
+
+def test_ctr_accessor_stats_and_shrink():
+    """CTR sparse table (ref: ctr_common_accessor): pushes carry show/click
+    increments; shrink decays the stats and evicts low-score rows."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PSClient, service
+    service._TABLES.clear()
+    port = _free_port()
+    rpc.init_rpc("ps_server:0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    client = PSClient("ps_server:0")
+    assert client.create_sparse_table(
+        "ctr_emb", 4, accessor={"type": "ctr", "lr": 0.1,
+                                "show_coeff": 0.2, "click_coeff": 1.0})
+    client.pull_sparse("ctr_emb", [1, 2])     # materialize rows
+    g = np.ones((2, 4), np.float32)
+    # row 1: hot (many shows + clicks); row 2: cold
+    client.push_sparse("ctr_emb", [1, 2], g, shows=[100.0, 1.0],
+                       clicks=[10.0, 0.0])
+    t = service._TABLES["ctr_emb"]
+    assert t["rows"][1]["state"]["show"] == 100.0
+    assert t["rows"][1]["state"]["click"] == 10.0
+    # duplicate-id merge sums the stats too
+    client.push_sparse("ctr_emb", [1, 1], np.zeros((2, 4), np.float32),
+                       shows=[1.0, 2.0], clicks=[0.0, 1.0])
+    assert t["rows"][1]["state"]["show"] == 103.0
+    assert t["rows"][1]["state"]["click"] == 11.0
+    # shrink: decay 0.5, threshold 1.0 -> cold row 2 evicted, hot row 1 kept
+    evicted = client.shrink_sparse_table("ctr_emb", score_threshold=1.0,
+                                         decay=0.5)
+    assert evicted == 1
+    assert 1 in t["rows"] and 2 not in t["rows"]
+    assert t["rows"][1]["state"]["show"] == 103.0 * 0.5
+    rpc.shutdown()
+    service._TABLES.clear()
+
+
+def test_geo_sgd_two_workers():
+    """geo-SGD (ref: GeoCommunicator): two workers train locally and sync
+    their parameter deltas every k steps; both converge to the merged
+    global weights containing each other's updates."""
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PSClient, service
+    service._TABLES.clear()
+    port = _free_port()
+    rpc.init_rpc("ps_server:0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{port}")
+    a = PSClient("ps_server:0")
+    b = PSClient("ps_server:0")
+    _, w_a = a.init_geo("geo_w", [2, 2], sync_steps=2)
+    _, w_b = b.init_geo("geo_w", [2, 2], sync_steps=2)
+
+    # worker A: two local steps of +1 each; second geo_step syncs
+    w_a = w_a + 1.0
+    w_a = a.geo_step("geo_w", w_a)          # step 1: local only
+    w_a = w_a + 1.0
+    w_a = a.geo_step("geo_w", w_a)          # step 2: pushes delta=+2, pulls
+    np.testing.assert_allclose(w_a, np.full((2, 2), 2.0))
+
+    # worker B trained in parallel from the ORIGINAL zeros: -1 per step
+    w_b = w_b - 1.0
+    w_b = b.geo_step("geo_w", w_b)
+    w_b = w_b - 1.0
+    w_b = b.geo_step("geo_w", w_b)          # pushes delta=-2 onto A's +2
+    np.testing.assert_allclose(w_b, np.zeros((2, 2)))
+    # A's next sync sees B's contribution merged in
+    w_a = a.geo_step("geo_w", w_a)
+    w_a = a.geo_step("geo_w", w_a)          # delta 0, pulls merged global
+    np.testing.assert_allclose(w_a, np.zeros((2, 2)))
+    rpc.shutdown()
+    service._TABLES.clear()
