@@ -15,11 +15,16 @@ import jax as _jax
 _jax.config.update("jax_enable_x64", True)
 
 from .framework import set_printoptions  # noqa: F401
+from .framework import LazyGuard, batch  # noqa: F401
+from .framework.random import (  # noqa: F401
+    get_cuda_rng_state, set_cuda_rng_state)
 from .framework import (  # noqa: F401
     CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
     set_device, get_device, device_count, get_flags, set_flags, seed,
     get_rng_state, set_rng_state, set_default_dtype, get_default_dtype,
     is_compiled_with_cuda, is_compiled_with_tpu,
+    is_compiled_with_xpu, is_compiled_with_rocm,
+    is_compiled_with_custom_device,
 )
 from .framework.dtype import iinfo, finfo  # noqa: F401
 from .framework.dtype import (  # noqa: F401

@@ -105,3 +105,15 @@ class cuda:  # namespace shim: paddle.device.cuda
     @staticmethod
     def empty_cache():
         return None
+
+
+def get_all_custom_device_type():
+    """ref: paddle.device.get_all_custom_device_type — device types
+    registered through the plugin (PJRT) mechanism."""
+    kinds = []
+    import jax
+    for d in jax.devices():
+        k = getattr(d, "platform", "")
+        if k not in ("cpu", "gpu") and k not in kinds:
+            kinds.append(k)
+    return kinds

@@ -11,7 +11,10 @@ from . import communication
 from .communication import stream
 from .communication import (Group, P2POp, ReduceOp, all_gather, all_reduce,
                             batch_isend_irecv, gather,
-                            all_to_all_single, alltoall, barrier, broadcast,
+                            all_gather_into_tensor, all_to_all_single,
+                            alltoall, barrier, broadcast,
+                            destroy_process_group, get_backend,
+                            monitored_barrier, reduce_scatter_tensor,
                             get_group, irecv, isend, new_group, ppermute,
                             recv, reduce, reduce_scatter, scatter, send)
 from .env import (get_rank, get_world_size, init_parallel_env, is_initialized,
@@ -20,6 +23,7 @@ from .parallel import DataParallel, spawn
 from . import checkpoint
 from . import rpc
 from . import ps
+from . import utils
 from . import auto_parallel
 from .auto_parallel.api import (shard_tensor, Shard, Replicate, Partial,
                                 ProcessMesh, reshard)

@@ -317,3 +317,45 @@ def batch_isend_irecv(p2p_op_list):
 
 
 from . import stream  # noqa: E402  (cyclic-safe: stream imports lazily)
+
+
+def get_backend(group=None):
+    """ref: paddle.distributed.get_backend — the collective backend name.
+    XLA collectives over ICI/DCN stand in for the reference's NCCL/GLOO."""
+    return "XLA"
+
+
+def destroy_process_group(group=None):
+    """ref: destroy_process_group. Groups are mesh-axis views with no
+    owned OS resources; dropping the default group reference suffices."""
+    global _default_group
+    if group is None or group is _default_group:
+        _default_group = None
+    return True
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """ref: monitored_barrier — barrier that surfaces straggler failures.
+    Multi-host sync_global_devices raises on peer failure, which is the
+    monitored property."""
+    return barrier(group)
+
+
+def all_gather_into_tensor(output, input, group=None, sync_op=True):
+    """ref: all_gather_into_tensor (tensor form: output holds the
+    concatenated result)."""
+    res = all_gather(input, group=group)
+    if isinstance(output, Tensor):
+        output._data = res._data
+        return output
+    return res
+
+
+def reduce_scatter_tensor(output, input, op=ReduceOp.SUM, group=None,
+                          sync_op=True):
+    """ref: reduce_scatter_tensor (tensor form)."""
+    res = reduce_scatter(input, op=op, group=group)
+    if isinstance(output, Tensor):
+        output._data = res._data
+        return output
+    return res

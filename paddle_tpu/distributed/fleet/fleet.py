@@ -77,7 +77,7 @@ class Fleet:
                     int(strategy.sharding_configs.get("stage", 1)) == 1:
                 from .meta_optimizers.dygraph_optimizer \
                     .hybrid_parallel_optimizer import DygraphShardingOptimizer
-                DygraphShardingOptimizer(optimizer, self._hcg)
+                optimizer = DygraphShardingOptimizer(optimizer, self._hcg)
             if getattr(strategy, "localsgd", False):
                 from .meta_optimizers.localsgd_dgc import LocalSGDOptimizer
                 k = getattr(strategy, "localsgd_configs",

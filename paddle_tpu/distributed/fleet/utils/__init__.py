@@ -1,1 +1,2 @@
 from . import hybrid_parallel_util, sequence_parallel_utils
+from ..recompute.recompute import recompute  # noqa: F401

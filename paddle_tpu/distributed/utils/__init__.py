@@ -1,0 +1,60 @@
+"""ref: paddle.distributed.utils — MoE token-exchange primitives
+(global_scatter / global_gather, the reference's expert-parallel ragged
+all-to-all from distributed/utils/moe_utils.py).
+
+TPU-native stance: XLA collectives are static-shape, so ragged token
+exchange does not lower to a single collective; the first-class
+expert-parallel path (paddle_tpu.parallel.moe) instead dispatches into
+CAPACITY-PADDED buckets whose all-to-all is static — the design the
+reference's gshard lineage also uses on TPU. These functions provide the
+reference's eager single-world semantics (used by its unit tests and
+single-rank paths) and point multi-rank callers at parallel.moe.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _counts(x):
+    return np.asarray(getattr(x, "_data", x)).astype(np.int64).ravel()
+
+
+def _world(group):
+    return group.nranks if group is not None else 1
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    """Tokens of x (grouped by destination expert, sizes in local_count)
+    are exchanged so each rank holds the tokens for ITS experts (sizes in
+    global_count). World size 1: the exchange is the identity on the
+    token block (validated against the counts)."""
+    lc, gc = _counts(local_count), _counts(global_count)
+    if _world(group) > 1:
+        raise NotImplementedError(
+            "ragged global_scatter has no static-shape XLA lowering; "
+            "multi-rank expert parallelism on TPU uses the capacity-"
+            "bucketed dispatch in paddle_tpu.parallel.moe (all_to_all "
+            "over the 'ep' mesh axis)")
+    total = int(lc.sum())
+    if int(gc.sum()) != total:
+        raise ValueError(
+            f"global_scatter: local_count sums to {total} but "
+            f"global_count sums to {int(gc.sum())}")
+    return x[:total] if total != x.shape[0] else x
+
+
+def global_gather(x, local_count, global_count, group=None):
+    """Inverse of global_scatter (experts' outputs return to the token
+    owners)."""
+    lc, gc = _counts(local_count), _counts(global_count)
+    if _world(group) > 1:
+        raise NotImplementedError(
+            "ragged global_gather has no static-shape XLA lowering; "
+            "multi-rank expert parallelism on TPU uses "
+            "paddle_tpu.parallel.moe")
+    total = int(gc.sum())
+    if int(lc.sum()) != total:
+        raise ValueError(
+            f"global_gather: global_count sums to {total} but "
+            f"local_count sums to {int(lc.sum())}")
+    return x[:total] if total != x.shape[0] else x

@@ -4,7 +4,9 @@ from .dtype import (DType, convert_dtype, to_framework_dtype, get_default_dtype,
                     set_default_dtype)
 from .place import (Place, CPUPlace, TPUPlace, GPUPlace, CUDAPlace, CustomPlace,
                     set_device, get_device, device_count,
-                    is_compiled_with_cuda, is_compiled_with_tpu)
+                    is_compiled_with_cuda, is_compiled_with_tpu,
+                    is_compiled_with_xpu, is_compiled_with_rocm,
+                    is_compiled_with_custom_device)
 from .flags import define_flag, get_flags, get_flag, set_flags
 from .random import seed, get_rng_state, set_rng_state, get_rng_state_tracker
 
@@ -26,3 +28,32 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     if sci_mode is not None:
         kw["suppress"] = not sci_mode
     _np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """ref: paddle.LazyGuard — defer parameter materialization during
+    Layer construction. Functional-runtime note: parameters here are jax
+    arrays whose initialization is itself a traced/jit-able computation;
+    there is no separate lazy-init graph to stage, so the guard simply
+    scopes (construction proceeds eagerly with the same semantics the
+    reference observes after its .initialize())."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: paddle.batch — wrap a sample reader into a batch reader."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

@@ -113,3 +113,19 @@ def is_compiled_with_tpu() -> bool:
 
 def device_count() -> int:
     return jax.device_count()
+
+
+def is_compiled_with_xpu() -> bool:  # parity shim
+    return False
+
+
+def is_compiled_with_rocm() -> bool:  # parity shim
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str = None) -> bool:
+    """The TPU backend registers through PJRT — the plugin mechanism the
+    reference's custom-device API describes."""
+    if device_type is None:
+        return True
+    return device_type.lower() in ("tpu", "axon")

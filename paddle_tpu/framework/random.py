@@ -118,3 +118,13 @@ def model_parallel_random_seed(seed_: int, mp_rank: int = 0):
     _tracker = RNGStatesTracker()
     _tracker.add("global_seed", seed_)
     _tracker.add("local_seed", seed_ + 1024 + mp_rank)
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (ref: get_cuda_rng_state; one stream serves
+    all devices under the functional-key design)."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)

@@ -1,5 +1,6 @@
 """Incubate: experimental API surface (ref: python/paddle/incubate/)."""
 from . import asp
+from . import autograd
 from . import distributed
 from . import nn
 from . import optimizer

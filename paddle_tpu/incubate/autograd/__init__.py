@@ -1,0 +1,25 @@
+"""ref: paddle.incubate.autograd — the prim/forward-AD API. The reference
+lowers to primitive ops and transposes them; jax's jvp/vjp ARE that
+machinery, so the API maps directly.
+"""
+from __future__ import annotations
+
+from . import primapi  # noqa: F401
+from .primapi import forward_grad, grad  # noqa: F401
+
+
+_PRIM_ENABLED = False
+
+
+def prim_enabled():
+    return _PRIM_ENABLED
+
+
+def enable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = True
+
+
+def disable_prim():
+    global _PRIM_ENABLED
+    _PRIM_ENABLED = False

@@ -1,0 +1,48 @@
+"""ref: python/paddle/incubate/autograd/primapi.py — forward_grad (JVP)
+and grad over the primitive system. TPU-native: jax.jvp / the existing
+reverse-mode tape."""
+from __future__ import annotations
+
+
+def _unwrap(xs):
+    from ...tensor.tensor import Tensor
+    single = isinstance(xs, Tensor)
+    lst = [xs] if single else list(xs)
+    return single, [t._data for t in lst]
+
+
+def forward_grad(outputs_fn_or_outputs, inputs, grad_inputs=None):
+    """Forward-mode derivatives (JVP). Callable form:
+    forward_grad(fn, inputs, tangents) -> (outputs, output_tangents);
+    the reference's static form (outputs, inputs) is served by the same
+    call with fn reconstructed from the tape — pass a callable here."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...tensor.tensor import Tensor
+    if not callable(outputs_fn_or_outputs):
+        raise TypeError(
+            "forward_grad takes a callable on this backend (the static-"
+            "program form has no separate primitive IR): "
+            "forward_grad(fn, inputs, tangents)")
+    fn = outputs_fn_or_outputs
+    single, xs = _unwrap(inputs)
+    if grad_inputs is None:
+        vs = [jnp.ones_like(x) for x in xs]
+    else:
+        _, vs = _unwrap(grad_inputs)
+
+    def raw(*arrays):
+        args = [Tensor._from_data(a) for a in arrays]
+        out = fn(*args) if not single else fn(args[0])
+        return out._data if isinstance(out, Tensor) else out
+
+    y, yd = jax.jvp(raw, tuple(xs), tuple(vs))
+    return Tensor(y), Tensor(yd)
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode gradients (ref: primapi.grad): same contract as
+    paddle.grad over the eager tape."""
+    from ...autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs)

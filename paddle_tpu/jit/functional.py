@@ -130,6 +130,10 @@ def _fn_host_guard(fn):
         if isinstance(v, _GUARD_TYPES):
             snap.append((kind, name, v))
             return True
+        if isinstance(v, (tuple, list)) and \
+                all(isinstance(e, _GUARD_TYPES) for e in v):
+            snap.append((kind, name, tuple(v)))
+            return True
         return isinstance(v, stable)
 
     for name, cell in zip(code.co_freevars, fn.__closure__ or ()):

@@ -610,8 +610,13 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     from ...framework import random as _random
     rng = np.random.default_rng(
         int(np.asarray(_random.next_key())[-1]))
-    if len(pos) >= num_samples:
-        sampled = pos[:num_samples]
+    if len(pos) > num_samples:
+        raise ValueError(
+            f"class_center_sample: num_samples={num_samples} is smaller "
+            f"than the {len(pos)} distinct positive classes in the batch "
+            "— every positive must be kept; raise num_samples")
+    if len(pos) == num_samples:
+        sampled = pos
     else:
         neg_pool = np.setdiff1d(np.arange(num_classes), pos,
                                 assume_unique=True)

@@ -1,5 +1,6 @@
 """Optimizers and LR schedulers (ref: python/paddle/optimizer/)."""
 from . import lr
 from .optimizer import Optimizer
-from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+from .optimizers import (ASGD, NAdam, RAdam, Rprop,  # noqa: F401
+                         SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
                          LarsMomentum, Momentum, RMSProp)

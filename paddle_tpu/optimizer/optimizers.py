@@ -306,3 +306,138 @@ class LarsMomentum(Optimizer):
             lr)
         v = self._momentum * state["velocity"] + local * (g32 + lars_wd * p32)
         return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (ref: paddle.optimizer.Rprop): per-element step
+    sizes grow when the gradient keeps its sign and shrink when it flips;
+    only the SIGN of the gradient is used."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _create_accumulators(self, p):
+        return {"prev_grad": jnp.zeros(p._data.shape, jnp.float32),
+                "step": jnp.full(p._data.shape, float(self._lr_value()),
+                                 jnp.float32)}
+
+    def _lr_value(self):
+        lr = self._learning_rate
+        return lr.get_lr() if hasattr(lr, "get_lr") else lr
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        sign = jnp.sign(g32 * state["prev_grad"])
+        step = jnp.clip(
+            jnp.where(sign > 0, state["step"] * self._eta_pos,
+                      jnp.where(sign < 0, state["step"] * self._eta_neg,
+                                state["step"])),
+            self._lr_min, self._lr_max)
+        # on a sign flip the pending update is skipped and the stored
+        # gradient zeroed (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        new_p = _f32(p) - jnp.sign(g_eff) * step
+        return new_p.astype(p.dtype), {"prev_grad": g_eff, "step": step}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (ref: paddle.optimizer.ASGD): plain SGD steps plus a
+    running average of the iterates (the averaged weights live in the
+    accumulator; `averaged(p)` reads them)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._batch_num = batch_num
+
+    def _create_accumulators(self, p):
+        return {"avg": _f32(p), "t": jnp.zeros((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        new_p = _f32(p) - lr * g32
+        t = state["t"] + 1
+        avg = state["avg"] + (new_p - state["avg"]) / t
+        return new_p.astype(p.dtype), {"avg": avg, "t": t}
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (ref: paddle.optimizer.NAdam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _create_accumulators(self, p):
+        return {"m": jnp.zeros(p._data.shape, jnp.float32),
+                "v": jnp.zeros(p._data.shape, jnp.float32),
+                "t": jnp.zeros((), jnp.float32),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        t = state["t"] + 1
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_prod"] * mu_t
+        m = self._b1 * state["m"] + (1 - self._b1) * g32
+        v = self._b2 * state["v"] + (1 - self._b2) * g32 * g32
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g32 / (1 - mu_prod))
+        v_hat = v / (1 - self._b2 ** t)
+        new_p = _f32(p) - lr * m_hat / (jnp.sqrt(v_hat) + self._eps)
+        return new_p.astype(p.dtype), {"m": m, "v": v, "t": t,
+                                       "mu_prod": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (ref: paddle.optimizer.RAdam): warms up the adaptive
+    term by the variance-rectification factor; falls back to SGD-with-
+    momentum while the variance estimate is untrustworthy."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _create_accumulators(self, p):
+        return {"m": jnp.zeros(p._data.shape, jnp.float32),
+                "v": jnp.zeros(p._data.shape, jnp.float32),
+                "t": jnp.zeros((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd, group):
+        g32 = _f32(g)
+        if wd:
+            g32 = g32 + wd * _f32(p)
+        t = state["t"] + 1
+        m = self._b1 * state["m"] + (1 - self._b1) * g32
+        v = self._b2 * state["v"] + (1 - self._b2) * g32 * g32
+        m_hat = m / (1 - self._b1 ** t)
+        rho_inf = 2.0 / (1 - self._b2) - 1
+        rho_t = rho_inf - 2 * t * self._b2 ** t / (1 - self._b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        v_hat = jnp.sqrt(v / (1 - self._b2 ** t))
+        adaptive = lr * r * m_hat / (v_hat + self._eps)
+        plain = lr * m_hat
+        new_p = _f32(p) - jnp.where(rho_t > 4.0, adaptive, plain)
+        return new_p.astype(p.dtype), {"m": m, "v": v, "t": t}

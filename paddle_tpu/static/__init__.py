@@ -210,3 +210,94 @@ def scope_guard(scope):
 
 
 Scope = _Scope
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    """ref: paddle.static.device_guard — pin ops in the block to a device.
+    Under XLA, placement is whole-computation (jit device / shardings);
+    the guard temporarily switches the framework default device for host
+    placements and is a no-op inside a trace."""
+    from ..framework import place as _place
+    if device is None:
+        yield
+        return
+    prev = _place.get_device()
+    try:
+        _place.set_device(device)
+        yield
+    finally:
+        _place.set_device(prev)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """ref: paddle.static.gradients — grads of targets w.r.t. inputs.
+    The dygraph tape serves both modes here (programs are op captures of
+    eager execution): delegates to paddle.grad."""
+    from ..autograd import grad as _grad
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return list(outs)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref: paddle.static.py_func — embed a host python function as an op.
+
+    TPU-native: lowers to jax.pure_callback, so the callback survives jit
+    (the host function runs on the host each step, its result is shipped
+    back to the device). `out` provides the output spec (a Tensor whose
+    shape/dtype describe the result, as the reference requires).
+    backward_func (called with the forward inputs — minus
+    skip_vars_in_backward_input — followed by the output gradients, and
+    returning input gradients) is wired through a custom VJP; without it
+    the op is non-differentiable, as in the reference."""
+    import jax
+    import numpy as np
+
+    from ..tensor.tensor import Tensor, _run_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+             for o in outs]
+    skip = set(id(v) for v in (skip_vars_in_backward_input or []))
+
+    def host(*arrays):
+        res = func(*[Tensor(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(getattr(r, "_data", r), dtype=s.dtype)
+                     for r, s in zip(res, specs))
+
+    def f(*arrays):
+        res = jax.pure_callback(host, tuple(specs), *arrays)
+        return res if len(res) > 1 else res[0]
+
+    if backward_func is None:
+        return _run_op("py_func", f, tuple(xs), {})
+
+    keep = [i for i, v in enumerate(xs) if id(v) not in skip]
+
+    @jax.custom_vjp
+    def op(*arrays):
+        return f(*arrays)
+
+    def op_fwd(*arrays):
+        return f(*arrays), arrays
+
+    def op_bwd(res, g):
+        gs = g if isinstance(g, tuple) else (g,)
+        in_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in res)
+
+        def host_bwd(*args):
+            n = len(res)
+            fwd_in = [Tensor(a) for j, a in enumerate(args[:n]) if j in keep]
+            gys = [Tensor(a) for a in args[n:]]
+            grads = backward_func(*fwd_in, *gys)
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            return tuple(np.asarray(getattr(r, "_data", r), dtype=s.dtype)
+                         for r, s in zip(grads, in_specs))
+
+        return jax.pure_callback(host_bwd, in_specs, *res, *gs)
+
+    op.defvjp(op_fwd, op_bwd)
+    return _run_op("py_func", op, tuple(xs), {})

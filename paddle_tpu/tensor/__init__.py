@@ -229,6 +229,13 @@ def _cauchy_(x, loc=0.0, scale=1.0, name=None):
 Tensor.unsqueeze_ = _unsqueeze_
 Tensor.flatten_ = _flatten_
 Tensor.scatter_ = _scatter_
+def _tensor_coalesce(x):
+    raise ValueError(
+        "coalesce expects a SparseCooTensor (paddle.sparse.sparse_coo_tensor)"
+        "; dense tensors have no duplicate-index entries to merge")
+
+
+Tensor.coalesce = _tensor_coalesce
 Tensor.masked_fill_ = _masked_fill_
 Tensor.index_fill_ = _index_fill_
 Tensor.uniform_ = _uniform_

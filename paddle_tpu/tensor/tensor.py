@@ -162,6 +162,31 @@ class Tensor:
     def element_size(self):
         return self._data.dtype.itemsize
 
+    @property
+    def nbytes(self):
+        return int(self._data.size) * self._data.dtype.itemsize
+
+    def data_ptr(self):
+        """Host-inspectable buffer address (ref: Tensor.data_ptr). XLA
+        buffers are opaque; this returns the stable object id — usable as
+        an identity key, NOT a dereferenceable pointer."""
+        try:
+            return self._data.unsafe_buffer_pointer()
+        except Exception:
+            return id(self._data)
+
+    def apply(self, func):
+        """ref: Tensor.apply — return func(self) as a new tensor."""
+        out = func(self)
+        return out if isinstance(out, Tensor) else Tensor(out)
+
+    def apply_(self, func):
+        """ref: Tensor.apply_ — in-place apply (no autograd through it)."""
+        out = func(self)
+        self._data = (out._data if isinstance(out, Tensor)
+                      else jnp.asarray(out)).astype(self._data.dtype)
+        return self
+
     rank = dim
     ndimension = dim
 

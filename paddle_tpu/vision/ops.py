@@ -516,3 +516,203 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
                   * mask)
         return boxes * mask, scores
     return _run_op("yolo_box", f, (x, img_size), {})
+
+
+class RoIAlign(_Layer):
+    """Layer form of roi_align (ref: vision.ops.RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool(_Layer):
+    """Layer form of roi_pool (ref: vision.ops.RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map (ref:
+    vision.ops.prior_box / phi prior_box kernel). input: [N, C, H, W]
+    feature map; image: [N, C, IH, IW]. Returns (boxes [H, W, P, 4],
+    variances [H, W, P, 4]) with normalized xmin/ymin/xmax/ymax."""
+    import numpy as np
+
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(float(ar))
+        if flip:
+            ars.append(1.0 / float(ar))
+
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[i]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[i]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    p = len(whs)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    cxg, cyg = np.meshgrid(cx, cy)                       # [H, W]
+    out = np.empty((fh, fw, p, 4), np.float32)
+    for i, (w_, h_) in enumerate(whs):
+        out[:, :, i, 0] = (cxg - w_ / 2) / iw
+        out[:, :, i, 1] = (cyg - h_ / 2) / ih
+        out[:, :, i, 2] = (cxg + w_ / 2) / iw
+        out[:, :, i, 3] = (cyg + h_ / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss for one detection head (ref: vision.ops.yolo_loss /
+    phi yolo_loss kernel): per ground-truth best-anchor assignment,
+    box (xy BCE + wh L2), objectness BCE with the ignore region, and
+    class BCE. x: [N, A*(5+C), H, W]; gt_box: [N, B, 4] (cx, cy, w, h in
+    image units); gt_label: [N, B]."""
+    def f(pred, gbox, glabel, *rest):
+        gscore = rest[0] if gt_score is not None else None
+        n, _, h, w = pred.shape
+        na = len(anchor_mask)
+        an_all = jnp.asarray(np.array(anchors, np.float32).reshape(-1, 2))
+        an = an_all[jnp.asarray(np.array(anchor_mask, np.int64))]
+        p = pred.reshape(n, na, 5 + class_num, h, w)
+        px, py = jax.nn.sigmoid(p[:, :, 0]), jax.nn.sigmoid(p[:, :, 1])
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]
+        in_w, in_h = w * downsample_ratio, h * downsample_ratio
+
+        gb = gbox.astype(jnp.float32)
+        gx = gb[..., 0] / in_w * w                       # [N, B] grid units
+        gy = gb[..., 1] / in_h * h
+        gw = gb[..., 2]
+        gh = gb[..., 3]
+        valid = (gw > 0) & (gh > 0)
+        gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+
+        # best anchor (over ALL anchors) per gt by wh-IoU; responsible
+        # only if it falls in this head's mask
+        inter = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+                 * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+        union = (gw * gh)[..., None] + an_all[:, 0] * an_all[:, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+        mask_arr = jnp.asarray(np.array(anchor_mask, np.int64))
+        resp_a = jnp.argmax(best[..., None] == mask_arr, axis=-1)
+        responsible = valid & jnp.any(best[..., None] == mask_arr, axis=-1)
+
+        # scatter gt targets onto the [N, A, H, W] grids
+        def scatter(vals):
+            out = jnp.zeros((n, na, h, w), jnp.float32)
+            bidx = jnp.arange(n)[:, None] * jnp.ones_like(gi)
+            return out.at[bidx, resp_a, gj, gi].add(
+                jnp.where(responsible, vals, 0.0))
+
+        obj_tgt = jnp.clip(scatter(jnp.ones_like(gx)), 0, 1)
+        sc = (gscore.astype(jnp.float32) if gscore is not None
+              else jnp.ones_like(gx))
+        tw = jnp.log(jnp.maximum(gw, 1e-9)
+                     / jnp.maximum(an[resp_a][..., 0], 1e-9))
+        th = jnp.log(jnp.maximum(gh, 1e-9)
+                     / jnp.maximum(an[resp_a][..., 1], 1e-9))
+        box_scale = 2.0 - gw * gh / (in_w * in_h)
+
+        def bce(z, t):
+            return jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+
+        obj_mask = obj_tgt > 0
+        tx = scatter(gx - gi.astype(jnp.float32))
+        ty = scatter(gy - gj.astype(jnp.float32))
+        twg = scatter(tw)
+        thg = scatter(th)
+        wgt = scatter(box_scale * sc)
+        loss_xy = jnp.sum(jnp.where(obj_mask,
+                                    wgt * ((px - tx) ** 2 + (py - ty) ** 2),
+                                    0.0), axis=(1, 2, 3))
+        loss_wh = jnp.sum(jnp.where(obj_mask,
+                                    wgt * ((pw - twg) ** 2
+                                           + (ph - thg) ** 2), 0.0),
+                          axis=(1, 2, 3))
+        # objectness with the IGNORE region: decode each prediction to
+        # image units, take its best IoU over the gt boxes, and exclude
+        # non-responsible predictions above ignore_thresh from the
+        # negative loss (the reference's per-prediction IoU test)
+        bx = (px + jnp.arange(w)[None, None, None, :]) * downsample_ratio
+        by = (py + jnp.arange(h)[None, None, :, None]) * downsample_ratio
+        bw_ = jnp.exp(jnp.clip(pw, -10, 10)) * an[:, 0][None, :, None, None]
+        bh_ = jnp.exp(jnp.clip(ph, -10, 10)) * an[:, 1][None, :, None, None]
+        p1x, p1y = bx - bw_ / 2, by - bh_ / 2
+        p2x, p2y = bx + bw_ / 2, by + bh_ / 2
+        g1x = (gb[..., 0] - gw / 2)[:, None, None, None, :]  # [N,1,1,1,B]
+        g1y = (gb[..., 1] - gh / 2)[:, None, None, None, :]
+        g2x = (gb[..., 0] + gw / 2)[:, None, None, None, :]
+        g2y = (gb[..., 1] + gh / 2)[:, None, None, None, :]
+        iw_ = jnp.clip(jnp.minimum(p2x[..., None], g2x)
+                       - jnp.maximum(p1x[..., None], g1x), 0)
+        ih_ = jnp.clip(jnp.minimum(p2y[..., None], g2y)
+                       - jnp.maximum(p1y[..., None], g1y), 0)
+        inter_p = iw_ * ih_
+        union_p = (bw_ * bh_)[..., None] + (gw * gh)[:, None, None, None, :] \
+            - inter_p
+        iou_p = jnp.where(valid[:, None, None, None, :],
+                          inter_p / jnp.maximum(union_p, 1e-9), 0.0)
+        ignore = (jnp.max(iou_p, axis=-1) > ignore_thresh) & ~obj_mask
+        loss_obj = jnp.sum(jnp.where(obj_mask, bce(pobj, 1.0),
+                                     jnp.where(ignore, 0.0,
+                                               bce(pobj, 0.0))),
+                           axis=(1, 2, 3))
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+        cls_tgt = jnp.zeros((n, na, class_num, h, w), jnp.float32)
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(gi)
+        safe_lb = jnp.clip(glabel, 0, class_num - 1)
+        cls_tgt = cls_tgt.at[bidx, resp_a, safe_lb, gj, gi].add(
+            jnp.where(responsible, 1.0, 0.0))
+        cls_tgt = jnp.clip(cls_tgt, smooth, 1.0 - smooth)
+        loss_cls = jnp.sum(jnp.where(obj_mask[:, :, None], bce(pcls, cls_tgt),
+                                     0.0), axis=(1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    args = [x, gt_box, gt_label]
+    if gt_score is not None:
+        args.append(gt_score)
+    return _run_op("yolo_loss", f, tuple(args), {})

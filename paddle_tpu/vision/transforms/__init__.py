@@ -481,3 +481,231 @@ class RandomPerspective(BaseTransform):
         out = hwc[yi, xi]
         out[~inside] = self.fill
         return _restore(out.astype(arr.dtype), was_chw)
+
+
+# -- learned/random augmentation policies (ref: python/paddle/vision/
+# transforms: RandAugment / AutoAugment / TrivialAugmentWide) --------------
+
+def _aug_affine(hwc, mat, fill=128):
+    import scipy.ndimage as ndi
+    out = np.empty_like(hwc)
+    for c in range(hwc.shape[-1]):
+        out[..., c] = ndi.affine_transform(
+            hwc[..., c].astype(np.float32), mat[:2, :2], offset=mat[:2, 2],
+            order=1, mode="constant", cval=fill).astype(hwc.dtype)
+    return out
+
+
+def _aug_apply(hwc, op, magnitude, fill=128):
+    """One augmentation primitive on a uint8-ish HWC array. `magnitude`
+    is already in the op's natural units."""
+    import scipy.ndimage as ndi
+    h, w = hwc.shape[:2]
+    f32 = hwc.astype(np.float32)
+    mx = 255.0 if hwc.max() > 1.5 else 1.0
+    if op == "Identity":
+        return hwc
+    if op == "Brightness":
+        return np.clip(f32 * (1.0 + magnitude), 0, mx).astype(hwc.dtype)
+    if op == "Color":
+        gray = f32 @ np.array([0.299, 0.587, 0.114],
+                              np.float32)[: hwc.shape[-1]]
+        out = gray[..., None] + (f32 - gray[..., None]) * (1.0 + magnitude)
+        return np.clip(out, 0, mx).astype(hwc.dtype)
+    if op == "Contrast":
+        mean = f32.mean()
+        return np.clip(mean + (f32 - mean) * (1.0 + magnitude),
+                       0, mx).astype(hwc.dtype)
+    if op == "Sharpness":
+        blurred = np.stack([ndi.uniform_filter(f32[..., c], 3)
+                            for c in range(hwc.shape[-1])], -1)
+        out = blurred + (f32 - blurred) * (1.0 + magnitude)
+        return np.clip(out, 0, mx).astype(hwc.dtype)
+    if op == "Posterize":
+        bits = int(round(magnitude))
+        if mx == 1.0:
+            q = (f32 * 255).astype(np.uint8)
+            q &= np.uint8(255 ^ (2 ** (8 - bits) - 1))
+            return (q / 255.0).astype(hwc.dtype)
+        q = hwc.astype(np.uint8) & np.uint8(255 ^ (2 ** (8 - bits) - 1))
+        return q.astype(hwc.dtype)
+    if op == "Solarize":
+        thr = magnitude if mx > 1.5 else magnitude / 255.0
+        return np.where(f32 >= thr, mx - f32, f32).astype(hwc.dtype)
+    if op == "AutoContrast":
+        lo = f32.min(axis=(0, 1), keepdims=True)
+        hi = f32.max(axis=(0, 1), keepdims=True)
+        scale = np.where(hi > lo, mx / np.maximum(hi - lo, 1e-6), 1.0)
+        return np.clip((f32 - lo) * scale, 0, mx).astype(hwc.dtype)
+    if op == "Equalize":
+        u8 = (f32 * (255.0 / mx)).astype(np.uint8)
+        out = np.empty_like(u8)
+        for c in range(u8.shape[-1]):
+            hist = np.bincount(u8[..., c].ravel(), minlength=256)
+            cdf = hist.cumsum()
+            nz = cdf[cdf > 0]
+            if len(nz) == 0 or nz[0] == cdf[-1]:
+                out[..., c] = u8[..., c]
+                continue
+            lut = np.clip(np.round((cdf - nz[0]) * 255.0
+                                   / (cdf[-1] - nz[0])), 0, 255)
+            out[..., c] = lut.astype(np.uint8)[u8[..., c]]
+        return (out.astype(np.float32) * (mx / 255.0)).astype(hwc.dtype)
+    if op == "Rotate":
+        out = ndi.rotate(hwc, magnitude, axes=(0, 1), reshape=False,
+                         order=1, mode="constant", cval=fill)
+        return out.astype(hwc.dtype)
+    if op == "ShearX":
+        return _aug_affine(hwc, np.array(
+            [[1, 0, 0], [magnitude, 1, -magnitude * h / 2], [0, 0, 1]],
+            np.float32), fill)
+    if op == "ShearY":
+        return _aug_affine(hwc, np.array(
+            [[1, magnitude, -magnitude * w / 2], [0, 1, 0], [0, 0, 1]],
+            np.float32), fill)
+    if op == "TranslateX":
+        return _aug_affine(hwc, np.array(
+            [[1, 0, 0], [0, 1, -magnitude * w], [0, 0, 1]], np.float32),
+            fill)
+    if op == "TranslateY":
+        return _aug_affine(hwc, np.array(
+            [[1, 0, -magnitude * h], [0, 1, 0], [0, 0, 1]], np.float32),
+            fill)
+    raise ValueError(f"unknown augmentation op {op!r}")
+
+
+# (op, magnitude 0..1 -> natural units, signed?) — the RandAugment space
+_AUG_SPACE = {
+    "Identity": (lambda m: 0.0, False),
+    "Brightness": (lambda m: 0.9 * m, True),
+    "Color": (lambda m: 0.9 * m, True),
+    "Contrast": (lambda m: 0.9 * m, True),
+    "Sharpness": (lambda m: 0.9 * m, True),
+    "Posterize": (lambda m: 8 - int(round(4 * m)), False),
+    "Solarize": (lambda m: 255.0 * (1.0 - m), False),
+    "AutoContrast": (lambda m: 0.0, False),
+    "Equalize": (lambda m: 0.0, False),
+    "Rotate": (lambda m: 30.0 * m, True),
+    "ShearX": (lambda m: 0.3 * m, True),
+    "ShearY": (lambda m: 0.3 * m, True),
+    "TranslateX": (lambda m: 0.45 * m, True),
+    "TranslateY": (lambda m: 0.45 * m, True),
+}
+
+
+class RandAugment(BaseTransform):
+    """ref: paddle.vision.transforms.RandAugment (Cubuk et al. 2020):
+    num_layers ops drawn uniformly from the op space, all at the shared
+    `magnitude` (of `num_magnitude_bins`), signs randomized."""
+
+    def __init__(self, num_ops=2, magnitude=9, num_magnitude_bins=31,
+                 interpolation="nearest", fill=128):
+        self.num_ops = num_ops
+        self.magnitude = magnitude
+        self.bins = num_magnitude_bins
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        for _ in range(self.num_ops):
+            op = list(_AUG_SPACE)[np.random.randint(len(_AUG_SPACE))]
+            to_units, signed = _AUG_SPACE[op]
+            mag = to_units(self.magnitude / max(self.bins - 1, 1))
+            if signed and np.random.rand() < 0.5:
+                mag = -mag
+            hwc = _aug_apply(hwc, op, mag, self.fill)
+        return _restore(hwc, was_chw)
+
+
+class TrivialAugmentWide(BaseTransform):
+    """ref: TrivialAugmentWide (Mueller & Hutter 2021): ONE random op at a
+    random magnitude from a wider range."""
+
+    _WIDE = dict(_AUG_SPACE)
+    _WIDE.update({
+        "Brightness": (lambda m: 0.99 * m, True),
+        "Color": (lambda m: 0.99 * m, True),
+        "Contrast": (lambda m: 0.99 * m, True),
+        "Sharpness": (lambda m: 0.99 * m, True),
+        "Rotate": (lambda m: 135.0 * m, True),
+        "ShearX": (lambda m: 0.99 * m, True),
+        "ShearY": (lambda m: 0.99 * m, True),
+        "TranslateX": (lambda m: 32.0 * m / 224.0, True),
+        "TranslateY": (lambda m: 32.0 * m / 224.0, True),
+        "Posterize": (lambda m: 8 - int(round(6 * m)), False),
+    })
+
+    def __init__(self, num_magnitude_bins=31, interpolation="nearest",
+                 fill=128):
+        self.bins = num_magnitude_bins
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        op = list(self._WIDE)[np.random.randint(len(self._WIDE))]
+        to_units, signed = self._WIDE[op]
+        mag = to_units(np.random.randint(self.bins) / max(self.bins - 1, 1))
+        if signed and np.random.rand() < 0.5:
+            mag = -mag
+        return _restore(_aug_apply(hwc, op, mag, self.fill), was_chw)
+
+
+class AutoAugment(BaseTransform):
+    """ref: AutoAugment (Cubuk et al. 2019) with the learned ImageNet
+    policy: one of 25 sub-policies (two (op, prob, magnitude-bin) steps)
+    per image."""
+
+    # (op, probability, magnitude bin 0-9)
+    _IMAGENET = [
+        (("Posterize", 0.4, 8), ("Rotate", 0.6, 9)),
+        (("Solarize", 0.6, 5), ("AutoContrast", 0.6, 5)),
+        (("Equalize", 0.8, 8), ("Equalize", 0.6, 3)),
+        (("Posterize", 0.6, 7), ("Posterize", 0.6, 6)),
+        (("Equalize", 0.4, 7), ("Solarize", 0.2, 4)),
+        (("Equalize", 0.4, 4), ("Rotate", 0.8, 8)),
+        (("Solarize", 0.6, 3), ("Equalize", 0.6, 7)),
+        (("Posterize", 0.8, 5), ("Equalize", 1.0, 2)),
+        (("Rotate", 0.2, 3), ("Solarize", 0.6, 8)),
+        (("Equalize", 0.6, 8), ("Posterize", 0.4, 6)),
+        (("Rotate", 0.8, 8), ("Color", 0.4, 0)),
+        (("Rotate", 0.4, 9), ("Equalize", 0.6, 2)),
+        (("Equalize", 0.0, 7), ("Equalize", 0.8, 8)),
+        (("Invert", 0.6, 4), ("Equalize", 1.0, 8)),
+        (("Color", 0.6, 4), ("Contrast", 1.0, 8)),
+        (("Rotate", 0.8, 8), ("Color", 1.0, 2)),
+        (("Color", 0.8, 8), ("Solarize", 0.8, 7)),
+        (("Sharpness", 0.4, 7), ("Invert", 0.6, 8)),
+        (("ShearX", 0.6, 5), ("Equalize", 1.0, 9)),
+        (("Color", 0.4, 0), ("Equalize", 0.6, 3)),
+        (("Equalize", 0.4, 7), ("Solarize", 0.2, 4)),
+        (("Solarize", 0.6, 5), ("AutoContrast", 0.6, 5)),
+        (("Invert", 0.6, 4), ("Equalize", 1.0, 8)),
+        (("Color", 0.6, 4), ("Contrast", 1.0, 8)),
+        (("Equalize", 0.8, 8), ("Equalize", 0.6, 3)),
+    ]
+
+    def __init__(self, policy="imagenet", interpolation="nearest", fill=128):
+        if policy != "imagenet":
+            raise ValueError("AutoAugment: only the 'imagenet' policy "
+                             "is provided")
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        hwc, was_chw = _hwc_view(arr)
+        sub = self._IMAGENET[np.random.randint(len(self._IMAGENET))]
+        for op, prob, binb in sub:
+            if np.random.rand() > prob:
+                continue
+            if op == "Invert":
+                mx = 255.0 if hwc.max() > 1.5 else 1.0
+                hwc = (mx - hwc.astype(np.float32)).astype(hwc.dtype)
+                continue
+            to_units, signed = _AUG_SPACE[op]
+            mag = to_units(binb / 9.0)
+            if signed and np.random.rand() < 0.5:
+                mag = -mag
+            hwc = _aug_apply(hwc, op, mag, self.fill)
+        return _restore(hwc, was_chw)

@@ -1,0 +1,234 @@
+"""Round-4 third API sweep: optimizers (Rprop/ASGD/NAdam/RAdam),
+static.py_func/gradients/device_guard, vision prior_box/yolo_loss/RoI
+layers, augment policies, incubate primapi/FusedTransformerEncoderLayer,
+distributed aliases and MoE utils, dlpack/cpp_extension/sysconfig."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def setup_module():
+    paddle.set_device("cpu")
+
+
+@pytest.mark.parametrize("opt_name", ["Rprop", "ASGD", "NAdam", "RAdam"])
+def test_new_optimizers_train(opt_name):
+    paddle.seed(0)
+    m = nn.Linear(6, 4)
+    opt = getattr(paddle.optimizer, opt_name)(
+        learning_rate=1e-2, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 6)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(8, 4)
+                         .astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = paddle.mean((m(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], (opt_name, losses)
+
+
+def test_py_func_host_callback_survives_jit():
+    import jax
+
+    def host_fn(t):
+        return t * 2 + 1
+
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    out_spec = paddle.zeros([4])
+    y = paddle.static.py_func(host_fn, x, out_spec)
+    np.testing.assert_allclose(np.asarray(y._data), [1, 3, 5, 7])
+    f = jax.jit(lambda a: paddle.static.py_func(
+        host_fn, paddle.Tensor(a), out_spec)._data)
+    np.testing.assert_allclose(np.asarray(f(x._data)), [1, 3, 5, 7])
+
+
+def test_static_gradients_and_device_guard():
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    x.stop_gradient = False
+    g = paddle.static.gradients([paddle.sum(x * 3)], [x])
+    np.testing.assert_allclose(np.asarray(g[0]._data), 3.0)
+    dev = paddle.get_device()
+    with paddle.static.device_guard("cpu"):
+        assert paddle.get_device().startswith("cpu")
+    assert paddle.get_device() == dev
+
+
+def test_prior_box():
+    from paddle_tpu.vision.ops import prior_box
+    feat = paddle.zeros([1, 16, 4, 4])
+    img = paddle.zeros([1, 3, 32, 32])
+    boxes, var = prior_box(feat, img, min_sizes=[8.0], max_sizes=[16.0],
+                           aspect_ratios=[2.0], flip=True, clip=True)
+    b = np.asarray(boxes._data)
+    assert b.shape[:2] == (4, 4) and b.shape[-1] == 4
+    assert (b >= 0).all() and (b <= 1).all()
+    # center prior of cell (0,0) is around step*offset/image
+    np.testing.assert_allclose((b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2,
+                               4.0 / 32, atol=1e-6)
+    assert np.asarray(var._data).shape == b.shape
+
+
+def test_yolo_loss_trains():
+    from paddle_tpu.vision.ops import yolo_loss
+    pred = paddle.to_tensor(np.random.RandomState(1)
+                            .randn(2, 3 * 9, 4, 4).astype(np.float32) * 0.1)
+    pred.stop_gradient = False
+    gt_box = paddle.to_tensor(
+        np.array([[[16, 16, 8, 12], [0, 0, 0, 0]]] * 2, np.float32))
+    gt_label = paddle.to_tensor(np.array([[1, 0]] * 2, np.int64))
+    loss = yolo_loss(pred, gt_box, gt_label,
+                     anchors=[10, 13, 16, 30, 33, 23],
+                     anchor_mask=[0, 1, 2], class_num=4,
+                     ignore_thresh=0.7, downsample_ratio=8)
+    l = np.asarray(loss._data)
+    assert l.shape == (2,) and np.isfinite(l).all() and (l > 0).all()
+    paddle.sum(loss).backward()
+    assert pred.grad is not None
+    assert np.isfinite(np.asarray(pred.grad._data)).all()
+
+
+def test_roi_layer_forms():
+    from paddle_tpu.vision.ops import RoIAlign, RoIPool, roi_align
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 4, 8, 8)
+                         .astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+    num = paddle.to_tensor(np.array([1], np.int32))
+    out = RoIAlign(2)(x, boxes, num)
+    np.testing.assert_allclose(
+        np.asarray(out._data),
+        np.asarray(roi_align(x, boxes, num, 2)._data))
+    assert tuple(RoIPool(2)(x, boxes, num).shape) == (1, 4, 2, 2)
+
+
+def test_augment_policies():
+    from paddle_tpu.vision.transforms import (AutoAugment, RandAugment,
+                                              TrivialAugmentWide)
+    np.random.seed(0)
+    img = (np.random.rand(24, 24, 3) * 255).astype(np.uint8)
+    for T in (RandAugment(num_ops=2, magnitude=9), AutoAugment(),
+              TrivialAugmentWide()):
+        changed = False
+        for _ in range(10):
+            out = np.asarray(T(img))
+            assert out.shape == (24, 24, 3) and out.dtype == np.uint8
+            changed = changed or not np.array_equal(out, img)
+        assert changed, type(T).__name__
+
+
+def test_incubate_primapi_and_fused_encoder():
+    import paddle_tpu.incubate.autograd as pag
+    out, tang = pag.forward_grad(
+        lambda x: x * x, paddle.to_tensor(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out._data), [9.0])
+    np.testing.assert_allclose(np.asarray(tang._data), [6.0])
+    pag.enable_prim()
+    assert pag.prim_enabled()
+    pag.disable_prim()
+
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+    enc = FusedTransformerEncoderLayer(32, 4, 64)
+    y = enc(paddle.to_tensor(np.random.RandomState(0).randn(2, 6, 32)
+                             .astype(np.float32)))
+    assert tuple(y.shape) == (2, 6, 32)
+
+
+def test_distributed_aliases_and_moe_utils():
+    import paddle_tpu.distributed as dist
+    assert dist.get_backend() == "XLA"
+    t = paddle.ones([4])
+    out = paddle.zeros([4])
+    dist.all_gather_into_tensor(out, t)
+    np.testing.assert_allclose(np.asarray(out._data), 1.0)
+    dist.reduce_scatter_tensor(out, t)
+    dist.monitored_barrier()
+    dist.destroy_process_group()
+    assert dist.fleet.utils.recompute is not None
+
+    from paddle_tpu.distributed.utils import global_gather, global_scatter
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    lc = paddle.to_tensor(np.array([3, 1], np.int64))
+    out = global_scatter(x, lc, lc)
+    assert out.shape[0] == 4
+    back = global_gather(out, lc, lc)
+    assert back.shape[0] == 4
+    with pytest.raises(ValueError, match="sums to"):
+        global_scatter(x, lc, paddle.to_tensor(np.array([1, 1], np.int64)))
+
+
+def test_tensor_tail_methods():
+    t = paddle.ones([2, 3])
+    assert t.nbytes == 24
+    assert t.data_ptr() != 0
+    np.testing.assert_allclose(
+        np.asarray(t.apply(lambda a: a * 3)._data), 3.0)
+    t.apply_(lambda a: a + 1)
+    np.testing.assert_allclose(np.asarray(t._data), 2.0)
+    with pytest.raises(ValueError, match="SparseCoo"):
+        t.coalesce()
+    assert not paddle.is_compiled_with_xpu()
+    assert not paddle.is_compiled_with_rocm()
+    assert paddle.is_compiled_with_custom_device("tpu")
+    assert paddle.get_cuda_rng_state() is not None
+    with paddle.LazyGuard():
+        nn.Linear(2, 2)
+    r = paddle.batch(lambda: iter(range(10)), 3)
+    assert [len(b) for b in r()] == [3, 3, 3, 1]
+    assert [len(b) for b in paddle.batch(lambda: iter(range(10)), 3,
+                                         drop_last=True)()] == [3, 3, 3]
+
+
+def test_py_func_backward_func():
+    def fwd(t):
+        return t * t
+
+    def bwd(t, gy):
+        return gy * 2 * t
+
+    x = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    x.stop_gradient = False
+    y = paddle.static.py_func(fwd, x, paddle.zeros([2]), backward_func=bwd)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data), [6.0, 8.0])
+
+
+def test_yolo_loss_ignore_thresh_matters():
+    from paddle_tpu.vision.ops import yolo_loss
+    pred = paddle.to_tensor(np.random.RandomState(1)
+                            .randn(1, 27, 4, 4).astype(np.float32) * 0.1)
+    gt_box = paddle.to_tensor(np.array([[[16, 16, 8, 12]]], np.float32))
+    gt_label = paddle.to_tensor(np.array([[1]], np.int64))
+    kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+              class_num=4, downsample_ratio=8)
+    strict = float(np.asarray(yolo_loss(pred, gt_box, gt_label,
+                                        ignore_thresh=0.99, **kw)._data)[0])
+    loose = float(np.asarray(yolo_loss(pred, gt_box, gt_label,
+                                       ignore_thresh=0.05, **kw)._data)[0])
+    # a looser threshold excludes more near-hit negatives from the
+    # objectness loss
+    assert loose < strict
+
+
+def test_class_center_sample_rejects_too_many_positives():
+    import paddle_tpu.nn.functional as F
+    label = paddle.to_tensor(np.arange(10, dtype=np.int64))
+    with pytest.raises(ValueError, match="positive"):
+        F.class_center_sample(label, 20, 8)
+
+
+def test_prior_box_duplicate_min_sizes():
+    from paddle_tpu.vision.ops import prior_box
+    feat = paddle.zeros([1, 8, 2, 2])
+    img = paddle.zeros([1, 3, 16, 16])
+    boxes, _ = prior_box(feat, img, min_sizes=[4.0, 4.0],
+                         max_sizes=[8.0, 12.0])
+    b = np.asarray(boxes._data)
+    # each min_size pairs with ITS max_size: sqrt(4*8) != sqrt(4*12)
+    w1 = b[0, 0, 1, 2] - b[0, 0, 1, 0]
+    w3 = b[0, 0, 3, 2] - b[0, 0, 3, 0]
+    assert abs(w1 - w3) > 1e-6
