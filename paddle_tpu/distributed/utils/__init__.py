@@ -20,7 +20,10 @@ def _counts(x):
 
 
 def _world(group):
-    return group.nranks if group is not None else 1
+    if group is not None:
+        return group.nranks
+    from ..env import get_world_size
+    return get_world_size()
 
 
 def global_scatter(x, local_count, global_count, group=None):

@@ -323,12 +323,8 @@ class Rprop(Optimizer):
 
     def _create_accumulators(self, p):
         return {"prev_grad": jnp.zeros(p._data.shape, jnp.float32),
-                "step": jnp.full(p._data.shape, float(self._lr_value()),
+                "step": jnp.full(p._data.shape, float(self.get_lr()),
                                  jnp.float32)}
-
-    def _lr_value(self):
-        lr = self._learning_rate
-        return lr.get_lr() if hasattr(lr, "get_lr") else lr
 
     def _update(self, p, g, state, lr, wd, group):
         g32 = _f32(g)
@@ -347,18 +343,29 @@ class Rprop(Optimizer):
 
 class ASGD(Optimizer):
     """Averaged SGD (ref: paddle.optimizer.ASGD): plain SGD steps plus a
-    running average of the iterates (the averaged weights live in the
-    accumulator; `averaged(p)` reads them)."""
+    running average of the iterates; read it with ``averaged(param)``
+    (e.g. to evaluate with the Polyak-averaged weights)."""
 
     def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
                  weight_decay=None, grad_clip=None, multi_precision=False,
                  name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
-        self._batch_num = batch_num
+        # the reference smooths grads over batch_num batches; with the
+        # whole batch's grad available per step this is a 1-step window
+        self._batch_num = max(int(batch_num), 1)
 
     def _create_accumulators(self, p):
         return {"avg": _f32(p), "t": jnp.zeros((), jnp.float32)}
+
+    def averaged(self, p):
+        """The running average of `p`'s iterates (zeros-state params that
+        never stepped return the current value)."""
+        state = self._accumulators.get(p.name)
+        if state is None:
+            return p
+        from ..tensor.tensor import Tensor
+        return Tensor(state["avg"].astype(p._data.dtype))
 
     def _update(self, p, g, state, lr, wd, group):
         g32 = _f32(g)

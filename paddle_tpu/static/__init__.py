@@ -285,8 +285,10 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
     def op_bwd(res, g):
         gs = g if isinstance(g, tuple) else (g,)
-        in_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
-                         for a in res)
+        # backward_func returns gradients for the KEPT inputs only;
+        # skipped inputs get zero tangents
+        kept_specs = tuple(jax.ShapeDtypeStruct(res[j].shape, res[j].dtype)
+                           for j in keep)
 
         def host_bwd(*args):
             n = len(res)
@@ -294,10 +296,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             gys = [Tensor(a) for a in args[n:]]
             grads = backward_func(*fwd_in, *gys)
             grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            if len(grads) != len(kept_specs):
+                raise ValueError(
+                    f"py_func backward_func returned {len(grads)} "
+                    f"gradients for {len(kept_specs)} non-skipped inputs")
             return tuple(np.asarray(getattr(r, "_data", r), dtype=s.dtype)
-                         for r, s in zip(grads, in_specs))
+                         for r, s in zip(grads, kept_specs))
 
-        return jax.pure_callback(host_bwd, in_specs, *res, *gs)
+        kept_grads = jax.pure_callback(host_bwd, kept_specs, *res, *gs)
+        it = iter(kept_grads)
+        import jax.numpy as jnp
+        return tuple(next(it) if j in keep else jnp.zeros_like(res[j])
+                     for j in range(len(res)))
 
     op.defvjp(op_fwd, op_bwd)
     return _run_op("py_func", op, tuple(xs), {})

@@ -641,12 +641,17 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         resp_a = jnp.argmax(best[..., None] == mask_arr, axis=-1)
         responsible = valid & jnp.any(best[..., None] == mask_arr, axis=-1)
 
-        # scatter gt targets onto the [N, A, H, W] grids
+        # scatter gt targets onto the [N, A, H, W] grids. .set, not .add:
+        # two gts landing in the same (anchor, cell) must have ONE owner
+        # (summed tx/ty would leave the sigmoid range); jax picks one
+        # writer for duplicate indices, matching the reference's
+        # last-writer-wins build of the target maps
         def scatter(vals):
             out = jnp.zeros((n, na, h, w), jnp.float32)
             bidx = jnp.arange(n)[:, None] * jnp.ones_like(gi)
-            return out.at[bidx, resp_a, gj, gi].add(
-                jnp.where(responsible, vals, 0.0))
+            safe_a = jnp.where(responsible, resp_a, na)  # na = out of range
+            return out.at[bidx, safe_a, gj, gi].set(
+                jnp.where(responsible, vals, 0.0), mode="drop")
 
         obj_tgt = jnp.clip(scatter(jnp.ones_like(gx)), 0, 1)
         sc = (gscore.astype(jnp.float32) if gscore is not None
@@ -705,8 +710,9 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         cls_tgt = jnp.zeros((n, na, class_num, h, w), jnp.float32)
         bidx = jnp.arange(n)[:, None] * jnp.ones_like(gi)
         safe_lb = jnp.clip(glabel, 0, class_num - 1)
-        cls_tgt = cls_tgt.at[bidx, resp_a, safe_lb, gj, gi].add(
-            jnp.where(responsible, 1.0, 0.0))
+        safe_a2 = jnp.where(responsible, resp_a, na)
+        cls_tgt = cls_tgt.at[bidx, safe_a2, safe_lb, gj, gi].set(
+            1.0, mode="drop")
         cls_tgt = jnp.clip(cls_tgt, smooth, 1.0 - smooth)
         loss_cls = jnp.sum(jnp.where(obj_mask[:, :, None], bce(pcls, cls_tgt),
                                      0.0), axis=(1, 2, 3, 4))

@@ -498,11 +498,13 @@ def _aug_affine(hwc, mat, fill=128):
 
 def _aug_apply(hwc, op, magnitude, fill=128):
     """One augmentation primitive on a uint8-ish HWC array. `magnitude`
-    is already in the op's natural units."""
+    is already in the op's natural units. `fill` is specified on the
+    0-255 scale and rescaled for float images in [0, 1]."""
     import scipy.ndimage as ndi
     h, w = hwc.shape[:2]
     f32 = hwc.astype(np.float32)
     mx = 255.0 if hwc.max() > 1.5 else 1.0
+    fill = fill * (mx / 255.0)
     if op == "Identity":
         return hwc
     if op == "Brightness":

@@ -232,3 +232,65 @@ def test_prior_box_duplicate_min_sizes():
     w1 = b[0, 0, 1, 2] - b[0, 0, 1, 0]
     w3 = b[0, 0, 3, 2] - b[0, 0, 3, 0]
     assert abs(w1 - w3) > 1e-6
+
+
+def test_py_func_skip_vars_in_backward_input():
+    def fwd(a, b):
+        return a * b
+
+    # backward_func returns gradients for the NON-skipped inputs only
+    def bwd_kept(a, gy):
+        return gy * 10.0
+
+    a = paddle.to_tensor(np.array([2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0], np.float32))
+    a.stop_gradient = False
+    b.stop_gradient = False
+    y = paddle.static.py_func(fwd, [a, b], paddle.zeros([1]),
+                              backward_func=bwd_kept,
+                              skip_vars_in_backward_input=[b])
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(np.asarray(a.grad._data), [10.0])
+    np.testing.assert_allclose(np.asarray(b.grad._data), [0.0])
+
+
+def test_yolo_loss_same_cell_targets_bounded():
+    from paddle_tpu.vision.ops import yolo_loss
+    # two gts in the SAME cell with the same best anchor: targets must be
+    # single-owner, not summed (tx/ty stay within the sigmoid range)
+    pred = paddle.to_tensor(np.zeros((1, 27, 4, 4), np.float32))
+    gt_box = paddle.to_tensor(np.array(
+        [[[12, 12, 8, 12], [14, 14, 8, 12]]], np.float32))
+    gt_label = paddle.to_tensor(np.array([[1, 2]], np.int64))
+    loss = yolo_loss(pred, gt_box, gt_label,
+                     anchors=[10, 13, 16, 30, 33, 23],
+                     anchor_mask=[0, 1, 2], class_num=4,
+                     ignore_thresh=0.7, downsample_ratio=8)
+    l = float(np.asarray(loss._data)[0])
+    assert np.isfinite(l) and 0 < l < 100, l
+
+
+def test_asgd_averaged():
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.ASGD(learning_rate=0.1,
+                                parameters=m.parameters())
+    w0 = np.asarray(m.weight._data).copy()
+    x = paddle.ones([2, 4])
+    for _ in range(3):
+        loss = paddle.mean(m(x) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    avg = np.asarray(opt.averaged(m.weight)._data)
+    cur = np.asarray(m.weight._data)
+    # the average lags the current iterate and differs from the start
+    assert not np.allclose(avg, cur)
+    assert not np.allclose(avg, w0)
+
+
+def test_augment_float_image_fill_in_range():
+    from paddle_tpu.vision.transforms import _aug_apply
+    img = np.random.RandomState(0).rand(16, 16, 3).astype(np.float32)
+    out = _aug_apply(img, "Rotate", 45.0)
+    assert out.max() <= 1.0 + 1e-6, out.max()
