@@ -255,3 +255,115 @@ def add_n(inputs, name=None):
 
 
 Tensor.add_n = staticmethod(add_n)
+
+
+# -- in-place unary/binary wrappers (taped; ref: Tensor.<op>_) --------------
+
+def _make_inplace(fn):
+    def method(x, *args, **kwargs):
+        return _inplace_taped(x, lambda a: fn(a, *args, **kwargs))
+    return method
+
+
+Tensor.divide_ = _make_inplace(math.divide)
+Tensor.floor_ = _make_inplace(math.floor)
+Tensor.ceil_ = _make_inplace(math.ceil)
+Tensor.exp_ = _make_inplace(math.exp)
+Tensor.sqrt_ = _make_inplace(math.sqrt)
+Tensor.rsqrt_ = _make_inplace(math.rsqrt)
+Tensor.reciprocal_ = _make_inplace(math.reciprocal)
+Tensor.round_ = _make_inplace(math.round)
+Tensor.abs_ = _make_inplace(math.abs)
+Tensor.tanh_ = _make_inplace(math.tanh)
+Tensor.sigmoid_ = _make_inplace(math.sigmoid)
+Tensor.put_along_axis_ = _make_inplace(manipulation.put_along_axis)
+Tensor.index_put_ = _make_inplace(manipulation.index_put)
+Tensor.index_add_ = _make_inplace(manipulation.index_add)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """ref: paddle.Tensor.fill_diagonal_ (functional form): fill the
+    main (offset) diagonal of a 2-D tensor; ND fills the [i, i, ..., i]
+    hyperdiagonal."""
+    import builtins
+
+    import jax.numpy as jnp
+
+    if offset != 0 and getattr(x, "ndim", 2) != 2:
+        raise ValueError(
+            "fill_diagonal: offset is only defined for 2-D tensors "
+            f"(got ndim={x.ndim}, offset={offset})")
+
+    # NB: bare min/max here would resolve to paddle's REDUCTION ops
+    # (star-imported above) — use the builtins explicitly
+    def f(a):
+        if a.ndim == 2:
+            rows, cols = a.shape
+            if wrap and offset == 0 and rows > cols:
+                # tall matrix wrap (reference semantics): the diagonal
+                # restarts after a one-row gap every (cols + 1) rows
+                r = jnp.arange(rows)
+                c = r % (cols + 1)
+                keep = c < cols
+                r, c = r[keep], c[keep]
+            elif offset >= 0:
+                n = builtins.max(builtins.min(rows, cols - offset), 0)
+                r = jnp.arange(n)
+                c = r + offset
+            else:
+                n = builtins.max(builtins.min(rows + offset, cols), 0)
+                r = jnp.arange(n) - offset
+                c = jnp.arange(n)
+            return a.at[r, c].set(jnp.asarray(value).astype(a.dtype))
+        idx = jnp.arange(builtins.min(a.shape))
+        return a.at[tuple([idx] * a.ndim)].set(
+            jnp.asarray(value).astype(a.dtype))
+
+    return _run_op("fill_diagonal", f, (x,), {})
+
+
+def _fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    return _inplace_taped(x, lambda a: fill_diagonal(a, value, offset, wrap))
+
+
+Tensor.fill_diagonal_ = _fill_diagonal_
+
+
+def _tensor_gradient(x):
+    """ref: legacy Tensor.gradient() — the accumulated grad as ndarray."""
+    import numpy as np
+    if x.grad is None:
+        return None
+    return np.asarray(x.grad._data)
+
+
+Tensor.gradient = _tensor_gradient
+
+
+def fliplr(x, name=None):
+    """ref: paddle.fliplr — flip along axis 1."""
+    return manipulation.flip(x, axis=1)
+
+
+def flipud(x, name=None):
+    """ref: paddle.flipud — flip along axis 0."""
+    return manipulation.flip(x, axis=0)
+
+
+bitwise_invert = math.bitwise_not
+Tensor.fliplr = fliplr
+Tensor.flipud = flipud
+Tensor.bitwise_invert = math.bitwise_not
+
+
+def binomial(count, prob, name=None):
+    """ref: paddle.binomial — elementwise Binomial(count, prob) draws."""
+    import jax as _jax
+    import jax.numpy as jnp
+
+    def f(c, p):
+        # f64 counts: float32 would silently round trial counts > 2^24
+        return _jax.random.binomial(_fill_key(0), c.astype(jnp.float64),
+                                    p.astype(jnp.float32)).astype(jnp.int64)
+
+    return _run_op("binomial", f, (count, prob), {})

@@ -294,3 +294,32 @@ def test_augment_float_image_fill_in_range():
     img = np.random.RandomState(0).rand(16, 16, 3).astype(np.float32)
     out = _aug_apply(img, "Rotate", 45.0)
     assert out.max() <= 1.0 + 1e-6, out.max()
+
+
+def test_fourth_sweep_tensor_tail():
+    t = paddle.to_tensor(np.array([1.0, 4.0, 9.0], np.float32))
+    t.sqrt_()
+    np.testing.assert_allclose(np.asarray(t._data), [1, 2, 3])
+    m = paddle.zeros([4, 4])
+    m.fill_diagonal_(1.0, offset=1)
+    assert np.asarray(m._data)[0, 1] == 1.0
+    m.fill_diagonal_(2.0, offset=-1)
+    assert np.asarray(m._data)[1, 0] == 2.0
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(paddle.fliplr(x)._data),
+                               np.fliplr(np.arange(6).reshape(2, 3)))
+    np.testing.assert_allclose(np.asarray(paddle.flipud(x)._data),
+                               np.flipud(np.arange(6).reshape(2, 3)))
+    b = paddle.binomial(paddle.to_tensor(np.full(500, 10, np.int64)),
+                        paddle.to_tensor(np.full(500, 0.5, np.float32)))
+    assert abs(float(np.asarray(b._data).mean()) - 5.0) < 0.6
+    inv = paddle.bitwise_invert(paddle.to_tensor(np.array([0], np.int32)))
+    assert int(np.asarray(inv._data)[0]) == -1
+    # taped in-place: grads flow through sqrt_
+    z = paddle.to_tensor(np.array([4.0], np.float32))
+    z.stop_gradient = False
+    w = z * 1.0
+    w.sqrt_()
+    paddle.sum(w).backward()
+    np.testing.assert_allclose(np.asarray(z.grad._data), [0.25])
+    np.testing.assert_allclose(z.gradient(), [0.25])
