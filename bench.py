@@ -34,13 +34,16 @@ def peak_flops(device) -> float:
     return PEAK_BF16_FLOPS["cpu"]
 
 
-def run_config(config, batch, seq, dev, policy="save_attn"):
+def run_config(config, batch, seq, dev, policy="save_mlp"):
     """Train-step MFU for one model config. Returns (mfu, tok_s, dt, loss).
 
-    policy: remat policy. 'save_attn' (keep flash outputs across the remat
-    boundary) wins on the flagship head_dim=128 shape; plain 'full' wins on
-    the head_dim=64 shape (measured each round); 'dots'/no-remat exceed
-    memory at these shapes."""
+    policy: remat policy. 'save_mlp' (keep flash outputs AND the gate/up
+    matmul outputs — half the forward matmul FLOPs — across the remat
+    boundary) wins wherever the residuals fit: flagship 0.621 vs 0.612
+    (save_attn), 13B-geometry 0.642 vs 0.602, hd64 0.466. The 7B
+    geometry (L=4, B=8) cannot hold the extra [B, S, I] residuals and
+    keeps 'save_attn'; 'dots'/no-remat exceed memory at all these
+    shapes."""
     import jax
     from paddle_tpu.models.llama import (ParallelConfig, build_train_step,
                                          train_flops_per_token)
@@ -343,8 +346,7 @@ def main():
         "loss": round(loss, 4),
     }
     if config_hd64 is not None:
-        mfu64, tok_s64, dt64, _ = run_config(config_hd64, batch, seq, dev,
-                                             policy="full")
+        mfu64, tok_s64, dt64, _ = run_config(config_hd64, batch, seq, dev)
         detail["hd64_shape"] = {
             "mfu": round(float(mfu64), 4),
             "tokens_per_sec_per_chip": round(tok_s64, 1),
@@ -364,9 +366,9 @@ def main():
         # keeps the embedding from crowding out layers — per-layer MFU is
         # the quantity of interest. Per-chip MFU at these shapes is the
         # single-chip factor of the v5p-128 north-star target.
-        for key, h, inter, heads, L7, b7 in (
-                ("7b_shape", 4096, 11008, 32, 4, 8),
-                ("13b_layer", 5120, 13824, 40, 2, 8)):
+        for key, h, inter, heads, L7, b7, pol in (
+                ("7b_shape", 4096, 11008, 32, 4, 8, "save_attn"),
+                ("13b_layer", 5120, 13824, 40, 2, 8, "save_mlp")):
             cfg_ns = LlamaConfig(vocab_size=8192, hidden_size=h,
                                  intermediate_size=inter,
                                  num_hidden_layers=L7,
@@ -374,7 +376,8 @@ def main():
                                  num_key_value_heads=heads,
                                  max_position_embeddings=seq,
                                  dtype=jnp.bfloat16)
-            mfu_ns, tok_ns, dt_ns, _ = run_config(cfg_ns, b7, seq, dev)
+            mfu_ns, tok_ns, dt_ns, _ = run_config(cfg_ns, b7, seq, dev,
+                                                  policy=pol)
             detail[key] = {
                 "mfu": round(float(mfu_ns), 4),
                 "tokens_per_sec_per_chip": round(tok_ns, 1),

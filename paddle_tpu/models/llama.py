@@ -197,18 +197,73 @@ def _maybe_hint(x, mesh, spec):
 
 
 def _mat(x, w):
-    """x @ w for plain weights or weight-only int8 ({'w': int8 [..., in,
-    out], 's': [..., out] scales}). The int8->bf16 convert fuses into the
-    matmul's operand read (measured 1.97x on a decode-shaped matvec), so
-    quantized weights stream at half the bytes — see
-    quantize_llama_int8."""
+    """x @ w for plain weights, weight-only int8 ({'w': int8 [..., in,
+    out], 's': [..., out] scales}), or the decode-transposed form (key
+    'wT': [..., out, in], optional 's'; see _decode_weights). The int8->bf16
+    convert fuses into the matmul's operand read (measured 1.97x on a
+    decode-shaped matvec), so quantized weights stream at half the
+    bytes — see quantize_llama_int8."""
     if isinstance(w, dict):
+        if "wT" in w:
+            r = jnp.einsum("...i,oi->...o", x, w["wT"].astype(x.dtype))
+            return r * w["s"].astype(x.dtype) if "s" in w else r
         return (x @ w["w"].astype(x.dtype)) * w["s"].astype(x.dtype)
     return x @ w
 
 
 def _mat_out_dim(w):
-    return (w["w"] if isinstance(w, dict) else w).shape[-1]
+    if isinstance(w, dict):
+        if "wT" in w:
+            return w["wT"].shape[-2]
+        return w["w"].shape[-1]
+    return w.shape[-1]
+
+
+def _decode_weights(params, config):
+    """Transpose the stacked q/k/v projections to [L, out, in] ONCE per
+    generate call (outside the token scan). XLA's chosen operand layout
+    for the [B, H] @ W decode matmuls is in-dim-minor; slicing the
+    natural [L, in, out] stack per layer forced a 2 MB relayout copy per
+    projection per layer EVERY token step (profiled ~0.2 ms/step at hd64
+    b8 — constant_dynamic-slice fusions with transposed output layout).
+    The transposed stack slices straight into the wanted layout; the
+    one-time transpose cost amortizes over the whole continuation."""
+    layers = dict(params["layers"])
+    if "qkv_proj" in layers:
+        return params  # already prepared
+    # the fused split site (llama_decode_step) re-derives nh/nkv from
+    # config, so only fuse when the actual weight shapes agree with the
+    # config's head ratio — mismatched params (e.g. pruned heads) keep
+    # the unfused three-matmul path instead of silently mis-splitting
+    q_out = _mat_out_dim(layers["q_proj"])
+    k_out = _mat_out_dim(layers["k_proj"])
+    ratio = config.num_attention_heads // config.num_key_value_heads
+    if q_out != k_out * ratio or k_out != _mat_out_dim(layers["v_proj"]):
+        for name in ("q_proj", "k_proj", "v_proj"):
+            w = layers[name]
+            if isinstance(w, dict):
+                if "wT" in w:
+                    continue
+                layers[name] = {"wT": jnp.swapaxes(w["w"], -1, -2),
+                                "s": w["s"]}
+            else:
+                layers[name] = {"wT": jnp.swapaxes(w, -1, -2)}
+        out = dict(params)
+        out["layers"] = layers
+        return out
+    ws = [layers.pop(n) for n in ("q_proj", "k_proj", "v_proj")]
+    if isinstance(ws[0], dict):
+        layers["qkv_proj"] = {
+            "wT": jnp.concatenate(
+                [jnp.swapaxes(w["w"], -1, -2) for w in ws], axis=-2),
+            "s": jnp.concatenate([w["s"] for w in ws], axis=-1),
+        }
+    else:
+        layers["qkv_proj"] = {"wT": jnp.concatenate(
+            [jnp.swapaxes(w, -1, -2) for w in ws], axis=-2)}
+    out = dict(params)
+    out["layers"] = layers
+    return out
 
 
 def quantize_llama_int8(params):
@@ -247,6 +302,7 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
     compute + lax.psum after the row-parallel matmuls (o_proj, down_proj);
     when None, GSPMD derives the same collectives from param shardings.
     """
+    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
     c = config
     b, s, _ = h_in.shape
     hd = c.head_dim
@@ -272,7 +328,6 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
     attn = attn.reshape(b, s, nh * hd)
     # named so the 'save_attn' remat policy can keep it (skips recomputing
     # the flash kernel in backward at the cost of one [B,S,H*D] residual)
-    from jax.ad_checkpoint import checkpoint_name as _ckpt_name
     attn = _ckpt_name(attn, "attn_out")
     attn_out = _mat(attn, p["o_proj"])
     if tp_axis is not None:
@@ -280,7 +335,13 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
     h = h_in + _maybe_hint(attn_out, mesh, _act_spec(parallel))
 
     x = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
-    gated = jax.nn.silu(_mat(x, p["gate_proj"])) * _mat(x, p["up_proj"])
+    # named so 'save_mlp' can keep the gate/up matmul outputs across the
+    # remat boundary — gate+up are HALF the forward matmul FLOPs, so
+    # saving them halves the backward recompute at the cost of two
+    # [B, S, I] residuals per layer
+    g = _ckpt_name(_mat(x, p["gate_proj"]), "mlp_gate")
+    u = _ckpt_name(_mat(x, p["up_proj"]), "mlp_up")
+    gated = jax.nn.silu(g) * u
     mlp_out = _mat(gated, p["down_proj"])
     if tp_axis is not None:
         mlp_out = lax.psum(mlp_out, tp_axis)
@@ -294,6 +355,11 @@ def _remat_policy(parallel):
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if parallel.remat_policy == "save_attn":
         return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if parallel.remat_policy == "save_mlp":
+        # attn output + gate/up matmul outputs: backward recomputes only
+        # the cheap elementwise/norm chain plus qkv/o (19% of fwd FLOPs)
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "mlp_gate", "mlp_up")
     if parallel.remat_policy == "full":
         return None
     if parallel.remat_policy == "offload_attn":
@@ -307,7 +373,8 @@ def _remat_policy(parallel):
             offload_src="device", offload_dst="pinned_host")
     raise ValueError(
         f"unknown remat_policy {parallel.remat_policy!r}; "
-        "expected 'full', 'dots', 'save_attn', or 'offload_attn'")
+        "expected 'full', 'dots', 'save_attn', 'save_mlp', or "
+        "'offload_attn'")
 
 
 def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
@@ -429,16 +496,35 @@ def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
 # ---------------------------------------------------------------------------
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int):
-    """Stacked per-layer cache: k/v of [L, B, KV, max_len, HD].
+    """Stacked per-layer cache: k AND v [L, B, KV*HD, max_len]
+    (time-in-lanes slabs) for head_dim < 128.
 
-    Head-major layout: the decode attention contracts over the time dim,
-    and [KV, T, HD] makes each head's [T, HD] panel contiguous — the
-    [B, T, KV, HD] layout forced XLA to TRANSPOSE both cache slices every
-    layer of every step (measured 1.5 ms/step of pure copies at b8 on the
-    hd64 shape, the whole gap between b8 and the weight-stream floor)."""
+    These are the layouts the BLOCK-DIAGONAL decode attention consumes
+    (see llama_decode_step): scores = Q_blockdiag [NH, KV*HD] @ K-slab
+    [KV*HD, T] and values = V-slab [KV*HD, T] contracted over T — one
+    MXU-shaped matmul per batch element per layer instead of NH separate
+    [1, HD] matvecs. At head_dim 64 the per-head matvecs ran 2.5x their
+    bytes-bound time (M=1 sublane padding + HD=64 half-lane contraction,
+    profiled 14 us vs 5.6 for the score einsum at b8); the slab matmuls
+    are bytes-bound. V shares K's layout so both per-token writes are
+    in-place lane columns and both per-layer reads fuse into the dot —
+    a time-major [T, KV*HD] V measured a 4.2 MB slice copy + a copying
+    row update per layer per step (~0.26 ms/step at hd64 b8). At
+    head_dim >= 128 the per-head contraction already fills the lanes and
+    the block-diag detour measured SLOWER (flagship b8: 2.92 vs 2.81
+    ms/step), so those configs keep the head-major [L, B, KV, T, HD]
+    cache + grouped einsums. Earlier layouts for the next reader:
+    [B, T, KV, HD] forced whole-cache transposes every layer (~1.5
+    ms/step of pure copies)."""
     c = config
-    shape = (c.num_hidden_layers, batch, c.num_key_value_heads, max_len,
-             c.head_dim)
+    if c.head_dim >= 128:
+        shape = (c.num_hidden_layers, batch, c.num_key_value_heads,
+                 max_len, c.head_dim)
+        return {"k": jnp.zeros(shape, c.dtype),
+                "v": jnp.zeros(shape, c.dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+    kvd = c.num_key_value_heads * c.head_dim
+    shape = (c.num_hidden_layers, batch, kvd, max_len)
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype),
             "pos": jnp.zeros((), jnp.int32)}
 
@@ -449,7 +535,8 @@ def llama_prefill(params, cache, ids, config: LlamaConfig):
     into one compiled call with MXU-sized matmuls."""
     c = config
     b, s = ids.shape
-    max_len = cache["k"].shape[3]
+    slab = c.head_dim < 128  # see init_kv_cache
+    max_len = cache["k"].shape[3]  # T is dim 3 in both layouts
     h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)  # [B, S, H]
     cos_all, sin_all = build_rope_cache(max_len, c.head_dim, base=c.rope_theta)
     cos, sin = cos_all[:s], sin_all[:s]
@@ -465,13 +552,26 @@ def llama_prefill(params, cache, ids, config: LlamaConfig):
         v = _mat(x, p["v_proj"]).reshape(b, s, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        # cache layout is head-major [B, KV, T, HD] (see init_kv_cache)
-        k_cache = lax.dynamic_update_slice(
-            k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype),
-            (0, 0, 0, 0))
-        v_cache = lax.dynamic_update_slice(
-            v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
-            (0, 0, 0, 0))
+        if slab:
+            # k and v [B, KV*HD, T] (time-in-lanes)
+            k_cache = lax.dynamic_update_slice(
+                k_cache,
+                k.reshape(b, s, nkv * hd).transpose(0, 2, 1)
+                 .astype(k_cache.dtype),
+                (0, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache,
+                v.reshape(b, s, nkv * hd).transpose(0, 2, 1)
+                 .astype(v_cache.dtype),
+                (0, 0, 0))
+        else:
+            # head-major [B, KV, T, HD]
+            k_cache = lax.dynamic_update_slice(
+                k_cache, k.transpose(0, 2, 1, 3).astype(k_cache.dtype),
+                (0, 0, 0, 0))
+            v_cache = lax.dynamic_update_slice(
+                v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
+                (0, 0, 0, 0))
         from ..nn.functional.attention import _xla_sdpa
         attn = _xla_sdpa(q, k, v, is_causal=True)
         attn_out = _mat(attn.reshape(b, s, nh * hd), p["o_proj"])
@@ -500,7 +600,8 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
     """
     c = config
     b = ids.shape[0]
-    max_len = cache["k"].shape[3]
+    slab = c.head_dim < 128  # see init_kv_cache
+    max_len = cache["k"].shape[3]  # T is dim 3 in both layouts
     pos = cache["pos"]
     h = jnp.take(params["embed"], ids[:, 0], axis=0).astype(c.dtype)  # [B, H]
 
@@ -517,44 +618,93 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
         h, kc, vc = carry
         p, layer = xs
         hd = c.head_dim
-        nh = _mat_out_dim(p["q_proj"]) // hd
-        nkv = _mat_out_dim(p["k_proj"]) // hd
         x = fused_rms_norm(h[:, None], p["input_norm"], c.rms_norm_eps)
-        q = _mat(x, p["q_proj"]).reshape(b, 1, nh, hd)
-        k = _mat(x, p["k_proj"]).reshape(b, 1, nkv, hd)
-        v = _mat(x, p["v_proj"]).reshape(b, 1, nkv, hd)
+        if "qkv_proj" in p:
+            # fused projection (_decode_weights): one weight slice + one
+            # matmul per layer instead of three
+            ratio = c.num_attention_heads // c.num_key_value_heads
+            nkv = _mat_out_dim(p["qkv_proj"]) // hd // (ratio + 2)
+            nh = nkv * ratio
+            qkv = _mat(x, p["qkv_proj"])
+            q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+            q = q.reshape(b, 1, nh, hd)
+            k = k.reshape(b, 1, nkv, hd)
+            v = v.reshape(b, 1, nkv, hd)
+        else:
+            nh = _mat_out_dim(p["q_proj"]) // hd
+            nkv = _mat_out_dim(p["k_proj"]) // hd
+            q = _mat(x, p["q_proj"]).reshape(b, 1, nh, hd)
+            k = _mat(x, p["k_proj"]).reshape(b, 1, nkv, hd)
+            v = _mat(x, p["v_proj"]).reshape(b, 1, nkv, hd)
+        kvd = nkv * hd
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
 
         zero = jnp.zeros((), jnp.int32)
-        # head-major cache [L, B, KV, T, HD]: the new [B, 1, KV, HD] k/v
-        # transpose to [B, KV, 1, HD] slivers, and both attention einsums
-        # contract against CONTIGUOUS per-head [T, HD] panels — the
-        # time-major layout transposed ~the whole cache every layer
-        # (pure-copy fusions, the b8 decode-floor gap)
         layer_i = jnp.asarray(layer, jnp.int32)
-        kc = lax.dynamic_update_slice(
-            kc, k.transpose(0, 2, 1, 3).astype(kc.dtype)[None],
-            (layer_i, zero, zero, pos, zero))
-        vc = lax.dynamic_update_slice(
-            vc, v.transpose(0, 2, 1, 3).astype(vc.dtype)[None],
-            (layer_i, zero, zero, pos, zero))
-        k_cache = lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
-        v_cache = lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
-        # grouped-query scores against the unrepeated cache: no [B,T,NH,HD]
-        # head-repeat temporaries in the decode hot loop (an elementwise
-        # broadcast+reduce VPU formulation measured SLOWER than these
-        # einsums at b8: 2.48 vs 2.15 ms/step on hd64)
         rep = nh // nkv
         qg = q[:, 0].reshape(b, nkv, rep, hd)
-        scores = jnp.einsum("bgrd,bgtd->bgrt", qg, k_cache,
-                            preferred_element_type=jnp.float32)
-        scores = scores / (hd ** 0.5)
-        valid = jnp.arange(max_len)[None, None, None, :] <= pos
-        scores = jnp.where(valid, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-        attn = jnp.einsum("bgrt,bgtd->bgrd", probs, v_cache,
-                          preferred_element_type=jnp.float32).astype(c.dtype)
+        if slab:
+            # cache k AND v [B, KV*HD, T]; each step writes one in-place
+            # lane column per slab.
+            kc = lax.dynamic_update_slice(
+                kc, k.reshape(b, kvd, 1).astype(kc.dtype)[None],
+                (layer_i, zero, zero, pos))
+            vc = lax.dynamic_update_slice(
+                vc, v.reshape(b, kvd, 1).astype(vc.dtype)[None],
+                (layer_i, zero, zero, pos))
+            k_cache = lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
+            v_cache = lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
+            # BLOCK-DIAGONAL attention: per batch element ONE [NH, KV*HD]
+            # x [KV*HD, T] score matmul and ONE [KV*HD, T] x [T, NH]
+            # value matmul. q is scattered into a block-diagonal
+            # [NH, KV*HD] (head (g, r) occupies kv-group g's column
+            # block; the zeros kill cross-head terms exactly), and the
+            # value result's diagonal blocks are gathered back. Trades
+            # nkv x padded FLOPs (~0.3 us/layer; decode is bytes-bound)
+            # for MXU-shaped operands: per-head [1, HD<128] matvecs ran
+            # 2.5x bytes-bound time (M=1 sublane padding, profiled 14 vs
+            # 5.6 us at hd64 b8); a VPU broadcast+reduce formulation was
+            # worse still (2.48 ms/step).
+            eye = jnp.eye(nkv, dtype=qg.dtype)
+            q_bd = jnp.einsum("bgrd,ge->bgred", qg, eye).reshape(b, nh, kvd)
+            scores = jnp.einsum("bhc,bct->bht", q_bd, k_cache,
+                                preferred_element_type=jnp.float32)
+            scores = scores / (hd ** 0.5)
+            valid = jnp.arange(max_len)[None, None, :] <= pos
+            scores = jnp.where(valid, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+            # V slab as the dot RHS contracting its minor (T) dim — the
+            # same operand role the K slab plays in the score einsum,
+            # so XLA assigns the same in-place layout (V as LHS or
+            # time-major both measured a 4.2 MB slice copy per layer).
+            attn_full = jnp.einsum("bht,bct->bhc", probs, v_cache,
+                                   preferred_element_type=jnp.float32)
+            attn = jnp.einsum("bgred,ge->bgrd",
+                              attn_full.reshape(b, nkv, rep, nkv, hd),
+                              eye.astype(attn_full.dtype)).astype(c.dtype)
+        else:
+            # head-major cache [B, KV, T, HD]: grouped-query einsums
+            # against contiguous per-head [T, HD] panels — at HD >= 128
+            # the contraction fills the lanes and this is bytes-bound;
+            # the block-diag detour measured slower here.
+            kc = lax.dynamic_update_slice(
+                kc, k.transpose(0, 2, 1, 3).astype(kc.dtype)[None],
+                (layer_i, zero, zero, pos, zero))
+            vc = lax.dynamic_update_slice(
+                vc, v.transpose(0, 2, 1, 3).astype(vc.dtype)[None],
+                (layer_i, zero, zero, pos, zero))
+            k_cache = lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
+            v_cache = lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
+            scores = jnp.einsum("bgrd,bgtd->bgrt", qg, k_cache,
+                                preferred_element_type=jnp.float32)
+            scores = scores / (hd ** 0.5)
+            valid = jnp.arange(max_len)[None, None, None, :] <= pos
+            scores = jnp.where(valid, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
+            attn = jnp.einsum("bgrt,bgtd->bgrd", probs, v_cache,
+                              preferred_element_type=jnp.float32
+                              ).astype(c.dtype)
         attn_out = _mat(attn.reshape(b, nh * hd), p["o_proj"])
         h = h + attn_out
 
@@ -581,6 +731,8 @@ def generate_scan(params, cache, first_token, num_tokens,
     first_token: [B, 1] int32 (normally argmax of the prefill logits).
     Returns (tokens [B, num_tokens], cache).
     """
+    params = _decode_weights(params, config)
+
     def step(carry, _):
         cache, tok = carry
         logits, cache = llama_decode_step(params, cache, tok, config)
@@ -619,6 +771,8 @@ def sample_scan(params, cache, first_logits, num_tokens, config, key,
                 temperature=1.0, top_k=0, top_p=1.0):
     """Sampling counterpart of generate_scan: the whole continuation is one
     device dispatch; the PRNG key splits per step inside the scan."""
+    params = _decode_weights(params, config)
+
     def step(carry, _):
         cache, tok, key = carry
         key, sub = jax.random.split(key)

@@ -316,7 +316,9 @@ def _fwd_kernel_varlen_stacked(qi_ref, ki_ref, first_ref, last_ref, live_ref,
 
 
 def _stacked_nh(h):
-    """Heads fused per grid step: largest divisor of h that is <= 8."""
+    """Heads fused per grid step: largest power-of-two divisor of h that
+    is <= 8 (powers of two keep the stacked scratch row count
+    tile-aligned; non-power-of-two head counts amortize less)."""
     for cand in (8, 4, 2, 1):
         if h % cand == 0:
             return cand
@@ -714,13 +716,16 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
         import numpy as np
         cuq_np = np.asarray(cu_q)
         cuk_np = np.asarray(cu_k)
-        if self_attn and len(cuq_np) > 1:
+        if self_attn and len(cuq_np) > 1 \
+                and (block_q, block_k) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K):
             # short-segment packs (mean segment < 1024 tokens) go to the
             # rows-stacked head-fused kernel: small tiles cut the
             # dead-area waste of 1024^2 tiles quadratically, and stacking
             # pays the serial softmax-chain latency once per chunk
             # instead of once per (chunk, head). Long-segment packs keep
             # the per-head streaming kernel (full-rate 1024^2 matmuls).
+            # Callers passing EXPLICIT block sizes get the streaming
+            # kernel with exactly those blocks (tuning stays honored).
             mean_seg = tq / (len(cuq_np) - 1)
             stacked = bool(mean_seg < 1024)
         if stacked:

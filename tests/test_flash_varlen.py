@@ -182,3 +182,51 @@ def test_max_seqlen_smaller_than_longest_segment_raises():
     with pytest.raises(ValueError, match="max_seqlen"):
         flash_varlen_attention(q, q, q, cu, cu, scale=0.125, causal=True,
                                max_seqlen=256)
+
+
+def test_stacked_path_matches_streaming_and_ref():
+    """The rows-stacked head-fused kernel (auto-selected for short-segment
+    packs at DEFAULT blocks) must match both the per-head streaming kernel
+    (forced via explicit non-default blocks) and the dense reference —
+    including a non-power-of-two head count (nh grouping falls to 2)."""
+    for heads in (4, 6):
+        rng = np.random.RandomState(13 + heads)
+        lens = [70, 300, 33, 129, 256, 64]
+        q, cu = _packed(lens, heads, rng)
+        k, _ = _packed(lens, heads, rng)
+        v, _ = _packed(lens, heads, rng)
+        stacked = flash_varlen_attention(q, k, v, cu, cu, SCALE, True,
+                                         self_attn=True)
+        streaming = flash_varlen_attention(q, k, v, cu, cu, SCALE, True,
+                                           self_attn=True, block_q=128,
+                                           block_k=128)
+        ref = _dense_ref(q, k, v, cu, cu, True, SCALE)
+        np.testing.assert_allclose(np.asarray(stacked), ref,
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(stacked), np.asarray(streaming),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_stacked_path_backward_matches_ref():
+    """Grads through the stacked forward flow to the (block-size-agnostic)
+    streaming backward; check against numerical grads of the dense ref."""
+    rng = np.random.RandomState(17)
+    lens = [60, 130, 40]
+    q, cu = _packed(lens, 2, rng)
+    k, _ = _packed(lens, 2, rng)
+    v, _ = _packed(lens, 2, rng)
+
+    def loss(q, k, v):
+        return (flash_varlen_attention(q, k, v, cu, cu, SCALE, True,
+                                       self_attn=True) ** 2).sum()
+
+    def loss_stream(q, k, v):
+        return (flash_varlen_attention(q, k, v, cu, cu, SCALE, True,
+                                       self_attn=True, block_q=128,
+                                       block_k=128) ** 2).sum()
+
+    g_stacked = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_stream = jax.grad(loss_stream, argnums=(0, 1, 2))(q, k, v)
+    for gs, gr in zip(g_stacked, g_stream):
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3)
