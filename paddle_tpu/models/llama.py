@@ -572,8 +572,14 @@ def llama_prefill(params, cache, ids, config: LlamaConfig):
             v_cache = lax.dynamic_update_slice(
                 v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype),
                 (0, 0, 0, 0))
-        from ..nn.functional.attention import _xla_sdpa
-        attn = _xla_sdpa(q, k, v, is_causal=True)
+        from ..ops._common import interpret_mode
+        if s >= 1024 and not interpret_mode():
+            # long prompts: the Pallas flash kernel (O(S) memory, causal
+            # DMA skipping) — XLA sdpa materializes [B, H, S, S] scores
+            attn = flash_attention_bshd(q, k, v, causal=True)
+        else:
+            from ..nn.functional.attention import _xla_sdpa
+            attn = _xla_sdpa(q, k, v, is_causal=True)
         attn_out = _mat(attn.reshape(b, s, nh * hd), p["o_proj"])
         h = h + attn_out
         x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
