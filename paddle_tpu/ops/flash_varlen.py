@@ -315,14 +315,41 @@ def _fwd_kernel_varlen_stacked(qi_ref, ki_ref, first_ref, last_ref, live_ref,
             lse_ref[hh] = big_lse[sl].T
 
 
-def _stacked_nh(h):
+# Scoped-VMEM budget for one stacked grid step. v5e exposes ~16 MB of
+# scoped VMEM to a Mosaic kernel; leave headroom for compiler temporaries.
+# (Measured: f32 inputs at nh=8 request 20.72 MB and fail to compile;
+# bf16 at nh=8 is ~13.9 MB and compiles.)
+_STACKED_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def _stacked_vmem_bytes(nh, itemsize, bq, bk, d):
+    """Estimated scoped-VMEM footprint of one stacked-kernel grid step:
+    f32 scratch (scores + m/l columns + acc) plus double-buffered in/out
+    blocks (q, k, v, code tiles, o, lse)."""
+    scratch = 4 * (nh * bq * bk + 2 * nh * bq * 128 + nh * bq * d)
+    blocks = (nh * bq * d * itemsize          # q
+              + 2 * nh * bk * d * itemsize    # k, v
+              + bq * 128 * 4 + 8 * bk * 4     # code tiles
+              + nh * bq * d * itemsize        # o
+              + nh * bq * 4)                  # lse
+    return scratch + 2 * blocks
+
+
+def _stacked_nh(h, itemsize=2, d=128, bq=None, bk=None):
     """Heads fused per grid step: largest power-of-two divisor of h that
     is <= 8 (powers of two keep the stacked scratch row count
-    tile-aligned; non-power-of-two head counts amortize less)."""
+    tile-aligned; non-power-of-two head counts amortize less) AND whose
+    grid-step footprint fits the scoped-VMEM budget — f32 inputs double
+    the block bytes, so nh=8 that compiles in bf16 OOMs at f32 (advisor
+    r4 finding). Returns 0 when no grouping fits (caller falls back to
+    the per-head streaming kernel)."""
+    bq = STACKED_BLOCK_Q if bq is None else bq
+    bk = STACKED_BLOCK_K if bk is None else bk
     for cand in (8, 4, 2, 1):
-        if h % cand == 0:
+        if h % cand == 0 and _stacked_vmem_bytes(
+                cand, itemsize, bq, bk, d) <= _STACKED_VMEM_BUDGET:
             return cand
-    return 1
+    return 0
 
 
 def _flash_varlen_fwd_stacked(q, k, v, cu_q, causal, scale, block_q,
@@ -348,7 +375,11 @@ def _flash_varlen_fwd_stacked(q, k, v, cu_q, causal, scale, block_q,
     lo, hi = _fwd_bounds(cu_q, cu_q, n_q, block_q, block_k, t, causal, True)
     n_flat = min(n_flat_hint, n_q * n_k) if n_flat_hint else n_q * n_k
     qi_a, ki_a, first_a, last_a, live_a = _flat_schedule(lo, hi, n_q, n_flat)
-    nh = _stacked_nh(h)
+    nh = _stacked_nh(h, jnp.dtype(q.dtype).itemsize, d, block_q, block_k)
+    if nh == 0:
+        raise ValueError(
+            "stacked varlen kernel does not fit VMEM at this dtype/shape; "
+            "selection should have fallen back to the streaming kernel")
     kernel = functools.partial(_fwd_kernel_varlen_stacked, causal=causal,
                                nh=nh, block_q=block_q)
     with _mosaic_ctx():
@@ -726,8 +757,16 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
             # the per-head streaming kernel (full-rate 1024^2 matmuls).
             # Callers passing EXPLICIT block sizes get the streaming
             # kernel with exactly those blocks (tuning stays honored).
+            # The stacked kernel must also FIT scoped VMEM at this dtype
+            # (f32 doubles the block bytes — advisor r4: nh=8 f32 was a
+            # compile-time OOM) and needs >=2 fused heads to amortize
+            # anything; otherwise keep the streaming kernel.
             mean_seg = tq / (len(cuq_np) - 1)
-            stacked = bool(mean_seg < 1024)
+            nh_fit = _stacked_nh(q.shape[1], jnp.dtype(q.dtype).itemsize,
+                                 q.shape[2],
+                                 _fit_block(STACKED_BLOCK_Q, tq),
+                                 _fit_block(STACKED_BLOCK_K, tk))
+            stacked = bool(mean_seg < 1024) and nh_fit >= 2
         if stacked:
             bq2 = _fit_block(STACKED_BLOCK_Q, tq)
             bk2 = _fit_block(STACKED_BLOCK_K, tk)

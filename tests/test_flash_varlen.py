@@ -207,6 +207,37 @@ def test_stacked_path_matches_streaming_and_ref():
                                    rtol=2e-3, atol=2e-3)
 
 
+def test_stacked_path_8_heads_incl_f32():
+    """nh=8 grouping (what real head counts 8/16/32 hit) — untested
+    pre-r5, which hid a compile-time VMEM OOM for f32 inputs (advisor
+    r4): at 4-byte dtypes the nh=8 grid step exceeds scoped VMEM at
+    d=128, so selection must drop to a fitting grouping instead of
+    OOMing. Pins the d=128 capping and runs the nh=8 scratch shapes."""
+    from paddle_tpu.ops.flash_varlen import _stacked_nh
+    assert _stacked_nh(8, itemsize=2, d=128) == 8   # bf16 fits at nh=8
+    assert _stacked_nh(8, itemsize=4, d=128) == 4   # f32 nh=8 would OOM
+    lens = [70, 300, 33, 129, 256, 64]
+    for seed, dtype in ((21, np.float32), (22, jnp.bfloat16)):
+        rng = np.random.RandomState(seed)
+        q, cu = _packed(lens, 8, rng)
+        k, _ = _packed(lens, 8, rng)
+        v, _ = _packed(lens, 8, rng)
+        q, k, v = (x.astype(dtype) for x in (q, k, v))
+        stacked = flash_varlen_attention(q, k, v, cu, cu, SCALE, True,
+                                         self_attn=True)
+        streaming = flash_varlen_attention(q, k, v, cu, cu, SCALE, True,
+                                           self_attn=True, block_q=128,
+                                           block_k=128)
+        tol = 2e-3 if dtype == np.float32 else 2e-2
+        ref = _dense_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32), cu, cu, True, SCALE)
+        np.testing.assert_allclose(
+            np.asarray(stacked, dtype=np.float32), ref, rtol=tol, atol=tol)
+        np.testing.assert_allclose(
+            np.asarray(stacked, dtype=np.float32),
+            np.asarray(streaming, dtype=np.float32), rtol=tol, atol=tol)
+
+
 def test_stacked_path_backward_matches_ref():
     """Grads through the stacked forward flow to the (block-size-agnostic)
     streaming backward; check against numerical grads of the dense ref."""
