@@ -1,9 +1,10 @@
 """Fleet: hybrid-parallel training (ref: python/paddle/distributed/fleet/)."""
 from . import utils
 from .distributed_strategy import DistributedStrategy
-from .fleet import (Fleet, distributed_model, distributed_optimizer, fleet,
-                    init, init_server, init_worker, is_server, is_worker,
-                    run_server, stop_server, stop_worker)
+from .fleet import (Fleet, distributed_model, distributed_optimizer,
+                    distributed_scaler, fleet, init, init_server,
+                    init_worker, is_server, is_worker, run_server,
+                    stop_server, stop_worker)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,
                        get_hybrid_communicate_group,
                        set_hybrid_communicate_group)

@@ -53,15 +53,41 @@ class Fleet:
     def distributed_model(self, model: Layer):
         assert self._is_initialized, "call fleet.init first"
         hcg = self._hcg
+        strategy = self._strategy
+        # strategy.recompute (ref: fleet/meta_optimizers/recompute — a
+        # graph rewrite in the static reference; here sublayer forwards
+        # are wrapped so remat lands in the compiled HLO / eager tape)
+        if strategy is not None and getattr(strategy, "recompute", False):
+            from .recompute.recompute import attach_recompute
+            attach_recompute(
+                model,
+                strategy.recompute_configs.get("checkpoints") or None)
         if hcg.get_pipe_parallel_world_size() > 1:
             if not isinstance(model, PipelineLayer):
                 raise TypeError("pp_degree > 1 requires a PipelineLayer model")
-            return PipelineParallel(model, hcg, self._strategy)
-        if hcg.get_model_parallel_world_size() > 1 or \
+            wrapped = PipelineParallel(model, hcg, strategy)
+        elif hcg.get_model_parallel_world_size() > 1 or \
                 hcg.get_sep_parallel_world_size() > 1:
-            return TensorParallel(model, hcg, self._strategy)
-        # pure dp/sharding: model unchanged (mesh handles it in compiled steps)
-        return model
+            wrapped = TensorParallel(model, hcg, strategy)
+        else:
+            # pure dp/sharding: model unchanged (mesh handles it in
+            # compiled steps)
+            wrapped = model
+        # strategy.amp (ref: fleet/meta_optimizers/amp_optimizer): the
+        # wrapped model's forward runs under auto_cast, so matmul/conv
+        # dispatch casts to the amp dtype in BOTH eager and compiled
+        # (TrainStep traces through this forward). use_pure_fp16 -> O2
+        # param cast with fp32 master weights in the optimizer.
+        if strategy is not None and getattr(strategy, "amp", False):
+            cfg = getattr(strategy, "amp_configs", {}) or {}
+            dtype = "float16" if cfg.get("use_pure_fp16") and \
+                not cfg.get("use_bf16", True) else "bfloat16"
+            level = "O2" if cfg.get("use_pure_fp16") else "O1"
+            from ...amp import decorate as amp_decorate
+            if level == "O2":
+                amp_decorate(model, level="O2", dtype=dtype)
+            _wrap_forward_with_autocast(wrapped, level, dtype)
+        return wrapped
 
     def distributed_optimizer(self, optimizer, strategy=None):
         """Compose the strategy's meta-optimizer toggles around the user
@@ -83,7 +109,21 @@ class Fleet:
                 k = getattr(strategy, "localsgd_configs",
                             {}).get("k_steps", 1)
                 optimizer = LocalSGDOptimizer(optimizer, k_steps=k)
+            if getattr(strategy, "amp", False):
+                # O2 (pure low-precision params) keeps fp32 master
+                # weights in the optimizer (ref: amp meta-optimizer's
+                # master-weight path)
+                cfg = getattr(strategy, "amp_configs", {}) or {}
+                if cfg.get("use_pure_fp16"):
+                    optimizer._multi_precision = True
         return HybridParallelOptimizer(optimizer, self._hcg, strategy)
+
+    def distributed_scaler(self, scaler):
+        """Hybrid-parallel GradScaler (ref: fleet.distributed_scaler):
+        under SPMD the found-inf check is computed on replicated loss/
+        grads inside the compiled step, so the scaler itself needs no
+        per-group allreduce — returned as-is for API parity."""
+        return scaler
 
     # -- parameter-server mode (ref: fleet PS role flow:
     # fleet.init(is_collective=False) -> init_server/run_server on PSERVER
@@ -163,6 +203,24 @@ class Fleet:
         barrier()
 
 
+def _wrap_forward_with_autocast(wrapped, level, dtype):
+    """Make the model's forward run under paddle.amp.auto_cast — the
+    observable effect of strategy.amp (matmuls/convs compute in the amp
+    dtype when the step is traced or run eagerly)."""
+    import functools
+
+    from ...amp import auto_cast
+    orig = wrapped.forward
+
+    @functools.wraps(orig)
+    def fwd(*args, **kwargs):
+        with auto_cast(enable=True, level=level, dtype=dtype):
+            return orig(*args, **kwargs)
+
+    wrapped.forward = fwd
+    wrapped._amp_wrapped = (level, dtype)
+
+
 fleet = Fleet()
 
 
@@ -176,6 +234,10 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def distributed_scaler(scaler):
+    return fleet.distributed_scaler(scaler)
 
 
 def get_hybrid_communicate_group_():

@@ -16,9 +16,16 @@ from ....tensor.tensor import Tensor
 class _RecomputeFunction(PyLayer):
     @staticmethod
     def forward(ctx, run_function, preserve_rng_state, *args):
+        from ....amp import state as amp_state
         ctx.run_function = run_function
         ctx.preserve_rng = preserve_rng_state
         ctx.rng_state = random_mod.get_rng_state()
+        # amp autocast is consulted at op-dispatch time; backward re-runs
+        # the forward AFTER the auto_cast context has exited, so the
+        # state must be captured here and re-applied during the re-run
+        # (reference recompute does the same amp-state dance)
+        ctx.amp_state = (amp_state._enabled, amp_state._dtype,
+                         amp_state._level)
         ctx.inputs = args
         ctx.save_for_backward(*[a for a in args if isinstance(a, Tensor)])
         with engine.no_grad():
@@ -27,8 +34,11 @@ class _RecomputeFunction(PyLayer):
 
     @staticmethod
     def backward(ctx, *grads):
-        # re-run forward WITH the tape, under the saved RNG state
+        from ....amp import state as amp_state
+        # re-run forward WITH the tape, under the saved RNG + AMP state
         saved_state = random_mod.get_rng_state()
+        saved_amp = (amp_state._enabled, amp_state._dtype, amp_state._level)
+        amp_state._enabled, amp_state._dtype, amp_state._level = ctx.amp_state
         if ctx.preserve_rng:
             random_mod.set_rng_state(ctx.rng_state)
         detached = []
@@ -41,8 +51,12 @@ class _RecomputeFunction(PyLayer):
                 tensor_inputs.append((a, d))
             else:
                 detached.append(a)
-        with engine.enable_grad():
-            out = ctx.run_function(*detached)
+        try:
+            with engine.enable_grad():
+                out = ctx.run_function(*detached)
+        finally:
+            (amp_state._enabled, amp_state._dtype,
+             amp_state._level) = saved_amp
         if ctx.preserve_rng:
             random_mod.set_rng_state(saved_state)
         outs = out if isinstance(out, (tuple, list)) else (out,)
@@ -113,3 +127,128 @@ def recompute_hybrid(ctx, function, *args, **kwargs):
     """mp-aware recompute (ref: recompute_hybrid.py): the RNG tracker keeps
     global/local dropout seeds consistent across the recomputation."""
     return recompute(function, *args, **kwargs)
+
+
+def _tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _recompute_dispatch(layer, orig, args, kwargs):
+    """Run one checkpointed sublayer forward: the eager path uses the
+    PyLayer tape recompute above; under a jax trace (functional_call /
+    TrainStep, where params and activations wrap tracers) it instead
+    wraps a PURE function of (arg arrays, param/buffer arrays) in
+    ``jax.checkpoint`` so XLA's native remat lands in the compiled HLO
+    — the strategy.recompute meta-optimizer's observable effect."""
+    import jax
+    import jax.core as jc
+
+    def _is_tracer(x):
+        return isinstance(getattr(x, "_data", x), jc.Tracer)
+
+    flat, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                               is_leaf=_tensor_leaf)
+    traced = any(_is_tracer(x) for x in flat if isinstance(x, Tensor)) or \
+        any(isinstance(p._data, jc.Tracer) for p in layer.parameters())
+    if not traced:
+        import functools as _ft
+        fn = _ft.partial(orig, **kwargs) if kwargs else orig
+        return recompute(fn, *args)
+
+    is_t = [isinstance(x, Tensor) for x in flat]
+    arg_arrs = [x._data for x, t in zip(flat, is_t) if t]
+    params = list(layer.parameters())
+    bufs = [b for _, b in layer.named_buffers() if b is not None]
+    state = params + bufs
+    s_arrs = [s._data for s in state]
+
+    def pure(arg_arrs, s_arrs):
+        saved = [s._data for s in state]
+        it = iter(arg_arrs)
+        re_flat = [Tensor._from_data(next(it)) if t else x
+                   for x, t in zip(flat, is_t)]
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, re_flat)
+        for s, a in zip(state, s_arrs):
+            s._data = a
+        try:
+            out = orig(*a2, **k2)
+            new_buf = [b._data for b in bufs]
+        finally:
+            for s, sv in zip(state, saved):
+                s._data = sv
+        out_arrs = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, out,
+            is_leaf=_tensor_leaf)
+        return out_arrs, new_buf
+
+    out_arrs, new_buf = jax.checkpoint(pure)(arg_arrs, s_arrs)
+    for b, a in zip(bufs, new_buf):
+        b._data = a
+
+    def _wrap_out(x):
+        import jax as _j
+        if isinstance(x, _j.Array) or hasattr(x, "aval"):
+            return Tensor._from_data(x)
+        return x
+
+    return jax.tree_util.tree_map(_wrap_out, out_arrs)
+
+
+def attach_recompute(root, checkpoints=None):
+    """Wrap sublayers of ``root`` so their forwards recompute in backward
+    (the strategy.recompute meta-optimizer; ref: fleet/meta_optimizers/
+    recompute_optimizer.py applies the static-graph rewrite — here the
+    wrapper recomputes via PyLayer eagerly and via jax.checkpoint under
+    the compiled trace).
+
+    checkpoints: sublayer names from ``root.named_sublayers()`` (exact,
+    or a trailing component like "block1"); EMPTY means every direct
+    child holding parameters — the whole-layer default a dygraph user
+    gets from wrapping each block manually. Returns the wrapped layer
+    names (so callers/tests can see what was attached)."""
+    import functools as _ft
+    subs = dict(root.named_sublayers())
+    chosen = {}
+    if checkpoints:
+        for want in checkpoints:
+            hits = {n: l for n, l in subs.items()
+                    if n == want or n.split(".")[-1] == want}
+            if not hits:
+                raise ValueError(
+                    f"strategy.recompute checkpoint '{want}' names no "
+                    f"sublayer; known: {sorted(subs)[:20]}")
+            chosen.update(hits)
+    else:
+        # direct parameterized children — but containers (LayerList, or
+        # any layer without its own forward) are transparent: wrapping
+        # their never-called forward would be a silent no-op, so descend
+        # into THEIR children instead (a GPT block list checkpoints each
+        # block, not the list)
+        from ....nn.layer.layers import Layer as _BaseLayer
+
+        def collect(layer, prefix, out):
+            for n, l in getattr(layer, "_sub_layers", {}).items():
+                name = f"{prefix}.{n}" if prefix else n
+                if type(l).forward is _BaseLayer.forward:
+                    collect(l, name, out)
+                elif any(True for _ in l.parameters()):
+                    out[name] = l
+
+        chosen = {}
+        collect(root, "", chosen)
+        if not chosen:
+            raise ValueError(
+                "strategy.recompute is on but the model has no "
+                "parameterized direct children to checkpoint; set "
+                "recompute_configs['checkpoints'] to sublayer names")
+    for name, sub in chosen.items():
+        if getattr(sub, "_recompute_wrapped", False):
+            continue
+        orig = sub.forward
+
+        def fwd(*args, _layer=sub, _orig=orig, **kwargs):
+            return _recompute_dispatch(_layer, _orig, args, kwargs)
+
+        sub.forward = _ft.wraps(orig)(fwd)
+        sub._recompute_wrapped = True
+    return sorted(chosen)
