@@ -12,7 +12,7 @@ from .communication import stream
 from .communication import (Group, P2POp, ReduceOp, all_gather, all_reduce,
                             batch_isend_irecv, gather,
                             all_gather_into_tensor, all_to_all_single,
-                            alltoall, barrier, broadcast,
+                            alltoall, alltoall_single, barrier, broadcast,
                             destroy_process_group, get_backend,
                             monitored_barrier, reduce_scatter_tensor,
                             get_group, irecv, isend, new_group, ppermute,

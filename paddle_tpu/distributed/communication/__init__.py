@@ -172,6 +172,27 @@ def all_to_all_single(output, input, output_split_sizes=None,
     return res
 
 
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """ref: paddle.distributed.alltoall_single (distributed/communication/
+    all_to_all.py): scatter slices of one tensor to every rank and gather
+    their slices back — the in-tensor's dim 0 splits across the group.
+    Equal splits only (the XLA all_to_all form); ragged splits would need
+    host-side repacking, which the MoE layer does at a higher level."""
+    for name, sizes in (("in_split_sizes", in_split_sizes),
+                        ("out_split_sizes", out_split_sizes)):
+        if sizes is not None and len(set(sizes)) > 1:
+            raise NotImplementedError(
+                f"alltoall_single: ragged {name}={sizes} is not "
+                "supported on a TPU mesh (XLA all_to_all splits evenly); "
+                "pad to equal splits")
+    res = alltoall(in_tensor, group=group)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = res._data
+        return out_tensor
+    return res
+
+
 def ppermute(tensor, perm, group=None):
     """collective_permute over the group axis (the TPU-native p2p primitive;
     PP microbatch rotation uses this instead of send/recv)."""

@@ -62,6 +62,19 @@ class Fleet:
             attach_recompute(
                 model,
                 strategy.recompute_configs.get("checkpoints") or None)
+        # strategy.amp wraps the INNER model's forward (before the
+        # parallel wrappers): PipelineParallel.train_batch calls
+        # self._layers(...) directly, so an outer-wrapper-only autocast
+        # would be a silent no-op on the pp path (review r5)
+        if strategy is not None and getattr(strategy, "amp", False):
+            cfg = getattr(strategy, "amp_configs", {}) or {}
+            dtype = "float16" if cfg.get("use_pure_fp16") and \
+                not cfg.get("use_bf16", True) else "bfloat16"
+            level = "O2" if cfg.get("use_pure_fp16") else "O1"
+            from ...amp import decorate as amp_decorate
+            if level == "O2":
+                amp_decorate(model, level="O2", dtype=dtype)
+            _wrap_forward_with_autocast(model, level, dtype)
         if hcg.get_pipe_parallel_world_size() > 1:
             if not isinstance(model, PipelineLayer):
                 raise TypeError("pp_degree > 1 requires a PipelineLayer model")
@@ -73,20 +86,6 @@ class Fleet:
             # pure dp/sharding: model unchanged (mesh handles it in
             # compiled steps)
             wrapped = model
-        # strategy.amp (ref: fleet/meta_optimizers/amp_optimizer): the
-        # wrapped model's forward runs under auto_cast, so matmul/conv
-        # dispatch casts to the amp dtype in BOTH eager and compiled
-        # (TrainStep traces through this forward). use_pure_fp16 -> O2
-        # param cast with fp32 master weights in the optimizer.
-        if strategy is not None and getattr(strategy, "amp", False):
-            cfg = getattr(strategy, "amp_configs", {}) or {}
-            dtype = "float16" if cfg.get("use_pure_fp16") and \
-                not cfg.get("use_bf16", True) else "bfloat16"
-            level = "O2" if cfg.get("use_pure_fp16") else "O1"
-            from ...amp import decorate as amp_decorate
-            if level == "O2":
-                amp_decorate(model, level="O2", dtype=dtype)
-            _wrap_forward_with_autocast(wrapped, level, dtype)
         return wrapped
 
     def distributed_optimizer(self, optimizer, strategy=None):
@@ -210,6 +209,8 @@ def _wrap_forward_with_autocast(wrapped, level, dtype):
     import functools
 
     from ...amp import auto_cast
+    if getattr(wrapped, "_amp_wrapped", None) is not None:
+        return
     orig = wrapped.forward
 
     @functools.wraps(orig)

@@ -87,8 +87,34 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     return _run_op("send_uv", f, (x, y, src_index, dst_index), {})
 
 
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """ref: paddle.geometric.sample_neighbors — one-hop neighbor
+    sampling on a CSC graph (host-side op; see incubate/graph_sampling
+    for the TPU-native stance)."""
+    from ..incubate.graph_sampling import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids)
+
+
 def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                   name=None):
-    raise NotImplementedError(
-        "reindex_graph: host-side graph preprocessing; use numpy upstream of "
-        "the device pipeline")
+    """ref: paddle.geometric.reindex_graph — contiguous reindexing of a
+    sampled neighborhood: out_nodes is x then newly-seen neighbors in
+    first-appearance order; reindex_src maps each neighbor, reindex_dst
+    repeats each center node per its neighbor count. Host-side op (the
+    output shape is data-dependent)."""
+    import numpy as _onp
+
+    from ..incubate.graph_sampling import _np, _remap_ids, _wrap
+    xs = _np(x).ravel()
+    nb = _np(neighbors).ravel()
+    cnt = _np(count).ravel()
+    cat = _onp.concatenate([xs, nb])
+    _, order = _onp.unique(cat, return_index=True)
+    out_nodes = cat[_onp.sort(order)]
+    reindex_src = _remap_ids(out_nodes, nb)
+    reindex_dst = _onp.repeat(_remap_ids(out_nodes, xs), cnt)
+    return (_wrap(reindex_src), _wrap(reindex_dst),
+            _wrap(out_nodes, xs.dtype))

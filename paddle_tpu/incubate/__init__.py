@@ -11,6 +11,8 @@ from . import optimizer
 from ..geometric import (segment_sum, segment_mean,  # noqa: F401
                          segment_min, segment_max)
 from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from .graph_sampling import (graph_khop_sampler,  # noqa: F401
+                             graph_sample_neighbors)
 
 
 def softmax_mask_fuse(x, mask, name=None):

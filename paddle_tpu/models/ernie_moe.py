@@ -64,19 +64,45 @@ def init_params(config: ErnieMoEConfig, seed: int = 0):
     def rnd(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * std).astype(d)
 
-    layers = {
-        "ln1": jnp.ones((L, c.hidden_size), d),
-        "qkv": rnd(ks[1], (L, c.hidden_size, 3 * c.hidden_size)),
-        "o": rnd(ks[2], (L, c.hidden_size, c.hidden_size)),
-        "ln2": jnp.ones((L, c.hidden_size), d),
-        # dense FFN (used on non-MoE layers)
-        "w1": rnd(ks[3], (L, c.hidden_size, c.intermediate_size)),
-        "w2": rnd(ks[4], (L, c.intermediate_size, c.hidden_size)),
-        # MoE experts (used on MoE layers)
-        "gate": rnd(ks[5], (L, c.hidden_size, E)).astype(jnp.float32),
-        "e_w1": rnd(ks[6], (L, E, c.hidden_size, c.intermediate_size)),
-        "e_w2": rnd(ks[7], (L, E, c.intermediate_size, c.hidden_size)),
-    }
+    def attn_block(n, k1, k2):
+        return {
+            "ln1": jnp.ones((n, c.hidden_size), d),
+            "qkv": rnd(k1, (n, c.hidden_size, 3 * c.hidden_size)),
+            "o": rnd(k2, (n, c.hidden_size, c.hidden_size)),
+            "ln2": jnp.ones((n, c.hidden_size), d),
+        }
+
+    if _split_stacks(c):
+        # SPLIT stacks: dense layers carry ONLY dense FFN weights, MoE
+        # layers ONLY expert weights. The old single [L, ...] layout
+        # allocated e_w1/e_w2 for every layer (537M dead params at the
+        # bench shape) whose f32 AdamW moments streamed ~15 GB of HBM
+        # per step — the r4 "dispatch dominates" diagnosis was half the
+        # story; the optimizer streaming dead state was the other half.
+        n = L // 2
+        layers = {
+            "dense": {**attn_block(n, ks[1], ks[2]),
+                      "w1": rnd(ks[3], (n, c.hidden_size,
+                                        c.intermediate_size)),
+                      "w2": rnd(ks[4], (n, c.intermediate_size,
+                                        c.hidden_size))},
+            "moe": {**attn_block(n, ks[9], ks[10]),
+                    "gate": rnd(ks[5], (n, c.hidden_size, E))
+                    .astype(jnp.float32),
+                    "e_w1": rnd(ks[6], (n, E, c.hidden_size,
+                                        c.intermediate_size)),
+                    "e_w2": rnd(ks[7], (n, E, c.intermediate_size,
+                                        c.hidden_size))},
+        }
+    else:
+        layers = {
+            **attn_block(L, ks[1], ks[2]),
+            "w1": rnd(ks[3], (L, c.hidden_size, c.intermediate_size)),
+            "w2": rnd(ks[4], (L, c.intermediate_size, c.hidden_size)),
+            "gate": rnd(ks[5], (L, c.hidden_size, E)).astype(jnp.float32),
+            "e_w1": rnd(ks[6], (L, E, c.hidden_size, c.intermediate_size)),
+            "e_w2": rnd(ks[7], (L, E, c.intermediate_size, c.hidden_size)),
+        }
     return {
         "embed": rnd(ks[0], (c.vocab_size, c.hidden_size)),
         "pos": rnd(ks[8], (c.max_position_embeddings, c.hidden_size)),
@@ -85,19 +111,30 @@ def init_params(config: ErnieMoEConfig, seed: int = 0):
     }
 
 
+def _split_stacks(config):
+    """Split dense/moe layer stacks (see init_params) — the standard
+    every-other-layer ERNIE layout."""
+    return config.moe_every == 2 and config.num_hidden_layers % 2 == 0
+
+
 def param_pspecs(config, ep_degree: int, dp_degree: int = 1):
     ep = "ep" if ep_degree > 1 else None
-    layers = {
+    attn = {
         "ln1": P(None, None),
         "qkv": P(None, None, None),
         "o": P(None, None, None),
         "ln2": P(None, None),
-        "w1": P(None, None, None),
-        "w2": P(None, None, None),
+    }
+    dense = {"w1": P(None, None, None), "w2": P(None, None, None)}
+    moe = {
         "gate": P(None, None, None),
         "e_w1": P(None, ep, None, None),   # experts sharded over 'ep'
         "e_w2": P(None, ep, None, None),
     }
+    if _split_stacks(config):
+        layers = {"dense": {**attn, **dense}, "moe": {**attn, **moe}}
+    else:
+        layers = {**attn, **dense, **moe}
     return {"embed": P(None, None), "pos": P(None, None), "layers": layers,
             "final_ln": P(None)}
 
@@ -120,7 +157,7 @@ def _attn_and_norm(p, h, config: ErnieMoEConfig):
     return h, fused_rms_norm(h, p["ln2"], c.layer_norm_eps)
 
 
-def _moe_ffn(p, x_, config: ErnieMoEConfig):
+def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False):
     c = config
     hid = x_.shape[-1]
     tokens = x_.reshape(-1, hid)
@@ -133,7 +170,8 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig):
     out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
                                     (p["e_w1"], p["e_w2"]),
                                     c.num_experts, k=c.moe_topk,
-                                    capacity_factor=c.capacity_factor)
+                                    capacity_factor=c.capacity_factor,
+                                    use_onehot=use_onehot)
     return out.reshape(x_.shape).astype(x_.dtype), aux.astype(jnp.float32)
 
 
@@ -142,18 +180,19 @@ def _dense_ffn(p, x_, config: ErnieMoEConfig):
         jnp.zeros((), jnp.float32)
 
 
-def _layer_static(p, h, is_moe, config: ErnieMoEConfig):
+def _layer_static(p, h, is_moe, config: ErnieMoEConfig, use_onehot=False):
     """One decoder layer with a STATIC moe/dense choice (no lax.cond)."""
     h, x = _attn_and_norm(p, h, config)
-    ffn_out, aux = (_moe_ffn if is_moe else _dense_ffn)(p, x, config)
+    ffn_out, aux = (_moe_ffn(p, x, config, use_onehot) if is_moe
+                    else _dense_ffn(p, x, config))
     return h + ffn_out, aux
 
 
-def _layer(p, h, layer_idx, config: ErnieMoEConfig):
+def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False):
     c = config
 
     def moe_branch(x_):
-        return _moe_ffn(p, x_, c)
+        return _moe_ffn(p, x_, c, use_onehot)
 
     def dense_branch(x_):
         return _dense_ffn(p, x_, c)
@@ -165,7 +204,11 @@ def _layer(p, h, layer_idx, config: ErnieMoEConfig):
     return h + ffn_out, aux
 
 
-def moe_loss(params, ids, labels, config: ErnieMoEConfig):
+def moe_loss(params, ids, labels, config: ErnieMoEConfig,
+             use_onehot=False):
+    # use_onehot: ep>1 meshes keep the einsum dispatch (its vocab-
+    # style contraction partitions into the ep all-to-all; the slot
+    # schedule's gathers would involuntarily rematerialize there)
     c = config
     b, s = ids.shape
     h = (jnp.take(params["embed"], ids, axis=0)
@@ -174,28 +217,31 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig):
     # remat per scan step: the capacity-bucketed dispatch one-hots are
     # large and per-layer; recomputing them in the backward trades cheap
     # FLOPs for the activation memory that OOMed real-sized configs
-    if c.moe_every == 2 and c.num_hidden_layers % 2 == 0:
+    if _split_stacks(c):
         # the moe/dense pattern is STATIC: scan over (dense, moe) layer
         # PAIRS with both bodies inline — the traced-idx lax.cond was the
         # single largest span in the profiled step (it blocks fusion
-        # across the ffn boundary and carries both branches)
-        grouped = jax.tree_util.tree_map(
-            lambda a: a.reshape(c.num_hidden_layers // 2, 2, *a.shape[1:]),
-            params["layers"])
-
+        # across the ffn boundary and carries both branches). Stacks are
+        # SPLIT (see init_params): each kind streams only its own weights.
         def pair_body(h, lp):
-            p0 = jax.tree_util.tree_map(lambda a: a[0], lp)
-            p1 = jax.tree_util.tree_map(lambda a: a[1], lp)
+            p0, p1 = lp
             h, aux0 = _layer_static(p0, h, False, c)
-            h, aux1 = _layer_static(p1, h, True, c)
+            h, aux1 = _layer_static(p1, h, True, c, use_onehot)
             return h, aux0 + aux1
 
-        h, auxes = lax.scan(jax.checkpoint(pair_body), h, grouped)
+        # checkpoint_dots: matmul outputs survive the remat boundary, so
+        # the backward's re-forward is elementwise-only (measured -3 ms
+        # per step vs full remat at the bench shape; the saved dot
+        # residuals are well within HBM at these sizes)
+        h, auxes = lax.scan(
+            jax.checkpoint(pair_body,
+                           policy=jax.checkpoint_policies.checkpoint_dots),
+            h, (params["layers"]["dense"], params["layers"]["moe"]))
     else:
         def body(carry, inp):
             h = carry
             idx, layer_params = inp
-            h, aux = _layer(layer_params, h, idx, c)
+            h, aux = _layer(layer_params, h, idx, c, use_onehot)
             return h, aux
 
         idxs = jnp.arange(c.num_hidden_layers)
@@ -229,9 +275,11 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
             params, pspecs, is_leaf=lambda x: not isinstance(x, dict))
     opt = _adamw_init(params)
 
+    use_onehot = ep_degree > 1
+
     def step(p, o, ids, labels):
         (loss, lm_loss), grads = jax.value_and_grad(
-            moe_loss, has_aux=True)(p, ids, labels, config)
+            moe_loss, has_aux=True)(p, ids, labels, config, use_onehot)
         new_p, new_o = _adamw_update(p, grads, o, lr)
         return new_p, new_o, loss, lm_loss
 

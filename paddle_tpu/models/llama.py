@@ -404,11 +404,49 @@ def _remat_policy(parallel):
         "'offload_attn'")
 
 
+def vocab_parallel_embed(embed, ids, config, parallel, mesh=None,
+                         force_matmul=False):
+    """Embedding lookup that PARTITIONS when the table is vocab-sharded.
+
+    A plain jnp.take over an embed table sharded P('mp', ...) is a gather
+    GSPMD cannot partition: the compiler emits "Involuntary full
+    rematerialization" and all-gathers the whole [V, H] table every step
+    (recorded in MULTICHIP_r04). This is exactly what the reference's
+    VocabParallelEmbedding avoids (ref: fleet/meta_parallel/
+    parallel_layers/mp_layers.py): each mp shard looks up only ids that
+    land in its vocab slice (masked local gather) and the partial rows
+    are summed over 'mp' — every (b, s) row is non-zero on exactly one
+    shard, so the psum is exact in any dtype. Implemented as a partial-
+    manual shard_map over 'mp' alone; dp/sharding/sep stay auto."""
+    c = config
+    mp_sharded = (mesh is not None and parallel.mp > 1
+                  and "mp" in mesh.axis_names)
+    if not (mp_sharded or force_matmul):
+        return jnp.take(embed, ids, axis=0).astype(c.dtype)
+    # One-hot matmul: the lookup becomes [B,S,V] @ [V,H] with the vocab
+    # dim CONTRACTED — GSPMD partitions it over 'mp' as local partial
+    # products + psum (each shard multiplies only its vocab slice:
+    # numerically the reference's masked-local-lookup + allreduce), and
+    # the hidden dim over 'sharding' falls out of normal matmul
+    # partitioning. The backward is the transposed matmul — equally
+    # partition-friendly, unlike take's scatter-add whose cotangent
+    # resharding was r4's second involuntary-remat warning. XLA fuses
+    # the iota/compare one-hot into the dot's operand read, so the
+    # [B,S,V] operand never materializes in HBM.
+    oh = jax.nn.one_hot(ids, embed.shape[0], dtype=embed.dtype)
+    return jnp.einsum("bsv,vh->bsh", oh, embed).astype(c.dtype)
+
+
 def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
                  layer_slice=None, in_shard_map=False):
     """Embed + scan decoder stack. Returns final hidden (pre-norm)."""
     c = config
-    h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)
+    # inside the sep manual region the mesh handle is gone but the table
+    # is still mp-sharded on the auto axes — keep the one-hot matmul
+    # there too (the in-region take is the same unpartitionable gather)
+    h = vocab_parallel_embed(params["embed"], ids, config, parallel,
+                             None if in_shard_map else mesh,
+                             force_matmul=in_shard_map and parallel.mp > 1)
     h = _maybe_hint(h, mesh, _act_spec(parallel))
     s_total = ids.shape[1] * (parallel.sep if in_shard_map else 1)
     cos, sin = build_rope_cache(s_total, c.head_dim, base=c.rope_theta)
@@ -442,19 +480,22 @@ def llama_logits(params, h, config):
     return _mat(x, params["lm_head"])
 
 
-def masked_ce_loss(logits, labels, sep_psum: bool = False):
-    """Mean CE over labels != -100 (fp32 logits). With sep_psum, the sum and
-    the token count are psum'd over the manual 'sep' axis BEFORE the clamp so
-    sequence shards with no valid tokens don't deflate the denominator."""
+def masked_ce_loss(logits, labels, sep_psum: bool = False, psum_axes=None):
+    """Mean CE over labels != -100 (fp32 logits). With sep_psum (or an
+    explicit psum_axes tuple of MANUAL mesh axes), the sum and the token
+    count are psum'd over those axes BEFORE the clamp so shards with no
+    valid tokens don't deflate the denominator."""
+    if psum_axes is None and sep_psum:
+        psum_axes = ("sep",)
     mask = labels != -100
     safe = jnp.where(mask, labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
     count = jnp.sum(mask)
-    if sep_psum:
-        loss_sum = lax.psum(loss_sum, "sep")
-        count = lax.psum(count, "sep")
+    if psum_axes:
+        loss_sum = lax.psum(loss_sum, psum_axes)
+        count = lax.psum(count, psum_axes)
     return loss_sum / jnp.maximum(count, 1)
 
 
@@ -501,7 +542,8 @@ def chunked_ce_loss(x, head, labels, sep_psum: bool = False, n_chunks=8):
 
 
 def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
-               mesh=None, use_flash=True, in_shard_map=False):
+               mesh=None, use_flash=True, in_shard_map=False,
+               loss_psum_axes=None):
     """Causal LM loss, fp32 softmax. labels: [B, S] with -100 = ignore.
 
     Uses the DENSE logits path: chunked_ce_loss measured faster in
@@ -512,9 +554,14 @@ def llama_loss(params, ids, labels, config, parallel=ParallelConfig(),
     h = llama_hidden(params, ids, config, parallel, mesh, use_flash,
                      in_shard_map=in_shard_map)
     logits = llama_logits(params, h, config).astype(jnp.float32)
-    # only 'sep' is manual; dp/sharding stay auto (GSPMD reduces them)
-    return masked_ce_loss(logits, labels,
-                          sep_psum=in_shard_map and parallel.sep > 1)
+    # psum over whatever MANUAL axes shard the loss terms (callers pass
+    # loss_psum_axes; default: 'sep' alone — dp/sharding stay auto and
+    # GSPMD reduces them)
+    return masked_ce_loss(
+        logits, labels,
+        psum_axes=(loss_psum_axes if loss_psum_axes is not None
+                   else (("sep",) if in_shard_map and parallel.sep > 1
+                         else ())))
 
 
 # ---------------------------------------------------------------------------
@@ -1034,18 +1081,29 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
     def loss_fn(p, ids, labels):
         if needs_shard_map:
             from jax import shard_map
-            # manual ONLY over 'sep' (ring attention does explicit ppermute);
-            # dp/mp/sharding remain auto -> GSPMD partitions them as usual.
+            # manual over 'sep' (ring attention does explicit ppermute)
+            # AND the batch axes: a dp-sharded batch entering a manual
+            # region on an AUTO axis CHECK-fails XLA's SPMD group
+            # expansion (spmd_partitioner_util.cc:495, seen at the
+            # dp2·sep2·mp2 factoring) — making the batch axes manual
+            # sidesteps the auto/manual reshard entirely. mp/sharding-
+            # of-params remain auto -> GSPMD partitions them as usual.
+            batch_axes = _act_spec(parallel)[0]
+            if isinstance(batch_axes, str):  # P collapses 1-tuples
+                batch_axes = (batch_axes,)
+            manual = {"sep", *batch_axes}
             sep_only = jax.tree_util.tree_map(
                 lambda _: P(), pspecs, is_leaf=lambda x: isinstance(x, P))
             smap = shard_map(
                 functools.partial(llama_loss, config=config, parallel=parallel,
                                   mesh=None, use_flash=use_flash,
-                                  in_shard_map=True),
+                                  in_shard_map=True,
+                                  loss_psum_axes=("sep",) + tuple(batch_axes)),
                 mesh=mesh,
-                in_specs=(sep_only, P(None, "sep"), P(None, "sep")),
+                in_specs=(sep_only, P(batch_axes, "sep"),
+                          P(batch_axes, "sep")),
                 out_specs=P(),
-                axis_names={"sep"},
+                axis_names=manual,
                 check_vma=False)
             return smap(p, ids, labels)
         return llama_loss(p, ids, labels, config, parallel, mesh,
@@ -1053,6 +1111,19 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
 
     def step(p, opt, ids, labels):
         loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        if mesh is not None:
+            # pin grads to the PARAM specs: the backward layer-scan
+            # otherwise accumulates stacked-layer grads in whatever
+            # sharding propagation picked (an L-dim split, observed as
+            # "[SPMD] Involuntary full rematerialization ... %fake_
+            # parameter f32[L,H,H]" in the r4 dryrun) and pays a
+            # replicate-and-reslice at the optimizer boundary; the
+            # constraint propagates into the while-loop state so the
+            # accumulator is laid out like the update wants it
+            grads = jax.tree_util.tree_map(
+                lambda g, s: lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, s)),
+                grads, pspecs, is_leaf=lambda x: not isinstance(x, dict))
         new_p, new_opt = _adamw_update(p, grads, opt, lr)
         return new_p, new_opt, loss
 

@@ -32,8 +32,13 @@ def _xla_sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, scale=Non
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(mask, logits, -1e30)
+        # ADDITIVE mask, not select: a broadcasted-pred select over
+        # sharded logits made GSPMD replicate the operand ("Involuntary
+        # full rematerialization" on the select_n in the r4 multichip
+        # dryrun); addition partitions elementwise with no resharding
+        neg = jnp.triu(jnp.full((sq, sk), -1e30, jnp.float32),
+                       k=sk - sq + 1)
+        logits = logits + neg
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
             logits = jnp.where(attn_mask, logits, -1e30)

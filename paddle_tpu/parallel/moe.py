@@ -9,9 +9,21 @@ shard_map path (moe_shard_map_dispatch) for when the schedule must be manual.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _gshard_aux_loss(probs, E):
+    """gshard load-balancing loss: E * sum(mean_prob * fraction_top1).
+    ONE definition shared by the one-hot and slot-schedule gates — their
+    numerical parity is test-asserted."""
+    top1 = jnp.argmax(probs, axis=-1)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=probs.dtype), axis=0)
+    return E * jnp.sum(me * ce)
 
 
 def top_k_gating(logits, k: int, capacity: int):
@@ -28,11 +40,7 @@ def top_k_gating(logits, k: int, capacity: int):
         gates = gates + onehot * probs
         remaining = remaining * (1 - onehot)
 
-    # aux load-balancing loss (gshard): E * mean(fraction_tokens * mean_prob)
-    top1 = jnp.argmax(probs, axis=-1)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(top1, E, dtype=probs.dtype), axis=0)
-    aux_loss = E * jnp.sum(me * ce)
+    aux_loss = _gshard_aux_loss(probs, E)
 
     # capacity assignment: position of each token within its expert queue
     chosen = gates > 0  # [T, E]
@@ -47,19 +55,135 @@ def top_k_gating(logits, k: int, capacity: int):
     return combine, dispatch, aux_loss
 
 
+def _round_up(n, m):
+    return -(-n // m) * m
+
+
+def topk_route(logits, k: int, capacity: int):
+    """Slot-schedule routing (no [T,E,C] one-hots). logits [T, E] fp32.
+
+    Returns (slot [T*k] int32 in [0, E*C] with E*C = the trash slot for
+    capacity-dropped pairs, weight [T, k] f32 combine weights, aux_loss).
+    Pair order is token-major, so per-expert queue positions match the
+    gshard cumsum-over-tokens assignment the one-hot path used."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates, experts = lax.top_k(probs, k)            # [T, k] each
+    aux_loss = _gshard_aux_loss(probs, E)
+
+    e_flat = experts.reshape(-1)                    # [T*k] token-major
+    oh = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)  # [T*k, E] (tiny)
+    pos = (jnp.cumsum(oh, axis=0) - oh)             # exclusive prefix count
+    pos = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
+    valid = pos < capacity
+    slot = jnp.where(valid, e_flat * capacity + pos, E * capacity)
+
+    # combine weights: renormalize so each token's surviving gates carry
+    # the full selected mass (the one-hot path's denom dance)
+    g = gates * valid.reshape(T, k)
+    denom = jnp.maximum(g.sum(-1, keepdims=True), 1e-9)
+    weight = g / denom * gates.sum(-1, keepdims=True)
+    return slot.astype(jnp.int32), weight, aux_loss
+
+
 def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
-                         k=2, capacity_factor=1.25):
-    """GSPMD MoE: x [T, D] tokens, expert_params stacked [E, ...] (shard the
-    leading axis over 'ep' with PartitionSpec). The dispatch einsum produces
-    [E, C, D] which GSPMD all-to-alls to the expert owners."""
+                         k=2, capacity_factor=1.25, use_onehot=False):
+    """MoE dispatch/combine. x [T, D] tokens, expert_params stacked [E, ...].
+
+    Default path (single-device / ep=1): SLOT SCHEDULE — each routed
+    (token, choice) pair gets a slot in its expert's capacity bucket; the
+    expert inputs are one row-GATHER of x in slot order ([E*C, D]), the
+    combine is one row-gather of the expert outputs weighted by the gate.
+    Replaces the one-hot einsum dispatch whose [T,E,C] x [T,D] matmuls
+    cost ~E*C/(k) times the useful expert FLOPs (the r4 profile: 0.195
+    active MFU with dispatch/combine dominant). Capacity is rounded up
+    to a multiple of 128 so the expert matmul rows stay MXU-tiled.
+
+    use_onehot=True keeps the einsum form whose vocab-style contraction
+    GSPMD partitions into the ep all-to-all cleanly (gathers over a
+    sharded token dim would involuntarily rematerialize); the ep>1 mesh
+    path selects it."""
     T, D = x.shape
-    capacity = int(capacity_factor * T * k / num_experts + 1)
-    combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
-    # [T,E,C] x [T,D] -> [E,C,D]
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    # ONE capacity formula for both paths (numerical parity between the
+    # ep=1 slot schedule and the ep>1 einsum: same drops, same slots),
+    # rounded up to an MXU-tiled row count
+    capacity = _round_up(max(int(capacity_factor * T * k / num_experts), 1),
+                         128)
+    if use_onehot:
+        combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
+        # [T,E,C] x [T,D] -> [E,C,D]
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+        expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
+        out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype),
+                         expert_out)
+        return out, aux
+
+    E = num_experts
+    slot, weight, aux = topk_route(gate_logits, k, capacity)
+
+    # slot -> source token (E*C is the trash slot; sentinel token T reads
+    # the appended zero row, so dropped/unfilled slots compute on zeros)
+    token_of_pair = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    inv = jnp.full((E * capacity + 1,), T, jnp.int32).at[slot].set(
+        token_of_pair, mode="drop")
+    # slot -> source PAIR (for the combine gather's transpose)
+    pair_inv = jnp.full((E * capacity + 1,), T * k, jnp.int32).at[slot].set(
+        jnp.arange(T * k, dtype=jnp.int32), mode="drop")
+
+    expert_in = _dispatch_rows(x, inv, slot, k).reshape(E, capacity, D)
     expert_out = jax.vmap(expert_fn)(expert_params, expert_in)  # [E,C,D']
-    out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype), expert_out)
+    d_out = expert_out.shape[-1]
+    picked = _combine_rows(expert_out.reshape(E * capacity, d_out),
+                           slot, pair_inv).reshape(T, k, d_out)
+    out = jnp.einsum("tk,tkd->td", weight.astype(picked.dtype), picked)
     return out, aux
+
+
+# Both routing gathers carry GATHER-ONLY custom vjps: slots are unique
+# per routed pair, so each transpose (naturally a scatter-add) is exactly
+# another row gather through the precomputed inverse index — XLA's
+# scatter lowering cost ~0.8 ms/layer in the r5 profile; these are free.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _dispatch_rows(x, inv, slot, k):
+    """[E*C, D] expert-slot rows from token rows (sentinel -> zeros)."""
+    x_pad = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)], 0)
+    return x_pad[inv[:-1]]
+
+
+def _dispatch_rows_fwd(x, inv, slot, k):
+    return _dispatch_rows(x, inv, slot, k), (x.shape[0], inv, slot)
+
+
+def _dispatch_rows_bwd(k, res, g):
+    T, inv, slot = res
+    g_pad = jnp.concatenate([g, jnp.zeros((1, g.shape[1]), g.dtype)], 0)
+    # d_x[t] = sum over the token's k routed slots (trash slot -> zero row)
+    d_x = g_pad[slot].reshape(T, k, g.shape[1]).sum(axis=1)
+    return d_x, None, None
+
+
+_dispatch_rows.defvjp(_dispatch_rows_fwd, _dispatch_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(flat, slot, pair_inv):
+    """[T*k, D] per-pair rows from expert-slot rows (trash -> zeros)."""
+    f_pad = jnp.concatenate([flat, jnp.zeros((1, flat.shape[1]),
+                                             flat.dtype)], 0)
+    return f_pad[slot]
+
+
+def _combine_rows_fwd(flat, slot, pair_inv):
+    return _combine_rows(flat, slot, pair_inv), pair_inv
+
+
+def _combine_rows_bwd(pair_inv, g):
+    g_pad = jnp.concatenate([g, jnp.zeros((1, g.shape[1]), g.dtype)], 0)
+    return g_pad[pair_inv[:-1]], None, None
+
+
+_combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 
 def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,

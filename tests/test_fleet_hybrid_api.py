@@ -323,3 +323,50 @@ def test_amp_plus_recompute_eager_grads_match():
     for k in g_plain:
         np.testing.assert_allclose(g_rc[k], g_plain[k], rtol=1e-6,
                                    atol=1e-7, err_msg=k)
+
+
+def test_strategy_amp_applies_on_pipeline_path(serial_losses):
+    """strategy.amp with pp_degree>1: train_batch calls the PipelineLayer
+    directly (not the outer wrapper's forward), so the autocast must be
+    attached to the INNER model (review r5 — outer-only wrapping was a
+    silent fp32 no-op on the pp path)."""
+    from paddle_tpu.amp import state as amp_state
+    paddle.set_device("cpu")
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    strategy.amp = True
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(42)
+    descs = [LayerDesc(VocabParallelEmbedding, VOCAB, HIDDEN),
+             LayerDesc(_Block),
+             LayerDesc(_Block),
+             LayerDesc(ColumnParallelLinear, HIDDEN, VOCAB,
+                       has_bias=False)]
+    pipe = PipelineLayer(descs, num_stages=2, loss_fn=_loss_fn)
+    model = fleet.distributed_model(pipe)
+    assert getattr(pipe, "_amp_wrapped", None) == ("O1", "bfloat16")
+    opt = fleet.distributed_optimizer(
+        AdamW(learning_rate=1e-2, parameters=model.parameters()))
+    ids, labels = _batch()
+
+    # probe INSIDE the autocast wrapper: a sublayer's forward must see
+    # the autocast state enabled during train_batch
+    blk = next(l for _, l in pipe.named_sublayers() if isinstance(l, _Block))
+    seen = {}
+    orig = blk.forward
+
+    def spy(*a, **k):
+        seen["enabled"] = amp_state._enabled
+        seen["dtype"] = amp_state._dtype
+        return orig(*a, **k)
+
+    blk.forward = spy
+    loss = model.train_batch([ids, labels], opt)
+    blk.forward = orig
+    import jax.numpy as jnp
+    assert seen.get("enabled") is True
+    assert seen.get("dtype") == jnp.bfloat16
+    assert np.isfinite(float(loss))

@@ -1,0 +1,89 @@
+"""Graph sampling trivia tail (VERDICT r4 #10): incubate
+graph_sample_neighbors / graph_khop_sampler + geometric sample_neighbors
+/ reindex_graph, and distributed.alltoall_single presence."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _toy_csc():
+    # 4 nodes; in-neighbors: 0<-{1,2,3}, 1<-{0}, 2<-{0,3}, 3<-{}
+    row = np.array([1, 2, 3, 0, 0, 3], np.int64)
+    colptr = np.array([0, 3, 4, 6, 6], np.int64)
+    return paddle.to_tensor(row), paddle.to_tensor(colptr)
+
+
+def test_graph_sample_neighbors_full_and_capped():
+    row, colptr = _toy_csc()
+    nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+    # sample_size=-1: every neighbor, in CSC order
+    neigh, cnt = paddle.incubate.graph_sample_neighbors(
+        row, colptr, nodes, sample_size=-1)
+    np.testing.assert_array_equal(np.asarray(cnt._data), [3, 2])
+    np.testing.assert_array_equal(np.asarray(neigh._data), [1, 2, 3, 0, 3])
+    # capped: counts clamp to sample_size, sampled values are neighbors
+    neigh2, cnt2 = paddle.incubate.graph_sample_neighbors(
+        row, colptr, nodes, sample_size=2)
+    np.testing.assert_array_equal(np.asarray(cnt2._data), [2, 2])
+    got = np.asarray(neigh2._data)
+    assert set(got[:2]) <= {1, 2, 3} and len(set(got[:2])) == 2
+    assert set(got[2:]) == {0, 3}
+
+
+def test_graph_sample_neighbors_eids():
+    row, colptr = _toy_csc()
+    eids = paddle.to_tensor(np.arange(10, 16, dtype=np.int64))
+    nodes = paddle.to_tensor(np.array([2], np.int64))
+    neigh, cnt, out_eids = paddle.incubate.graph_sample_neighbors(
+        row, colptr, nodes, eids=eids, sample_size=-1, return_eids=True)
+    np.testing.assert_array_equal(np.asarray(out_eids._data), [14, 15])
+
+
+def test_graph_khop_sampler_reindexing():
+    row, colptr = _toy_csc()
+    nodes = paddle.to_tensor(np.array([0], np.int64))
+    src, dst, sample_index, reindex_x = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, sample_sizes=[-1, -1])
+    si = np.asarray(sample_index._data)
+    s, d, rx = (np.asarray(t._data) for t in (src, dst, reindex_x))
+    # input node first in the unique set; its reindex position is 0
+    assert si[0] == 0 and rx.tolist() == [0]
+    # every edge endpoint is a valid position into sample_index
+    assert s.max() < len(si) and d.max() < len(si)
+    # hop-1 edges: neighbors {1,2,3} -> node 0; reconstructed originals
+    orig_edges = {(int(si[a]), int(si[b])) for a, b in zip(s, d)}
+    assert {(1, 0), (2, 0), (3, 0)} <= orig_edges
+    # hop-2 adds in-neighbors of {1,2,3}: 1<-0, 2<-{0,3}
+    assert {(0, 1), (0, 2), (3, 2)} <= orig_edges
+
+
+def test_geometric_sample_and_reindex():
+    row, colptr = _toy_csc()
+    nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+    neigh, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes)
+    rs, rd, out_nodes = paddle.geometric.reindex_graph(nodes, neigh, cnt)
+    on = np.asarray(out_nodes._data)
+    # centers first, then new neighbors in first-appearance order
+    assert on[0] == 0 and on[1] == 2
+    assert set(on) == {0, 1, 2, 3}
+    # dst repeats each center per count; src indexes into out_nodes
+    np.testing.assert_array_equal(
+        np.asarray(rd._data),
+        np.repeat([0, 1], np.asarray(cnt._data)))
+    np.testing.assert_array_equal(
+        on[np.asarray(rs._data)], np.asarray(neigh._data))
+
+
+def test_alltoall_single_surface():
+    import jax
+    from paddle_tpu.distributed import alltoall_single
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    out = alltoall_single(x)  # no group: identity
+    np.testing.assert_array_equal(np.asarray(out._data),
+                                  np.arange(8, dtype=np.float32))
+    try:
+        alltoall_single(x, in_split_sizes=[3, 5])
+        raised = False
+    except NotImplementedError:
+        raised = True
+    assert raised, "ragged splits must raise, not silently mis-split"
