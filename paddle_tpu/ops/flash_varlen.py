@@ -122,8 +122,11 @@ def _fwd_kernel_varlen(qi_ref, ki_ref, first_ref, last_ref, live_ref,
                        q_ref, k_ref, v_ref, cq_ref, ck_ref,
                        o_ref, lse_ref, m_s, l_s, acc_s, *, causal, scale):
     """Streaming forward over the packed stream: FLAT grid (H, n_flat),
-    one live (q-tile, k-tile) pair per step (_flat_schedule), same
-    online-softmax scratch scheme as flash_attention._fwd_kernel_stream.
+    one live (q-tile, k-tile) pair per step (_flat_schedule), classic
+    ONLINE-softmax scratch scheme (running max + alpha rescale). NOTE:
+    flash_attention's dense kernels moved to the r5 fixed-base scheme
+    (tile-0-anchored exponent base, no rescale) — the varlen kernels
+    still rescale online; the two no longer share softmax semantics.
     Init/finalize are driven by the scalar-prefetched first/last flags
     (a q tile's steps are consecutive in the flat order); masking needs
     no positional bookkeeping — the segment codes carry it."""
