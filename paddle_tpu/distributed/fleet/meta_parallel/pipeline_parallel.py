@@ -33,6 +33,10 @@ class PipelineParallel(MetaParallelBase):
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.total_loss = None
+        # compiled micro-batch step, built lazily on first train_batch
+        # (None = untried, False = fell back to eager permanently)
+        self._compiled_step = None
+        self._compiled_opt = None
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -78,8 +82,74 @@ class PipelineParallel(MetaParallelBase):
             total = loss.detach() if total is None else total + loss.detach()
         return total
 
+    def _build_compiled_step(self, optimizer):
+        """COMPILED micro-batch schedule (r5, VERDICT #8): one jitted
+        program per train_batch — the micro-batches run as a lax.scan
+        with grad accumulation (jit/train_step.py), params/opt state
+        live sharded over hcg.mesh (TP from param pspecs), and XLA
+        schedules/overlaps the whole step. The reference's 1F1B exists
+        to overlap p2p between stage PROCESSES; under single-controller
+        SPMD the compiled step is the equivalent — the eager per-micro-
+        batch python loop below is only the fallback for untraceable
+        models or scaler-driven loss scaling."""
+        from jax.sharding import PartitionSpec as P
+
+        from ....jit.train_step import TrainStep
+        mesh = getattr(self._hcg, "mesh", None) if self._hcg else None
+        batch_spec = None
+        if mesh is not None and \
+                self._hcg.get_data_parallel_world_size() > 1:
+            batch_spec = P("dp")
+        return TrainStep(self._layers, self._layers.loss_fn, optimizer,
+                         mesh=mesh, batch_spec=batch_spec,
+                         grad_accum=self.accumulate_steps)
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         self._layers.train()
+        ran_compiled = getattr(self, "_compiled_ran", False)
+        if scaler is not None and ran_compiled:
+            # the compiled step's optimizer state lives in TrainStep; a
+            # mid-training switch to the eager scaler path would step
+            # stale moments — refuse loudly rather than diverge
+            raise RuntimeError(
+                "PipelineParallel.train_batch: a GradScaler was passed "
+                "after compiled steps already ran; pass the scaler from "
+                "the FIRST call (the scaler path uses the eager loop)")
+        if scaler is None and self._compiled_step is not False:
+            # the try covers ONLY build + the compiled update: failures
+            # after the update applied (sync, lr step) must propagate,
+            # not double-apply the batch through the eager path
+            step_ok = False
+            try:
+                if self._compiled_step is None or \
+                        self._compiled_opt is not optimizer:
+                    self._compiled_step = self._build_compiled_step(
+                        optimizer)
+                    self._compiled_opt = optimizer
+                x, y = data
+                loss = self._compiled_step(x, labels=y)
+                step_ok = True
+            except Exception as e:
+                if ran_compiled:
+                    # moments live in TrainStep — a silent eager
+                    # fallback mid-training would train on stale state
+                    raise
+                import warnings
+                warnings.warn(
+                    "PipelineParallel.train_batch could not compile the "
+                    f"micro-batch schedule ({type(e).__name__}: {e}); "
+                    "falling back to the eager per-micro-batch loop "
+                    "(numerically identical, no stage overlap)",
+                    stacklevel=2)
+                self._compiled_step = False
+            if step_ok:
+                self._compiled_ran = True
+                # keep the Layer objects coherent for state_dict/eager
+                # reads (device-array rebinds, no host transfer)
+                self._compiled_step.sync_to_model()
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
         loss = self.forward_backward_pipeline(data, scaler)
         self._layers.allreduce_shared_weight_gradients()
         if scaler is not None:

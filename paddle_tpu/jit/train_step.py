@@ -261,8 +261,15 @@ class TrainStep:
 
     def _place_batch(self, x):
         arr = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
-        if self.mesh is not None and self.batch_spec is not None:
-            spec = list(self.batch_spec) + [None] * (arr.ndim - len(self.batch_spec))
+        if self.mesh is not None:
+            if self.batch_spec is not None:
+                spec = list(self.batch_spec) + \
+                    [None] * (arr.ndim - len(self.batch_spec))
+            else:
+                # no dp sharding: the batch must still live on the MESH
+                # (replicated) — mesh-sharded params + single-device
+                # batch is an incompatible-devices error under jit
+                spec = [None] * arr.ndim
             arr = jax.device_put(arr, NamedSharding(self.mesh, P(*spec)))
         return arr
 

@@ -929,6 +929,12 @@ def _prefill_for_generate(params, prompt_ids, config, max_new_tokens,
             f"{caller}: max_len={max_len} < prompt {plen} + "
             f"max_new_tokens {max_new_tokens}; the cache would overflow")
     frozen = _freeze_config(config)
+    # cache extent stays RAGGED on purpose (r5 finding): plen+1+bucket
+    # (e.g. 257) steers XLA to a copy-free layout for the decode slab
+    # einsums — a tight 256 extent measured 1.90 -> 2.52 ms/step at
+    # hd64 b8 (the V-slice relayout copy returns at aligned extents),
+    # and rounding UP to 384 costs dead kv reads. See PARITY.md r5
+    # decode notes before "fixing" this.
     cache = init_kv_cache(config, b, max(max_len, plen + extra_len))
     logits, cache = _jitted_prefill(frozen)(params, cache,
                                             jnp.asarray(prompt))

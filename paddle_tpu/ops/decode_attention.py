@@ -1,0 +1,172 @@
+"""Pallas decode attention over the stacked KV-cache slabs.
+
+Ref: paddle/fluid/operators/fused/fused_multi_transformer_op.cu (the
+reference's decode kernel reads its cache in-place). TPU-native: the
+kernel indexes the FULL stacked cache [L, B, KV*HD, T] directly via
+scalar-prefetched (layer, pos) — no per-layer cache slice ever
+materializes.
+
+STATUS (r5, measured on v5e — why this is NOT the default decode path):
+standalone, a 24-layer attention loop through this kernel beats the XLA
+einsum path (423 vs 568 us at hd64 b8, floor 209); wired INTO the
+decode scan it measured SLOWER end-to-end (2.9 vs 1.9 ms/step) across
+three designs (per-batch grid, batch-in-block, batch-block-diagonal) —
+the caches are in-place-updated scan carries, and a custom call reading
+them appears to break XLA's in-place dynamic-update-slice (conservative
+aliasing), re-copying cache state each layer. Owning the UPDATE too
+(input_output_aliased cache in/outs) is the path to flipping this, at
+the cost of write-back traffic for visited tiles. A second r5 finding
+keeps the einsum path fast: RAGGED cache extents (257, not 256/384)
+steer XLA to a copy-free slab layout — see models/llama.py
+_prefill_for_generate. Until the aliased-update design lands, this
+kernel serves callers whose caches are not loop carries.
+
+Layout contract (matches models/llama.py's head_dim<128 "slab" cache):
+  q_bd  [B, NH, KVD]    block-diagonal queries, PRE-SCALED by
+                        scale*log2(e) (the kernel softmax runs in the
+                        exp2 domain)
+  cache [L, B, KVD, T]  k and v slabs, time in lanes
+returns attn_full [B, NH, KVD] f32 (the caller gathers the diagonal
+blocks back to heads).
+
+The softmax uses the r5 fixed-base scheme (see flash_attention.py):
+T-tile 0 anchors the exponent base — position 0 is always <= pos, so
+every row has a live column there. Tiles wholly past `pos` are skipped
+AND their DMA is elided (the index map clamps to the last live tile, so
+Mosaic sees an unchanged block index and skips the copy).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_mode as _interpret
+from ._common import mosaic_trace_ctx as _mosaic_ctx
+
+_LOG2E = 1.4426950408889634
+
+# lanes per T tile: 512 bf16 lanes x KVD sublanes keeps each DMA big
+# enough to stream at full HBM rate while bounding VMEM at long caches
+DECODE_BLOCK_T = 512
+
+
+def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
+            block_t, n_t, nb):
+    import numpy as np
+    j = pl.program_id(0)
+    pos = lp_ref[1]
+    nh = q_ref.shape[1]
+    kvd = q_ref.shape[2]
+    start = j * np.int32(block_t)
+
+    @pl.when(j == 0)
+    def _build_qdiag():
+        # batch-block-diagonal queries [B*NH, B*KVD], built ONCE per
+        # layer call in VMEM: each T tile is then ONE MXU dot against
+        # the batch-flattened [B*KVD, Tt] slab — per-batch [NH, KVD]
+        # dots (M=16) ran at 1/8 MXU occupancy and a (B, n_t) grid
+        # starved the pipeline; decode is bytes-bound, so the 8x padded
+        # FLOPs are free while the DMA stream stays one big contiguous
+        # read
+        qd_s[...] = jnp.zeros(qd_s.shape, qd_s.dtype)
+        for bi in range(nb):
+            qd_s[bi * nh:(bi + 1) * nh,
+                 bi * kvd:(bi + 1) * kvd] = q_ref[bi]
+
+    def scores():
+        k = k_ref[0].reshape(nb * kvd, block_t)
+        s = jax.lax.dot_general(
+            qd_s[...], k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [B*NH, Tt]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(t <= pos, s, -1e30)
+
+    def pv(p):
+        v = v_ref[0].reshape(nb * kvd, block_t)
+        return jax.lax.dot_general(
+            p, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [B*NH, B*KVD]
+
+    @pl.when(j == 0)
+    def _first():
+        s = scores()
+        base = s.max(axis=-1, keepdims=True)
+        p = jnp.exp2(s - base)
+        b_s[...] = jnp.broadcast_to(base, b_s.shape)
+        l_s[...] = jnp.broadcast_to(p.sum(axis=-1, keepdims=True),
+                                    l_s.shape)
+        acc_s[...] = pv(p.astype(v_ref.dtype))
+
+    @pl.when(jnp.logical_and(j > 0, start <= pos))
+    def _more():
+        s = scores()
+        p = jnp.exp2(s - b_s[:, :1])
+        l_s[...] = l_s[...] + jnp.broadcast_to(
+            p.sum(axis=-1, keepdims=True), l_s.shape)
+        acc_s[...] = acc_s[...] + pv(p.astype(v_ref.dtype))
+
+    @pl.when(j == np.int32(n_t - 1))
+    def _fin():
+        big = acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        for bi in range(nb):
+            o_ref[bi] = big[bi * nh:(bi + 1) * nh,
+                            bi * kvd:(bi + 1) * kvd]
+
+
+def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
+    """q_bd [B, NH, KVD], PRE-SCALED by the caller with scale*log2(e)
+    (the kernel softmax runs in the exp2 domain and applies no scaling
+    itself); k_cache/v_cache [L, B, KVD, T]; layer/pos i32 scalars.
+    Returns attn_full [B, NH, KVD] f32, or None when T isn't a
+    128-multiple (caller falls back to its XLA path)."""
+    b, nh, kvd = q_bd.shape
+    L, _, _, T = k_cache.shape
+    if T % 128:
+        return None  # ragged cache: caller falls back to the XLA path
+    # small tiles for short caches: the pos-clamp skips dead-tile DMA at
+    # tile granularity, so finer tiles track the live prefix closely
+    # (a [KVD, 128] bf16 tile is 256KB — still a full-rate DMA); larger
+    # caches take 512 lanes to bound grid length
+    block_t = 128 if T <= 2048 else DECODE_BLOCK_T
+    while T % block_t:
+        block_t //= 2
+    n_t = T // block_t
+    lp = jnp.stack([jnp.asarray(layer, jnp.int32),
+                    jnp.asarray(pos, jnp.int32)])
+
+    def live_map(j, lp_ref):
+        # clamp to the last live tile: dead tiles re-present the same
+        # block index and Mosaic skips their DMA
+        jmax = lp_ref[1] // block_t
+        return (lp_ref[0], 0, 0, jnp.minimum(j, jmax))
+
+    kernel = functools.partial(_kernel, block_t=block_t, n_t=n_t, nb=b)
+    with _mosaic_ctx():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_t,),
+                in_specs=[
+                    pl.BlockSpec((b, nh, kvd), lambda j, lp_ref: (0, 0, 0)),
+                    pl.BlockSpec((1, b, kvd, block_t), live_map),
+                    pl.BlockSpec((1, b, kvd, block_t), live_map),
+                ],
+                out_specs=pl.BlockSpec(
+                    (b, nh, kvd), lambda j, lp_ref: (0, 0, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((b * nh, b * kvd), q_bd.dtype),
+                    pltpu.VMEM((b * nh, 128), jnp.float32),
+                    pltpu.VMEM((b * nh, 128), jnp.float32),
+                    pltpu.VMEM((b * nh, b * kvd), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+            interpret=_interpret(),
+        )(lp, q_bd, k_cache, v_cache)
+    return out

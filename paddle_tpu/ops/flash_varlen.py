@@ -425,9 +425,10 @@ def _flash_varlen_fwd_stacked(q, k, v, cu_q, causal, scale, block_q,
     return o[:, :t], lse.reshape(h, tp)[:, :t]
 
 
-# blocks for the stacked short-segment path (measured best on v5e over
-# {128..512}x{384..1024}: waste cap 0.84 at 256 rows, chain amortized 8x)
-STACKED_BLOCK_Q = 256
+# blocks for the stacked short-segment path. r5 re-sweep on the 16-seq
+# 16k bench pack: 512x512 (nh drops 8->4 for VMEM) edges out 256x512
+# (0.179 vs 0.173 eff); 384x512, 256x768, 512x768, 128x512 all worse.
+STACKED_BLOCK_Q = 512
 STACKED_BLOCK_K = 512
 
 

@@ -214,8 +214,12 @@ def test_stacked_path_8_heads_incl_f32():
     d=128, so selection must drop to a fitting grouping instead of
     OOMing. Pins the d=128 capping and runs the nh=8 scratch shapes."""
     from paddle_tpu.ops.flash_varlen import _stacked_nh
-    assert _stacked_nh(8, itemsize=2, d=128) == 8   # bf16 fits at nh=8
-    assert _stacked_nh(8, itemsize=4, d=128) == 4   # f32 nh=8 would OOM
+    nh_bf16 = _stacked_nh(8, itemsize=2, d=128)
+    nh_f32 = _stacked_nh(8, itemsize=4, d=128)
+    assert nh_bf16 >= 2 and nh_f32 >= 2, (nh_bf16, nh_f32)
+    assert nh_f32 <= nh_bf16   # 4-byte dtypes cap the grouping earlier
+    # at the r4 256x512 geometry the uncapped f32 nh=8 was a compile OOM
+    assert _stacked_nh(8, itemsize=4, d=128, bq=256, bk=512) < 8
     lens = [70, 300, 33, 129, 256, 64]
     for seed, dtype in ((21, np.float32), (22, jnp.bfloat16)):
         rng = np.random.RandomState(seed)

@@ -135,6 +135,10 @@ def test_fleet_pp2_mp2_train_batch_matches_serial(serial_losses):
         loss = model.train_batch([ids, labels], opt)
         losses.append(float(loss))
     np.testing.assert_allclose(losses, serial_losses, rtol=2e-4, atol=1e-5)
+    # r5: train_batch must have taken the COMPILED micro-batch schedule
+    # (the eager loop is only a fallback for untraceable models)
+    from paddle_tpu.jit.train_step import TrainStep
+    assert isinstance(model._compiled_step, TrainStep), model._compiled_step
 
 
 def test_distributed_optimizer_honors_strategy_toggles():

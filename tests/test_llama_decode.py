@@ -343,3 +343,34 @@ def test_prepare_decode_params_idempotent_and_equivalent():
         assert again is prepared
         out = greedy_generate(prepared, prompt, config, 6)
         np.testing.assert_array_equal(raw, out)
+
+
+def test_decode_slab_kernel_matches_reference():
+    """The Pallas slab decode kernel (ops/decode_attention.py — a
+    standalone alternative to the in-scan einsum path; see its module
+    docstring for why it is NOT the default) must match a dense numpy
+    attention over the live cache prefix."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.decode_attention import (_LOG2E,
+                                                 decode_attention_slab)
+    L, B, NH, HD, T, pos = 3, 2, 4, 64, 256, 100
+    KVD = NH * HD
+    rng = np.random.RandomState(11)
+    q = rng.randn(B, NH, KVD).astype(np.float32) * 0.1
+    kc = rng.randn(L, B, KVD, T).astype(np.float32)
+    vc = rng.randn(L, B, KVD, T).astype(np.float32)
+    layer = 1
+    qs = jnp.asarray(q * (_LOG2E / (HD ** 0.5)))
+    out = decode_attention_slab(qs, jnp.asarray(kc), jnp.asarray(vc),
+                                layer, pos)
+    assert out is not None
+    # dense reference over the live prefix [0, pos]
+    s = np.einsum("bhc,bct->bht", q, kc[layer][:, :, :pos + 1]) / (HD ** 0.5)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bht,bct->bhc", p, vc[layer][:, :, :pos + 1])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+    # ragged extent falls back
+    assert decode_attention_slab(qs, jnp.asarray(kc[:, :, :, :250]),
+                                 jnp.asarray(vc[:, :, :, :250]),
+                                 layer, pos) is None
