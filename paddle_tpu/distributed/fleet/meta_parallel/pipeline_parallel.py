@@ -116,6 +116,22 @@ class PipelineParallel(MetaParallelBase):
                 "after compiled steps already ran; pass the scaler from "
                 "the FIRST call (the scaler path uses the eager loop)")
         if scaler is None and self._compiled_step is not False:
+            if ran_compiled and self._compiled_opt is not optimizer:
+                # rebuilding TrainStep would seed FRESH (zero) Adam
+                # moments — a silent mid-training reset
+                raise RuntimeError(
+                    "PipelineParallel.train_batch: a different optimizer "
+                    "object was passed after compiled steps already ran; "
+                    "keep passing the same optimizer (its state lives in "
+                    "the compiled step)")
+            if getattr(self, "_eager_ran", False):
+                # moments accumulated in the eager optimizer would be
+                # silently dropped by a fresh compiled step
+                raise RuntimeError(
+                    "PipelineParallel.train_batch: earlier steps ran the "
+                    "eager (scaler) path; mixing in the compiled path "
+                    "would discard the optimizer moments accumulated "
+                    "there — keep passing the scaler for the whole run")
             # the try covers ONLY build + the compiled update: failures
             # after the update applied (sync, lr step) must propagate,
             # not double-apply the batch through the eager path
@@ -150,6 +166,7 @@ class PipelineParallel(MetaParallelBase):
                 if lr_scheduler is not None:
                     lr_scheduler.step()
                 return loss
+        self._eager_ran = True
         loss = self.forward_backward_pipeline(data, scaler)
         self._layers.allreduce_shared_weight_gradients()
         if scaler is not None:

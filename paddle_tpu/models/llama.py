@@ -737,16 +737,6 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
         rep = nh // nkv
         qg = q[:, 0].reshape(b, nkv, rep, hd)
         if slab:
-            # cache k AND v [B, KV*HD, T]; each step writes one in-place
-            # lane column per slab.
-            kc = lax.dynamic_update_slice(
-                kc, k.reshape(b, kvd, 1).astype(kc.dtype)[None],
-                (layer_i, zero, zero, pos))
-            vc = lax.dynamic_update_slice(
-                vc, v.reshape(b, kvd, 1).astype(vc.dtype)[None],
-                (layer_i, zero, zero, pos))
-            k_cache = lax.dynamic_index_in_dim(kc, layer, 0, keepdims=False)
-            v_cache = lax.dynamic_index_in_dim(vc, layer, 0, keepdims=False)
             # BLOCK-DIAGONAL attention: per batch element ONE [NH, KV*HD]
             # x [KV*HD, T] score matmul and ONE [KV*HD, T] x [T, NH]
             # value matmul. q is scattered into a block-diagonal
@@ -760,18 +750,46 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
             # worse still (2.48 ms/step).
             eye = jnp.eye(nkv, dtype=qg.dtype)
             q_bd = jnp.einsum("bgrd,ge->bgred", qg, eye).reshape(b, nh, kvd)
-            scores = jnp.einsum("bhc,bct->bht", q_bd, k_cache,
-                                preferred_element_type=jnp.float32)
-            scores = scores / (hd ** 0.5)
-            valid = jnp.arange(max_len)[None, None, :] <= pos
-            scores = jnp.where(valid, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(v_cache.dtype)
-            # V slab as the dot RHS contracting its minor (T) dim — the
-            # same operand role the K slab plays in the score einsum,
-            # so XLA assigns the same in-place layout (V as LHS or
-            # time-major both measured a 4.2 MB slice copy per layer).
-            attn_full = jnp.einsum("bht,bct->bhc", probs, v_cache,
-                                   preferred_element_type=jnp.float32)
+            if max_len % 128 == 0:
+                # fused Pallas attend+update: the new k/v column is
+                # written in-place INSIDE the kernel (caches alias
+                # through the custom call), and the attention reads the
+                # slabs directly — neither the per-layer cache slice
+                # nor the V relayout copy exists. Requires the
+                # 128-aligned cache extents _prefill_for_generate now
+                # allocates.
+                from ..ops.decode_attention import (
+                    _LOG2E, decode_attend_update_slab)
+                qs = (q_bd.astype(jnp.float32)
+                      * (_LOG2E / (hd ** 0.5))).astype(q_bd.dtype)
+                attn_full, kc, vc = decode_attend_update_slab(
+                    qs, k.reshape(b, kvd).astype(kc.dtype),
+                    v.reshape(b, kvd).astype(vc.dtype), kc, vc,
+                    layer_i, pos)
+            else:
+                # ragged extent: XLA einsum path. V slab as the dot RHS
+                # contracting its minor (T) dim — the same operand role
+                # the K slab plays in the score einsum, so XLA assigns
+                # the same in-place layout.
+                kc = lax.dynamic_update_slice(
+                    kc, k.reshape(b, kvd, 1).astype(kc.dtype)[None],
+                    (layer_i, zero, zero, pos))
+                vc = lax.dynamic_update_slice(
+                    vc, v.reshape(b, kvd, 1).astype(vc.dtype)[None],
+                    (layer_i, zero, zero, pos))
+                k_cache = lax.dynamic_index_in_dim(kc, layer, 0,
+                                                   keepdims=False)
+                v_cache = lax.dynamic_index_in_dim(vc, layer, 0,
+                                                   keepdims=False)
+                scores = jnp.einsum("bhc,bct->bht", q_bd, k_cache,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / (hd ** 0.5)
+                valid = jnp.arange(max_len)[None, None, :] <= pos
+                scores = jnp.where(valid, scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1) \
+                    .astype(v_cache.dtype)
+                attn_full = jnp.einsum("bht,bct->bhc", probs, v_cache,
+                                       preferred_element_type=jnp.float32)
             attn = jnp.einsum("bgred,ge->bgrd",
                               attn_full.reshape(b, nkv, rep, nkv, hd),
                               eye.astype(attn_full.dtype)).astype(c.dtype)
@@ -889,7 +907,7 @@ def sample_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     bucket = generate_scan_bucket(max_new_tokens + 1)  # all sampled steps
     prompt, logits, cache, frozen = _prefill_for_generate(
         params, prompt_ids, config, max_new_tokens, max_len,
-        1 + bucket, "sample_generate")
+        bucket, "sample_generate")
     if logits is None:
         return np.zeros((prompt.shape[0], 0), np.int32)
     key = jax.random.PRNGKey(seed)
@@ -929,13 +947,15 @@ def _prefill_for_generate(params, prompt_ids, config, max_new_tokens,
             f"{caller}: max_len={max_len} < prompt {plen} + "
             f"max_new_tokens {max_new_tokens}; the cache would overflow")
     frozen = _freeze_config(config)
-    # cache extent stays RAGGED on purpose (r5 finding): plen+1+bucket
-    # (e.g. 257) steers XLA to a copy-free layout for the decode slab
-    # einsums — a tight 256 extent measured 1.90 -> 2.52 ms/step at
-    # hd64 b8 (the V-slice relayout copy returns at aligned extents),
-    # and rounding UP to 384 costs dead kv reads. See PARITY.md r5
-    # decode notes before "fixing" this.
-    cache = init_kv_cache(config, b, max(max_len, plen + extra_len))
+    # 128-ALIGNED cache extents: the fused Pallas attend+update decode
+    # kernel (ops/decode_attention.py) needs them, and its pos-clamped
+    # DMA never reads the padding. (The XLA einsum FALLBACK prefers
+    # ragged extents — aligned ones re-introduce a V-slice relayout
+    # copy, 1.90 vs 2.52 ms/step at hd64 b8 — but the fallback only
+    # runs when a caller forces a non-128-multiple max_len. PARITY.md
+    # r5 decode notes have the full story.)
+    cache_len = -(-max(max_len, plen + extra_len) // 128) * 128
+    cache = init_kv_cache(config, b, cache_len)
     logits, cache = _jitted_prefill(frozen)(params, cache,
                                             jnp.asarray(prompt))
     return prompt, logits, cache, frozen
@@ -952,7 +972,7 @@ def greedy_generate(params, prompt_ids, config: LlamaConfig, max_new_tokens,
     bucket = generate_scan_bucket(max_new_tokens)
     prompt, logits, cache, frozen = _prefill_for_generate(
         params, prompt_ids, config, max_new_tokens, max_len,
-        1 + bucket, "greedy_generate")
+        bucket, "greedy_generate")
     if logits is None:
         return np.zeros((prompt.shape[0], 0), np.int32)
     first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]

@@ -118,16 +118,13 @@ def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
                             bi * kvd:(bi + 1) * kvd]
 
 
-def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
-    """q_bd [B, NH, KVD], PRE-SCALED by the caller with scale*log2(e)
-    (the kernel softmax runs in the exp2 domain and applies no scaling
-    itself); k_cache/v_cache [L, B, KVD, T]; layer/pos i32 scalars.
-    Returns attn_full [B, NH, KVD] f32, or None when T isn't a
-    128-multiple (caller falls back to its XLA path)."""
-    b, nh, kvd = q_bd.shape
-    L, _, _, T = k_cache.shape
+
+def _tile_plan(T, layer, pos):
+    """Shared tiling prologue for both slab kernels: (block_t, n_t, lp,
+    live_map) or None for ragged (non-128-multiple) cache extents —
+    ONE copy so the two entry points can never diverge in tiling."""
     if T % 128:
-        return None  # ragged cache: caller falls back to the XLA path
+        return None
     # small tiles for short caches: the pos-clamp skips dead-tile DMA at
     # tile granularity, so finer tiles track the live prefix closely
     # (a [KVD, 128] bf16 tile is 256KB — still a full-rate DMA); larger
@@ -135,7 +132,6 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
     block_t = 128 if T <= 2048 else DECODE_BLOCK_T
     while T % block_t:
         block_t //= 2
-    n_t = T // block_t
     lp = jnp.stack([jnp.asarray(layer, jnp.int32),
                     jnp.asarray(pos, jnp.int32)])
 
@@ -144,6 +140,174 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
         # block index and Mosaic skips their DMA
         jmax = lp_ref[1] // block_t
         return (lp_ref[0], 0, 0, jnp.minimum(j, jmax))
+
+    return block_t, T // block_t, lp, live_map
+
+
+def _kernel_update(lp_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref,
+                   o_ref, ko_ref, vo_ref, l_s, b_s, acc_s, *,
+                   block_t, n_t, nb):
+    import numpy as np
+    j = pl.program_id(0)
+    pos = lp_ref[1]
+    nh = q_ref.shape[1]
+    kvd = q_ref.shape[2]
+    start = j * np.int32(block_t)
+    pos_tile = pos // np.int32(block_t)
+    col = pos - pos_tile * np.int32(block_t)
+    lane = lax.broadcasted_iota(jnp.int32, (kvd, block_t), 1)
+
+    def upd(tile_ref, new_ref, bi):
+        # minor-dim insert must go through f32 (Mosaic: "Insertion of
+        # minor dim that is not a no-op only supported for 32-bit
+        # types"); runs on the pos tile ONLY
+        new32 = new_ref[bi].astype(jnp.float32)[:, None]
+        return jnp.where(lane == col, new32,
+                         tile_ref[0, bi].astype(jnp.float32)) \
+            .astype(tile_ref.dtype)
+
+    @pl.when(j == pos_tile)
+    def _write_cache():
+        # the SAME out block index every grid step -> Mosaic writes the
+        # tile back once; the new k/v column lands in-place (the out
+        # refs alias the caches via input_output_aliases)
+        for bi in range(nb):
+            ko_ref[0, bi] = upd(k_ref, nk_ref, bi)
+            vo_ref[0, bi] = upd(v_ref, nv_ref, bi)
+
+    def chain(k_at, v_at, first):
+        # one softmax step reading k/v via the given accessors; the
+        # UPDATED pos tile is read back from the just-written out refs,
+        # every other tile straight from the cache blocks — no blanket
+        # fresh-column select pass (that select on every tile measured
+        # ~0.11 ms/step at hd64 b8)
+        rows = []
+        for bi in range(nb):
+            rows.append(jax.lax.dot_general(
+                q_ref[bi], k_at(bi), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s = jnp.concatenate(rows, axis=0)          # [B*NH, Tt]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(t <= pos, s, -1e30)
+        if first:
+            bvec = s.max(axis=-1, keepdims=True)
+            b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
+        else:
+            bvec = b_s[:, :1]
+        p = jnp.exp2(s - bvec)
+        psum = jnp.broadcast_to(p.sum(axis=-1, keepdims=True), l_s.shape)
+        l_s[...] = psum if first else l_s[...] + psum
+        pb = p.astype(v_ref.dtype)
+        for bi in range(nb):
+            sl = slice(bi * nh, (bi + 1) * nh)
+            d = jax.lax.dot_general(
+                pb[sl], v_at(bi), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_s[sl] = d if first else acc_s[sl] + d
+
+    def at(ref):
+        return lambda bi: ref[0, bi]
+
+    # 4-way branch: (first tile?) x (tile containing pos?) — the pos
+    # tile reads the updated slabs back from the aliased out refs
+    @pl.when(jnp.logical_and(j == 0, pos_tile == 0))
+    def _first_updated():
+        chain(at(ko_ref), at(vo_ref), True)
+
+    @pl.when(jnp.logical_and(j == 0, pos_tile > 0))
+    def _first_raw():
+        chain(at(k_ref), at(v_ref), True)
+
+    @pl.when(jnp.logical_and(j > 0,
+                             jnp.logical_and(j == pos_tile,
+                                             start <= pos)))
+    def _more_updated():
+        chain(at(ko_ref), at(vo_ref), False)
+
+    @pl.when(jnp.logical_and(j > 0,
+                             jnp.logical_and(j != pos_tile,
+                                             start <= pos)))
+    def _more_raw():
+        chain(at(k_ref), at(v_ref), False)
+
+    @pl.when(j == np.int32(n_t - 1))
+    def _fin():
+        big = acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        for bi in range(nb):
+            o_ref[bi] = big[bi * nh:(bi + 1) * nh]
+
+
+def decode_attend_update_slab(q_bd, new_k, new_v, k_cache, v_cache,
+                              layer, pos):
+    """Fused cache-update + attention for one decode layer: writes the
+    new k/v column IN PLACE (the caches alias through the custom call —
+    input_output_aliases — so the scan carry stays a single buffer) and
+    returns the attention over the live prefix including it.
+
+    q_bd [B, NH, KVD] PRE-SCALED by scale*log2(e); new_k/new_v
+    [B, KVD]; caches [L, B, KVD, T] with T a 128-multiple (returns None
+    otherwise). Returns (attn [B, NH, KVD] f32, k_cache, v_cache)."""
+    b, nh, kvd = q_bd.shape
+    L, _, _, T = k_cache.shape
+    plan = _tile_plan(T, layer, pos)
+    if plan is None:
+        return None
+    block_t, n_t, lp, live_map = plan
+
+    def pos_map(j, lp_ref):
+        return (lp_ref[0], 0, 0, lp_ref[1] // block_t)
+
+    kernel = functools.partial(_kernel_update, block_t=block_t, n_t=n_t,
+                               nb=b)
+    with _mosaic_ctx():
+        out, kc, vc = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_t,),
+                in_specs=[
+                    pl.BlockSpec((b, nh, kvd), lambda j, lp_ref: (0, 0, 0)),
+                    pl.BlockSpec((b, kvd), lambda j, lp_ref: (0, 0)),
+                    pl.BlockSpec((b, kvd), lambda j, lp_ref: (0, 0)),
+                    pl.BlockSpec((1, b, kvd, block_t), live_map),
+                    pl.BlockSpec((1, b, kvd, block_t), live_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((b, nh, kvd), lambda j, lp_ref: (0, 0, 0)),
+                    pl.BlockSpec((1, b, kvd, block_t), pos_map),
+                    pl.BlockSpec((1, b, kvd, block_t), pos_map),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((b * nh, 128), jnp.float32),
+                    pltpu.VMEM((b * nh, 128), jnp.float32),
+                    pltpu.VMEM((b * nh, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+                jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+                jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+            ],
+            # operand indices count scalar-prefetch first: 0=lp, 1=q,
+            # 2=new_k, 3=new_v, 4=k_cache, 5=v_cache
+            input_output_aliases={4: 1, 5: 2},
+            interpret=_interpret(),
+        )(lp, q_bd, new_k, new_v, k_cache, v_cache)
+    return out, kc, vc
+
+
+def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
+    """q_bd [B, NH, KVD], PRE-SCALED by the caller with scale*log2(e)
+    (the kernel softmax runs in the exp2 domain and applies no scaling
+    itself); k_cache/v_cache [L, B, KVD, T]; layer/pos i32 scalars.
+    Returns attn_full [B, NH, KVD] f32, or None when T isn't a
+    128-multiple (caller falls back to its XLA path)."""
+    b, nh, kvd = q_bd.shape
+    L, _, _, T = k_cache.shape
+    plan = _tile_plan(T, layer, pos)
+    if plan is None:
+        return None  # ragged cache: caller falls back to the XLA path
+    block_t, n_t, lp, live_map = plan
 
     kernel = functools.partial(_kernel, block_t=block_t, n_t=n_t, nb=b)
     with _mosaic_ctx():
