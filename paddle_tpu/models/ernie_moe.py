@@ -157,21 +157,52 @@ def _attn_and_norm(p, h, config: ErnieMoEConfig):
     return h, fused_rms_norm(h, p["ln2"], c.layer_norm_eps)
 
 
-def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False):
+def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
+             mesh=None):
     c = config
     hid = x_.shape[-1]
     tokens = x_.reshape(-1, hid)
-    logits = tokens.astype(jnp.float32) @ p["gate"]
 
     def expert_fn(params, toks):
         w1, w2 = params
         return jax.nn.gelu(toks @ w1) @ w2
 
-    out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
-                                    (p["e_w1"], p["e_w2"]),
-                                    c.num_experts, k=c.moe_topk,
-                                    capacity_factor=c.capacity_factor,
-                                    use_onehot=use_onehot)
+    if use_onehot and mesh is not None:
+        # ep>1 with the SLOT schedule (r5): a fully-manual shard_map
+        # island over (dp, ep) — each shard routes its local tokens,
+        # gathers only its local experts' slots, and the combine psums
+        # [T,D] partials over 'ep'. Capacity is per-dp-shard (the
+        # reference's MoE also sizes capacity from the local batch);
+        # with no drops this is numerically identical to serial, which
+        # the ep-vs-serial tests assert. The one-hot einsum fallback
+        # below stays for mesh-less callers.
+        from jax import shard_map
+        from ..parallel.moe import moe_slot_dispatch_local
+
+        def island(tok, gate, w1, w2):
+            logits = tok.astype(jnp.float32) @ gate
+            out, aux = moe_slot_dispatch_local(
+                tok, logits, expert_fn, (w1, w2), c.num_experts,
+                axis_name="ep", k=c.moe_topk,
+                capacity_factor=c.capacity_factor)
+            # aux is computed from LOCAL tokens: average over dp so the
+            # P() out-spec is genuinely replicated (the standard
+            # data-parallel MoE aux — per-shard balance loss, averaged)
+            return out, lax.pmean(aux, "dp")
+
+        out, aux = shard_map(
+            island, mesh=mesh,
+            in_specs=(P("dp", None), P(None, None),
+                      P("ep", None, None), P("ep", None, None)),
+            out_specs=(P("dp", None), P()),
+            check_vma=False)(tokens, p["gate"], p["e_w1"], p["e_w2"])
+    else:
+        logits = tokens.astype(jnp.float32) @ p["gate"]
+        out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
+                                        (p["e_w1"], p["e_w2"]),
+                                        c.num_experts, k=c.moe_topk,
+                                        capacity_factor=c.capacity_factor,
+                                        use_onehot=use_onehot)
     return out.reshape(x_.shape).astype(x_.dtype), aux.astype(jnp.float32)
 
 
@@ -180,19 +211,21 @@ def _dense_ffn(p, x_, config: ErnieMoEConfig):
         jnp.zeros((), jnp.float32)
 
 
-def _layer_static(p, h, is_moe, config: ErnieMoEConfig, use_onehot=False):
+def _layer_static(p, h, is_moe, config: ErnieMoEConfig, use_onehot=False,
+                  mesh=None):
     """One decoder layer with a STATIC moe/dense choice (no lax.cond)."""
     h, x = _attn_and_norm(p, h, config)
-    ffn_out, aux = (_moe_ffn(p, x, config, use_onehot) if is_moe
+    ffn_out, aux = (_moe_ffn(p, x, config, use_onehot, mesh) if is_moe
                     else _dense_ffn(p, x, config))
     return h + ffn_out, aux
 
 
-def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False):
+def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False,
+           mesh=None):
     c = config
 
     def moe_branch(x_):
-        return _moe_ffn(p, x_, c, use_onehot)
+        return _moe_ffn(p, x_, c, use_onehot, mesh)
 
     def dense_branch(x_):
         return _dense_ffn(p, x_, c)
@@ -205,10 +238,10 @@ def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False):
 
 
 def moe_loss(params, ids, labels, config: ErnieMoEConfig,
-             use_onehot=False):
-    # use_onehot: ep>1 meshes keep the einsum dispatch (its vocab-
-    # style contraction partitions into the ep all-to-all; the slot
-    # schedule's gathers would involuntarily rematerialize there)
+             use_onehot=False, mesh=None):
+    # use_onehot marks ep>1: WITH a mesh the slot-schedule shard_map
+    # island runs (see _moe_ffn); the one-hot einsum only serves
+    # mesh-less callers as a fallback
     c = config
     b, s = ids.shape
     h = (jnp.take(params["embed"], ids, axis=0)
@@ -226,7 +259,7 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
         def pair_body(h, lp):
             p0, p1 = lp
             h, aux0 = _layer_static(p0, h, False, c)
-            h, aux1 = _layer_static(p1, h, True, c, use_onehot)
+            h, aux1 = _layer_static(p1, h, True, c, use_onehot, mesh)
             return h, aux0 + aux1
 
         # checkpoint_dots: matmul outputs survive the remat boundary, so
@@ -241,7 +274,7 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
         def body(carry, inp):
             h = carry
             idx, layer_params = inp
-            h, aux = _layer(layer_params, h, idx, c, use_onehot)
+            h, aux = _layer(layer_params, h, idx, c, use_onehot, mesh)
             return h, aux
 
         idxs = jnp.arange(c.num_hidden_layers)
@@ -276,10 +309,12 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
     opt = _adamw_init(params)
 
     use_onehot = ep_degree > 1
+    moe_mesh = mesh if ep_degree > 1 else None
 
     def step(p, o, ids, labels):
         (loss, lm_loss), grads = jax.value_and_grad(
-            moe_loss, has_aux=True)(p, ids, labels, config, use_onehot)
+            moe_loss, has_aux=True)(p, ids, labels, config, use_onehot,
+                                    moe_mesh)
         new_p, new_o = _adamw_update(p, grads, o, lr)
         return new_p, new_o, loss, lm_loss
 

@@ -3,9 +3,11 @@
 Ref: python/paddle/incubate/distributed/models/moe/moe_layer.py +
 global_scatter/global_gather collective ops. The reference dispatches tokens
 with capacity-bucketed all-to-all (brpc/NCCL global_scatter). TPU-native:
-capacity-bucketed one-hot dispatch expressed as einsums — GSPMD turns the
-expert-sharded einsum into the all-to-all over ICI — plus an explicit
-shard_map path (moe_shard_map_dispatch) for when the schedule must be manual.
+the r5 SLOT SCHEDULE (row gathers into MXU-tiled capacity buckets with
+gather-only vjps) at ep=1 and, inside a manual shard_map over (dp, ep),
+at ep>1 (moe_slot_dispatch_local — local-expert gathers + one [T,D] psum);
+the capacity-bucketed one-hot einsum form (GSPMD all-to-all) and the
+explicit all-to-all moe_shard_map_dispatch remain as alternates.
 """
 from __future__ import annotations
 
@@ -59,6 +61,13 @@ def _round_up(n, m):
     return -(-n // m) * m
 
 
+def _capacity(T, k, E, capacity_factor):
+    """ONE capacity formula for every dispatch path (ep=1 slot schedule,
+    ep>1 local slot schedule, one-hot einsum): MXU-tiled 128-rounded
+    per-expert bucket size for T routed tokens."""
+    return _round_up(max(int(capacity_factor * T * k / E), 1), 128)
+
+
 def topk_route(logits, k: int, capacity: int):
     """Slot-schedule routing (no [T,E,C] one-hots). logits [T, E] fp32.
 
@@ -101,14 +110,11 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
 
     use_onehot=True keeps the einsum form whose vocab-style contraction
     GSPMD partitions into the ep all-to-all cleanly (gathers over a
-    sharded token dim would involuntarily rematerialize); the ep>1 mesh
-    path selects it."""
+    sharded token dim would involuntarily rematerialize). It serves
+    mesh-less ep>1 callers only — models with a mesh route ep>1 through
+    the moe_slot_dispatch_local shard_map island instead."""
     T, D = x.shape
-    # ONE capacity formula for both paths (numerical parity between the
-    # ep=1 slot schedule and the ep>1 einsum: same drops, same slots),
-    # rounded up to an MXU-tiled row count
-    capacity = _round_up(max(int(capacity_factor * T * k / num_experts), 1),
-                         128)
+    capacity = _capacity(T, k, num_experts, capacity_factor)
     if use_onehot:
         combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
         # [T,E,C] x [T,D] -> [E,C,D]
@@ -186,6 +192,60 @@ def _combine_rows_bwd(pair_inv, g):
 _combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 
+def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
+                            num_experts, axis_name="ep", k=2,
+                            capacity_factor=1.25):
+    """Slot-schedule MoE INSIDE a manual shard_map over `axis_name` (r5):
+    each ep shard holds E/n experts and its local tokens; it computes the
+    full top-k routing, gathers ONLY the slots belonging to its local
+    experts, runs them, and the combine psums partial outputs over 'ep'
+    (each token's k expert outputs live on exactly the owning shards).
+    Replaces the one-hot einsum dispatch at ep>1 with the same row-gather
+    schedule the ep=1 path uses — no [T,E,C] one-hots, no all-to-all of
+    padded capacity buckets (the psum moves [T,D] once).
+
+    x [T_local, D] this shard's tokens; expert_params_local leaves with
+    leading dim E/n. Same capacity formula and queue positions as
+    moe_dispatch_combine, but capacity is sized from the dp-LOCAL token
+    count: identical to serial when nothing is dropped (test-asserted);
+    under capacity overflow at dp>1 the drop sets may differ from the
+    global-batch formula."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    T, D = x.shape
+    E = num_experts
+    e_local = E // n
+    # capacity from the LOCAL (per-dp-shard) token count — the
+    # reference's MoE also sizes capacity from the local batch. With no
+    # drops this matches the serial/einsum path exactly (test-asserted);
+    # when a skewed router overflows capacity at dp>1, drop sets can
+    # differ from the global-batch formula.
+    capacity = _capacity(T, k, E, capacity_factor)
+    slot, weight, aux = topk_route(gate_logits, k, capacity)
+
+    # keep only slots owned by THIS shard's experts; re-base to local
+    lo = idx * e_local * capacity
+    local_span = e_local * capacity
+    loc = slot - lo
+    mine = (loc >= 0) & (loc < local_span)
+    loc = jnp.where(mine, loc, local_span)          # local trash slot
+    token_of_pair = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    inv = jnp.full((local_span + 1,), T, jnp.int32).at[loc].set(
+        token_of_pair, mode="drop")
+    pair_inv = jnp.full((local_span + 1,), T * k, jnp.int32).at[loc].set(
+        jnp.arange(T * k, dtype=jnp.int32), mode="drop")
+
+    expert_in = _dispatch_rows(x, inv, loc, k).reshape(
+        e_local, capacity, D)
+    expert_out = jax.vmap(expert_fn)(expert_params_local, expert_in)
+    d_out = expert_out.shape[-1]
+    picked = _combine_rows(expert_out.reshape(local_span, d_out),
+                           loc, pair_inv).reshape(T, k, d_out)
+    w = weight * mine.reshape(T, k)                 # remote pairs -> 0
+    partial = jnp.einsum("tk,tkd->td", w.astype(picked.dtype), picked)
+    return lax.psum(partial, axis_name), aux
+
+
 def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
                            num_experts, axis_name="ep", k=2,
                            capacity_factor=1.25):
@@ -195,7 +255,7 @@ def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
     n = lax.axis_size(axis_name)
     T, D = x.shape  # T = this device's LOCAL tokens
     e_local = num_experts // n
-    capacity = int(capacity_factor * T * k / num_experts + 1)
+    capacity = _capacity(T, k, num_experts, capacity_factor)
     combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E,C,D]
     # tiled all_to_all: expert axis (owner-major: expert e lives on device
