@@ -1,0 +1,70 @@
+"""Version-compatibility shims over the installed jax.
+
+The codebase targets the modern jax surface (``jax.shard_map`` with
+``axis_names=``/``check_vma=``, ``jax.enable_x64``, ``lax.axis_size``); 0.4.x
+jaxlibs only expose ``jax.experimental.shard_map.shard_map(..., check_rep=,
+auto=)`` and ``jax.experimental.enable_x64``. Everything in-tree imports these
+three names from here so the same source runs on both.
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map as _new_shard_map  # jax >= 0.6
+    _HAS_NEW_SHARD_MAP = True
+except ImportError:
+    _HAS_NEW_SHARD_MAP = False
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+              check_vma=None, **kwargs):
+    """``jax.shard_map`` signature, lowered to the experimental API on 0.4.x.
+
+    ``axis_names`` (manual axes) maps to the old ``auto=`` complement;
+    ``check_vma`` maps to ``check_rep``. Mesh axes outside ``axis_names`` with
+    size 1 are treated as manual rather than auto — partially-manual regions
+    over trivial axes CHECK-fail old XLA SPMD partitioners
+    (spmd_partitioner.cc: IsManualSubgroup mismatch) and are semantically
+    identical at size 1.
+    """
+    if _HAS_NEW_SHARD_MAP:
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kwargs)
+    auto = frozenset()
+    if axis_names is not None and mesh is not None:
+        auto = frozenset(ax for ax in mesh.axis_names
+                         if ax not in frozenset(axis_names)
+                         and mesh.shape[ax] > 1)
+    check_rep = bool(check_vma) if check_vma is not None else False
+    return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_rep, auto=auto)
+
+
+def enable_x64(new_val=True):
+    """``jax.enable_x64`` context manager (experimental module on 0.4.x)."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(new_val)
+    from jax.experimental import enable_x64 as _enable_x64
+    return _enable_x64(new_val)
+
+
+def axis_size(axis_name):
+    """``lax.axis_size``; on 0.4.x ``psum(1, axis)`` folds to the static size."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams``; 0.4.x spells it ``TPUCompilerParams``."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

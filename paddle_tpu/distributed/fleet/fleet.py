@@ -68,8 +68,21 @@ class Fleet:
         # would be a silent no-op on the pp path (review r5)
         if strategy is not None and getattr(strategy, "amp", False):
             cfg = getattr(strategy, "amp_configs", {}) or {}
-            dtype = "float16" if cfg.get("use_pure_fp16") and \
-                not cfg.get("use_bf16", True) else "bfloat16"
+            # use_pure_fp16=True means FLOAT16 as in the reference;
+            # bfloat16 only on an explicit use_bf16=True (the
+            # DistributedStrategy default dict carries one, keeping the
+            # TPU-friendly bf16 default). The previous mapping defaulted
+            # use_bf16 to True in the lookup, silently remapping every
+            # pure-fp16 request to bf16 (ADVICE r5 inversion).
+            use_bf16 = bool(cfg.get("use_bf16", False))
+            if cfg.get("use_pure_fp16") and use_bf16:
+                import warnings
+                warnings.warn(
+                    "amp_configs sets use_pure_fp16=True together with "
+                    "use_bf16=True: running pure BFLOAT16; set "
+                    "use_bf16=False for the reference's float16 behavior",
+                    UserWarning, stacklevel=2)
+            dtype = "bfloat16" if use_bf16 else "float16"
             level = "O2" if cfg.get("use_pure_fp16") else "O1"
             from ...amp import decorate as amp_decorate
             if level == "O2":

@@ -19,13 +19,39 @@ from .....nn import functional as F
 from .....nn import initializer as I
 from .....nn.layer.layers import Layer
 from .....tensor.tensor import _run_op
-from ....sharding_utils import hint, hint_tensor
+from ....sharding_utils import active_mesh, hint, hint_tensor
 from ...topology import get_hybrid_communicate_group
 
 
 def _mp_degree():
     hcg = get_hybrid_communicate_group()
     return hcg.get_model_parallel_world_size() if hcg else 1
+
+
+def _overlap_plan(kind, x, weight):
+    """Collective-matmul plan for this call, or None for the fused GSPMD
+    path (overlap off / eager / mp==1 / sub-MXU chunks — see
+    parallel/collective_matmul.py gates)."""
+    from .....amp import state as amp_state
+    from .....parallel import collective_matmul as cm
+    if not cm.overlap_enabled():
+        return None
+    mesh = active_mesh()
+    if mesh is None:
+        return None
+    plan_fn = (cm.plan_row_parallel if kind == "row"
+               else cm.plan_column_parallel)
+    plan = plan_fn(tuple(x.shape), tuple(weight.shape), mesh)
+    if plan is None:
+        return None
+
+    def apply(a, w):
+        # same O1 autocast F.linear applies — the ring kernels (and their
+        # custom VJPs) need uniform operand dtypes
+        a, w = amp_state.maybe_autocast_pair(a, w)
+        return plan(a, w)
+
+    return apply
 
 
 class VocabParallelEmbedding(Layer):
@@ -73,6 +99,15 @@ class ColumnParallelLinear(Layer):
             self.bias.is_distributed = self.weight.is_distributed
 
     def forward(self, x):
+        if self.gather_output:
+            # decomposed matmul + all-gather: the weight shards ride a
+            # ppermute ring, each hop's transfer hidden behind the previous
+            # column block's matmul
+            plan = _overlap_plan("column", x, self.weight)
+            if plan is not None:
+                out = _run_op("column_parallel_overlap", plan,
+                              (x, self.weight), {})
+                return out + self.bias if self.bias is not None else out
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             return hint_tensor(out, *([None] * out.ndim))
@@ -104,6 +139,13 @@ class RowParallelLinear(Layer):
             self.bias.pspec = P()
 
     def forward(self, x):
+        # decomposed matmul + all-reduce: partial matmuls ride a
+        # reduce-scatter ppermute ring, then a ring all-gather — every hop
+        # overlaps the next row chunk's compute
+        plan = _overlap_plan("row", x, self.weight)
+        if plan is not None:
+            out = _run_op("row_parallel_overlap", plan, (x, self.weight), {})
+            return out + self.bias if self.bias is not None else out
         if self.input_is_parallel:
             spec = [None] * (x.ndim - 1) + ["mp"]
             x = hint_tensor(x, *spec)

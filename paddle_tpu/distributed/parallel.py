@@ -24,6 +24,9 @@ class DataParallel(Layer):
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         self.group = group
+        # picked up by TrainStep(grad_sync="bucketed") as the bucket cap,
+        # mirroring the reference reducer's comm_buffer_size (MB)
+        self._comm_buffer_mb = comm_buffer_size
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

@@ -59,3 +59,59 @@ def param_sharding(param, mesh: Mesh) -> NamedSharding:
     """The NamedSharding for a parameter, from its attached pspec."""
     spec = getattr(param, "pspec", None) or P()
     return NamedSharding(mesh, spec)
+
+
+# ---------------------------------------------------------------------------
+# Bucketed gradient synchronization (ref: DataParallel's EagerReducer /
+# comm_buffer_size). Grads are grouped into size-capped buckets in REVERSE
+# parameter order — the approximate order backward produces them — and each
+# bucket is all-reduced as one fused collective. Inside the compiled step the
+# buckets are independent ops whose operands become ready progressively
+# during backward, so XLA's async collective scheduler overlaps each bucket's
+# reduce with the remaining backward compute instead of one end-of-step
+# barrier (and far fewer launches than per-parameter reduces).
+# ---------------------------------------------------------------------------
+
+def plan_grad_buckets(shapes: dict, cap_bytes: int, reverse: bool = True):
+    """Group param names into size-capped buckets.
+
+    shapes: {name: (shape_tuple, itemsize_bytes)}. Order of dict insertion is
+    forward/creation order; ``reverse`` walks it backwards (reverse-
+    topological, grads-ready-first). A single oversized grad gets its own
+    bucket. Returns a list of name lists.
+    """
+    names = list(shapes)
+    if reverse:
+        names = names[::-1]
+    buckets, cur, cur_bytes = [], [], 0
+    for name in names:
+        shape, itemsize = shapes[name]
+        nbytes = int(itemsize)
+        for d in shape:
+            nbytes *= int(d)
+        if cur and cur_bytes + nbytes > cap_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(name)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_psum(grads: dict, buckets, axis_names):
+    """Per-bucket fused psum of a {name: grad} dict (call INSIDE shard_map).
+
+    Each bucket is reduced as ONE variadic psum (XLA's combined all-reduce —
+    many operands, one collective launch, no flatten/concat copies). psum is
+    elementwise per leaf, so the result is bit-identical to per-parameter
+    psums — bucketing changes the collective granularity, not the numerics.
+    """
+    out = dict(grads)
+    for bucket in buckets:
+        present = [n for n in bucket if n in grads]
+        if not present:
+            continue
+        reduced = jax.lax.psum(tuple(grads[n] for n in present), axis_names)
+        out.update(zip(present, reduced))
+    return out

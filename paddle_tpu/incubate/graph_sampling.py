@@ -25,6 +25,28 @@ def _np(x):
     return np.asarray(x)
 
 
+def _host_rng():
+    """NumPy Generator seeded from the framework's global RNG state.
+
+    The reference samplers draw from the stateful per-device Generator
+    (pinned by ``paddle.seed``); an unseeded per-call ``default_rng()``
+    made every run irreproducible (ADVICE r5). Each call folds a fresh
+    subkey out of the global generator, so ``paddle.seed(s)`` pins the
+    whole sample stream while consecutive calls still draw fresh
+    randomness.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..framework.random import next_key
+    key = next_key()
+    if jnp.issubdtype(key.dtype, jnp.integer):  # old-style raw uint32 pair
+        data = np.asarray(key)
+    else:  # new-style typed key
+        data = np.asarray(jax.random.key_data(key))
+    return np.random.default_rng(data.astype(np.uint32).ravel().tolist())
+
+
 def _wrap(a, dtype=None):
     import jax.numpy as jnp
     arr = np.asarray(a)
@@ -76,8 +98,13 @@ def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
     Returns (out_neighbors, out_count[, out_eids]): the sampled
     neighbors of each input node concatenated, the per-node neighbor
     counts, and (when return_eids) the edge ids of the sampled edges.
+
+    ``perm_buffer`` / ``flag_perm_buffer`` are accepted for API parity
+    and ignored: the reference's pre-allocated Fisher-Yates workspace is
+    a CUDA-kernel optimization; the host-side NumPy sampler draws
+    without replacement directly, so the buffer is a no-op here.
     """
-    rng = np.random.default_rng()
+    rng = _host_rng()
     row_np, col_np = _np(row), _np(colptr)
     nodes = _np(input_nodes).ravel()
     if return_eids and eids is None:
@@ -105,7 +132,7 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     of the unique nodes, and the positions of the input nodes in that
     unique set.
     """
-    rng = np.random.default_rng()
+    rng = _host_rng()
     row_np, col_np = _np(row), _np(colptr)
     nodes = _np(input_nodes).ravel()
     if return_eids and sort_eids is None:

@@ -10,11 +10,13 @@ dp-sharded, and XLA emits all collectives.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..distributed import sharding_utils
@@ -33,7 +35,9 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  mesh: Optional[Mesh] = None, batch_spec=None,
-                 grad_accum: int = 1, donate: bool = True, rng_seed: int = 0):
+                 grad_accum: int = 1, donate: bool = True, rng_seed: int = 0,
+                 grad_sync: Optional[str] = None,
+                 grad_bucket_mb: Optional[float] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -124,7 +128,8 @@ class TrainStep:
         mesh_ref = mesh
         bspec = batch_spec
 
-        def compute_loss(train_params, frozen_params, buffers, batch, rng):
+        def compute_loss(train_params, frozen_params, buffers, batch, rng,
+                         use_hints=True):
             all_params = {**frozen_params, **train_params}
             def run():
                 out, new_buf = functional_call(model_ref, all_params,
@@ -135,7 +140,10 @@ class TrainStep:
                 labels = [Tensor._from_data(l) for l in batch["labels"]]
                 loss = loss_ref(t_out, *labels)
                 return loss._data.astype(jnp.float32), new_buf
-            if mesh_ref is not None:
+            # hints are skipped inside the explicit-sync shard_map island:
+            # with_sharding_constraint is meaningless on manual (per-shard)
+            # values, and the island only activates when mp/pp/sep are trivial
+            if mesh_ref is not None and use_hints:
                 with _mesh_hints(mesh_ref):
                     return run()
             return run()
@@ -143,13 +151,14 @@ class TrainStep:
         accum = int(grad_accum)
 
         def accum_loss_grads(train_params, frozen_params, buffers, batch,
-                             rng):
+                             rng, use_hints=True):
+            compute = functools.partial(compute_loss, use_hints=use_hints)
             """Gradient merge (ref: GradientMergeOptimizer / pipeline
             accumulate_steps): split the batch into `accum` microbatches on
             axis 0 and lax.scan them, summing grads in the carry (O(1) grad
             memory) and applying ONE optimizer update for the mean."""
             if accum <= 1:
-                return jax.value_and_grad(compute_loss, has_aux=True)(
+                return jax.value_and_grad(compute, has_aux=True)(
                     train_params, frozen_params, buffers, batch, rng)
 
             def split(a):
@@ -173,8 +182,8 @@ class TrainStep:
                 bufs, gsum, lsum = carry
                 batch_i, rng_i = xs
                 (l, new_bufs), g = jax.value_and_grad(
-                    compute_loss, has_aux=True)(train_params, frozen_params,
-                                                bufs, batch_i, rng_i)
+                    compute, has_aux=True)(train_params, frozen_params,
+                                           bufs, batch_i, rng_i)
                 gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
                 return (new_bufs, gsum, lsum + l), None
 
@@ -183,10 +192,90 @@ class TrainStep:
             grads = jax.tree_util.tree_map(lambda g: g / accum, gsum)
             return (lsum / accum, new_buffers), grads
 
+        # --- explicit bucketed/per-param gradient sync (DataParallel /
+        # GroupSharded stage-1/2). Instead of GSPMD's implicit per-parameter
+        # grad reduces, a fully-manual shard_map island computes per-shard
+        # grads and issues the reduces itself — one fused psum per size-capped
+        # bucket, in reverse parameter order, so each bucket's collective
+        # overlaps the rest of backward. Opt-in (grad_sync=/env); only
+        # activates when every non-trivial mesh axis is a data axis (dp/
+        # sharding) — hybrid mp/pp/sep keeps the GSPMD path.
+        sync_mode = grad_sync or os.environ.get("PADDLE_TPU_GRAD_SYNC", "auto")
+        reduce_axes = ()
+        if sync_mode not in ("auto", "explicit", "bucketed"):
+            raise ValueError(f"grad_sync must be auto/explicit/bucketed, "
+                             f"got {sync_mode!r}")
+        if sync_mode != "auto":
+            if mesh is None or batch_spec is None:
+                sync_mode = "auto"
+            else:
+                nontrivial = {ax for ax, sz in mesh.shape.items() if sz > 1}
+                reduce_axes = tuple(ax for ax in ("dp", "sharding")
+                                    if mesh.shape.get(ax, 1) > 1)
+                if not reduce_axes or nontrivial - {"dp", "sharding"}:
+                    sync_mode, reduce_axes = "auto", ()
+        self.grad_sync_mode = sync_mode
+        self.grad_buckets = None
+        if sync_mode == "bucketed":
+            if grad_bucket_mb is None:
+                grad_bucket_mb = getattr(model, "_comm_buffer_mb", None)
+            if grad_bucket_mb is None:
+                grad_bucket_mb = float(os.environ.get(
+                    "PADDLE_TPU_DP_BUCKET_MB", 25))
+            shapes = {k: (tuple(params[k].shape), params[k].dtype.itemsize)
+                      for k in trainable_keys}
+            self.grad_buckets = sharding_utils.plan_grad_buckets(
+                shapes, int(float(grad_bucket_mb) * 2 ** 20))
+        buckets_ref = self.grad_buckets
+        sync_axes = reduce_axes
+
+        def island_loss_grads(train_params, frozen_params, buffers, batch,
+                              rng):
+            from .._compat import shard_map
+            n_tot = 1
+            for ax in sync_axes:
+                n_tot *= mesh.shape[ax]
+
+            def local(train_params, frozen_params, buffers, batch, rng):
+                idx = lax.axis_index(sync_axes[0])
+                for ax in sync_axes[1:]:
+                    idx = idx * mesh.shape[ax] + lax.axis_index(ax)
+                rng_local = jax.random.fold_in(rng, idx)
+                (loss, new_buf), grads = accum_loss_grads(
+                    train_params, frozen_params, buffers, batch, rng_local,
+                    use_hints=False)
+                if buckets_ref is not None:
+                    grads = sharding_utils.bucketed_psum(
+                        grads, buckets_ref, sync_axes)
+                else:
+                    grads = {k: lax.psum(g, sync_axes)
+                             for k, g in grads.items()}
+                grads = {k: g / n_tot for k, g in grads.items()}
+                loss = lax.psum(loss, sync_axes) / n_tot
+                new_buf = {k: lax.psum(v, sync_axes) / n_tot
+                           for k, v in new_buf.items()}
+                return loss, new_buf, grads
+
+            bs = list(bspec)
+            batch_specs = jax.tree_util.tree_map(
+                lambda a: P(*(bs + [None] * (a.ndim - len(bs)))), batch)
+            f = shard_map(local, mesh=mesh,
+                          in_specs=(P(), P(), P(), batch_specs, P()),
+                          out_specs=(P(), P(), P()),
+                          axis_names=frozenset(mesh.axis_names),
+                          check_vma=False)
+            loss, new_buf, grads = f(train_params, frozen_params, buffers,
+                                     batch, rng)
+            return (loss, new_buf), grads
+
         def step_fn(train_params, opt_states, buffers, frozen_params, batch,
                     rng, lr):
-            (loss, new_buffers), grads = accum_loss_grads(
-                train_params, frozen_params, buffers, batch, rng)
+            if sync_axes:
+                (loss, new_buffers), grads = island_loss_grads(
+                    train_params, frozen_params, buffers, batch, rng)
+            else:
+                (loss, new_buffers), grads = accum_loss_grads(
+                    train_params, frozen_params, buffers, batch, rng)
             if grad_shardings_ref:
                 grads = {
                     k: jax.lax.with_sharding_constraint(

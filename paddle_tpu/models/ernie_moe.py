@@ -176,7 +176,7 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
         # with no drops this is numerically identical to serial, which
         # the ep-vs-serial tests assert. The one-hot einsum fallback
         # below stays for mesh-less callers.
-        from jax import shard_map
+        from .._compat import shard_map
         from ..parallel.moe import moe_slot_dispatch_local
 
         def island(tok, gate, w1, w2):

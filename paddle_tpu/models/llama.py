@@ -89,6 +89,9 @@ class ParallelConfig:
     scan_unroll: int = 1
     zero_stage: int = 3  # what 'sharding' shards: 1=os, 2=os+g, 3=os+g+p
     use_flash: Optional[bool] = None  # None = auto (TPU yes, CPU no)
+    # async pp p2p: each activation ppermute overlaps the next tick's stage
+    # compute (one extra skew tick per stage). None = PADDLE_TPU_PP_OVERLAP.
+    overlap_p2p: Optional[bool] = None
 
     @property
     def total(self):
@@ -1106,18 +1109,22 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
 
     def loss_fn(p, ids, labels):
         if needs_shard_map:
-            from jax import shard_map
-            # manual over 'sep' (ring attention does explicit ppermute)
-            # AND the batch axes: a dp-sharded batch entering a manual
-            # region on an AUTO axis CHECK-fails XLA's SPMD group
-            # expansion (spmd_partitioner_util.cc:495, seen at the
-            # dp2·sep2·mp2 factoring) — making the batch axes manual
-            # sidesteps the auto/manual reshard entirely. mp/sharding-
-            # of-params remain auto -> GSPMD partitions them as usual.
+            from .._compat import shard_map
+            # FULLY manual island: 'sep' (ring attention does explicit
+            # ppermute) and the batch axes carry real sharding; a dp-
+            # sharded batch entering a manual region on an AUTO axis
+            # CHECK-fails XLA's SPMD group expansion (spmd_partitioner_
+            # util.cc:495, seen at the dp2·sep2·mp2 factoring), and any
+            # leftover auto axis turns lax.axis_index into a PartitionId
+            # instruction the SPMD partitioner rejects as UNIMPLEMENTED.
+            # mp-sharded params enter on P() specs, i.e. gathered at the
+            # boundary and computed replicated across mp inside — the
+            # sep>1 factorings trade TP inside this island for a working
+            # partition (the pp path keeps explicit TP via tp_axis).
             batch_axes = _act_spec(parallel)[0]
             if isinstance(batch_axes, str):  # P collapses 1-tuples
                 batch_axes = (batch_axes,)
-            manual = {"sep", *batch_axes}
+            manual = frozenset(mesh.axis_names)
             sep_only = jax.tree_util.tree_map(
                 lambda _: P(), pspecs, is_leaf=lambda x: isinstance(x, P))
             smap = shard_map(
@@ -1170,9 +1177,10 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
 
 def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
     """Pipeline path: stage-stacked params sharded over 'pp', collective
-    schedule via shard_map + ppermute (parallel/pipeline.py design) with the
-    other axes left to GSPMD (auto)."""
-    from jax import shard_map
+    schedule via shard_map + ppermute (parallel/pipeline.py design), every
+    mesh axis manual inside the island (batch axes handled by explicit loss
+    psums — see manual_axes below)."""
+    from .._compat import shard_map
     c = config
     S = parallel.pp
     L = c.num_hidden_layers
@@ -1205,8 +1213,11 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
 
     act = _act_spec(parallel)
     batch_axes = act[0]
+    if isinstance(batch_axes, str):  # P collapses 1-tuples
+        batch_axes = (batch_axes,)
     tp_axis = "mp" if parallel.mp > 1 else None
     sep_on = parallel.sep > 1
+    loss_psum_axes = (("sep",) if sep_on else ()) + tuple(batch_axes)
 
     def stage_fn(stage_params, h, cos, sin):
         body = functools.partial(decoder_layer, config=c, parallel=parallel,
@@ -1235,20 +1246,23 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
 
         pipe = pipeline_apply(
             lambda sp, hh: stage_fn(sp, hh, cos, sin), S, M, "pp",
-            remat=False)  # remat already inside stage scan
+            remat=False,  # remat already inside stage scan
+            overlap_p2p=parallel.overlap_p2p)
         out_mb = pipe(p["layers"], h_mb)
         h_out = out_mb.reshape(b, s, c.hidden_size)
         logits = llama_logits(p, h_out, c).astype(jnp.float32)
-        loss = masked_ce_loss(logits, labels, sep_psum=sep_on)
+        loss = masked_ce_loss(logits, labels, psum_axes=loss_psum_axes)
         return last_stage_value(loss, S, "pp")
 
-    # Manual over 'pp' (+ 'mp' when TP is on: the explicit Megatron psum
-    # pattern, + 'sep' when context parallel is on: ring attention's explicit
-    # ppermute — mixing manual pp with auto mp/sep collectives crashes XLA's
-    # SPMD group expansion, spmd_partitioner_util CHECK failure at 32 devices).
-    # dp/sharding stay auto/GSPMD.
-    manual_axes = ({"pp"} | ({"mp"} if tp_axis else set())
-                   | ({"sep"} if sep_on else set()))
+    # FULLY manual island: 'pp' (ppermute schedule), 'mp' (explicit Megatron
+    # psums), 'sep' (ring attention's ppermute), AND the batch axes. Mixing
+    # manual and auto axes fails twice over: auto mp/sep collectives crash
+    # XLA's SPMD group expansion (spmd_partitioner_util CHECK at 32 devices),
+    # and ANY leftover auto axis makes lax.axis_index lower to a PartitionId
+    # instruction the SPMD partitioner rejects as UNIMPLEMENTED. The batch
+    # axes are handled like the sep path above: ids/labels enter batch-
+    # sharded and masked_ce_loss psums token sum/count across them.
+    manual_axes = frozenset(mesh.axis_names)
 
     def manual_spec(full_spec, lead_pp: bool):
         parts = ["pp"] if lead_pp else []
@@ -1268,7 +1282,7 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
     pp_manual["final_norm"] = P()
     if "lm_head" in pp_manual:
         pp_manual["lm_head"] = P()
-    ids_spec = P(None, "sep") if sep_on else P()
+    ids_spec = P(batch_axes, "sep" if sep_on else None)
     in_specs = (pp_manual, ids_spec, ids_spec)
     smap_loss = shard_map(pipelined_loss, mesh=mesh, in_specs=in_specs,
                           out_specs=P(), axis_names=manual_axes,

@@ -18,7 +18,8 @@ def mosaic_trace_ctx():
     index/constant types that leak into the kernel trace ("failed to legalize
     operation 'func.return'" on v5e). Kernel inputs/outputs are explicit f32/
     bf16, so disabling x64 inside the trace is semantics-preserving."""
-    return jax.enable_x64(False)
+    from .._compat import enable_x64
+    return enable_x64(False)
 
 
 def interpret_mode() -> bool:

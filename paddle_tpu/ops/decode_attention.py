@@ -47,6 +47,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
+from .flash_attention import softmax_mode
 
 _LOG2E = 1.4426950408889634
 
@@ -56,7 +57,7 @@ DECODE_BLOCK_T = 512
 
 
 def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
-            block_t, n_t, nb):
+            block_t, n_t, nb, online=False):
     import numpy as np
     j = pl.program_id(0)
     pos = lp_ref[1]
@@ -105,10 +106,22 @@ def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
     @pl.when(jnp.logical_and(j > 0, start <= pos))
     def _more():
         s = scores()
-        p = jnp.exp2(s - b_s[:, :1])
-        l_s[...] = l_s[...] + jnp.broadcast_to(
-            p.sum(axis=-1, keepdims=True), l_s.shape)
-        acc_s[...] = acc_s[...] + pv(p.astype(v_ref.dtype))
+        if online:
+            # PADDLE_TPU_FLASH_SOFTMAX=online: running-max recurrence
+            # instead of the tile-0 anchored base (see flash_attention)
+            m_prev = b_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            b_s[...] = jnp.broadcast_to(m_new, b_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + pv(p.astype(v_ref.dtype))
+        else:
+            p = jnp.exp2(s - b_s[:, :1])
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + pv(p.astype(v_ref.dtype))
 
     @pl.when(j == np.int32(n_t - 1))
     def _fin():
@@ -146,7 +159,7 @@ def _tile_plan(T, layer, pos):
 
 def _kernel_update(lp_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref,
                    o_ref, ko_ref, vo_ref, l_s, b_s, acc_s, *,
-                   block_t, n_t, nb):
+                   block_t, n_t, nb, online=False):
     import numpy as np
     j = pl.program_id(0)
     pos = lp_ref[1]
@@ -189,21 +202,38 @@ def _kernel_update(lp_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref,
         s = jnp.concatenate(rows, axis=0)          # [B*NH, Tt]
         t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(t <= pos, s, -1e30)
+        alpha = None
         if first:
             bvec = s.max(axis=-1, keepdims=True)
+            b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
+        elif online:
+            # PADDLE_TPU_FLASH_SOFTMAX=online: running-max recurrence
+            m_prev = b_s[:, :1]
+            bvec = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - bvec)
             b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
         else:
             bvec = b_s[:, :1]
         p = jnp.exp2(s - bvec)
         psum = jnp.broadcast_to(p.sum(axis=-1, keepdims=True), l_s.shape)
-        l_s[...] = psum if first else l_s[...] + psum
+        if first:
+            l_s[...] = psum
+        elif online:
+            l_s[...] = l_s[...] * alpha + psum
+        else:
+            l_s[...] = l_s[...] + psum
         pb = p.astype(v_ref.dtype)
         for bi in range(nb):
             sl = slice(bi * nh, (bi + 1) * nh)
             d = jax.lax.dot_general(
                 pb[sl], v_at(bi), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            acc_s[sl] = d if first else acc_s[sl] + d
+            if first:
+                acc_s[sl] = d
+            elif online:
+                acc_s[sl] = acc_s[sl] * alpha[sl] + d
+            else:
+                acc_s[sl] = acc_s[sl] + d
 
     def at(ref):
         return lambda bi: ref[0, bi]
@@ -258,7 +288,7 @@ def decode_attend_update_slab(q_bd, new_k, new_v, k_cache, v_cache,
         return (lp_ref[0], 0, 0, lp_ref[1] // block_t)
 
     kernel = functools.partial(_kernel_update, block_t=block_t, n_t=n_t,
-                               nb=b)
+                               nb=b, online=softmax_mode() == "online")
     with _mosaic_ctx():
         out, kc, vc = pl.pallas_call(
             kernel,
@@ -309,7 +339,8 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
         return None  # ragged cache: caller falls back to the XLA path
     block_t, n_t, lp, live_map = plan
 
-    kernel = functools.partial(_kernel, block_t=block_t, n_t=n_t, nb=b)
+    kernel = functools.partial(_kernel, block_t=block_t, n_t=n_t, nb=b,
+                               online=softmax_mode() == "online")
     with _mosaic_ctx():
         out = pl.pallas_call(
             kernel,

@@ -18,6 +18,7 @@ interpret=True under CPU so the same code runs in tests.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,7 @@ _LN2 = 0.6931471805599453
 
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
+from .._compat import tpu_compiler_params as _tpu_compiler_params
 
 
 def _fit_block(block, n):
@@ -267,6 +269,26 @@ def _fwd_kernel_fixed_base(q_ref, k_ref, v_ref, *rest, block_k, causal,
     lse_ref[0] = ((base + jnp.log2(jnp.maximum(l, 1e-30))) * _LN2).T
 
 
+# Escape hatch (ADVICE r5): the fixed-base scheme anchors every row's
+# exponent base on block/tile 0's max, which overflows (LOUD inf/nan, never
+# silent) if a later block's true row max exceeds it by >~100 log2 units.
+# Callers with such heavy-tailed logits set PADDLE_TPU_FLASH_SOFTMAX=online
+# to force the unconditionally-stable online-softmax recurrence in every
+# kernel that has a fixed-base variant (resident forward, streaming
+# forward, decode slabs). Read per call so tests can flip it via
+# monkeypatched env.
+ENV_FLASH_SOFTMAX = "PADDLE_TPU_FLASH_SOFTMAX"
+
+
+def softmax_mode() -> str:
+    """'auto' (fixed-base wherever its VMEM budget fits) or 'online'."""
+    mode = os.environ.get(ENV_FLASH_SOFTMAX, "auto").strip().lower()
+    if mode not in ("auto", "online"):
+        raise ValueError(
+            f"{ENV_FLASH_SOFTMAX} must be 'auto' or 'online', got {mode!r}")
+    return mode
+
+
 # scoped-VMEM budget for selecting the fixed-base resident kernel: its
 # extra s0/p0 live ranges cost ~2 more [BQ, BK] f32 buffers than the
 # online kernel (measured: flagship 1024^2 blocks hit 16.02M > 16M)
@@ -281,6 +303,15 @@ def _fb_resident_fits(skp, d, bq, bk, itemsize):
     return kv + sp + io + tri < _FB_RESIDENT_BUDGET
 
 
+def _resident_kernel_choice(skp, d, bq, bk, itemsize):
+    """The resident forward kernel _flash_fwd will run: fixed-base when the
+    escape hatch is off and its scoped-VMEM stack fits, else online."""
+    if softmax_mode() == "online":
+        return _fwd_kernel
+    return (_fwd_kernel_fixed_base
+            if _fb_resident_fits(skp, d, bq, bk, itemsize) else _fwd_kernel)
+
+
 # whole-KV-in-VMEM ceiling: above this the forward streams KV tiles through
 # a third grid dimension instead. Empirical (v5e, 16MB scoped vmem): the
 # resident kernel's scoped stack is ~2x(K+V) (double buffering) + ~1.3MB, so
@@ -289,7 +320,7 @@ STREAM_KV_BYTES = 3 * 2 ** 20
 
 
 def _fwd_kernel_stream(q_ref, k_ref, v_ref, *rest, block_k, causal, kv_len,
-                       seq_k, n_k, use_tri=False):
+                       seq_k, n_k, use_tri=False, online=False):
     """Streaming variant: grid (BH, n_q, n_k); one KV tile per step, online
     stats in VMEM scratch persisted across the innermost (sequential) k
     steps. Removes the whole-KV VMEM residency ceiling (S beyond ~12k at
@@ -335,7 +366,10 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, *rest, block_k, causal, kv_len,
     # the exponent base for all later tiles, so p never waits on the
     # current tile's reduction and acc never rescales (measured 0.633 ->
     # 0.82 eff at S=32k; the exp2+sum are free, the online-max data
-    # path was the whole gap). Tile 0 always has a live column.
+    # path was the whole gap). Tile 0 always has a live column. With
+    # online=True (PADDLE_TPU_FLASH_SOFTMAX=online) m_s instead carries
+    # the running row max and l/acc rescale each tile — the
+    # unconditionally-stable recurrence for heavy-tailed logits.
     @pl.when(ki == 0)
     def _first():
         q = q_ref[0]
@@ -365,12 +399,23 @@ def _fwd_kernel_stream(q_ref, k_ref, v_ref, *rest, block_k, causal, kv_len,
         else:
             s = _mask_scores(s, qi * bq_i, start, causal,
                              col_limit=kv_len if mask_kv else None)
-        base = m_s[:, :1]
-        p = jnp.exp2(s - base)
-        l_s[...] = l_s[...] + jnp.broadcast_to(
-            p.sum(axis=-1, keepdims=True), l_s.shape)
-        acc_s[...] = acc_s[...] + jnp.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        if online:
+            m_prev = m_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        else:
+            base = m_s[:, :1]
+            p = jnp.exp2(s - base)
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + jnp.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
 
     @pl.when(ki == np.int32(n_k - 1))
     def _finalize():
@@ -422,7 +467,8 @@ def _flash_fwd_stream(qp, kp, vp, causal, block_q, block_k, sk,
     use_tri = causal and sk == skp and block_q == block_k
     kernel = functools.partial(_fwd_kernel_stream, block_k=block_k,
                                causal=causal, kv_len=sk,
-                               seq_k=skp, n_k=n_k, use_tri=use_tri)
+                               seq_k=skp, n_k=n_k, use_tri=use_tri,
+                               online=softmax_mode() == "online")
     kv_map = _kv_clamp_map(block_q, block_k, causal)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -493,10 +539,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
         return o[:, :s], lse.reshape(bh, sp)[:, :s]
     grid = (bh, sp // block_q)
     use_tri = causal and sk == skp and block_q == block_k
-    kern_fn = (_fwd_kernel_fixed_base
-               if _fb_resident_fits(skp, d, block_q, block_k,
-                                    q.dtype.itemsize)
-               else _fwd_kernel)
+    kern_fn = _resident_kernel_choice(skp, d, block_q, block_k,
+                                      q.dtype.itemsize)
     kernel = functools.partial(kern_fn, block_k=block_k, causal=causal,
                                seq_k=skp, kv_len=sk,
                                use_tri=use_tri)
@@ -892,7 +936,7 @@ def _bwd_fused_stream_chunk(qp, kp, vp, dop, lse3, delta3, causal,
             # the 16M scoped-VMEM default is a compiler guardrail, not the
             # hardware (v5e has 128M): bkdma=4096 needs ~19M of windows +
             # scratch and halves the dq-partial traffic vs bkdma=2048
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_compiler_params(
                 vmem_limit_bytes=48 * 1024 * 1024),
             interpret=_interpret(),
         )(*args)

@@ -1,0 +1,258 @@
+"""Decomposed collective matmuls for tensor parallelism ("collective matmul").
+
+The blocking TP path lets GSPMD emit one fused collective around each sharded
+matmul: row-parallel is matmul -> all-reduce, column-parallel (gathered) is
+matmul -> all-gather, both sitting as barriers on the critical path. Here each
+fused collective is decomposed into a ``ppermute`` ring of partial matmuls so
+every hop's transfer overlaps the next chunk's compute (Megatron / maxtext
+style), inside a fully-manual shard_map island over the active mesh.
+
+Numerics: the ring kernels carry a custom_vjp whose backward issues exactly
+the same ops as the blocking path's backward, and at mp=2 the forward ring
+reduction is a two-term sum (commutative in fp), so overlapped == blocking
+bit-for-bit at mp=2; for mp>2 the all-reduce variant re-associates the
+partial-sum order and matches to fp tolerance (the all-gather variant is
+bitwise at any degree — it has no cross-rank reduction).
+
+Switches: ``PADDLE_TPU_TP_OVERLAP=1`` turns the overlap on;
+``PADDLE_TPU_TP_OVERLAP_MIN_CHUNK`` (default 64) is the smallest per-step
+chunk (ring rows / gathered columns) worth issuing — below it the partial
+matmuls can't keep an MXU busy and the fused collective wins, so the layer
+falls back. Fallback is also automatic when mp == 1, no mesh is active, or
+the shapes don't divide the ring.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .._compat import shard_map
+
+ENV_OVERLAP = "PADDLE_TPU_TP_OVERLAP"
+ENV_MIN_CHUNK = "PADDLE_TPU_TP_OVERLAP_MIN_CHUNK"
+_DEFAULT_MIN_CHUNK = 64
+
+
+def overlap_enabled() -> bool:
+    return os.environ.get(ENV_OVERLAP, "0").lower() in ("1", "true", "ring",
+                                                        "on")
+
+
+def min_chunk() -> int:
+    return int(os.environ.get(ENV_MIN_CHUNK, _DEFAULT_MIN_CHUNK))
+
+
+# ---------------------------------------------------------------------------
+# ring kernels (called INSIDE a fully-manual shard_map over the mesh)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ring_allreduce_matmul(x, w, n, axis_name):
+    """Row-parallel matmul with the all-reduce decomposed into a ring.
+
+    x: [t, k/n] local rows (full t), w: [k/n, out] local shard ->
+    [t, out] fully reduced, identical on every rank along ``axis_name``.
+
+    Reduce-scatter ring: at step s rank r multiplies its row chunk
+    c = (r - s - 1) % n and adds it onto the accumulator arriving from rank
+    r-1 (which computed the same chunk's partial last step) — the constraint
+    c_s(r) = c_{s-1}(r-1) pins the schedule. After n steps rank r holds row
+    chunk r fully reduced; a ring all-gather reassembles [t, out]. Each
+    ppermute overlaps the next chunk's partial matmul.
+    """
+    r = lax.axis_index(axis_name)
+    t = x.shape[0]
+    tc = t // n
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    acc = None
+    for s in range(n):
+        if s > 0:
+            acc = lax.ppermute(acc, axis_name, fwd)
+        c = (r - s - 1) % n
+        rows = lax.dynamic_slice_in_dim(x, c * tc, tc, 0)
+        part = rows @ w
+        acc = part if acc is None else acc + part
+    out = jnp.zeros((t,) + acc.shape[1:], acc.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, acc, r * tc, 0)
+    buf = acc
+    for h in range(1, n):
+        buf = lax.ppermute(buf, axis_name, fwd)
+        out = lax.dynamic_update_slice_in_dim(out, buf, ((r - h) % n) * tc, 0)
+    return out
+
+
+def _rar_fwd(x, w, n, axis_name):
+    return ring_allreduce_matmul(x, w, n, axis_name), (x, w)
+
+
+def _rar_bwd(n, axis_name, res, g):
+    # shard_map (check_rep/vma off) hands an mp-replicated output's cotangent
+    # back DIVIDED by the mp size; the blocking psum(x @ w) backward restores
+    # it through its psum transpose. Issue the identical psum so both paths
+    # run the same ops bitwise, then both grads are local matmuls.
+    x, w = res
+    g = lax.psum(g, axis_name)
+    return g @ w.T, x.T @ g
+
+
+ring_allreduce_matmul.defvjp(_rar_fwd, _rar_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ring_allgather_matmul(x, w, n, axis_name):
+    """Column-parallel matmul with the output all-gather decomposed into a
+    chunked pipeline.
+
+    x: [t, k] replicated, w: [k, out/n] local shard -> [t, out] gathered.
+
+    The local column block is computed in n row chunks; as soon as chunk c's
+    [t/n, out/n] block is done it starts riding the ring (n-1 hops to reach
+    everyone) while chunk c+1's matmul runs — the hops carry no data
+    dependence on later chunks, so the scheduler overlaps transfer with
+    compute. Per-device FLOPs and bytes moved are identical to the fused
+    path, and every output element is produced by the same x @ w_shard
+    product on its owning rank, so the result is bitwise identical to
+    matmul + all-gather at ANY degree.
+    """
+    r = lax.axis_index(axis_name)
+    t = x.shape[0]
+    tc = t // n
+    nc = w.shape[1]
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    out = jnp.zeros((t, nc * n), jnp.result_type(x.dtype, w.dtype))
+    for c in range(n):
+        rows = lax.dynamic_slice_in_dim(x, c * tc, tc, 0)
+        buf = rows @ w
+        row0 = jnp.asarray(c * tc, r.dtype)
+        out = lax.dynamic_update_slice(out, buf, (row0, r * nc))
+        for h in range(1, n):
+            buf = lax.ppermute(buf, axis_name, fwd)
+            out = lax.dynamic_update_slice(
+                out, buf, (row0, ((r - h) % n) * nc))
+    return out
+
+
+def _rag_fwd(x, w, n, axis_name):
+    return ring_allgather_matmul(x, w, n, axis_name), (x, w)
+
+
+def _rag_bwd(n, axis_name, res, g):
+    # blocking backward of all_gather(x @ w, tiled): the gather transpose is a
+    # psum_scatter — psum the (1/n-scaled, see _rar_bwd) cotangent and slice
+    # the rank's own column block. dx stays per-rank partial; the shard_map
+    # boundary transpose psums it over mp (x is unmentioned there), exactly as
+    # it does for the blocking path.
+    x, w = res
+    r = lax.axis_index(axis_name)
+    nc = w.shape[1]
+    g_loc = lax.dynamic_slice_in_dim(lax.psum(g, axis_name), r * nc, nc, 1)
+    dx = g_loc @ w.T
+    dw = x.T @ g_loc
+    return dx, dw
+
+
+ring_allgather_matmul.defvjp(_rag_fwd, _rag_bwd)
+
+
+# blocking references (same island layout, fused collective) — the parity
+# baseline the ring kernels must match bit-for-bit at degree 2
+def blocking_allreduce_matmul(x, w, n, axis_name):
+    return lax.psum(x @ w, axis_name)
+
+
+def blocking_allgather_matmul(x, w, n, axis_name):
+    return lax.all_gather(x @ w, axis_name, axis=1, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# GSPMD embedding: fully-manual islands callable from hint-traced layer code
+# ---------------------------------------------------------------------------
+
+def _batch_axis_spec(mesh, t, batch_axis):
+    """Shard the flattened token dim over ``batch_axis`` when it divides
+    cleanly (keeps a dp-sharded batch in place); replicate otherwise."""
+    if batch_axis and batch_axis in mesh.shape and mesh.shape[batch_axis] > 1 \
+            and t % mesh.shape[batch_axis] == 0:
+        return batch_axis
+    return None
+
+
+def _island(mesh, body, n, mp_axis, x_spec, w_spec, out_spec):
+    return shard_map(functools.partial(body, n=n, axis_name=mp_axis),
+                     mesh=mesh, in_specs=(x_spec, w_spec),
+                     out_specs=out_spec, axis_names=frozenset(mesh.axis_names),
+                     check_vma=False)
+
+
+def plan_row_parallel(x_shape, w_shape, mesh, mp_axis="mp", batch_axis="dp",
+                      kernel=ring_allreduce_matmul):
+    """Overlapped row-parallel linear: x [..., k] (k sharded over mp),
+    w [k, out] -> [..., out] replicated over mp. Returns an apply(x, w)
+    closure, or None when the overlap doesn't apply (caller falls back to
+    the fused GSPMD path)."""
+    n = mesh.shape.get(mp_axis, 1)
+    if n <= 1:
+        return None
+    k, out_f = w_shape
+    if x_shape[-1] != k or k % n:
+        return None
+    t = 1
+    for d in x_shape[:-1]:
+        t *= d
+    bax = _batch_axis_spec(mesh, t, batch_axis)
+    t_loc = t // (mesh.shape[bax] if bax else 1)
+    # ring chunks are rows of the LOCAL token block
+    if t_loc % n or t_loc // n < min_chunk():
+        return None
+    f = _island(mesh, kernel, n, mp_axis,
+                P(bax, mp_axis), P(mp_axis, None), P(bax, None))
+
+    def apply(x, w):
+        out = f(x.reshape(t, k), w)
+        return out.reshape(tuple(x_shape[:-1]) + (out_f,))
+
+    return apply
+
+
+def plan_column_parallel(x_shape, w_shape, mesh, mp_axis="mp",
+                         batch_axis="dp", kernel=ring_allgather_matmul):
+    """Overlapped column-parallel linear with gathered output: x [..., k]
+    replicated, w [k, out] (out sharded over mp) -> [..., out] gathered.
+    Returns an apply(x, w) closure, or None when the overlap doesn't apply."""
+    n = mesh.shape.get(mp_axis, 1)
+    if n <= 1:
+        return None
+    k, out_f = w_shape
+    if x_shape[-1] != k or out_f % n or out_f // n < min_chunk():
+        return None
+    t = 1
+    for d in x_shape[:-1]:
+        t *= d
+    bax = _batch_axis_spec(mesh, t, batch_axis)
+    t_loc = t // (mesh.shape[bax] if bax else 1)
+    # pipeline chunks are row blocks of the LOCAL token dim
+    if t_loc % n or t_loc // n < min_chunk():
+        return None
+    f = _island(mesh, kernel, n, mp_axis,
+                P(bax, None), P(None, mp_axis), P(bax, None))
+
+    def apply(x, w):
+        out = f(x.reshape(t, k), w)
+        return out.reshape(tuple(x_shape[:-1]) + (out_f,))
+
+    return apply
+
+
+def overlap_row_parallel(x, w, mesh, **kwargs):
+    plan = plan_row_parallel(x.shape, w.shape, mesh, **kwargs)
+    return None if plan is None else plan(x, w)
+
+
+def overlap_column_parallel(x, w, mesh, **kwargs):
+    plan = plan_column_parallel(x.shape, w.shape, mesh, **kwargs)
+    return None if plan is None else plan(x, w)

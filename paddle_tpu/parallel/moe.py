@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._compat import axis_size as _axis_size
+
 
 def _gshard_aux_loss(probs, E):
     """gshard load-balancing loss: E * sum(mean_prob * fraction_top1).
@@ -28,9 +30,14 @@ def _gshard_aux_loss(probs, E):
     return E * jnp.sum(me * ce)
 
 
-def top_k_gating(logits, k: int, capacity: int):
+def top_k_gating(logits, k: int, capacity: int, drop_capacity=None):
     """gshard/switch gating. logits [T, E] fp32. Returns (combine [T, E, C],
-    dispatch [T, E, C] bool, aux_loss scalar)."""
+    dispatch [T, E, C] bool, aux_loss scalar).
+
+    ``drop_capacity`` (default: ``capacity``) is the per-expert queue
+    length beyond which tokens drop; the [T, E, C] buffers stay sized by
+    ``capacity``. Passing the unrounded reference capacity here gives
+    reference-exact drop accounting while compute stays MXU-tiled."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
@@ -45,9 +52,11 @@ def top_k_gating(logits, k: int, capacity: int):
     aux_loss = _gshard_aux_loss(probs, E)
 
     # capacity assignment: position of each token within its expert queue
+    if drop_capacity is None:
+        drop_capacity = capacity
     chosen = gates > 0  # [T, E]
     position_in_expert = (jnp.cumsum(chosen, axis=0) - 1) * chosen  # [T, E]
-    in_capacity = chosen & (position_in_expert < capacity)
+    in_capacity = chosen & (position_in_expert < min(drop_capacity, capacity))
     pos_oh = jax.nn.one_hot(position_in_expert, capacity, dtype=probs.dtype)  # [T,E,C]
     dispatch = pos_oh * in_capacity[..., None]
     combine = dispatch * gates[..., None]
@@ -61,20 +70,45 @@ def _round_up(n, m):
     return -(-n // m) * m
 
 
+def _ref_capacity(T, k, E, capacity_factor):
+    """The reference's per-expert capacity (moe_layer.py: floor of
+    cap_factor * tokens * k / experts, min 1) — UNROUNDED."""
+    return max(int(capacity_factor * T * k / E), 1)
+
+
 def _capacity(T, k, E, capacity_factor):
     """ONE capacity formula for every dispatch path (ep=1 slot schedule,
     ep>1 local slot schedule, one-hot einsum): MXU-tiled 128-rounded
     per-expert bucket size for T routed tokens."""
-    return _round_up(max(int(capacity_factor * T * k / E), 1), 128)
+    return _round_up(_ref_capacity(T, k, E, capacity_factor), 128)
 
 
-def topk_route(logits, k: int, capacity: int):
+def moe_capacity(T, k, E, capacity_factor):
+    """(compute_capacity, reference_capacity) for drop accounting.
+
+    The slot schedule sizes its buckets by the 128-rounded compute
+    capacity so expert matmul rows stay MXU-tiled; the reference drops
+    tokens at the UNROUNDED capacity. Rounding up therefore admits up to
+    127 extra tokens per expert that the reference would drop (strictly
+    fewer drops — a quality upside, but a parity deviation; PARITY.md).
+    Dispatch entry points take ``strict_capacity=True`` to drop at the
+    reference capacity while keeping the rounded buffers."""
+    return _capacity(T, k, E, capacity_factor), \
+        _ref_capacity(T, k, E, capacity_factor)
+
+
+def topk_route(logits, k: int, capacity: int, drop_capacity=None):
     """Slot-schedule routing (no [T,E,C] one-hots). logits [T, E] fp32.
 
     Returns (slot [T*k] int32 in [0, E*C] with E*C = the trash slot for
     capacity-dropped pairs, weight [T, k] f32 combine weights, aux_loss).
     Pair order is token-major, so per-expert queue positions match the
-    gshard cumsum-over-tokens assignment the one-hot path used."""
+    gshard cumsum-over-tokens assignment the one-hot path used.
+
+    ``drop_capacity`` (default: ``capacity``) caps each expert's queue
+    for DROP purposes only; slots beyond it route to the trash slot
+    while the bucket layout stays ``capacity`` rows per expert. Pass the
+    unrounded reference capacity for reference-exact drop accounting."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gates, experts = lax.top_k(probs, k)            # [T, k] each
@@ -84,7 +118,9 @@ def topk_route(logits, k: int, capacity: int):
     oh = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)  # [T*k, E] (tiny)
     pos = (jnp.cumsum(oh, axis=0) - oh)             # exclusive prefix count
     pos = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
-    valid = pos < capacity
+    if drop_capacity is None:
+        drop_capacity = capacity
+    valid = pos < min(drop_capacity, capacity)
     slot = jnp.where(valid, e_flat * capacity + pos, E * capacity)
 
     # combine weights: renormalize so each token's surviving gates carry
@@ -96,7 +132,8 @@ def topk_route(logits, k: int, capacity: int):
 
 
 def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
-                         k=2, capacity_factor=1.25, use_onehot=False):
+                         k=2, capacity_factor=1.25, use_onehot=False,
+                         strict_capacity=False):
     """MoE dispatch/combine. x [T, D] tokens, expert_params stacked [E, ...].
 
     Default path (single-device / ep=1): SLOT SCHEDULE — each routed
@@ -112,11 +149,18 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
     GSPMD partitions into the ep all-to-all cleanly (gathers over a
     sharded token dim would involuntarily rematerialize). It serves
     mesh-less ep>1 callers only — models with a mesh route ep>1 through
-    the moe_slot_dispatch_local shard_map island instead."""
+    the moe_slot_dispatch_local shard_map island instead.
+
+    strict_capacity=True drops tokens at the UNROUNDED reference
+    capacity (see moe_capacity) instead of the 128-rounded bucket size —
+    reference-exact drop accounting at the cost of up to 127 usable
+    bucket rows per expert going idle."""
     T, D = x.shape
-    capacity = _capacity(T, k, num_experts, capacity_factor)
+    capacity, ref_cap = moe_capacity(T, k, num_experts, capacity_factor)
+    drop_cap = ref_cap if strict_capacity else capacity
     if use_onehot:
-        combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
+        combine, dispatch, aux = top_k_gating(gate_logits, k, capacity,
+                                              drop_capacity=drop_cap)
         # [T,E,C] x [T,D] -> [E,C,D]
         expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
         expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
@@ -125,7 +169,8 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
         return out, aux
 
     E = num_experts
-    slot, weight, aux = topk_route(gate_logits, k, capacity)
+    slot, weight, aux = topk_route(gate_logits, k, capacity,
+                                   drop_capacity=drop_cap)
 
     # slot -> source token (E*C is the trash slot; sentinel token T reads
     # the appended zero row, so dropped/unfilled slots compute on zeros)
@@ -194,7 +239,7 @@ _combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
                             num_experts, axis_name="ep", k=2,
-                            capacity_factor=1.25):
+                            capacity_factor=1.25, strict_capacity=False):
     """Slot-schedule MoE INSIDE a manual shard_map over `axis_name` (r5):
     each ep shard holds E/n experts and its local tokens; it computes the
     full top-k routing, gathers ONLY the slots belonging to its local
@@ -210,7 +255,7 @@ def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
     count: identical to serial when nothing is dropped (test-asserted);
     under capacity overflow at dp>1 the drop sets may differ from the
     global-batch formula."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     T, D = x.shape
     E = num_experts
@@ -220,8 +265,10 @@ def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
     # drops this matches the serial/einsum path exactly (test-asserted);
     # when a skewed router overflows capacity at dp>1, drop sets can
     # differ from the global-batch formula.
-    capacity = _capacity(T, k, E, capacity_factor)
-    slot, weight, aux = topk_route(gate_logits, k, capacity)
+    capacity, ref_cap = moe_capacity(T, k, E, capacity_factor)
+    slot, weight, aux = topk_route(
+        gate_logits, k, capacity,
+        drop_capacity=ref_cap if strict_capacity else capacity)
 
     # keep only slots owned by THIS shard's experts; re-base to local
     lo = idx * e_local * capacity
@@ -248,15 +295,17 @@ def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
 
 def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
                            num_experts, axis_name="ep", k=2,
-                           capacity_factor=1.25):
+                           capacity_factor=1.25, strict_capacity=False):
     """Explicit all-to-all path (inside shard_map over 'ep'): each device owns
     E/ep experts; tokens route via lax.all_to_all, mirroring the reference's
     global_scatter/global_gather."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     T, D = x.shape  # T = this device's LOCAL tokens
     e_local = num_experts // n
-    capacity = _capacity(T, k, num_experts, capacity_factor)
-    combine, dispatch, aux = top_k_gating(gate_logits, k, capacity)
+    capacity, ref_cap = moe_capacity(T, k, num_experts, capacity_factor)
+    combine, dispatch, aux = top_k_gating(
+        gate_logits, k, capacity,
+        drop_capacity=ref_cap if strict_capacity else capacity)
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E,C,D]
     # tiled all_to_all: expert axis (owner-major: expert e lives on device
     # e // e_local) splits into n chunks of e_local experts, received chunks

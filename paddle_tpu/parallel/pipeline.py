@@ -18,13 +18,23 @@ Utilization is M/(M+S-1), identical to the reference's schedules.
 from __future__ import annotations
 
 import functools
-from typing import Callable
+import os
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ENV_PP_OVERLAP = "PADDLE_TPU_PP_OVERLAP"
+
+
+def p2p_overlap_enabled(overlap: Optional[bool] = None) -> bool:
+    """Async-p2p schedule switch: explicit arg wins, else the env flag."""
+    if overlap is not None:
+        return bool(overlap)
+    return os.environ.get(ENV_PP_OVERLAP, "0").lower() in ("1", "true", "on")
 
 
 def stack_stage_params(per_stage_params):
@@ -33,7 +43,8 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
-                   axis_name: str = "pp", remat: bool = True):
+                   axis_name: str = "pp", remat: bool = True,
+                   overlap_p2p: Optional[bool] = None):
     """Build f(stacked_params_local, x_microbatches) -> outputs, to be called
     INSIDE shard_map over ``axis_name``.
 
@@ -41,9 +52,23 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
     x_microbatches: [M, ...] hidden inputs (replicated across stages).
     Returns [M, ...] outputs, valid on the LAST stage (garbage elsewhere);
     callers mask/psum-select (see last_stage_value).
+
+    overlap_p2p (default: ``PADDLE_TPU_PP_OVERLAP``): in the blocking
+    schedule each tick ends with the activation ppermute, so the transfer is
+    a barrier between consecutive stage computes. The overlapped schedule
+    double-buffers the carry: tick t's stage body runs while the PREVIOUS
+    tick's output rides the ring — the two are independent ops inside one
+    scan step, which XLA's latency-hiding scheduler turns into an async
+    collective-permute-start/done pair bracketing the compute. Producer ->
+    consumer skew grows from 1 to 2 ticks (T = M + 2(S-1) instead of
+    M + S - 1): each transfer gets a full stage compute to hide behind, the
+    reference's p2p-on-a-side-stream. Per-microbatch ops are identical, so
+    outputs match the blocking schedule bit-for-bit.
     """
     S, M = num_stages, num_microbatches
-    T = M + S - 1
+    overlap = p2p_overlap_enabled(overlap_p2p) and S > 1
+    skew = 2 if overlap else 1
+    T = M + skew * (S - 1)
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def run(params_local, x_mb):
@@ -52,6 +77,7 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
         stage = lax.axis_index(axis_name)
         h0 = jnp.zeros_like(x_mb[0])
         out0 = jnp.zeros_like(x_mb)
+        perm = [(i, i + 1) for i in range(S - 1)]
 
         def tick(carry, t):
             h, outputs = carry
@@ -65,11 +91,31 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
             write = active & (stage == S - 1)
             outputs = outputs.at[idx].set(
                 jnp.where(write, out, outputs[idx]))
-            perm = [(i, i + 1) for i in range(S - 1)]
             h_next = lax.ppermute(out, axis_name, perm) if S > 1 else out
             return (h_next, outputs), None
 
-        (_, outputs), _ = lax.scan(tick, (h0, out0), jnp.arange(T))
+        def tick_overlap(carry, t):
+            h_ready, out_prev, outputs = carry
+            # async send: the previous tick's output permutes while THIS
+            # tick's body computes — no data dependence between the two
+            h_recv = lax.ppermute(out_prev, axis_name, perm)
+            mb = t - 2 * stage
+            active = (mb >= 0) & (mb < M)
+            fresh = x_mb[jnp.clip(t, 0, M - 1)]  # stage 0: mb == t
+            x_in = jnp.where(stage == 0, fresh, h_ready)
+            out = body(params_here, x_in)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+            idx = jnp.clip(mb, 0, M - 1)
+            write = active & (stage == S - 1)
+            outputs = outputs.at[idx].set(
+                jnp.where(write, out, outputs[idx]))
+            return (h_recv, out, outputs), None
+
+        if overlap:
+            (_, _, outputs), _ = lax.scan(
+                tick_overlap, (h0, h0, out0), jnp.arange(T))
+        else:
+            (_, outputs), _ = lax.scan(tick, (h0, out0), jnp.arange(T))
         return outputs
 
     return run
@@ -161,7 +207,8 @@ def last_stage_value(value, num_stages: int, axis_name: str = "pp"):
 
 
 def build_pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, num_stages,
-                           num_microbatches, axis_name="pp", remat=True):
+                           num_microbatches, axis_name="pp", remat=True,
+                           overlap_p2p=None):
     """Compose a full pipelined loss suitable for jax.value_and_grad.
 
     embed_fn(embed_params, batch) -> [M, ...] microbatched hidden states
@@ -171,7 +218,7 @@ def build_pipeline_loss_fn(embed_fn, stage_fn, head_loss_fn, num_stages,
     stage logically but are computed replicated (cheap vs the stage stack).
     """
     pipe = pipeline_apply(stage_fn, num_stages, num_microbatches, axis_name,
-                          remat)
+                          remat, overlap_p2p=overlap_p2p)
 
     def loss_fn(params, batch):
         embed_params, stacked_stage_params, head_params = params

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .._compat import axis_size as _axis_size
+
 from ..ops.flash_attention import flash_block_fwd, flash_block_bwd
 
 
@@ -51,7 +53,7 @@ def _merge_partials(o, lse, o_blk, lse_blk):
 
 def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     """q/k/v: [BH, S_local, D]. Returns (o [BH, S_local, D], lse [BH, S])."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -92,7 +94,7 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis_name, causal, scale):
     dK/dV accumulators rotate together with their K/V block, so after the
     final rotation each shard holds the fully-accumulated grads for its own
     chunk."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -164,7 +166,7 @@ def _ring_attention_xla(q, k, v, axis_name, causal, scale):
     """fp32-einsum flash-style ring: per-block scores materialize in HBM.
     Kept as the non-Pallas fallback and the micro-bench comparison point."""
     B, Sq, H, D = q.shape
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
     o = jnp.zeros((B, H, Sq, D), jnp.float32)
@@ -237,7 +239,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
                       scale=None, attn_fn=None):
     """DeepSpeed-Ulysses style: all_to_all heads<->sequence over 'sep'.
     Requires num_heads % sep_degree == 0."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     B, S_local, H, D = q.shape
     assert H % n == 0, f"heads {H} not divisible by sep degree {n}"
 

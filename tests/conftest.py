@@ -24,6 +24,13 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: register the marker so filtered tests
+    # (multi-device overlap sweeps, benches) don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 CPU run (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import paddle_tpu as paddle

@@ -1,0 +1,113 @@
+"""PADDLE_TPU_FLASH_SOFTMAX escape hatch (ADVICE r5): 'online' must force
+the unconditionally-stable online-softmax recurrence in every kernel that
+defaults to the fixed-base scheme, without changing well-conditioned
+numerics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.ops import flash_attention as fa
+
+
+@pytest.fixture()
+def online_mode(monkeypatch):
+    monkeypatch.setenv(fa.ENV_FLASH_SOFTMAX, "online")
+
+
+def _ref_sdpa(q, k, v, causal, scale):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32))
+
+
+def test_flag_flips_resident_kernel_selection(monkeypatch):
+    # a shape whose fixed-base scoped stack FITS: auto picks fixed-base
+    dims = (512, 64, 128, 128, 2)  # skp, d, bq, bk, itemsize (bf16)
+    assert fa._fb_resident_fits(*dims)
+    monkeypatch.delenv(fa.ENV_FLASH_SOFTMAX, raising=False)
+    assert fa._resident_kernel_choice(*dims) is fa._fwd_kernel_fixed_base
+    monkeypatch.setenv(fa.ENV_FLASH_SOFTMAX, "online")
+    assert fa._resident_kernel_choice(*dims) is fa._fwd_kernel
+    # the budget gate still applies in auto mode
+    monkeypatch.setenv(fa.ENV_FLASH_SOFTMAX, "auto")
+    big = (64 * 1024, 128, 1024, 1024, 4)
+    assert not fa._fb_resident_fits(*big)
+    assert fa._resident_kernel_choice(*big) is fa._fwd_kernel
+
+
+def test_invalid_flag_rejected(monkeypatch):
+    monkeypatch.setenv(fa.ENV_FLASH_SOFTMAX, "sometimes")
+    with pytest.raises(ValueError, match="PADDLE_TPU_FLASH_SOFTMAX"):
+        fa.softmax_mode()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_online_matches_reference_resident(online_mode, causal):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 256, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 256, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 256, 64).astype(np.float32))
+    o, lse = fa._flash_fwd(q, k, v, causal, 0.125, 128, 128)
+    ref = _ref_sdpa(q, k, v, causal, 0.125)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isfinite(np.asarray(lse)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_online_matches_reference_stream(online_mode, monkeypatch, causal):
+    monkeypatch.setattr(fa, "STREAM_KV_BYTES", 0)  # force the 3D-grid path
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(2, 384, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 384, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 384, 64).astype(np.float32))
+    o, lse = fa._flash_fwd(q, k, v, causal, 0.125, 128, 128)
+    ref = _ref_sdpa(q, k, v, causal, 0.125)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    assert np.isfinite(np.asarray(lse)).all()
+
+
+def test_online_survives_heavy_tail_stream(online_mode, monkeypatch):
+    """The case the hatch exists for: a later tile whose row max exceeds
+    tile 0's. The online recurrence must stay exact regardless of the
+    gap (the fixed base only holds to ~100 log2 units of headroom)."""
+    monkeypatch.setattr(fa, "STREAM_KV_BYTES", 0)
+    rng = np.random.RandomState(2)
+    S = 512
+    qn = rng.randn(1, S, 64).astype(np.float32)
+    kn = rng.randn(1, S, 64).astype(np.float32)
+    vn = rng.randn(1, S, 64).astype(np.float32)
+    kn[:, 300:340] *= 8.0  # late keys dominate tile 0
+    q, k, v = (jnp.asarray(a) for a in (qn, kn, vn))
+    o, _ = fa._flash_fwd(q, k, v, True, 0.125, 128, 128)
+    ref = _ref_sdpa(q, k, v, True, 0.125)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_online_matches_auto_decode_slab(monkeypatch):
+    from paddle_tpu.ops.decode_attention import (_LOG2E,
+                                                 decode_attention_slab)
+    L, B, NH, HD, T, pos = 2, 2, 4, 64, 256, 100
+    KVD = NH * HD
+    rng = np.random.RandomState(3)
+    q = rng.randn(B, NH, KVD).astype(np.float32) * 0.1
+    kc = rng.randn(L, B, KVD, T).astype(np.float32)
+    vc = rng.randn(L, B, KVD, T).astype(np.float32)
+    qs = jnp.asarray(q * (_LOG2E / (HD ** 0.5)))
+    monkeypatch.delenv(fa.ENV_FLASH_SOFTMAX, raising=False)
+    auto = decode_attention_slab(qs, jnp.asarray(kc), jnp.asarray(vc),
+                                 1, pos)
+    monkeypatch.setenv(fa.ENV_FLASH_SOFTMAX, "online")
+    online = decode_attention_slab(qs, jnp.asarray(kc), jnp.asarray(vc),
+                                   1, pos)
+    np.testing.assert_allclose(np.asarray(online), np.asarray(auto),
+                               rtol=1e-5, atol=1e-5)

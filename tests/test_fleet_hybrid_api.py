@@ -329,6 +329,31 @@ def test_amp_plus_recompute_eager_grads_match():
                                    atol=1e-7, err_msg=k)
 
 
+def test_amp_dtype_mapping_follows_reference():
+    """use_pure_fp16=True means FLOAT16 (O2) as in the reference; bfloat16
+    is keyed on an explicit use_bf16=True, with a warning when both are
+    requested (ADVICE r5: the old lookup defaulted use_bf16 to True and
+    silently remapped every pure-fp16 run to bf16)."""
+    paddle.set_device("cpu")
+
+    def build(cfg):
+        strategy = DistributedStrategy()
+        strategy.amp = True
+        strategy.amp_configs = cfg
+        fleet.init(is_collective=True, strategy=strategy)
+        return fleet.distributed_model(nn.Linear(HIDDEN, HIDDEN))
+
+    assert build({"use_pure_fp16": True})._amp_wrapped == ("O2", "float16")
+    assert build({})._amp_wrapped == ("O1", "float16")
+    # the DistributedStrategy default dict carries an explicit
+    # use_bf16: True -> default amp stays the TPU-friendly bf16 O1
+    assert (build(DistributedStrategy().amp_configs)._amp_wrapped
+            == ("O1", "bfloat16"))
+    with pytest.warns(UserWarning, match="use_bf16"):
+        m = build({"use_pure_fp16": True, "use_bf16": True})
+    assert m._amp_wrapped == ("O2", "bfloat16")
+
+
 def test_strategy_amp_applies_on_pipeline_path(serial_losses):
     """strategy.amp with pp_degree>1: train_batch calls the PipelineLayer
     directly (not the outer wrapper's forward), so the autocast must be

@@ -1,0 +1,79 @@
+"""Graph sampler reproducibility (ADVICE r5): the samplers must draw
+from the framework's global RNG — paddle.seed pins the sample stream —
+instead of an unseeded per-call np.random.default_rng()."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _dense_csc(n=64, deg=16):
+    # every node has `deg` pseudo-random in-neighbors; large enough that
+    # two independent 4-of-16 draws coinciding across all 32 query nodes
+    # is negligible (~(1/1820)^32)
+    rs = np.random.RandomState(0)
+    row = rs.randint(0, n, size=n * deg).astype(np.int64)
+    colptr = (np.arange(n + 1) * deg).astype(np.int64)
+    return paddle.to_tensor(row), paddle.to_tensor(colptr)
+
+
+def _sample(row, colptr, nodes, **kw):
+    neigh, _ = paddle.incubate.graph_sample_neighbors(
+        row, colptr, nodes, sample_size=4, **kw)
+    return np.asarray(neigh._data)
+
+
+def test_sample_neighbors_reproducible_under_paddle_seed():
+    row, colptr = _dense_csc()
+    nodes = paddle.to_tensor(np.arange(32, dtype=np.int64))
+    paddle.seed(1234)
+    a = _sample(row, colptr, nodes)
+    b = _sample(row, colptr, nodes)  # stream advances between calls
+    paddle.seed(1234)
+    np.testing.assert_array_equal(a, _sample(row, colptr, nodes))
+    np.testing.assert_array_equal(b, _sample(row, colptr, nodes))
+    assert not np.array_equal(a, b), "consecutive draws must differ"
+    paddle.seed(4321)
+    assert not np.array_equal(a, _sample(row, colptr, nodes)), \
+        "different seed must give a different sample"
+
+
+def test_khop_sampler_reproducible_under_paddle_seed():
+    row, colptr = _dense_csc()
+    nodes = paddle.to_tensor(np.arange(8, dtype=np.int64))
+    paddle.seed(7)
+    outs1 = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, sample_sizes=[4, 4])
+    paddle.seed(7)
+    outs2 = paddle.incubate.graph_khop_sampler(
+        row, colptr, nodes, sample_sizes=[4, 4])
+    for t1, t2 in zip(outs1, outs2):
+        np.testing.assert_array_equal(np.asarray(t1._data),
+                                      np.asarray(t2._data))
+
+
+def test_geometric_sampler_shares_the_seeded_stream():
+    # geometric.sample_neighbors delegates to the incubate sampler, so
+    # paddle.seed governs it identically
+    row, colptr = _dense_csc()
+    nodes = paddle.to_tensor(np.arange(16, dtype=np.int64))
+    paddle.seed(11)
+    a, _ = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                             sample_size=4)
+    paddle.seed(11)
+    b, _ = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                             sample_size=4)
+    np.testing.assert_array_equal(np.asarray(a._data), np.asarray(b._data))
+
+
+def test_perm_buffer_is_noop():
+    # perm_buffer is a CUDA workspace in the reference; here it is
+    # documented as accepted-and-ignored — passing it must not perturb
+    # the sample stream
+    row, colptr = _dense_csc()
+    nodes = paddle.to_tensor(np.arange(16, dtype=np.int64))
+    buf = paddle.to_tensor(np.zeros(64 * 16, np.int64))
+    paddle.seed(99)
+    a = _sample(row, colptr, nodes)
+    paddle.seed(99)
+    b = _sample(row, colptr, nodes, perm_buffer=buf, flag_perm_buffer=True)
+    np.testing.assert_array_equal(a, b)

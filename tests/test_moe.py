@@ -165,3 +165,53 @@ def test_slot_schedule_matches_onehot_dispatch():
     for gs, go in zip(g_slot, g_oh):
         np.testing.assert_allclose(np.asarray(gs), np.asarray(go),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_strict_capacity_matches_reference_drop_accounting():
+    """MXU rounding admits up to 127 extra tokens per expert that the
+    reference's unrounded capacity would drop; strict_capacity=True
+    restores reference-exact drops while buffers stay 128-rounded
+    (PARITY.md 'MoE capacity accounting')."""
+    from paddle_tpu.parallel.moe import moe_capacity
+    E, k, T, D = 2, 1, 200, 8
+    cap, ref = moe_capacity(T, k, E, 1.0)
+    assert (cap, ref) == (128, 100)
+    # every token routes to expert 0 -> queue position == token index
+    logits = jnp.tile(jnp.asarray([[9.0, 0.0]], jnp.float32), (T, 1))
+    x = jnp.ones((T, D), jnp.float32)
+    w = jnp.stack([jnp.eye(D, dtype=jnp.float32)] * E)
+    expert_fn = lambda w, t: t @ w  # noqa: E731
+
+    out_dflt, _ = moe_dispatch_combine(x, logits, expert_fn, w, E,
+                                       k=k, capacity_factor=1.0)
+    out_strict, _ = moe_dispatch_combine(x, logits, expert_fn, w, E,
+                                         k=k, capacity_factor=1.0,
+                                         strict_capacity=True)
+    alive_d = np.flatnonzero(np.abs(np.asarray(out_dflt)).sum(-1) > 1e-6)
+    alive_s = np.flatnonzero(np.abs(np.asarray(out_strict)).sum(-1) > 1e-6)
+    # rounded bucket admits cap tokens; the reference drops after ref
+    assert len(alive_d) == cap and alive_d.max() == cap - 1
+    assert len(alive_s) == ref and alive_s.max() == ref - 1
+    # one-hot einsum path applies the same strict accounting
+    out_oh, _ = moe_dispatch_combine(x, logits, expert_fn, w, E,
+                                     k=k, capacity_factor=1.0,
+                                     use_onehot=True, strict_capacity=True)
+    np.testing.assert_allclose(np.asarray(out_strict), np.asarray(out_oh),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_strict_capacity_noop_without_overflow():
+    """When no expert queue reaches the reference capacity, strict and
+    default accounting are bit-identical."""
+    rng = np.random.RandomState(3)
+    E, k, T, D = 4, 2, 64, 16
+    logits = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(E, D, D).astype(np.float32))
+    expert_fn = lambda w, t: t @ w  # noqa: E731
+    out_a, _ = moe_dispatch_combine(x, logits, expert_fn, w, E, k=k,
+                                    capacity_factor=8.0)
+    out_b, _ = moe_dispatch_combine(x, logits, expert_fn, w, E, k=k,
+                                    capacity_factor=8.0,
+                                    strict_capacity=True)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
