@@ -47,7 +47,8 @@ from . import version  # noqa: F401
 from . import utils  # noqa: F401
 
 for _sub in ("nn", "optimizer", "io", "jit", "vision", "metric", "distributed",
-             "incubate", "ops", "profiler", "device", "hapi", "static",
+             "incubate", "ops", "profiler", "observability", "device", "hapi",
+             "static",
              "inference", "runtime", "fft", "signal", "distribution", "sparse",
              "quantization", "audio", "text", "onnx", "linalg", "geometric"):
     try:

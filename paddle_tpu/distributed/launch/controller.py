@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ... import runtime as rt
+from ...observability import get_logger, log_event
 
 
 @dataclass
@@ -162,14 +163,18 @@ class NodeController:
                 self._terminate_all()
                 if status == 0:
                     return 0
+                # rank-tagged structured logging (observability.get_logger
+                # writes [ts] [rank N] ... to stderr)
+                log = get_logger("paddle_tpu.launch")
                 if restart_round >= self.cfg.max_restarts:
-                    print(f"[launch] job failed with exit code {status} "
-                          f"after {restart_round} restarts", file=sys.stderr)
+                    log.error("job failed with exit code %s after %s "
+                              "restarts", status, restart_round)
+                    log_event(log, "job_failed", exit_code=status,
+                              restarts=restart_round)
                     return status
                 restart_round += 1
-                print(f"[launch] worker failed (exit {status}); restart "
-                      f"{restart_round}/{self.cfg.max_restarts}",
-                      file=sys.stderr)
+                log.error("worker failed (exit %s); restart %s/%s",
+                          status, restart_round, self.cfg.max_restarts)
                 # Scrub job keys so the next round re-rendezvouses cleanly.
                 if self.server is not None:
                     try:

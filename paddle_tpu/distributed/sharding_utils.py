@@ -15,6 +15,8 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..observability import trace as _obs
+
 P = PartitionSpec
 
 _active_mesh: Optional[Mesh] = None
@@ -99,6 +101,25 @@ def plan_grad_buckets(shapes: dict, cap_bytes: int, reverse: bool = True):
     return buckets
 
 
+def bucket_bytes(shapes: dict, buckets) -> list:
+    """Per-bucket payload bytes for a ``plan_grad_buckets`` plan.
+
+    shapes: {name: (shape_tuple, itemsize_bytes)} as given to the planner.
+    Telemetry helper — the numbers the step log reports per grad-sync bucket.
+    """
+    sizes = []
+    for bucket in buckets:
+        total = 0
+        for name in bucket:
+            shape, itemsize = shapes[name]
+            nbytes = int(itemsize)
+            for d in shape:
+                nbytes *= int(d)
+            total += nbytes
+        sizes.append(total)
+    return sizes
+
+
 def bucketed_psum(grads: dict, buckets, axis_names):
     """Per-bucket fused psum of a {name: grad} dict (call INSIDE shard_map).
 
@@ -106,12 +127,20 @@ def bucketed_psum(grads: dict, buckets, axis_names):
     many operands, one collective launch, no flatten/concat copies). psum is
     elementwise per leaf, so the result is bit-identical to per-parameter
     psums — bucketing changes the collective granularity, not the numerics.
+
+    Each bucket's psum is traced under a named ``grad_sync.bucketNN`` span
+    (observability.comm_span), so device profiles attribute every bucket's
+    collective separately and counters carry the per-bucket local bytes.
     """
     out = dict(grads)
-    for bucket in buckets:
+    for i, bucket in enumerate(buckets):
         present = [n for n in bucket if n in grads]
         if not present:
             continue
-        reduced = jax.lax.psum(tuple(grads[n] for n in present), axis_names)
+        nbytes = sum(grads[n].size * grads[n].dtype.itemsize
+                     for n in present)
+        with _obs.comm_span(f"grad_sync.bucket{i:02d}", nbytes=nbytes):
+            reduced = jax.lax.psum(tuple(grads[n] for n in present),
+                                   axis_names)
         out.update(zip(present, reduced))
     return out

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Callable, Dict, Optional
 
 import jax
@@ -19,6 +20,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observability
 from ..distributed import sharding_utils
 from ..nn.layer.layers import Layer
 from ..tensor.tensor import Tensor
@@ -37,7 +39,10 @@ class TrainStep:
                  mesh: Optional[Mesh] = None, batch_spec=None,
                  grad_accum: int = 1, donate: bool = True, rng_seed: int = 0,
                  grad_sync: Optional[str] = None,
-                 grad_bucket_mb: Optional[float] = None):
+                 grad_bucket_mb: Optional[float] = None,
+                 telemetry: Optional[bool] = None,
+                 telemetry_dir: Optional[str] = None,
+                 tokens_per_step: Optional[int] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -229,6 +234,37 @@ class TrainStep:
         buckets_ref = self.grad_buckets
         sync_axes = reduce_axes
 
+        # --- step telemetry (observability.StepMetrics). Explicit arg wins,
+        # else PADDLE_TPU_TELEMETRY. Nothing below adds host syncs: wall
+        # times are perf_counter intervals around the ASYNC dispatch, FLOPs
+        # are captured once per compile from the lowered program's cost
+        # analysis, memory stats are host-side PJRT queries.
+        self.telemetry = None
+        self._flops_stale = True
+        self._seen_cache_size = 0
+        if observability.telemetry_enabled(telemetry):
+            self.telemetry = observability.StepMetrics(
+                name="train_step", tokens_per_step=tokens_per_step,
+                n_devices=(mesh.size if mesh is not None else 1))
+            logdir = telemetry_dir or observability.telemetry_dir()
+            if logdir:
+                rank = observability.process_rank()
+                self.telemetry.attach(observability.JsonlWriter(
+                    os.path.join(logdir, f"steps_rank{rank:03d}.jsonl")))
+            observability.set_active(self.telemetry)
+            observability.set_counter(
+                "grad_sync.mode." + sync_mode, 1)
+        if self.grad_buckets is not None:
+            sizes = sharding_utils.bucket_bytes(shapes, self.grad_buckets)
+            observability.set_counter("grad_sync.n_buckets",
+                                      len(self.grad_buckets))
+            observability.set_counter("grad_sync.total_bytes", sum(sizes))
+            for i, nbytes in enumerate(sizes):
+                # .plan_bytes: the static bucket payload; the traced span
+                # separately tallies .bytes per trace
+                observability.set_counter(
+                    f"grad_sync.bucket{i:02d}.plan_bytes", nbytes)
+
         def island_loss_grads(train_params, frozen_params, buffers, batch,
                               rng):
             from .._compat import shard_map
@@ -331,13 +367,81 @@ class TrainStep:
     def __call__(self, *inputs, labels=None):
         batch, train_params, frozen, lr = self._prepare(list(inputs), labels)
         self._rng, sub = jax.random.split(self._rng)
+        m = self.telemetry
+        captured = False
+        if m is not None and self._flops_stale:
+            # once per (re)compile, BEFORE dispatch (donation hasn't consumed
+            # the buffers yet): lower the step for this batch and read the
+            # program's cost analysis — trace-time work, nothing per step
+            self._capture_cost(train_params, frozen, batch, sub, lr)
+            captured = True
+        t0 = time.perf_counter() if m is not None else 0.0
         new_p, new_s, new_b, loss = self._compiled(
             train_params, self.opt_states, self.buffers, frozen, batch, sub, lr)
+        if m is not None:
+            dt = time.perf_counter() - t0
+            if self._note_compile():
+                # this dispatch paid trace+compile: account it as compile
+                # time, not a step sample. A recompile marks FLOPs stale
+                # (the program changed) — unless they were captured for
+                # exactly this program a few lines up.
+                if captured:
+                    self._flops_stale = False
+                m.record_compile(compile_s=dt, flops=m.flops_per_step)
+            else:
+                m.step(tokens=self._batch_tokens(batch), dispatch_ms=dt * 1e3)
         self.params.update(new_p)
         self.opt_states = new_s
         self.buffers = new_b
         self._step_count += 1
         return Tensor._from_data(loss)
+
+    def _capture_cost(self, train_params, frozen, batch, sub, lr):
+        """FLOPs-per-step from the lowered program's cost analysis (client-
+        side HLO analysis; no extra XLA compile, no device work)."""
+        self._flops_stale = False
+        try:
+            t0 = time.perf_counter()
+            lowered = self._compiled.lower(train_params, self.opt_states,
+                                           self.buffers, frozen, batch, sub,
+                                           lr)
+            trace_s = time.perf_counter() - t0
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            flops = float((cost or {}).get("flops", 0.0))
+            self.telemetry.trace_time_s += trace_s
+            if flops > 0:
+                self.telemetry.flops_per_step = flops
+        except Exception:
+            pass
+
+    def _note_compile(self) -> bool:
+        """Detect a fresh jit compile via the pjit cache size (True exactly
+        when this call compiled); marks FLOPs stale on recompiles."""
+        try:
+            size = self._compiled._cache_size()
+        except Exception:
+            return self.telemetry.compiles == 0 and not self._step_count
+        if size != self._seen_cache_size:
+            self._seen_cache_size = size
+            self._flops_stale = True
+            return True
+        return False
+
+    def _batch_tokens(self, batch) -> Optional[int]:
+        """Tokens per step for throughput: [B, S] integer inputs count B*S
+        (sequence ids), anything else counts batch rows. Override with the
+        ``tokens_per_step`` ctor arg."""
+        if self.telemetry.tokens_per_step is not None:
+            return self.telemetry.tokens_per_step
+        try:
+            x = batch["inputs"][0]
+            if x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.integer):
+                return int(x.shape[0]) * int(x.shape[1])
+            return int(x.shape[0])
+        except Exception:
+            return None
 
     def compiled_hlo(self, *inputs, labels=None) -> str:
         """Post-SPMD-partitioning HLO of the step (for inspecting which

@@ -19,7 +19,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.moe import moe_dispatch_combine
+from ..parallel.moe import moe_dispatch_combine, zero_routing_stats
 from ..ops.rms_norm import fused_rms_norm
 from .llama import _adamw_init, _adamw_update
 
@@ -158,7 +158,7 @@ def _attn_and_norm(p, h, config: ErnieMoEConfig):
 
 
 def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
-             mesh=None):
+             mesh=None, with_stats=False):
     c = config
     hid = x_.shape[-1]
     tokens = x_.reshape(-1, hid)
@@ -181,67 +181,109 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
 
         def island(tok, gate, w1, w2):
             logits = tok.astype(jnp.float32) @ gate
-            out, aux = moe_slot_dispatch_local(
+            res = moe_slot_dispatch_local(
                 tok, logits, expert_fn, (w1, w2), c.num_experts,
                 axis_name="ep", k=c.moe_topk,
-                capacity_factor=c.capacity_factor)
+                capacity_factor=c.capacity_factor,
+                return_stats=with_stats)
             # aux is computed from LOCAL tokens: average over dp so the
             # P() out-spec is genuinely replicated (the standard
             # data-parallel MoE aux — per-shard balance loss, averaged)
+            if with_stats:
+                out, aux, st = res
+                # stats are per-dp-shard (identical across ep): counts sum
+                # over dp (whole-batch totals), ratios average over dp
+                st = {"moe_dropped_tokens":
+                          lax.psum(st["moe_dropped_tokens"], "dp"),
+                      "moe_routed_tokens":
+                          lax.psum(st["moe_routed_tokens"], "dp"),
+                      "moe_load_imbalance":
+                          lax.pmean(st["moe_load_imbalance"], "dp"),
+                      "moe_capacity_util":
+                          lax.pmean(st["moe_capacity_util"], "dp")}
+                return out, lax.pmean(aux, "dp"), st
+            out, aux = res
             return out, lax.pmean(aux, "dp")
 
-        out, aux = shard_map(
+        stats_spec = jax.tree_util.tree_map(lambda _: P(),
+                                            zero_routing_stats())
+        out_specs = ((P("dp", None), P(), stats_spec) if with_stats
+                     else (P("dp", None), P()))
+        res = shard_map(
             island, mesh=mesh,
             in_specs=(P("dp", None), P(None, None),
                       P("ep", None, None), P("ep", None, None)),
-            out_specs=(P("dp", None), P()),
+            out_specs=out_specs,
             check_vma=False)(tokens, p["gate"], p["e_w1"], p["e_w2"])
+        out, aux = res[0], res[1]
+        stats = res[2] if with_stats else None
     else:
         logits = tokens.astype(jnp.float32) @ p["gate"]
-        out, aux = moe_dispatch_combine(tokens, logits, expert_fn,
-                                        (p["e_w1"], p["e_w2"]),
-                                        c.num_experts, k=c.moe_topk,
-                                        capacity_factor=c.capacity_factor,
-                                        use_onehot=use_onehot)
-    return out.reshape(x_.shape).astype(x_.dtype), aux.astype(jnp.float32)
+        res = moe_dispatch_combine(tokens, logits, expert_fn,
+                                   (p["e_w1"], p["e_w2"]),
+                                   c.num_experts, k=c.moe_topk,
+                                   capacity_factor=c.capacity_factor,
+                                   use_onehot=use_onehot,
+                                   return_stats=with_stats)
+        out, aux = res[0], res[1]
+        stats = res[2] if with_stats else None
+    out = out.reshape(x_.shape).astype(x_.dtype)
+    if with_stats:
+        return out, aux.astype(jnp.float32), stats
+    return out, aux.astype(jnp.float32)
 
 
-def _dense_ffn(p, x_, config: ErnieMoEConfig):
-    return (jax.nn.gelu(x_ @ p["w1"]) @ p["w2"]).astype(x_.dtype), \
-        jnp.zeros((), jnp.float32)
+def _dense_ffn(p, x_, config: ErnieMoEConfig, with_stats=False):
+    out = (jax.nn.gelu(x_ @ p["w1"]) @ p["w2"]).astype(x_.dtype)
+    if with_stats:
+        return out, jnp.zeros((), jnp.float32), zero_routing_stats()
+    return out, jnp.zeros((), jnp.float32)
 
 
 def _layer_static(p, h, is_moe, config: ErnieMoEConfig, use_onehot=False,
-                  mesh=None):
+                  mesh=None, with_stats=False):
     """One decoder layer with a STATIC moe/dense choice (no lax.cond)."""
     h, x = _attn_and_norm(p, h, config)
-    ffn_out, aux = (_moe_ffn(p, x, config, use_onehot, mesh) if is_moe
-                    else _dense_ffn(p, x, config))
+    res = (_moe_ffn(p, x, config, use_onehot, mesh, with_stats) if is_moe
+           else _dense_ffn(p, x, config, with_stats))
+    if with_stats:
+        ffn_out, aux, stats = res
+        return h + ffn_out, aux, stats
+    ffn_out, aux = res
     return h + ffn_out, aux
 
 
 def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False,
-           mesh=None):
+           mesh=None, with_stats=False):
     c = config
 
     def moe_branch(x_):
-        return _moe_ffn(p, x_, c, use_onehot, mesh)
+        return _moe_ffn(p, x_, c, use_onehot, mesh, with_stats)
 
     def dense_branch(x_):
-        return _dense_ffn(p, x_, c)
+        return _dense_ffn(p, x_, c, with_stats)
 
     h, x = _attn_and_norm(p, h, c)
     is_moe = (layer_idx % c.moe_every) == (c.moe_every - 1)
     # layer_idx is a traced scan counter: lax.cond keeps one compiled body
-    ffn_out, aux = lax.cond(is_moe, moe_branch, dense_branch, x)
+    res = lax.cond(is_moe, moe_branch, dense_branch, x)
+    if with_stats:
+        ffn_out, aux, stats = res
+        return h + ffn_out, aux, stats
+    ffn_out, aux = res
     return h + ffn_out, aux
 
 
 def moe_loss(params, ids, labels, config: ErnieMoEConfig,
-             use_onehot=False, mesh=None):
+             use_onehot=False, mesh=None, with_stats=False):
     # use_onehot marks ep>1: WITH a mesh the slot-schedule shard_map
     # island runs (see _moe_ffn); the one-hot einsum only serves
     # mesh-less callers as a fallback
+    #
+    # with_stats=True: the aux output becomes (lm_loss, stats) where stats
+    # aggregates per-layer routing_stats over the MoE layers — counts
+    # (dropped/routed) sum, ratios (imbalance/util) average. Stats are
+    # lax.stop_gradient'd so the loss/grads are bit-identical either way.
     c = config
     b, s = ids.shape
     h = (jnp.take(params["embed"], ids, axis=0)
@@ -259,14 +301,20 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
         def pair_body(h, lp):
             p0, p1 = lp
             h, aux0 = _layer_static(p0, h, False, c)
-            h, aux1 = _layer_static(p1, h, True, c, use_onehot, mesh)
+            res = _layer_static(p1, h, True, c, use_onehot, mesh,
+                                with_stats)
+            if with_stats:
+                h, aux1, stats = res
+                return h, (aux0 + aux1,
+                           jax.lax.stop_gradient(stats))
+            h, aux1 = res
             return h, aux0 + aux1
 
         # checkpoint_dots: matmul outputs survive the remat boundary, so
         # the backward's re-forward is elementwise-only (measured -3 ms
         # per step vs full remat at the bench shape; the saved dot
         # residuals are well within HBM at these sizes)
-        h, auxes = lax.scan(
+        h, ys = lax.scan(
             jax.checkpoint(pair_body,
                            policy=jax.checkpoint_policies.checkpoint_dots),
             h, (params["layers"]["dense"], params["layers"]["moe"]))
@@ -274,12 +322,34 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
         def body(carry, inp):
             h = carry
             idx, layer_params = inp
-            h, aux = _layer(layer_params, h, idx, c, use_onehot, mesh)
+            res = _layer(layer_params, h, idx, c, use_onehot, mesh,
+                         with_stats)
+            if with_stats:
+                h, aux, stats = res
+                return h, (aux, jax.lax.stop_gradient(stats))
+            h, aux = res
             return h, aux
 
         idxs = jnp.arange(c.num_hidden_layers)
-        h, auxes = lax.scan(jax.checkpoint(body), h,
-                            (idxs, params["layers"]))
+        h, ys = lax.scan(jax.checkpoint(body), h,
+                         (idxs, params["layers"]))
+    if with_stats:
+        auxes, layer_stats = ys
+        n_moe = jnp.maximum(
+            (layer_stats["moe_routed_tokens"]
+             + layer_stats["moe_dropped_tokens"] > 0)
+            .astype(jnp.float32).sum(), 1.0)
+        stats = {
+            "moe_dropped_tokens": layer_stats["moe_dropped_tokens"].sum(),
+            "moe_routed_tokens": layer_stats["moe_routed_tokens"].sum(),
+            # ratios averaged over the layers that actually routed
+            "moe_load_imbalance":
+                layer_stats["moe_load_imbalance"].sum() / n_moe,
+            "moe_capacity_util":
+                layer_stats["moe_capacity_util"].sum() / n_moe,
+        }
+    else:
+        auxes = ys
     x = fused_rms_norm(h, params["final_ln"], c.layer_norm_eps)
     logits = (x @ params["embed"].T).astype(jnp.float32)
     mask = labels != -100
@@ -287,13 +357,23 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     lm_loss = jnp.sum(jnp.where(mask, -picked, 0.0)) / jnp.maximum(mask.sum(), 1)
-    return lm_loss + c.aux_loss_weight * auxes.sum(), lm_loss
+    total = lm_loss + c.aux_loss_weight * auxes.sum()
+    if with_stats:
+        return total, (lm_loss, stats)
+    return total, lm_loss
 
 
 def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
                      dp_degree: int = 1, mesh: Optional[Mesh] = None,
-                     lr: float = 3e-4, seed: int = 0):
-    """EP x DP training step; experts sharded over 'ep', batch over 'dp'."""
+                     lr: float = 3e-4, seed: int = 0,
+                     with_stats: bool = False):
+    """EP x DP training step; experts sharded over 'ep', batch over 'dp'.
+
+    with_stats=True: the step's 4th output becomes a dict
+    ``{"lm_loss": ..., "moe_dropped_tokens": ..., "moe_routed_tokens": ...,
+    "moe_load_imbalance": ..., "moe_capacity_util": ...}`` of on-device f32
+    scalars (aggregated over layers and the dp axis) instead of the bare
+    lm_loss — routing telemetry rides the step outputs, no extra sync."""
     if mesh is None and ep_degree * dp_degree > 1:
         from ..distributed.fleet.topology import _pick_devices
         devs = _pick_devices(ep_degree * dp_degree)
@@ -312,11 +392,14 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
     moe_mesh = mesh if ep_degree > 1 else None
 
     def step(p, o, ids, labels):
-        (loss, lm_loss), grads = jax.value_and_grad(
+        (loss, aux), grads = jax.value_and_grad(
             moe_loss, has_aux=True)(p, ids, labels, config, use_onehot,
-                                    moe_mesh)
+                                    moe_mesh, with_stats)
         new_p, new_o = _adamw_update(p, grads, o, lr)
-        return new_p, new_o, loss, lm_loss
+        if with_stats:
+            lm_loss, stats = aux
+            return new_p, new_o, loss, {"lm_loss": lm_loss, **stats}
+        return new_p, new_o, loss, aux
 
     jit_step = jax.jit(step, donate_argnums=(0, 1))
     batch_sharding = (NamedSharding(mesh, P("dp", None))

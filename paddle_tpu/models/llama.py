@@ -29,6 +29,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import trace as _obs
 from ..ops.flash_attention import flash_attention_bshd
 from ..ops.rms_norm import fused_rms_norm
 from ..ops.rope import apply_rope, build_rope_cache
@@ -1138,7 +1139,9 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
                 out_specs=P(),
                 axis_names=manual,
                 check_vma=False)
-            return smap(p, ids, labels)
+            with _obs.comm_span("llama.sep_island",
+                                nbytes=ids.size * ids.dtype.itemsize):
+                return smap(p, ids, labels)
         return llama_loss(p, ids, labels, config, parallel, mesh,
                           use_flash=use_flash)
 
@@ -1289,8 +1292,11 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
                           check_vma=False)
 
     def step(p, opt, ids, labels):
-        loss, grads = jax.value_and_grad(
-            lambda pp_, i, l: smap_loss(pp_, i, l))(p, ids, labels)
+        def island(pp_, i, l):
+            with _obs.comm_span("llama.pp_island",
+                                nbytes=i.size * i.dtype.itemsize):
+                return smap_loss(pp_, i, l)
+        loss, grads = jax.value_and_grad(island)(p, ids, labels)
         new_p, new_opt = _adamw_update(p, grads, opt, lr)
         return new_p, new_opt, loss
 

@@ -1,0 +1,19 @@
+"""Observability: step telemetry + comm/compute trace attribution.
+
+Grown from the profiler stub in the spirit of XLA's xplane/TensorBoard
+pipeline: ``StepMetrics`` collects wall step time, compile time, tokens/sec,
+device memory and MFU with zero host syncs on the hot path; ``comm_span``
+names every overlap site (TP ring hops, grad-sync buckets, 1F1B p2p,
+shard_map islands) in the HLO metadata so device profiles attribute comm vs
+compute; counters tally the static structure (hop counts, bucket bytes,
+overlap on/off); exporters stream JSONL / TensorBoard scalars / rank-tagged
+logs. Switched by ``PADDLE_TPU_TELEMETRY`` (+ ``PADDLE_TPU_TELEMETRY_DIR``
+for the step log).
+"""
+from .exporters import (JsonlWriter, TensorBoardWriter, get_logger,  # noqa: F401
+                        load_jsonl, log_event, process_rank)
+from .metrics import (PEAK_FLOPS_TABLE, StepMetrics, active,  # noqa: F401
+                      peak_flops_per_device, set_active)
+from .trace import (ENV_TELEMETRY, ENV_TELEMETRY_DIR, comm_span,  # noqa: F401
+                    counters, overlap_flags, record_counter, reset_counters,
+                    set_counter, telemetry_dir, telemetry_enabled)

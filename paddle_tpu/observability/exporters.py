@@ -1,0 +1,184 @@
+"""Telemetry exporters: JSONL step logs, TensorBoard scalars, rank logging.
+
+All exporters share one duck type — ``write(record: dict)`` + ``close()`` —
+so ``StepMetrics.attach`` composes them freely. Writes are buffered (flushed
+every ``flush_every`` records and on close) so an attached exporter costs an
+in-memory append on the hot path, not a syscall.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+
+def _jsonable(obj):
+    """json.dumps default= hook: numpy/jax scalars -> python numbers."""
+    try:
+        import numpy as np
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except Exception:
+        pass
+    if hasattr(obj, "item"):  # 0-d jax.Array (host fetch is the caller's call)
+        try:
+            return obj.item()
+        except Exception:
+            pass
+    return str(obj)
+
+
+class JsonlWriter:
+    """Append-only JSONL step log (one record per line)."""
+
+    def __init__(self, path: str, flush_every: int = 64):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self.path = path
+        self._f = open(path, "a")
+        self._flush_every = max(1, int(flush_every))
+        self._pending = 0
+
+    def write(self, record: dict) -> None:
+        self._f.write(json.dumps(record, default=_jsonable) + "\n")
+        self._pending += 1
+        if self._pending >= self._flush_every:
+            self._f.flush()
+            self._pending = 0
+
+    def flush(self) -> None:
+        self._f.flush()
+        self._pending = 0
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+            self._f.close()
+        except Exception:
+            pass
+
+
+def load_jsonl(path: str):
+    """Read a JSONL step log back into a list of dicts."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+class TensorBoardWriter:
+    """TensorBoard scalar writer over whichever backend is installed
+    (tensorboardX, torch.utils.tensorboard, or tf.summary). The import is
+    OPTIONAL: construction raises ImportError with a clear message when no
+    backend exists — gate on ``TensorBoardWriter.available()``."""
+
+    def __init__(self, logdir: str):
+        self._writer, self._mode = self._make(logdir)
+
+    @staticmethod
+    def _backend():
+        try:
+            from tensorboardX import SummaryWriter
+            return SummaryWriter, "x"
+        except ImportError:
+            pass
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            return SummaryWriter, "x"
+        except ImportError:
+            pass
+        try:
+            import tensorflow as tf
+            return tf.summary.create_file_writer, "tf"
+        except ImportError:
+            pass
+        return None, None
+
+    @staticmethod
+    def available() -> bool:
+        return TensorBoardWriter._backend()[0] is not None
+
+    def _make(self, logdir):
+        ctor, mode = self._backend()
+        if ctor is None:
+            raise ImportError(
+                "TensorBoardWriter needs tensorboardX, torch, or tensorflow; "
+                "none is installed (JSONL export has no dependency)")
+        return ctor(logdir), mode
+
+    def write(self, record: dict) -> None:
+        step = int(record.get("step", 0) or 0)
+        tag_root = record.get("name", "train")
+        for key, val in record.items():
+            if key in ("name", "step") or val is None:
+                continue
+            try:
+                val = float(val)
+            except (TypeError, ValueError):
+                continue
+            if self._mode == "x":
+                self._writer.add_scalar(f"{tag_root}/{key}", val, step)
+            else:
+                import tensorflow as tf
+                with self._writer.as_default():
+                    tf.summary.scalar(f"{tag_root}/{key}", val, step=step)
+
+    def close(self) -> None:
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+
+def process_rank() -> int:
+    """This process's rank: the launch env var before jax initializes,
+    ``jax.process_index()`` after."""
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record):
+        record.rank = process_rank()
+        return True
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    """Rank-tagged structured logger (shared by ``distributed/launch``).
+
+    Plain messages format as ``[ts] [rank N] name LEVEL: msg``; use
+    ``log_event(logger, event, **fields)`` for machine-parseable lines.
+    """
+    logger = logging.getLogger(name)
+    if not any(isinstance(f, _RankFilter) for f in logger.filters):
+        logger.addFilter(_RankFilter())
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s [rank %(rank)s] %(name)s %(levelname)s: %(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def log_event(logger: logging.Logger, event: str, level: int = logging.INFO,
+              **fields) -> None:
+    """Emit one structured (JSON) log line tagged with the process rank."""
+    payload = {"event": event, "rank": process_rank()}
+    payload.update(fields)
+    logger.log(level, json.dumps(payload, default=_jsonable))

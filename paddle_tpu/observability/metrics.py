@@ -1,0 +1,241 @@
+"""Step-level telemetry: wall step time, compile time, tokens/sec, MFU.
+
+Design constraint: NOTHING here may synchronize the device. Step wall time is
+the host-side interval between consecutive ``step()`` calls (in steady state
+with donated buffers the dispatch of step N+1 cannot run ahead of step N's
+completion, so the interval converges to true device step time without any
+``block_until_ready``); memory stats come from the PJRT host-side
+``device.memory_stats()`` query; FLOPs are captured once per compile from the
+program's cost analysis, not per step. MFU is FLOPs-per-step over
+(step_time x peak FLOPs of the slice), the paper's target metric.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from . import trace as _trace
+
+ENV_PEAK_FLOPS = "PADDLE_TPU_PEAK_FLOPS"
+
+# Per-chip peak FLOP/s by PJRT device_kind substring (bf16 with int8-free
+# MXU peaks, the denominators MFU papers use). Matched case-insensitively,
+# FIRST match wins, so the more specific names come first. The 'cpu' entry
+# is a nominal 100 GFLOP/s per virtual device so virtual-mesh runs report a
+# finite (clearly-labeled-estimate) MFU; override with PADDLE_TPU_PEAK_FLOPS.
+PEAK_FLOPS_TABLE = (
+    ("v6e", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("cpu", 100e9),
+)
+
+
+def peak_flops_per_device(device=None) -> Optional[float]:
+    """Peak FLOP/s for one device, from ``PADDLE_TPU_PEAK_FLOPS`` (wins) or
+    the device_kind table; None when the kind is unknown."""
+    env = os.environ.get(ENV_PEAK_FLOPS)
+    if env:
+        return float(env)
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return None
+        device = devs[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for key, flops in PEAK_FLOPS_TABLE:
+        if key in kind:
+            return flops
+    return None
+
+
+class StepMetrics:
+    """Per-step telemetry collector (the xplane-pipeline-shaped summary view).
+
+    Typical wiring (``jit.TrainStep`` does this when telemetry is on)::
+
+        m = StepMetrics(n_devices=mesh.size)
+        m.attach(JsonlWriter(path))
+        m.record_compile(compile_s=..., trace_s=..., flops=...)   # per compile
+        m.step(tokens=B * S)                                      # per step
+
+    ``step()`` builds one record dict, appends it to a bounded window, and
+    hands it to every attached exporter. ``summary()`` aggregates the window
+    and folds in the trace-time comm counters (hop counts, bucket bytes,
+    overlap flags).
+    """
+
+    def __init__(self, name: str = "train", tokens_per_step: Optional[int] = None,
+                 n_devices: Optional[int] = None,
+                 peak_flops: Optional[float] = None, window: int = 512):
+        self.name = name
+        self.tokens_per_step = tokens_per_step
+        self.n_devices = n_devices if n_devices is not None else jax.device_count()
+        per_dev = (peak_flops if peak_flops is not None
+                   else peak_flops_per_device())
+        self.peak_flops_total = (per_dev * self.n_devices
+                                 if per_dev is not None else None)
+        self.flops_per_step: Optional[float] = None
+        self.compile_time_s = 0.0
+        self.trace_time_s = 0.0
+        self.compiles = 0
+        self.recompiles = 0  # compiles beyond the first
+        self.steps = 0
+        self.records: collections.deque = collections.deque(maxlen=window)
+        self._last_t: Optional[float] = None
+        self._exporters: List = []
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self, exporter) -> "StepMetrics":
+        """Attach an exporter with a ``write(record: dict)`` method."""
+        self._exporters.append(exporter)
+        return self
+
+    def close(self) -> None:
+        for e in self._exporters:
+            try:
+                e.close()
+            except Exception:
+                pass
+
+    # -- recording ----------------------------------------------------------
+
+    def record_compile(self, compile_s: float = 0.0, trace_s: float = 0.0,
+                       flops: Optional[float] = None) -> None:
+        """One (re)compilation: wall compile/trace seconds and, when known,
+        the program's cost-analysis FLOPs per executed step."""
+        self.compiles += 1
+        if self.compiles > 1:
+            self.recompiles += 1
+        self.compile_time_s += float(compile_s)
+        self.trace_time_s += float(trace_s)
+        if flops:
+            self.flops_per_step = float(flops)
+        # a compile step's wall time is compile, not execution: restart the
+        # steady-state interval clock
+        self._last_t = None
+
+    def device_memory(self) -> Dict[str, Optional[int]]:
+        """Host-side PJRT memory stats of device 0 (no sync; {} on backends
+        like CPU that report none)."""
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            return {}
+        return {"mem_bytes_in_use": stats.get("bytes_in_use"),
+                "mem_peak_bytes_in_use": stats.get("peak_bytes_in_use")}
+
+    def mfu(self, step_time_s: Optional[float]) -> Optional[float]:
+        if (not step_time_s or step_time_s <= 0 or not self.flops_per_step
+                or not self.peak_flops_total):
+            return None
+        return self.flops_per_step / (step_time_s * self.peak_flops_total)
+
+    def step(self, step_time_s: Optional[float] = None,
+             tokens: Optional[int] = None, **extra) -> Dict:
+        """Record one training step. With no explicit ``step_time_s`` the
+        steady-state interval since the previous ``step()`` call is used
+        (None on the first step after a (re)compile — no fake numbers)."""
+        now = time.perf_counter()
+        if step_time_s is None and self._last_t is not None:
+            step_time_s = now - self._last_t
+        self._last_t = now
+        self.steps += 1
+        tokens = tokens if tokens is not None else self.tokens_per_step
+        rec: Dict = {
+            "name": self.name,
+            "step": self.steps,
+            "step_time_ms": (step_time_s * 1e3
+                             if step_time_s is not None else None),
+            "tokens": tokens,
+            "tokens_per_sec": (tokens / step_time_s
+                               if tokens and step_time_s else None),
+            "mfu": self.mfu(step_time_s),
+        }
+        rec.update(self.device_memory())
+        rec.update(extra)
+        self.records.append(rec)
+        for e in self._exporters:
+            e.write(rec)
+        return rec
+
+    # -- aggregation --------------------------------------------------------
+
+    def summary(self) -> Dict:
+        """Aggregate view: timing stats over the window, compile accounting,
+        MFU at the best step time, and the trace-time comm counters."""
+        times = [r["step_time_ms"] for r in self.records
+                 if r.get("step_time_ms")]
+        best = min(times) if times else None
+        mean = sum(times) / len(times) if times else None
+        toks = [r["tokens_per_sec"] for r in self.records
+                if r.get("tokens_per_sec")]
+        out: Dict = {
+            "name": self.name,
+            "steps": self.steps,
+            "compiles": self.compiles,
+            "recompiles": self.recompiles,
+            "compile_time_s": self.compile_time_s,
+            "trace_time_s": self.trace_time_s,
+            "flops_per_step": self.flops_per_step,
+            "peak_flops_total": self.peak_flops_total,
+            "n_devices": self.n_devices,
+            "step_time_ms_best": best,
+            "step_time_ms_mean": mean,
+            "tokens_per_sec_best": max(toks) if toks else None,
+            "mfu_best": self.mfu(best / 1e3) if best else None,
+        }
+        out.update(self.device_memory())
+        try:
+            out["overlap"] = _trace.overlap_flags()
+        except Exception:
+            pass
+        out["counters"] = _trace.counters()
+        return out
+
+    def summary_lines(self) -> List[str]:
+        """Human-readable summary (the Profiler.summary telemetry section)."""
+        s = self.summary()
+        lines = [f"StepMetrics[{self.name}]: {s['steps']} steps, "
+                 f"{s['compiles']} compiles ({s['recompiles']} re), "
+                 f"compile {s['compile_time_s']:.2f}s"]
+        if s["step_time_ms_best"] is not None:
+            lines.append(
+                f"  step time best {s['step_time_ms_best']:.2f} ms / "
+                f"mean {s['step_time_ms_mean']:.2f} ms")
+        if s["tokens_per_sec_best"]:
+            lines.append(f"  tokens/sec best {s['tokens_per_sec_best']:.0f}")
+        if s["mfu_best"] is not None:
+            lines.append(f"  MFU best {s['mfu_best'] * 100:.2f}% "
+                         f"({s['flops_per_step']:.3g} FLOPs/step over "
+                         f"{s['peak_flops_total']:.3g} peak FLOP/s)")
+        cnt = s.get("counters") or {}
+        for key in sorted(cnt):
+            lines.append(f"  {key}: {cnt[key]:.0f}")
+        for key, val in (s.get("overlap") or {}).items():
+            lines.append(f"  {key}: {val}")
+        return lines
+
+
+_active: Optional[StepMetrics] = None
+
+
+def set_active(metrics: Optional[StepMetrics]) -> None:
+    """Install the process-wide collector ``Profiler.summary()`` reports."""
+    global _active
+    _active = metrics
+
+
+def active() -> Optional[StepMetrics]:
+    return _active

@@ -1,0 +1,103 @@
+"""Trace attribution primitives for the hybrid-parallel hot path.
+
+Two mechanisms, both free at step time:
+
+- ``comm_span(name)`` — a context manager entered while a collective site is
+  being TRACED into a jitted program. It pushes a ``jax.named_scope`` (the
+  name lands in the HLO op metadata, so XLA's xplane profile attributes the
+  device time of that ppermute/psum to the span name in TensorBoard/Perfetto)
+  plus a host ``jax.profiler.TraceAnnotation`` so tracing itself shows up in
+  host timelines. No code runs per executed step.
+
+- counters — a process-global tally the spans (and planners) bump at trace
+  time: ppermute hop counts, grad-sync bucket bytes, overlap on/off. Because
+  instrumented code runs when a program is traced, counters are STATIC
+  attribution of the compiled step (like HLO op counts), not execution
+  counts: a kernel retraced for fwd+bwd or under remat tallies each trace.
+  ``reset_counters()`` before building a step and ``counters()`` after gives
+  the per-program attribution the StepMetrics collector surfaces.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Optional
+
+import jax
+
+ENV_TELEMETRY = "PADDLE_TPU_TELEMETRY"
+ENV_TELEMETRY_DIR = "PADDLE_TPU_TELEMETRY_DIR"
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def telemetry_enabled(explicit: Optional[bool] = None) -> bool:
+    """Telemetry switch: an explicit argument wins, else ``PADDLE_TPU_TELEMETRY``."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(ENV_TELEMETRY, "0").lower() in _TRUTHY
+
+
+def telemetry_dir() -> Optional[str]:
+    """Step-log directory from ``PADDLE_TPU_TELEMETRY_DIR`` (None: no file)."""
+    return os.environ.get(ENV_TELEMETRY_DIR) or None
+
+
+_counters: Dict[str, float] = {}
+_lock = threading.Lock()
+
+
+def record_counter(name: str, value: float = 1.0) -> None:
+    """Add ``value`` to counter ``name`` (creates at 0)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + float(value)
+
+
+def set_counter(name: str, value: float) -> None:
+    with _lock:
+        _counters[name] = float(value)
+
+
+def counters() -> Dict[str, float]:
+    """Snapshot of every counter."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counters.clear()
+
+
+@contextlib.contextmanager
+def comm_span(name: str, nbytes: Optional[int] = None):
+    """Attribute a collective site: named HLO scope + host trace annotation +
+    ``{name}.calls`` / ``{name}.bytes`` counters. Safe inside jit/shard_map/
+    scan tracing (where it tallies once per trace) and in eager host code."""
+    record_counter(name + ".calls", 1)
+    if nbytes is not None:
+        record_counter(name + ".bytes", int(nbytes))
+    ann = None
+    try:
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    except Exception:
+        ann = None
+    try:
+        with jax.named_scope(name):
+            yield
+    finally:
+        if ann is not None:
+            ann.__exit__(None, None, None)
+
+
+def overlap_flags() -> Dict[str, int]:
+    """The PR-1 overlap switches as 0/1 counters (tp ring, pp async-p2p,
+    grad-sync mode is per-TrainStep and recorded there)."""
+    from ..parallel import collective_matmul as _cm
+    from ..parallel import pipeline as _pl
+    return {
+        "tp.overlap_on": int(_cm.overlap_enabled()),
+        "pp.overlap_on": int(_pl.p2p_overlap_enabled()),
+    }

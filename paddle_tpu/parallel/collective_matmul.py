@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .._compat import shard_map
+from ..observability import trace as _obs
 
 ENV_OVERLAP = "PADDLE_TPU_TP_OVERLAP"
 ENV_MIN_CHUNK = "PADDLE_TPU_TP_OVERLAP_MIN_CHUNK"
@@ -72,16 +73,21 @@ def ring_allreduce_matmul(x, w, n, axis_name):
     acc = None
     for s in range(n):
         if s > 0:
-            acc = lax.ppermute(acc, axis_name, fwd)
+            with _obs.comm_span("tp_ring_allreduce.hop",
+                                nbytes=acc.size * acc.dtype.itemsize):
+                acc = lax.ppermute(acc, axis_name, fwd)
         c = (r - s - 1) % n
         rows = lax.dynamic_slice_in_dim(x, c * tc, tc, 0)
-        part = rows @ w
+        with jax.named_scope("tp_ring_allreduce.partial_matmul"):
+            part = rows @ w
         acc = part if acc is None else acc + part
     out = jnp.zeros((t,) + acc.shape[1:], acc.dtype)
     out = lax.dynamic_update_slice_in_dim(out, acc, r * tc, 0)
     buf = acc
     for h in range(1, n):
-        buf = lax.ppermute(buf, axis_name, fwd)
+        with _obs.comm_span("tp_ring_allreduce.gather_hop",
+                            nbytes=buf.size * buf.dtype.itemsize):
+            buf = lax.ppermute(buf, axis_name, fwd)
         out = lax.dynamic_update_slice_in_dim(out, buf, ((r - h) % n) * tc, 0)
     return out
 
@@ -127,11 +133,14 @@ def ring_allgather_matmul(x, w, n, axis_name):
     out = jnp.zeros((t, nc * n), jnp.result_type(x.dtype, w.dtype))
     for c in range(n):
         rows = lax.dynamic_slice_in_dim(x, c * tc, tc, 0)
-        buf = rows @ w
+        with jax.named_scope("tp_ring_allgather.partial_matmul"):
+            buf = rows @ w
         row0 = jnp.asarray(c * tc, r.dtype)
         out = lax.dynamic_update_slice(out, buf, (row0, r * nc))
         for h in range(1, n):
-            buf = lax.ppermute(buf, axis_name, fwd)
+            with _obs.comm_span("tp_ring_allgather.hop",
+                                nbytes=buf.size * buf.dtype.itemsize):
+                buf = lax.ppermute(buf, axis_name, fwd)
             out = lax.dynamic_update_slice(
                 out, buf, (row0, ((r - h) % n) * nc))
     return out
@@ -162,11 +171,17 @@ ring_allgather_matmul.defvjp(_rag_fwd, _rag_bwd)
 # blocking references (same island layout, fused collective) — the parity
 # baseline the ring kernels must match bit-for-bit at degree 2
 def blocking_allreduce_matmul(x, w, n, axis_name):
-    return lax.psum(x @ w, axis_name)
+    y = x @ w
+    with _obs.comm_span("tp_blocking.allreduce",
+                        nbytes=y.size * y.dtype.itemsize):
+        return lax.psum(y, axis_name)
 
 
 def blocking_allgather_matmul(x, w, n, axis_name):
-    return lax.all_gather(x @ w, axis_name, axis=1, tiled=True)
+    y = x @ w
+    with _obs.comm_span("tp_blocking.allgather",
+                        nbytes=y.size * y.dtype.itemsize):
+        return lax.all_gather(y, axis_name, axis=1, tiled=True)
 
 
 # ---------------------------------------------------------------------------
@@ -211,6 +226,7 @@ def plan_row_parallel(x_shape, w_shape, mesh, mp_axis="mp", batch_axis="dp",
         return None
     f = _island(mesh, kernel, n, mp_axis,
                 P(bax, mp_axis), P(mp_axis, None), P(bax, None))
+    _obs.record_counter("tp.row_parallel.plans")
 
     def apply(x, w):
         out = f(x.reshape(t, k), w)
@@ -240,6 +256,7 @@ def plan_column_parallel(x_shape, w_shape, mesh, mp_axis="mp",
         return None
     f = _island(mesh, kernel, n, mp_axis,
                 P(bax, None), P(None, mp_axis), P(bax, None))
+    _obs.record_counter("tp.column_parallel.plans")
 
     def apply(x, w):
         out = f(x.reshape(t, k), w)

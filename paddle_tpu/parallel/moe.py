@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .._compat import axis_size as _axis_size
+from ..observability import trace as _obs
 
 
 def _gshard_aux_loss(probs, E):
@@ -131,9 +132,65 @@ def topk_route(logits, k: int, capacity: int, drop_capacity=None):
     return slot.astype(jnp.int32), weight, aux_loss
 
 
+# ---------------------------------------------------------------------------
+# Routing statistics (on-device, returned as auxiliary outputs — telemetry
+# reads them AFTER the step, never syncing inside it). All values are f32
+# scalars so they ride along any jitted output pytree.
+# ---------------------------------------------------------------------------
+
+def routing_stats(slot, num_experts, capacity, k, drop_capacity=None):
+    """Per-step routing stats from a slot-schedule assignment.
+
+    slot: [T*k] int32 from ``topk_route`` (E*capacity = trash slot).
+    Returns {moe_dropped_tokens, moe_routed_tokens, moe_load_imbalance
+    (max/mean expert load), moe_capacity_util (routed / total drop-capacity
+    rows)} — all f32 scalars.
+    """
+    E = num_experts
+    if drop_capacity is None:
+        drop_capacity = capacity
+    valid = (slot < E * capacity).astype(jnp.float32)        # [T*k]
+    routed = valid.sum()
+    dropped = jnp.asarray(slot.shape[0], jnp.float32) - routed
+    expert_of = jnp.clip(slot // capacity, 0, E - 1)
+    load = jnp.zeros((E,), jnp.float32).at[expert_of].add(valid)
+    mean = jnp.maximum(routed / E, 1e-9)
+    imbalance = load.max() / mean
+    util = routed / float(E * min(drop_capacity, capacity))
+    return {"moe_dropped_tokens": dropped,
+            "moe_routed_tokens": routed,
+            "moe_load_imbalance": imbalance,
+            "moe_capacity_util": util}
+
+
+def routing_stats_onehot(dispatch, k, drop_capacity=None):
+    """Routing stats from a one-hot [T, E, C] dispatch mask (``top_k_gating``
+    path). Same keys/semantics as ``routing_stats``."""
+    T, E, C = dispatch.shape
+    if drop_capacity is None:
+        drop_capacity = C
+    load = dispatch.astype(jnp.float32).sum(axis=(0, 2))     # [E]
+    routed = load.sum()
+    dropped = jnp.asarray(T * k, jnp.float32) - routed
+    mean = jnp.maximum(routed / E, 1e-9)
+    imbalance = load.max() / mean
+    util = routed / float(E * min(drop_capacity, C))
+    return {"moe_dropped_tokens": dropped,
+            "moe_routed_tokens": routed,
+            "moe_load_imbalance": imbalance,
+            "moe_capacity_util": util}
+
+
+def zero_routing_stats():
+    """The stats pytree with all-zero values (layers without MoE / masking)."""
+    z = jnp.zeros((), jnp.float32)
+    return {"moe_dropped_tokens": z, "moe_routed_tokens": z,
+            "moe_load_imbalance": z, "moe_capacity_util": z}
+
+
 def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
                          k=2, capacity_factor=1.25, use_onehot=False,
-                         strict_capacity=False):
+                         strict_capacity=False, return_stats=False):
     """MoE dispatch/combine. x [T, D] tokens, expert_params stacked [E, ...].
 
     Default path (single-device / ep=1): SLOT SCHEDULE — each routed
@@ -154,7 +211,11 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
     strict_capacity=True drops tokens at the UNROUNDED reference
     capacity (see moe_capacity) instead of the 128-rounded bucket size —
     reference-exact drop accounting at the cost of up to 127 usable
-    bucket rows per expert going idle."""
+    bucket rows per expert going idle.
+
+    return_stats=True appends a ``routing_stats`` dict as a third output
+    (on-device f32 scalars: drops, load imbalance, capacity utilization)
+    for step telemetry; default keeps the 2-tuple API."""
     T, D = x.shape
     capacity, ref_cap = moe_capacity(T, k, num_experts, capacity_factor)
     drop_cap = ref_cap if strict_capacity else capacity
@@ -166,6 +227,9 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
         expert_out = jax.vmap(expert_fn)(expert_params, expert_in)
         out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype),
                          expert_out)
+        if return_stats:
+            return out, aux, routing_stats_onehot(dispatch, k,
+                                                  drop_capacity=drop_cap)
         return out, aux
 
     E = num_experts
@@ -187,6 +251,9 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
     picked = _combine_rows(expert_out.reshape(E * capacity, d_out),
                            slot, pair_inv).reshape(T, k, d_out)
     out = jnp.einsum("tk,tkd->td", weight.astype(picked.dtype), picked)
+    if return_stats:
+        return out, aux, routing_stats(slot, E, capacity, k,
+                                       drop_capacity=drop_cap)
     return out, aux
 
 
@@ -239,7 +306,8 @@ _combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
                             num_experts, axis_name="ep", k=2,
-                            capacity_factor=1.25, strict_capacity=False):
+                            capacity_factor=1.25, strict_capacity=False,
+                            return_stats=False):
     """Slot-schedule MoE INSIDE a manual shard_map over `axis_name` (r5):
     each ep shard holds E/n experts and its local tokens; it computes the
     full top-k routing, gathers ONLY the slots belonging to its local
@@ -290,12 +358,23 @@ def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
                            loc, pair_inv).reshape(T, k, d_out)
     w = weight * mine.reshape(T, k)                 # remote pairs -> 0
     partial = jnp.einsum("tk,tkd->td", w.astype(picked.dtype), picked)
-    return lax.psum(partial, axis_name), aux
+    with _obs.comm_span("moe.combine_psum",
+                        nbytes=partial.size * partial.dtype.itemsize):
+        out = lax.psum(partial, axis_name)
+    if return_stats:
+        # routing is computed identically on every ep shard from this dp
+        # shard's (ep-replicated) tokens, so the stats are per-dp-shard
+        # values replicated over ep; the caller aggregates over dp.
+        return out, aux, routing_stats(
+            slot, E, capacity, k,
+            drop_capacity=ref_cap if strict_capacity else capacity)
+    return out, aux
 
 
 def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
                            num_experts, axis_name="ep", k=2,
-                           capacity_factor=1.25, strict_capacity=False):
+                           capacity_factor=1.25, strict_capacity=False,
+                           return_stats=False):
     """Explicit all-to-all path (inside shard_map over 'ep'): each device owns
     E/ep experts; tokens route via lax.all_to_all, mirroring the reference's
     global_scatter/global_gather."""
@@ -311,12 +390,20 @@ def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
     # e // e_local) splits into n chunks of e_local experts, received chunks
     # concatenate along capacity -> each owner holds its experts' slots from
     # EVERY source device: [e_local, n*C, D]
-    recv = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=1,
-                          tiled=True)
+    with _obs.comm_span("moe.all_to_all_dispatch",
+                        nbytes=expert_in.size * expert_in.dtype.itemsize):
+        recv = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                              concat_axis=1, tiled=True)
     out_local = jax.vmap(expert_fn)(expert_params_local, recv)
     # inverse exchange: capacity splits back per source, experts concat back
     # to the full [E, C, D'] on each source device
-    expert_out = lax.all_to_all(out_local, axis_name, split_axis=1,
-                                concat_axis=0, tiled=True)
+    with _obs.comm_span("moe.all_to_all_combine",
+                        nbytes=out_local.size * out_local.dtype.itemsize):
+        expert_out = lax.all_to_all(out_local, axis_name, split_axis=1,
+                                    concat_axis=0, tiled=True)
     out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype), expert_out)
+    if return_stats:
+        return out, aux, routing_stats_onehot(
+            dispatch, k, drop_capacity=ref_cap if strict_capacity
+            else capacity)
     return out, aux

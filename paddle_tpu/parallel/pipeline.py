@@ -27,6 +27,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import trace as _obs
+
 ENV_PP_OVERLAP = "PADDLE_TPU_PP_OVERLAP"
 
 
@@ -70,6 +72,10 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
     skew = 2 if overlap else 1
     T = M + skew * (S - 1)
     body = jax.checkpoint(stage_fn) if remat else stage_fn
+    _obs.set_counter("pp.overlap", int(overlap))
+    _obs.set_counter("pp.stages", S)
+    _obs.set_counter("pp.microbatches", M)
+    _obs.set_counter("pp.ticks", T)
 
     def run(params_local, x_mb):
         # shard_map gives params_local a leading axis of size 1 (this stage)
@@ -91,14 +97,22 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
             write = active & (stage == S - 1)
             outputs = outputs.at[idx].set(
                 jnp.where(write, out, outputs[idx]))
-            h_next = lax.ppermute(out, axis_name, perm) if S > 1 else out
+            if S > 1:
+                with _obs.comm_span("pp.p2p",
+                                    nbytes=out.size * out.dtype.itemsize):
+                    h_next = lax.ppermute(out, axis_name, perm)
+            else:
+                h_next = out
             return (h_next, outputs), None
 
         def tick_overlap(carry, t):
             h_ready, out_prev, outputs = carry
             # async send: the previous tick's output permutes while THIS
             # tick's body computes — no data dependence between the two
-            h_recv = lax.ppermute(out_prev, axis_name, perm)
+            with _obs.comm_span(
+                    "pp.p2p_async",
+                    nbytes=out_prev.size * out_prev.dtype.itemsize):
+                h_recv = lax.ppermute(out_prev, axis_name, perm)
             mb = t - 2 * stage
             active = (mb >= 0) & (mb < M)
             fresh = x_mb[jnp.clip(t, 0, M - 1)]  # stage 0: mb == t
@@ -185,7 +199,10 @@ def pipeline_apply_interleave(stage_fn: Callable, num_stages: int,
             outputs = outputs.at[m].set(jnp.where(write, out, outputs[m]))
             if S > 1:
                 perm = [(i_, (i_ + 1) % S) for i_ in range(S)]
-                h_next = lax.ppermute(out, axis_name, perm)
+                with _obs.comm_span(
+                        "pp.p2p_interleave",
+                        nbytes=out.size * out.dtype.itemsize):
+                    h_next = lax.ppermute(out, axis_name, perm)
             else:
                 h_next = out
             return (h_next, outputs), None

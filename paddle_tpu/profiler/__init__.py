@@ -11,6 +11,7 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import socket
 import threading
 import time
 from enum import Enum
@@ -55,10 +56,18 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
 
 
 def export_chrome_tracing(dir_name: str, worker_name: str = None) -> Callable:
+    """on_trace_ready handler: write the recorded window's chrome trace into
+    ``dir_name`` as ``{worker}_time_{ns}.paddle_trace.json`` (the reference's
+    file naming; default worker is host_{hostname}_pid_{pid})."""
     os.makedirs(dir_name, exist_ok=True)
 
     def handler(prof):
         prof._export_dir = dir_name
+        worker = worker_name or \
+            f"host_{socket.gethostname()}_pid_{os.getpid()}"
+        path = os.path.join(
+            dir_name, f"{worker}_time_{time.time_ns()}.paddle_trace.json")
+        prof.export(path)
     return handler
 
 
@@ -112,6 +121,10 @@ class Profiler:
         self._active = False
         self._export_dir = None
         self._logdir = None
+        # True while a recorded window has not yet been handed to
+        # on_trace_ready — the single-fire guard (step() fires on the
+        # RECORD->CLOSED edge; stop() must not fire AGAIN for that window).
+        self._window_open = False
 
     def __enter__(self):
         self.start()
@@ -126,9 +139,11 @@ class Profiler:
         _rt.tracer_clear()
         self._state = (self._scheduler(self._step) if self._scheduler
                        else ProfilerState.RECORD)
-        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
-                and not self._timer_only:
-            self._start_jax()
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._window_open = True  # timer_only still records host spans
+            if not self._timer_only:
+                self._start_jax()
 
     def _start_jax(self):
         if self._active:
@@ -151,25 +166,40 @@ class Profiler:
                 pass
             self._active = False
 
+    def _fire_trace_ready(self):
+        """Hand the just-closed window to on_trace_ready EXACTLY once."""
+        if not self._window_open:
+            return
+        self._window_open = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
     def step(self, num_samples: Optional[int] = None):
         self._step += 1
         if self._scheduler is None:
             return
         new_state = self._scheduler(self._step)
-        if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+        recording = new_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN)
+        if recording:
+            # RECORD_AND_RETURN closes its window even when the next cycle
+            # records immediately (back-to-back windows export separately)
+            if self._state == ProfilerState.RECORD_AND_RETURN:
+                self._stop_jax()
+                self._fire_trace_ready()
+            self._window_open = True
             if not self._active and not self._timer_only:
                 self._start_jax()
-        else:
-            if self._active:
-                self._stop_jax()
-                if self._on_trace_ready:
-                    self._on_trace_ready(self)
+        elif self._window_open or self._active:
+            self._stop_jax()
+            self._fire_trace_ready()
         self._state = new_state
 
     def stop(self):
         self._stop_jax()
-        if self._on_trace_ready:
-            self._on_trace_ready(self)
+        # fires only when a recorded window is still pending — a window the
+        # scheduler already closed (and step() exported) does NOT re-fire
+        self._fire_trace_ready()
 
     def export(self, path: str, format: str = "json"):
         """Export collected host spans as chrome trace JSON (device timeline
@@ -194,15 +224,30 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        divisors = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}
+        if time_unit not in divisors:
+            raise ValueError(f"time_unit must be one of {sorted(divisors)}, "
+                             f"got {time_unit!r}")
+        div = divisors[time_unit]
         agg = {}
         with _host_lock:
             for name, t0, t1, _ in _host_events:
                 d = agg.setdefault(name, [0, 0.0])
                 d[0] += 1
-                d[1] += (t1 - t0) / 1e6
-        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"]
+                d[1] += (t1 - t0) / div
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>12}"]
         for name, (calls, total) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
             lines.append(f"{name:<40}{calls:>8}{total:>12.3f}")
+        # telemetry section: the active StepMetrics collector (installed by
+        # jit.TrainStep when PADDLE_TPU_TELEMETRY is on)
+        try:
+            from ..observability import active as _active_metrics
+            m = _active_metrics()
+        except Exception:
+            m = None
+        if m is not None:
+            lines.append("")
+            lines.extend(m.summary_lines())
         return "\n".join(lines)
 
 

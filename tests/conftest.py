@@ -38,3 +38,42 @@ def _seed_everything():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _telemetry_no_host_sync():
+    """Observability acceptance guard: a telemetry-enabled TrainStep must not
+    leak host syncs (device->host transfers / tracer leaks) into the jitted
+    hot path. The first call compiles OUTSIDE the guard (compiles legally
+    fetch cost analysis); steady-state steps run under jax.checking_leaks +
+    a disallow transfer guard and fail the session loudly if telemetry ever
+    grows a block_until_ready or implicit host fetch."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import observability as obs
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.set_device("cpu")
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.GELU(), nn.Linear(8, 4))
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt,
+                     telemetry=True)
+    x = paddle.to_tensor(np.zeros((4, 8), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    step(x, labels=y)  # compile step: trace + cost analysis happen here
+    try:
+        with jax.checking_leaks(), \
+                jax.transfer_guard_device_to_host("disallow"):
+            step(x, labels=y)
+            step(x, labels=y)
+    except Exception as e:  # pragma: no cover - the failure being guarded
+        pytest.fail(
+            f"telemetry leaked a host sync into the jitted step: {e!r}")
+    finally:
+        if step.telemetry is not None:
+            step.telemetry.close()
+        obs.set_active(None)
+        obs.reset_counters()
+    yield

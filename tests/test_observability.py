@@ -1,0 +1,274 @@
+"""Observability subsystem tests: StepMetrics, counters/comm_span, exporters,
+MoE routing stats, and the TrainStep telemetry integration."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    obs.reset_counters()
+    yield
+    obs.reset_counters()
+    obs.set_active(None)
+
+
+# -- counters + comm_span ----------------------------------------------------
+
+def test_counters_roundtrip():
+    obs.record_counter("x.calls")
+    obs.record_counter("x.calls", 2)
+    obs.set_counter("x.flag", 7)
+    c = obs.counters()
+    assert c["x.calls"] == 3
+    assert c["x.flag"] == 7
+    obs.reset_counters()
+    assert obs.counters() == {}
+
+
+def test_comm_span_counts_and_traces():
+    def f(a):
+        with obs.comm_span("t.span", nbytes=a.size * a.dtype.itemsize):
+            return a * 2
+
+    out = jax.jit(f)(jnp.ones((4, 4), jnp.float32))
+    assert float(out[0, 0]) == 2.0
+    c = obs.counters()
+    assert c["t.span.calls"] >= 1
+    assert c["t.span.bytes"] >= 64
+
+
+def test_comm_span_value_passthrough():
+    # the span must be transparent: same value, grads flow through
+    def f(a):
+        with obs.comm_span("t.g"):
+            b = a * 3.0
+        return b.sum()
+
+    g = jax.grad(f)(jnp.ones((3,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), 3.0)
+
+
+def test_telemetry_env_flag(monkeypatch):
+    monkeypatch.delenv(obs.ENV_TELEMETRY, raising=False)
+    assert not obs.telemetry_enabled()
+    assert obs.telemetry_enabled(True)
+    monkeypatch.setenv(obs.ENV_TELEMETRY, "1")
+    assert obs.telemetry_enabled()
+    assert not obs.telemetry_enabled(False)
+
+
+# -- StepMetrics -------------------------------------------------------------
+
+def test_step_metrics_records_and_summary(tmp_path):
+    path = str(tmp_path / "steps.jsonl")
+    m = obs.StepMetrics(name="t", n_devices=2, peak_flops=1e12)
+    m.attach(obs.JsonlWriter(path, flush_every=1))
+    m.record_compile(compile_s=0.5, trace_s=0.1, flops=4e9)
+    for _ in range(3):
+        m.step(tokens=128)
+    m.close()
+
+    assert m.compiles == 1 and m.recompiles == 0 and m.steps == 3
+    recs = obs.load_jsonl(path)
+    assert len(recs) == 3
+    # first step after a compile has no interval -> no fake timing
+    assert recs[0]["step_time_ms"] is None
+    assert recs[1]["step_time_ms"] > 0
+    assert recs[1]["tokens_per_sec"] > 0
+    # mfu = flops / (t * peak_total); peak_total = 2 * 1e12
+    t_s = recs[1]["step_time_ms"] / 1e3
+    np.testing.assert_allclose(recs[1]["mfu"], 4e9 / (t_s * 2e12), rtol=1e-6)
+
+    s = m.summary()
+    assert s["steps"] == 3 and s["compile_time_s"] == 0.5
+    assert s["step_time_ms_best"] <= s["step_time_ms_mean"]
+    assert any("StepMetrics[t]" in ln for ln in m.summary_lines())
+
+
+def test_step_metrics_recompile_resets_interval():
+    m = obs.StepMetrics(name="t", peak_flops=1e12)
+    m.record_compile(flops=1e6)
+    m.step()
+    m.record_compile(flops=2e6)      # recompile
+    rec = m.step()
+    assert m.recompiles == 1
+    assert rec["step_time_ms"] is None  # interval clock restarted
+    assert m.flops_per_step == 2e6
+
+
+def test_peak_flops_table(monkeypatch):
+    monkeypatch.setenv(obs.metrics.ENV_PEAK_FLOPS, "123.0")
+    assert obs.peak_flops_per_device() == 123.0
+    monkeypatch.delenv(obs.metrics.ENV_PEAK_FLOPS)
+
+    class FakeDev:
+        device_kind = "TPU v5p"
+    assert obs.peak_flops_per_device(FakeDev()) == 459e12
+
+    class Cpu:
+        device_kind = "cpu"
+    assert obs.peak_flops_per_device(Cpu()) == 100e9
+
+
+# -- exporters ---------------------------------------------------------------
+
+def test_jsonl_writer_buffers_and_flushes(tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    w = obs.JsonlWriter(path, flush_every=100)
+    w.write({"a": 1, "x": np.float32(2.5), "arr": np.arange(2)})
+    w.flush()
+    recs = obs.load_jsonl(path)
+    assert recs == [{"a": 1, "x": 2.5, "arr": [0, 1]}]
+    w.close()
+
+
+def test_rank_logger_format(capsys):
+    logger = obs.get_logger("paddle_tpu.test_obs")
+    obs.log_event(logger, "hello", foo=1)
+    err = capsys.readouterr().err
+    assert "[rank 0]" in err
+    payload = json.loads(err[err.index("{"):])
+    assert payload["event"] == "hello" and payload["foo"] == 1
+
+
+def test_tensorboard_writer_gated():
+    if obs.TensorBoardWriter.available():
+        pytest.skip("a tensorboard backend is installed")
+    with pytest.raises(ImportError):
+        obs.TensorBoardWriter("/tmp/tb")
+
+
+# -- MoE routing stats -------------------------------------------------------
+
+def test_moe_routing_stats_balanced_vs_skewed():
+    from paddle_tpu.parallel import moe
+    T, D, E, k = 64, 16, 4, 2
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(T, D).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(E, D, 32).astype(np.float32) * 0.02)
+    w2 = jnp.asarray(rng.randn(E, 32, D).astype(np.float32) * 0.02)
+
+    def expert_fn(params, t):
+        a, b = params
+        return jax.nn.gelu(t @ a) @ b
+
+    def run(logits):
+        return jax.jit(lambda xx, ll: moe.moe_dispatch_combine(
+            xx, ll, expert_fn, (w1, w2), E, k=k, strict_capacity=True,
+            return_stats=True))(x, logits)
+
+    balanced = jnp.asarray(rng.randn(T, E).astype(np.float32))
+    skewed = balanced + jnp.array([6.0, 0, 0, 0], jnp.float32)
+
+    _, _, st_b = run(balanced)
+    _, _, st_s = run(skewed)
+    assert float(st_s["moe_dropped_tokens"]) > float(st_b["moe_dropped_tokens"])
+    assert float(st_s["moe_load_imbalance"]) > float(st_b["moe_load_imbalance"])
+    assert 0.0 < float(st_b["moe_capacity_util"]) <= 1.0
+    # conservation: routed + dropped == T*k
+    assert float(st_s["moe_routed_tokens"]) + \
+        float(st_s["moe_dropped_tokens"]) == T * k
+
+    # the one-hot gating path reports identical stats for the same routing
+    _, _, st_oh = jax.jit(lambda xx, ll: moe.moe_dispatch_combine(
+        xx, ll, expert_fn, (w1, w2), E, k=k, strict_capacity=True,
+        use_onehot=True, return_stats=True))(x, skewed)
+    for key in st_s:
+        np.testing.assert_allclose(float(st_oh[key]), float(st_s[key]),
+                                   rtol=1e-6, err_msg=key)
+
+
+def test_moe_stats_do_not_change_loss():
+    from paddle_tpu.models import ernie_moe
+    cfg = ernie_moe.ernie_moe_tiny()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int32)
+
+    step0, p0, o0 = ernie_moe.build_train_step(cfg)
+    step1, p1, o1 = ernie_moe.build_train_step(cfg, with_stats=True)
+    _, _, loss0, lm0 = step0(p0, o0, ids, labels)
+    _, _, loss1, aux1 = step1(p1, o1, ids, labels)
+    assert float(loss0) == float(loss1)
+    assert float(lm0) == float(aux1["lm_loss"])
+    assert set(aux1) == {"lm_loss", "moe_dropped_tokens",
+                         "moe_routed_tokens", "moe_load_imbalance",
+                         "moe_capacity_util"}
+
+
+# -- TrainStep integration ---------------------------------------------------
+
+def _tiny_step(tmp_path, mesh=None, **kw):
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    return TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt,
+                     mesh=mesh, telemetry=True,
+                     telemetry_dir=str(tmp_path), **kw)
+
+
+def test_train_step_telemetry_jsonl(tmp_path):
+    step = _tiny_step(tmp_path)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+    n_calls = 6
+    for _ in range(n_calls):
+        step(x, labels=y)
+    m = step.telemetry
+    assert m is not None
+    # call 2 may legally recompile (donated outputs commit to a device and
+    # change the jit cache key); telemetry must classify every compile as a
+    # compile — never as a fake step sample — and settle into steady state
+    assert 1 <= m.compiles <= 2
+    assert m.recompiles == m.compiles - 1
+    assert m.steps == n_calls - m.compiles >= 3
+    assert m.flops_per_step and m.flops_per_step > 0
+    m.close()
+    recs = obs.load_jsonl(
+        str(tmp_path / f"steps_rank{obs.process_rank():03d}.jsonl"))
+    assert len(recs) == m.steps
+    timed = [r for r in recs if r["step_time_ms"]]
+    assert timed and all(r["mfu"] > 0 for r in timed)
+    assert all(r["tokens"] == 4 for r in recs)
+
+
+def test_train_step_bucket_counters(tmp_path):
+    cpus = jax.devices("cpu")
+    mesh = Mesh(np.array(cpus[:8]).reshape(8, 1), ("dp", "mp"))
+    step = _tiny_step(tmp_path, mesh=mesh, batch_spec=P("dp"),
+                      grad_sync="bucketed", grad_bucket_mb=0.0001)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    step(x, labels=y)
+    c = obs.counters()
+    n = c["grad_sync.n_buckets"]
+    assert n == len(step.grad_buckets) and n > 1
+    plan_total = sum(c[f"grad_sync.bucket{i:02d}.plan_bytes"]
+                     for i in range(int(n)))
+    assert plan_total == c["grad_sync.total_bytes"] > 0
+    # the traced spans tallied every bucket at least once
+    assert c["grad_sync.bucket00.calls"] >= 1
+    step.telemetry.close()
+
+
+def test_telemetry_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv(obs.ENV_TELEMETRY, raising=False)
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 4))
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt)
+    assert step.telemetry is None
