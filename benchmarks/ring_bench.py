@@ -55,6 +55,72 @@ def flash_block_step(q, k_blk, v_blk, o, lse, scale):
     return _merge_partials(o, lse, o_blk, lse_blk)
 
 
+def chunk_sweep(cpus=None, mp=4, t=512, k=512, out=512, chunks=(1, 2, 4),
+                reps=3, inner=3):
+    """Chunked ring collective-matmul sweep at mp>2 (importable; the n=8
+    multichip dryrun calls this through overlap_bench for mp=4 and mp=8).
+
+    For each sub-tile count, times the row-parallel all-reduce ring against
+    the fused-psum blocking twin and snapshots the per-hop comm_span trace
+    counters (tp_ring_allreduce.hop / .gather_hop calls and bytes), which is
+    how the chunking shows up in the step log: same total bytes, n_chunks x
+    the collective-permute count at 1/n_chunks the payload each.
+    """
+    import functools
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu._compat import shard_map
+    from paddle_tpu.parallel import collective_matmul as cm
+
+    if cpus is None:
+        cpus = jax.devices("cpu")
+    mesh = Mesh(np.array(cpus[:mp]), ("mp",))
+    rng = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(rng.randn(t, k), jnp.float32),
+                       NamedSharding(mesh, P(None, "mp")))
+    w = jax.device_put(jnp.asarray(rng.randn(k, out), jnp.float32),
+                       NamedSharding(mesh, P("mp", None)))
+    specs = (P(None, "mp"), P("mp", None))
+
+    def island(kern, **kw):
+        return jax.jit(shard_map(
+            functools.partial(kern, n=mp, axis_name="mp", **kw), mesh=mesh,
+            in_specs=specs, out_specs=P(),
+            axis_names=frozenset(["mp"]), check_vma=False))
+
+    def timeit(f):
+        jax.block_until_ready(f(x, w))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                o = f(x, w)
+            jax.block_until_ready(o)
+            best = min(best, (time.perf_counter() - t0) / inner)
+        return best * 1e3
+
+    res = {"mp": mp, "blocking_ms": timeit(island(cm.blocking_allreduce_matmul)),
+           "sweep": {}}
+    ref = None
+    for nc in chunks:
+        if (t // mp) % nc:
+            continue
+        obs.reset_counters()
+        f = island(cm.ring_allreduce_matmul, nchunks=nc)
+        ms = timeit(f)
+        snap = {name: v for name, v in obs.counters().items()
+                if name.startswith("tp_ring_allreduce.")}
+        out_val = f(x, w)
+        if ref is None:
+            ref = out_val
+        res["sweep"][nc] = dict(
+            ms=ms, bitwise_vs_unchunked=bool((out_val == ref).all()),
+            hop_counters=snap)
+    return res
+
+
 def main():
     dev = jax.devices()[0]
     print(f"device: {getattr(dev, 'device_kind', dev.platform)}")

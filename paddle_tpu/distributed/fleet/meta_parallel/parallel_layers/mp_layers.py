@@ -28,20 +28,28 @@ def _mp_degree():
     return hcg.get_model_parallel_world_size() if hcg else 1
 
 
+def _overlap_cm():
+    """The collective_matmul module when the overlap applies to this trace
+    (switch on AND a mesh is active), else None."""
+    from .....parallel import collective_matmul as cm
+    if not cm.overlap_enabled():
+        return None
+    if active_mesh() is None:
+        return None
+    return cm
+
+
 def _overlap_plan(kind, x, weight):
     """Collective-matmul plan for this call, or None for the fused GSPMD
     path (overlap off / eager / mp==1 / sub-MXU chunks — see
     parallel/collective_matmul.py gates)."""
     from .....amp import state as amp_state
-    from .....parallel import collective_matmul as cm
-    if not cm.overlap_enabled():
-        return None
-    mesh = active_mesh()
-    if mesh is None:
+    cm = _overlap_cm()
+    if cm is None:
         return None
     plan_fn = (cm.plan_row_parallel if kind == "row"
                else cm.plan_column_parallel)
-    plan = plan_fn(tuple(x.shape), tuple(weight.shape), mesh)
+    plan = plan_fn(tuple(x.shape), tuple(weight.shape), active_mesh())
     if plan is None:
         return None
 
@@ -50,6 +58,33 @@ def _overlap_plan(kind, x, weight):
         # custom VJPs) need uniform operand dtypes
         a, w = amp_state.maybe_autocast_pair(a, w)
         return plan(a, w)
+
+    return apply
+
+
+def fused_ffn_plan(x, w_cols, w_row, activation, col_bias=False,
+                   batch_axis="dp"):
+    """Single-island column->activation->row plan that never gathers the
+    intermediate activation (see collective_matmul.plan_fused_ffn), with the
+    same O1 autocast F.linear applies, or None for the fused GSPMD path.
+    Returned apply takes (x, w_cols tuple, w_row, b_cols tuple)."""
+    from .....amp import state as amp_state
+    cm = _overlap_cm()
+    if cm is None:
+        return None
+    plan = cm.plan_fused_ffn(tuple(x.shape), tuple(w_cols[0].shape),
+                             tuple(w_row.shape), active_mesh(),
+                             n_cols=len(w_cols), activation=activation,
+                             col_bias=col_bias, batch_axis=batch_axis)
+    if plan is None:
+        return None
+
+    def apply(a, cols, row, b_cols=()):
+        a, row = amp_state.maybe_autocast_pair(a, row)
+        cols = tuple(amp_state.maybe_autocast(w) for w in cols)
+        if amp_state.autocast_enabled():
+            b_cols = tuple(b.astype(a.dtype) for b in b_cols)
+        return plan(a, cols, row, b_cols)
 
     return apply
 
@@ -70,6 +105,15 @@ class VocabParallelEmbedding(Layer):
         self.weight.split_axis = 0
 
     def forward(self, x):
+        cm = _overlap_cm()
+        if cm is not None:
+            # masked local lookup + chunked reduce ring (exact: each token's
+            # row is non-zero on exactly one vocab shard)
+            plan = cm.plan_vocab_parallel_embedding(
+                tuple(x.shape), tuple(self.weight.shape), active_mesh())
+            if plan is not None:
+                return _run_op("vocab_embed_overlap", plan,
+                               (x, self.weight), {})
         out = F.embedding(x, self.weight)
         return hint_tensor(out, None, None, None)  # replicated activations
 
@@ -164,6 +208,25 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
+        cm = _overlap_cm()
+        ring = (cm.plan_parallel_cross_entropy(tuple(input.shape),
+                                               active_mesh())
+                if cm is not None else None)
+        if ring is not None:
+            # per-rank (max, sumexp, picked) stats ride a chunked gather
+            # ring — [n, t, 3] on the wire instead of replicated logits
+            def f(logits, lbl):
+                idx = lbl.astype(jnp.int32)
+                if idx.ndim == logits.ndim:
+                    idx = jnp.squeeze(idx, -1)
+                loss = ring(logits, idx)[..., None]
+                if self.ignore_index >= 0:
+                    loss = jnp.where((idx == self.ignore_index)[..., None],
+                                     0.0, loss)
+                return loss
+            return _run_op("parallel_cross_entropy_overlap", f,
+                           (input, label), {})
+
         def f(logits, lbl):
             spec = [None] * (logits.ndim - 1) + ["mp"]
             logits = hint(logits, *spec)

@@ -94,6 +94,10 @@ def group_sharded_parallel(model: Layer, optimizer, level: str,
         p.sharding_level = level
     optimizer._sharding_level = level
     model._group_sharded_level = level
+    # stage-3 prefetch bucket cap (jit/train_step param_gather buckets):
+    # reuse the reference's comm buffer knob — buffer_max_size caps how many
+    # param bytes one prefetched all-gather bucket carries
+    model._gs_buffer_bytes = int(buffer_max_size)
     return model, optimizer, scaler
 
 

@@ -83,3 +83,37 @@ class RowSequenceParallelLinear:
 
         layer.forward = forward
         return layer
+
+
+def fused_sequence_parallel_ffn(column_layer, row_layer, x, activation=None):
+    """Run a Column->activation->Row SP pair as ONE collective-matmul island
+    when the overlap applies: the column matmul, (sharded) column bias and
+    activation stay on the mp shard, the row matmul rides the chunked reduce
+    ring, and the intermediate [B, S, I] activation is never gathered. The
+    output re-enters the SP region via ScatterOp, like
+    RowSequenceParallelLinear. Falls back to ``row(activation(column(x)))``
+    through the individual layers (which carry their own overlap plans)
+    whenever the fused plan doesn't apply."""
+    from ..meta_parallel.parallel_layers.mp_layers import fused_ffn_plan
+    from ....parallel.collective_matmul import gelu_tanh
+    from ....tensor.tensor import _run_op
+    act = activation if activation is not None else gelu_tanh
+    plan = fused_ffn_plan(x, (column_layer.weight,), row_layer.weight, act,
+                          col_bias=column_layer.bias is not None)
+    if plan is not None:
+        if column_layer.bias is not None:
+            def f(a, w_in, b_in, w_out):
+                return plan(a, (w_in,), w_out, (b_in,))
+            args = (x, column_layer.weight, column_layer.bias,
+                    row_layer.weight)
+        else:
+            def f(a, w_in, w_out):
+                return plan(a, (w_in,), w_out)
+            args = (x, column_layer.weight, row_layer.weight)
+        out = _run_op("fused_ffn_overlap", f, args, {})
+        if row_layer.bias is not None:
+            out = out + row_layer.bias
+        return ScatterOp.apply(out)
+    h = column_layer(x)
+    h = _run_op("ffn_activation", act, (h,), {})
+    return ScatterOp.apply(row_layer(h))

@@ -40,6 +40,8 @@ class TrainStep:
                  grad_accum: int = 1, donate: bool = True, rng_seed: int = 0,
                  grad_sync: Optional[str] = None,
                  grad_bucket_mb: Optional[float] = None,
+                 param_prefetch: Optional[bool] = None,
+                 param_bucket_mb: Optional[float] = None,
                  telemetry: Optional[bool] = None,
                  telemetry_dir: Optional[str] = None,
                  tokens_per_step: Optional[int] = None):
@@ -234,6 +236,42 @@ class TrainStep:
         buckets_ref = self.grad_buckets
         sync_axes = reduce_axes
 
+        # --- stage-3 (ZeRO-3) param-gather prefetch: bucket the sharded
+        # params in FORWARD order (same planner as the grad buckets, not
+        # reversed) and issue each bucket's all-gather one bucket ahead of
+        # first use inside the compiled step (sharding_utils.
+        # prefetch_param_gathers). Default follows the overlap switch
+        # (PADDLE_TPU_TP_OVERLAP) like the ring matmuls; pure data movement,
+        # loss is bit-identical to the non-prefetched stage 3.
+        self.param_gather_buckets = None
+        prefetch_shardings = {}
+        pf_shapes = {}
+        if mesh is not None and mesh.shape.get("sharding", 1) > 1:
+            if param_prefetch is None:
+                from ..parallel import collective_matmul as _cm
+                param_prefetch = _cm.overlap_enabled()
+            if param_prefetch:
+                for k in trainable_keys:
+                    p = self.param_objs[k]
+                    if getattr(p, "sharding_level", None) != "p_g_os":
+                        continue
+                    full = getattr(p, "_pre_gs_pspec", None) or P()
+                    if self.param_shardings[k].spec == full:
+                        continue  # indivisible shape: never actually sharded
+                    pf_shapes[k] = (tuple(params[k].shape),
+                                    params[k].dtype.itemsize)
+                    prefetch_shardings[k] = NamedSharding(mesh, full)
+                if pf_shapes:
+                    cap = (int(float(param_bucket_mb) * 2 ** 20)
+                           if param_bucket_mb is not None
+                           else int(getattr(model, "_gs_buffer_bytes",
+                                            2 ** 23)))
+                    self.param_gather_buckets = \
+                        sharding_utils.plan_grad_buckets(
+                            pf_shapes, cap, reverse=False)
+        pf_buckets_ref = self.param_gather_buckets
+        pf_shardings_ref = prefetch_shardings
+
         # --- step telemetry (observability.StepMetrics). Explicit arg wins,
         # else PADDLE_TPU_TELEMETRY. Nothing below adds host syncs: wall
         # times are perf_counter intervals around the ASYNC dispatch, FLOPs
@@ -264,6 +302,15 @@ class TrainStep:
                 # separately tallies .bytes per trace
                 observability.set_counter(
                     f"grad_sync.bucket{i:02d}.plan_bytes", nbytes)
+        if self.param_gather_buckets is not None:
+            sizes = sharding_utils.bucket_bytes(pf_shapes,
+                                                self.param_gather_buckets)
+            observability.set_counter("param_gather.n_buckets",
+                                      len(self.param_gather_buckets))
+            observability.set_counter("param_gather.total_bytes", sum(sizes))
+            for i, nbytes in enumerate(sizes):
+                observability.set_counter(
+                    f"param_gather.bucket{i:02d}.plan_bytes", nbytes)
 
         def island_loss_grads(train_params, frozen_params, buffers, batch,
                               rng):
@@ -306,12 +353,20 @@ class TrainStep:
 
         def step_fn(train_params, opt_states, buffers, frozen_params, batch,
                     rng, lr):
+            # stage-3 prefetch: hand the forward the GATHERED view (bucketed,
+            # one ahead); the optimizer update below stays on the sharded
+            # originals. Constraints are value-identity, so grads wrt the
+            # gathered view equal grads wrt the originals bit-for-bit.
+            fwd_params = train_params
+            if pf_buckets_ref:
+                fwd_params = sharding_utils.prefetch_param_gathers(
+                    train_params, pf_buckets_ref, pf_shardings_ref)
             if sync_axes:
                 (loss, new_buffers), grads = island_loss_grads(
-                    train_params, frozen_params, buffers, batch, rng)
+                    fwd_params, frozen_params, buffers, batch, rng)
             else:
                 (loss, new_buffers), grads = accum_loss_grads(
-                    train_params, frozen_params, buffers, batch, rng)
+                    fwd_params, frozen_params, buffers, batch, rng)
             if grad_shardings_ref:
                 grads = {
                     k: jax.lax.with_sharding_constraint(

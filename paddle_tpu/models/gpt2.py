@@ -84,6 +84,28 @@ class GPT2MLP(Layer):
         self.dropout = Dropout(config.dropout)
 
     def forward(self, x):
+        from ..distributed.fleet.meta_parallel.parallel_layers.mp_layers \
+            import fused_ffn_plan
+        from ..parallel.collective_matmul import gelu_tanh
+        from ..tensor.tensor import _run_op
+        plan = fused_ffn_plan(x, (self.fc_in.weight,), self.fc_out.weight,
+                              gelu_tanh, col_bias=self.fc_in.bias is not None)
+        if plan is not None:
+            # single island: fc_in matmul + bias + gelu stay on the mp shard,
+            # fc_out rides the chunked reduce ring — no intermediate gather
+            if self.fc_in.bias is not None:
+                def f(a, w_in, b_in, w_out):
+                    return plan(a, (w_in,), w_out, (b_in,))
+                args = (x, self.fc_in.weight, self.fc_in.bias,
+                        self.fc_out.weight)
+            else:
+                def f(a, w_in, w_out):
+                    return plan(a, (w_in,), w_out)
+                args = (x, self.fc_in.weight, self.fc_out.weight)
+            out = _run_op("fused_ffn_overlap", f, args, {})
+            if self.fc_out.bias is not None:
+                out = out + self.fc_out.bias
+            return self.dropout(out)
         return self.dropout(self.fc_out(F.gelu(self.fc_in(x), approximate=True)))
 
 

@@ -366,18 +366,43 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
     h = h_in + _maybe_hint(attn_out, mesh, _act_spec(parallel))
 
     x = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
-    # named so 'save_mlp' can keep the gate/up matmul outputs across the
-    # remat boundary — gate+up are HALF the forward matmul FLOPs, so
-    # saving them halves the backward recompute at the cost of two
-    # [B, S, I] residuals per layer
-    g = _ckpt_name(_mat(x, p["gate_proj"]), "mlp_gate")
-    u = _ckpt_name(_mat(x, p["up_proj"]), "mlp_up")
-    gated = jax.nn.silu(g) * u
-    mlp_out = _mat(gated, p["down_proj"])
-    if tp_axis is not None:
-        mlp_out = lax.psum(mlp_out, tp_axis)
+    mlp_out = _fused_ffn_overlap(x, p, parallel, mesh, tp_axis)
+    if mlp_out is None:
+        # named so 'save_mlp' can keep the gate/up matmul outputs across the
+        # remat boundary — gate+up are HALF the forward matmul FLOPs, so
+        # saving them halves the backward recompute at the cost of two
+        # [B, S, I] residuals per layer
+        g = _ckpt_name(_mat(x, p["gate_proj"]), "mlp_gate")
+        u = _ckpt_name(_mat(x, p["up_proj"]), "mlp_up")
+        gated = jax.nn.silu(g) * u
+        mlp_out = _mat(gated, p["down_proj"])
+        if tp_axis is not None:
+            mlp_out = lax.psum(mlp_out, tp_axis)
     out = h + _maybe_hint(mlp_out, mesh, _act_spec(parallel))
     return out
+
+
+def _fused_ffn_overlap(x, p, parallel, mesh, tp_axis):
+    """gate/up -> silu-mul -> down inside ONE ring island (the [B, S, I]
+    activation never leaves the mp shard; the only collective is the down
+    matmul's chunked reduce ring). None -> run the GSPMD path: overlap off,
+    manual-TP region (weights arrive pre-sliced), sep sharding on the seq
+    dim, 'save_mlp' remat (the island hides the gate/up checkpoint names),
+    int8 weights, or shapes that don't divide the ring."""
+    from ..parallel import collective_matmul as cm
+    if (tp_axis is not None or mesh is None or parallel.mp <= 1
+            or parallel.sep > 1 or parallel.remat_policy == "save_mlp"
+            or not cm.overlap_enabled()
+            or any(isinstance(p[k], dict)
+                   for k in ("gate_proj", "up_proj", "down_proj"))):
+        return None
+    plan = cm.plan_fused_ffn(
+        tuple(x.shape), tuple(p["gate_proj"].shape),
+        tuple(p["down_proj"].shape), mesh, n_cols=2, activation=cm.swiglu,
+        batch_axis=_act_spec(parallel)[0])
+    if plan is None:
+        return None
+    return plan(x, (p["gate_proj"], p["up_proj"]), p["down_proj"])
 
 
 def _remat_policy(parallel):

@@ -7,6 +7,18 @@ fused collective is decomposed into a ``ppermute`` ring of partial matmuls so
 every hop's transfer overlaps the next chunk's compute (Megatron / maxtext
 style), inside a fully-manual shard_map island over the active mesh.
 
+Chunked hops (mp>2): each of the n ring hops still moves one full shard per
+``ppermute``, so at mp=4/8 the first hop exposes most of its transfer latency
+before any partial matmul can consume it. ``resolve_chunks`` therefore splits
+every hop into independent row sub-tiles (``PADDLE_TPU_TP_OVERLAP_CHUNKS``,
+default auto: ~``min_chunk()`` rows per sub-tile) — disjoint row slices
+ppermuted separately, so hop s's in-flight sub-tiles overlap hop s+1's
+partial matmul instead of serializing whole shards. Sub-tiling only splits
+transfer granularity (the adds stay elementwise on disjoint rows), so a
+chunked ring is BITWISE identical to the unchunked ring; mp=2 always runs
+unchunked (one transfer hop, nothing to split — and it is the bitwise parity
+contract against blocking).
+
 Numerics: the ring kernels carry a custom_vjp whose backward issues exactly
 the same ops as the blocking path's backward, and at mp=2 the forward ring
 reduction is a two-term sum (commutative in fp), so overlapped == blocking
@@ -14,15 +26,27 @@ bit-for-bit at mp=2; for mp>2 the all-reduce variant re-associates the
 partial-sum order and matches to fp tolerance (the all-gather variant is
 bitwise at any degree — it has no cross-rank reduction).
 
+Beyond the Linear pair, the same ring machinery backs three more surfaces:
+``plan_fused_ffn`` runs a column->act->row pair inside ONE island whose only
+collective is the final chunked reduce ring (the intermediate activation is
+never gathered); ``plan_vocab_parallel_embedding`` reduces the masked local
+lookups of a vocab-sharded table over a ring (each row is non-zero on exactly
+one rank, so the ring sum is exact in any dtype); and
+``plan_parallel_cross_entropy`` ring-gathers per-rank (max, sumexp, picked)
+stats — [n, t, 3] on the wire instead of replicated [t, V] logits.
+
 Switches: ``PADDLE_TPU_TP_OVERLAP=1`` turns the overlap on;
 ``PADDLE_TPU_TP_OVERLAP_MIN_CHUNK`` (default 64) is the smallest per-step
 chunk (ring rows / gathered columns) worth issuing — below it the partial
 matmuls can't keep an MXU busy and the fused collective wins, so the layer
 falls back. Fallback is also automatic when mp == 1, no mesh is active, or
-the shapes don't divide the ring.
+the shapes don't divide the ring. Plans are memoized per (shapes, mesh,
+kwargs, overlap env) so layer forwards don't rebuild the shard_map island —
+or re-bump the ``tp.*.plans`` counters — on every call.
 """
 from __future__ import annotations
 
+import collections
 import functools
 import os
 
@@ -36,6 +60,7 @@ from ..observability import trace as _obs
 
 ENV_OVERLAP = "PADDLE_TPU_TP_OVERLAP"
 ENV_MIN_CHUNK = "PADDLE_TPU_TP_OVERLAP_MIN_CHUNK"
+ENV_CHUNKS = "PADDLE_TPU_TP_OVERLAP_CHUNKS"
 _DEFAULT_MIN_CHUNK = 64
 
 
@@ -44,16 +69,83 @@ def overlap_enabled() -> bool:
                                                         "on")
 
 
+def _env_positive_int(var, default, allow_auto=False):
+    """Parse an env var as a strictly positive int, with a clear error
+    naming the variable on junk/non-positive values (not a bare int()
+    traceback). ``allow_auto``: ''/'auto' means "let the library pick"
+    and returns None."""
+    raw = os.environ.get(var)
+    if raw is None:
+        return default
+    s = raw.strip().lower()
+    if allow_auto and s in ("", "auto"):
+        return None
+    try:
+        v = int(s)
+    except ValueError:
+        raise ValueError(
+            f"{var} must be a positive integer"
+            + (" or 'auto'" if allow_auto else "") + f", got {raw!r}")
+    if v <= 0:
+        raise ValueError(f"{var} must be positive, got {raw!r}")
+    return v
+
+
 def min_chunk() -> int:
-    return int(os.environ.get(ENV_MIN_CHUNK, _DEFAULT_MIN_CHUNK))
+    return _env_positive_int(ENV_MIN_CHUNK, _DEFAULT_MIN_CHUNK)
+
+
+def overlap_chunks():
+    """Explicit per-hop sub-tile count from PADDLE_TPU_TP_OVERLAP_CHUNKS,
+    or None for auto (target ~min_chunk() rows per sub-tile)."""
+    return _env_positive_int(ENV_CHUNKS, None, allow_auto=True)
+
+
+def resolve_chunks(n: int, rows: int) -> int:
+    """Sub-tiles per ring hop for a hop payload of ``rows`` rows.
+
+    mp<=2 stays unchunked: a 2-ring has a single transfer hop per phase and
+    is the bitwise-vs-blocking parity contract, so there is nothing to
+    pipeline. An explicit PADDLE_TPU_TP_OVERLAP_CHUNKS wins when it divides
+    the hop rows (falling back to unchunked when it doesn't — never a
+    ragged sub-tile); auto targets ~min_chunk() rows per sub-tile, snapped
+    down to the nearest divisor of ``rows``.
+    """
+    if n <= 2 or rows <= 1:
+        return 1
+    req = overlap_chunks()
+    if req is not None:
+        return req if (req <= rows and rows % req == 0) else 1
+    k = max(1, min(rows, rows // max(1, min_chunk())))
+    while rows % k:
+        k -= 1
+    return k
 
 
 # ---------------------------------------------------------------------------
 # ring kernels (called INSIDE a fully-manual shard_map over the mesh)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def ring_allreduce_matmul(x, w, n, axis_name):
+def _ring_hop(buf, axis_name, perm, nchunks, span):
+    """One ring hop, split into ``nchunks`` independent row sub-tile
+    ppermutes. The sub-tiles are disjoint row slices reassembled by concat,
+    so chunked == unchunked bitwise; each sub-tile is its own
+    collective-permute in the HLO, free to be scheduled (and its latency
+    hidden) independently of its siblings."""
+    if nchunks <= 1:
+        with _obs.comm_span(span, nbytes=buf.size * buf.dtype.itemsize):
+            return lax.ppermute(buf, axis_name, perm)
+    rc = buf.shape[0] // nchunks
+    tiles = []
+    for j in range(nchunks):
+        t = lax.slice_in_dim(buf, j * rc, (j + 1) * rc, axis=0)
+        with _obs.comm_span(span, nbytes=t.size * t.dtype.itemsize):
+            tiles.append(lax.ppermute(t, axis_name, perm))
+    return jnp.concatenate(tiles, axis=0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def ring_allreduce_matmul(x, w, n, axis_name, nchunks=1):
     """Row-parallel matmul with the all-reduce decomposed into a ring.
 
     x: [t, k/n] local rows (full t), w: [k/n, out] local shard ->
@@ -64,7 +156,9 @@ def ring_allreduce_matmul(x, w, n, axis_name):
     r-1 (which computed the same chunk's partial last step) — the constraint
     c_s(r) = c_{s-1}(r-1) pins the schedule. After n steps rank r holds row
     chunk r fully reduced; a ring all-gather reassembles [t, out]. Each
-    ppermute overlaps the next chunk's partial matmul.
+    ppermute overlaps the next chunk's partial matmul, and at ``nchunks`` > 1
+    every hop is further split into row sub-tiles (bitwise-neutral; see
+    ``_ring_hop``).
     """
     r = lax.axis_index(axis_name)
     t = x.shape[0]
@@ -73,9 +167,8 @@ def ring_allreduce_matmul(x, w, n, axis_name):
     acc = None
     for s in range(n):
         if s > 0:
-            with _obs.comm_span("tp_ring_allreduce.hop",
-                                nbytes=acc.size * acc.dtype.itemsize):
-                acc = lax.ppermute(acc, axis_name, fwd)
+            acc = _ring_hop(acc, axis_name, fwd, nchunks,
+                            "tp_ring_allreduce.hop")
         c = (r - s - 1) % n
         rows = lax.dynamic_slice_in_dim(x, c * tc, tc, 0)
         with jax.named_scope("tp_ring_allreduce.partial_matmul"):
@@ -85,18 +178,17 @@ def ring_allreduce_matmul(x, w, n, axis_name):
     out = lax.dynamic_update_slice_in_dim(out, acc, r * tc, 0)
     buf = acc
     for h in range(1, n):
-        with _obs.comm_span("tp_ring_allreduce.gather_hop",
-                            nbytes=buf.size * buf.dtype.itemsize):
-            buf = lax.ppermute(buf, axis_name, fwd)
+        buf = _ring_hop(buf, axis_name, fwd, nchunks,
+                        "tp_ring_allreduce.gather_hop")
         out = lax.dynamic_update_slice_in_dim(out, buf, ((r - h) % n) * tc, 0)
     return out
 
 
-def _rar_fwd(x, w, n, axis_name):
-    return ring_allreduce_matmul(x, w, n, axis_name), (x, w)
+def _rar_fwd(x, w, n, axis_name, nchunks=1):
+    return ring_allreduce_matmul(x, w, n, axis_name, nchunks), (x, w)
 
 
-def _rar_bwd(n, axis_name, res, g):
+def _rar_bwd(n, axis_name, nchunks, res, g):
     # shard_map (check_rep/vma off) hands an mp-replicated output's cotangent
     # back DIVIDED by the mp size; the blocking psum(x @ w) backward restores
     # it through its psum transpose. Issue the identical psum so both paths
@@ -109,8 +201,8 @@ def _rar_bwd(n, axis_name, res, g):
 ring_allreduce_matmul.defvjp(_rar_fwd, _rar_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def ring_allgather_matmul(x, w, n, axis_name):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def ring_allgather_matmul(x, w, n, axis_name, nchunks=1):
     """Column-parallel matmul with the output all-gather decomposed into a
     chunked pipeline.
 
@@ -120,10 +212,11 @@ def ring_allgather_matmul(x, w, n, axis_name):
     [t/n, out/n] block is done it starts riding the ring (n-1 hops to reach
     everyone) while chunk c+1's matmul runs — the hops carry no data
     dependence on later chunks, so the scheduler overlaps transfer with
-    compute. Per-device FLOPs and bytes moved are identical to the fused
+    compute. At ``nchunks`` > 1 each hop additionally moves independent row
+    sub-tiles. Per-device FLOPs and bytes moved are identical to the fused
     path, and every output element is produced by the same x @ w_shard
     product on its owning rank, so the result is bitwise identical to
-    matmul + all-gather at ANY degree.
+    matmul + all-gather at ANY degree (chunked or not).
     """
     r = lax.axis_index(axis_name)
     t = x.shape[0]
@@ -138,19 +231,18 @@ def ring_allgather_matmul(x, w, n, axis_name):
         row0 = jnp.asarray(c * tc, r.dtype)
         out = lax.dynamic_update_slice(out, buf, (row0, r * nc))
         for h in range(1, n):
-            with _obs.comm_span("tp_ring_allgather.hop",
-                                nbytes=buf.size * buf.dtype.itemsize):
-                buf = lax.ppermute(buf, axis_name, fwd)
+            buf = _ring_hop(buf, axis_name, fwd, nchunks,
+                            "tp_ring_allgather.hop")
             out = lax.dynamic_update_slice(
                 out, buf, (row0, ((r - h) % n) * nc))
     return out
 
 
-def _rag_fwd(x, w, n, axis_name):
-    return ring_allgather_matmul(x, w, n, axis_name), (x, w)
+def _rag_fwd(x, w, n, axis_name, nchunks=1):
+    return ring_allgather_matmul(x, w, n, axis_name, nchunks), (x, w)
 
 
-def _rag_bwd(n, axis_name, res, g):
+def _rag_bwd(n, axis_name, nchunks, res, g):
     # blocking backward of all_gather(x @ w, tiled): the gather transpose is a
     # psum_scatter — psum the (1/n-scaled, see _rar_bwd) cotangent and slice
     # the rank's own column block. dx stays per-rank partial; the shard_map
@@ -166,6 +258,83 @@ def _rag_bwd(n, axis_name, res, g):
 
 
 ring_allgather_matmul.defvjp(_rag_fwd, _rag_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def ring_allreduce(x, n, axis_name, nchunks=1):
+    """Plain all-reduce of x [t, ...] decomposed into the same
+    reduce-scatter ring + gather ring as ``ring_allreduce_matmul``, minus
+    the matmul — the reduce surface for non-matmul partials (e.g. the
+    vocab-parallel embedding's masked local lookups). Re-associates the
+    partial-sum order like any ring (fp tolerance at n>2), EXCEPT when the
+    cross-rank addends are disjoint (at most one non-zero contribution per
+    element), where the sum is exact in any dtype and any order."""
+    r = lax.axis_index(axis_name)
+    t = x.shape[0]
+    tc = t // n
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    acc = None
+    for s in range(n):
+        if s > 0:
+            acc = _ring_hop(acc, axis_name, fwd, nchunks,
+                            "ring_allreduce.hop")
+        c = (r - s - 1) % n
+        part = lax.dynamic_slice_in_dim(x, c * tc, tc, 0)
+        acc = part if acc is None else acc + part
+    out = jnp.zeros_like(x)
+    out = lax.dynamic_update_slice_in_dim(out, acc, r * tc, 0)
+    buf = acc
+    for h in range(1, n):
+        buf = _ring_hop(buf, axis_name, fwd, nchunks,
+                        "ring_allreduce.gather_hop")
+        out = lax.dynamic_update_slice_in_dim(out, buf, ((r - h) % n) * tc, 0)
+    return out
+
+
+def _rr_fwd(x, n, axis_name, nchunks=1):
+    return ring_allreduce(x, n, axis_name, nchunks), None
+
+
+def _rr_bwd(n, axis_name, nchunks, res, g):
+    # replicated-output cotangent arrives 1/n-scaled (see _rar_bwd); the
+    # blocking psum's transpose is the same psum
+    return (lax.psum(g, axis_name),)
+
+
+ring_allreduce.defvjp(_rr_fwd, _rr_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def ring_allgather(x, n, axis_name, nchunks=1):
+    """all_gather of x (stacked on a NEW leading axis: [n, ...]) decomposed
+    into a ppermute ring. No cross-rank reduction, so bitwise identical to
+    the fused all_gather at any degree, chunked or not."""
+    r = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    zeros = (jnp.zeros((), r.dtype),) * x.ndim
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = lax.dynamic_update_slice(out, x[None], (r,) + zeros)
+    buf = x
+    for h in range(1, n):
+        buf = _ring_hop(buf, axis_name, fwd, nchunks, "ring_allgather.hop")
+        out = lax.dynamic_update_slice(
+            out, buf[None], (jnp.asarray((r - h) % n, r.dtype),) + zeros)
+    return out
+
+
+def _rg_fwd(x, n, axis_name, nchunks=1):
+    return ring_allgather(x, n, axis_name, nchunks), None
+
+
+def _rg_bwd(n, axis_name, nchunks, res, g):
+    # blocking all_gather transpose: psum the (1/n-scaled) [n, ...]
+    # cotangent and take the rank's own slab — same ops as the fused path.
+    r = lax.axis_index(axis_name)
+    return (lax.dynamic_index_in_dim(lax.psum(g, axis_name), r, 0,
+                                     keepdims=False),)
+
+
+ring_allgather.defvjp(_rg_fwd, _rg_bwd)
 
 
 # blocking references (same island layout, fused collective) — the parity
@@ -184,17 +353,50 @@ def blocking_allgather_matmul(x, w, n, axis_name):
         return lax.all_gather(y, axis_name, axis=1, tiled=True)
 
 
+# named activations for plan_fused_ffn — module-level defs (stable object
+# identity) so memoized plans keyed on the callable actually hit
+def swiglu(g, u):
+    """Llama MLP gate: silu(gate) * up."""
+    return jax.nn.silu(g) * u
+
+
+def gelu_tanh(h):
+    """GPT-2 MLP activation — tanh-approximate gelu, the same jax.nn op
+    F.gelu(approximate=True) lowers to."""
+    return jax.nn.gelu(h, approximate=True)
+
+
 # ---------------------------------------------------------------------------
 # GSPMD embedding: fully-manual islands callable from hint-traced layer code
 # ---------------------------------------------------------------------------
 
 def _batch_axis_spec(mesh, t, batch_axis):
-    """Shard the flattened token dim over ``batch_axis`` when it divides
+    """Shard the flattened token dim over ``batch_axis`` (an axis name or a
+    tuple of axis names) when the product of present axis sizes divides
     cleanly (keeps a dp-sharded batch in place); replicate otherwise."""
-    if batch_axis and batch_axis in mesh.shape and mesh.shape[batch_axis] > 1 \
-            and t % mesh.shape[batch_axis] == 0:
-        return batch_axis
-    return None
+    if not batch_axis:
+        return None
+    axes = (batch_axis,) if isinstance(batch_axis, str) else tuple(batch_axis)
+    axes = tuple(ax for ax in axes
+                 if ax in mesh.shape and mesh.shape[ax] > 1)
+    if not axes:
+        return None
+    deg = 1
+    for ax in axes:
+        deg *= mesh.shape[ax]
+    if t % deg:
+        return None
+    return axes[0] if len(axes) == 1 else axes
+
+
+def _batch_degree(mesh, bax):
+    if bax is None:
+        return 1
+    axes = (bax,) if isinstance(bax, str) else tuple(bax)
+    deg = 1
+    for ax in axes:
+        deg *= mesh.shape[ax]
+    return deg
 
 
 def _island(mesh, body, n, mp_axis, x_spec, w_spec, out_spec):
@@ -204,6 +406,43 @@ def _island(mesh, body, n, mp_axis, x_spec, w_spec, out_spec):
                      check_vma=False)
 
 
+# --- plan memoization -------------------------------------------------------
+# Every parallel layer used to call plan_* on EVERY forward, rebuilding the
+# shard_map island (a new traced callable per call — defeating jit caching of
+# anything keyed on it) and re-bumping the tp.*.plans counters. Plans are
+# pure functions of (shapes, mesh, kwargs) plus the overlap env knobs, so
+# they memoize cleanly; the env values join the key so tests (and users)
+# flipping PADDLE_TPU_TP_OVERLAP_* between calls still get fresh plans.
+
+_PLAN_CACHE = collections.OrderedDict()
+_PLAN_CACHE_MAX = 256
+
+
+def clear_plan_cache():
+    _PLAN_CACHE.clear()
+
+
+def _memoized_plan(fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        key = (fn.__name__, args, tuple(sorted(kwargs.items())),
+               os.environ.get(ENV_MIN_CHUNK), os.environ.get(ENV_CHUNKS))
+        try:
+            hash(key)
+        except TypeError:
+            return fn(*args, **kwargs)  # unhashable arg: build unmemoized
+        if key in _PLAN_CACHE:
+            _PLAN_CACHE.move_to_end(key)
+            return _PLAN_CACHE[key]
+        plan = fn(*args, **kwargs)
+        _PLAN_CACHE[key] = plan
+        if len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+            _PLAN_CACHE.popitem(last=False)
+        return plan
+    return wrapper
+
+
+@_memoized_plan
 def plan_row_parallel(x_shape, w_shape, mesh, mp_axis="mp", batch_axis="dp",
                       kernel=ring_allreduce_matmul):
     """Overlapped row-parallel linear: x [..., k] (k sharded over mp),
@@ -220,11 +459,12 @@ def plan_row_parallel(x_shape, w_shape, mesh, mp_axis="mp", batch_axis="dp",
     for d in x_shape[:-1]:
         t *= d
     bax = _batch_axis_spec(mesh, t, batch_axis)
-    t_loc = t // (mesh.shape[bax] if bax else 1)
+    t_loc = t // _batch_degree(mesh, bax)
     # ring chunks are rows of the LOCAL token block
     if t_loc % n or t_loc // n < min_chunk():
         return None
-    f = _island(mesh, kernel, n, mp_axis,
+    nchunks = resolve_chunks(n, t_loc // n)
+    f = _island(mesh, functools.partial(kernel, nchunks=nchunks), n, mp_axis,
                 P(bax, mp_axis), P(mp_axis, None), P(bax, None))
     _obs.record_counter("tp.row_parallel.plans")
 
@@ -235,6 +475,7 @@ def plan_row_parallel(x_shape, w_shape, mesh, mp_axis="mp", batch_axis="dp",
     return apply
 
 
+@_memoized_plan
 def plan_column_parallel(x_shape, w_shape, mesh, mp_axis="mp",
                          batch_axis="dp", kernel=ring_allgather_matmul):
     """Overlapped column-parallel linear with gathered output: x [..., k]
@@ -250,17 +491,182 @@ def plan_column_parallel(x_shape, w_shape, mesh, mp_axis="mp",
     for d in x_shape[:-1]:
         t *= d
     bax = _batch_axis_spec(mesh, t, batch_axis)
-    t_loc = t // (mesh.shape[bax] if bax else 1)
+    t_loc = t // _batch_degree(mesh, bax)
     # pipeline chunks are row blocks of the LOCAL token dim
     if t_loc % n or t_loc // n < min_chunk():
         return None
-    f = _island(mesh, kernel, n, mp_axis,
+    nchunks = resolve_chunks(n, t_loc // n)
+    f = _island(mesh, functools.partial(kernel, nchunks=nchunks), n, mp_axis,
                 P(bax, None), P(None, mp_axis), P(bax, None))
     _obs.record_counter("tp.column_parallel.plans")
 
     def apply(x, w):
         out = f(x.reshape(t, k), w)
         return out.reshape(tuple(x_shape[:-1]) + (out_f,))
+
+    return apply
+
+
+@_memoized_plan
+def plan_fused_ffn(x_shape, col_shape, row_shape, mesh, n_cols=1,
+                   mp_axis="mp", batch_axis="dp", activation=gelu_tanh,
+                   col_bias=False):
+    """Fused column->activation->row pair inside ONE island that skips the
+    intermediate gather: x [..., k] replicated; ``n_cols`` column weights
+    [k, i] (i sharded over mp); row weight [i, out] (i sharded over mp) ->
+    [..., out] reduced over mp. The local column matmuls and the activation
+    run entirely on the [t, i/n] shard — the only collective is the row
+    matmul's chunked reduce-scatter/gather ring, so the [t, i] activation
+    never rides the wire at all (the unfused pair gathers it or re-enters
+    GSPMD between the layers). Returns apply(x, w_cols, w_row, b_cols), or
+    None when the overlap doesn't apply."""
+    n = mesh.shape.get(mp_axis, 1)
+    if n <= 1:
+        return None
+    k, inter = col_shape
+    inter2, out_f = row_shape
+    if x_shape[-1] != k or inter2 != inter:
+        return None
+    if inter % n or inter // n < min_chunk():
+        return None
+    t = 1
+    for d in x_shape[:-1]:
+        t *= d
+    bax = _batch_axis_spec(mesh, t, batch_axis)
+    t_loc = t // _batch_degree(mesh, bax)
+    if t_loc % n or t_loc // n < min_chunk():
+        return None
+    nchunks = resolve_chunks(n, t_loc // n)
+
+    def body(x, w_cols, w_row, b_cols):
+        with jax.named_scope("tp_fused_ffn.column_matmul"):
+            hs = [x @ w for w in w_cols]
+            if b_cols:
+                hs = [h + b for h, b in zip(hs, b_cols)]
+            h = activation(*hs)
+        return ring_allreduce_matmul(h, w_row, n, mp_axis, nchunks)
+
+    col_specs = (P(None, mp_axis),) * n_cols
+    bias_specs = (P(mp_axis),) * n_cols if col_bias else ()
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P(bax, None), col_specs, P(mp_axis, None),
+                            bias_specs),
+                  out_specs=P(bax, None),
+                  axis_names=frozenset(mesh.axis_names), check_vma=False)
+    _obs.record_counter("tp.fused_ffn.plans")
+
+    def apply(x, w_cols, w_row, b_cols=()):
+        out = f(x.reshape(t, k), tuple(w_cols), w_row, tuple(b_cols))
+        return out.reshape(tuple(x_shape[:-1]) + (out_f,))
+
+    return apply
+
+
+@_memoized_plan
+def plan_vocab_parallel_embedding(ids_shape, table_shape, mesh, mp_axis="mp",
+                                  batch_axis="dp"):
+    """Ring-decomposed vocab-parallel embedding: table [V, H] with V sharded
+    over mp, ids [...] -> [..., H] replicated over mp. Each rank looks up
+    only the ids landing in its vocab slice (masked local gather) and the
+    partial rows ride the chunked reduce ring. Every (b, s) row is non-zero
+    on exactly ONE rank, so the ring sum is exact in any dtype and any
+    association — bitwise against the fused psum. Returns apply(ids, table)
+    or None when the overlap doesn't apply."""
+    n = mesh.shape.get(mp_axis, 1)
+    if n <= 1:
+        return None
+    V, H = table_shape
+    if V % n:
+        return None
+    t = 1
+    for d in ids_shape:
+        t *= d
+    bax = _batch_axis_spec(mesh, t, batch_axis)
+    t_loc = t // _batch_degree(mesh, bax)
+    if t_loc % n or t_loc // n < min_chunk():
+        return None
+    nchunks = resolve_chunks(n, t_loc // n)
+    vs = V // n
+
+    def body(ids, table):
+        r = lax.axis_index(mp_axis)
+        loc = ids.astype(jnp.int32) - r * vs
+        ok = (loc >= 0) & (loc < vs)
+        with jax.named_scope("vocab_embed.local_lookup"):
+            rows = jnp.take(table, jnp.where(ok, loc, 0), axis=0)
+            part = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
+        return ring_allreduce(part, n, mp_axis, nchunks)
+
+    f = shard_map(body, mesh=mesh, in_specs=(P(bax), P(mp_axis, None)),
+                  out_specs=P(bax, None),
+                  axis_names=frozenset(mesh.axis_names), check_vma=False)
+    _obs.record_counter("tp.vocab_embed.plans")
+
+    def apply(ids, table):
+        out = f(ids.reshape(t), table)
+        return out.reshape(tuple(ids_shape) + (H,))
+
+    return apply
+
+
+@_memoized_plan
+def plan_parallel_cross_entropy(logits_shape, mesh, mp_axis="mp",
+                                batch_axis="dp"):
+    """Ring-decomposed softmax CE over mp-sharded logits: per-rank partial
+    (max, sumexp, picked-logit) stats ride a chunked ring all-gather —
+    [n, t, 3] fp32 on the wire instead of the [t, V] logits the blocking
+    logsumexp replicates through its psum — and every rank combines the
+    gathered stats identically (fixed rank order, so the result is
+    rank-independent; vs blocking it matches to fp tolerance, the log-sum
+    is re-associated). The picked logit lives on exactly one rank (zero
+    elsewhere), so its gathered sum is exact. Returns apply(logits, labels)
+    -> [t] loss (no ignore_index masking — the caller masks), or None when
+    the overlap doesn't apply."""
+    n = mesh.shape.get(mp_axis, 1)
+    if n <= 1:
+        return None
+    V = logits_shape[-1]
+    if V % n or V // n < min_chunk():
+        return None
+    t = 1
+    for d in logits_shape[:-1]:
+        t *= d
+    bax = _batch_axis_spec(mesh, t, batch_axis)
+    t_loc = t // _batch_degree(mesh, bax)
+    if t_loc < 1:
+        return None
+    nchunks = resolve_chunks(n, t_loc)
+    vs = V // n
+
+    def body(logits, labels):
+        r = lax.axis_index(mp_axis)
+        l32 = logits.astype(jnp.float32)
+        with jax.named_scope("parallel_ce.local_stats"):
+            m = jnp.max(l32, axis=-1)
+            s = jnp.sum(jnp.exp(l32 - m[..., None]), axis=-1)
+            loc = labels.astype(jnp.int32) - r * vs
+            ok = (loc >= 0) & (loc < vs)
+            picked = jnp.where(
+                ok,
+                jnp.take_along_axis(
+                    l32, jnp.where(ok, loc, 0)[..., None], axis=-1)[..., 0],
+                0.0)
+            stats = jnp.stack([m, s, picked], axis=-1)  # [t, 3]
+        allst = ring_allgather(stats, n, mp_axis, nchunks)  # [n, t, 3]
+        with jax.named_scope("parallel_ce.combine"):
+            ms, ss, ps = allst[..., 0], allst[..., 1], allst[..., 2]
+            gm = jnp.max(ms, axis=0)
+            lse = gm + jnp.log(jnp.sum(ss * jnp.exp(ms - gm), axis=0))
+            return lse - jnp.sum(ps, axis=0)
+
+    f = shard_map(body, mesh=mesh, in_specs=(P(bax, mp_axis), P(bax)),
+                  out_specs=P(bax),
+                  axis_names=frozenset(mesh.axis_names), check_vma=False)
+    _obs.record_counter("tp.parallel_ce.plans")
+
+    def apply(logits, labels):
+        out = f(logits.reshape(t, V), labels.reshape(t).astype(jnp.int32))
+        return out.reshape(tuple(logits_shape[:-1]))
 
     return apply
 

@@ -143,6 +143,55 @@ def test_stage3_params_allgathered_in_hlo():
         "stage 2 must not all-gather params (they are stored full)"
 
 
+def test_stage3_param_prefetch_bitwise():
+    """Bucketed one-ahead param-gather prefetch only re-orders WHEN the
+    stage-3 all-gathers are issued (optimization_barrier chaining +
+    sharding constraints) — the gathered values are identical, so losses
+    must match the non-prefetched step BIT-FOR-BIT."""
+
+    def run(prefetch, spec):
+        model, opt = _make_model_and_opt()
+        model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+        step = TrainStep(model, _loss_fn, opt, mesh=_mesh(),
+                         batch_spec=spec,
+                         param_prefetch=prefetch, param_bucket_mb=0.001)
+        x, y = _batch()
+        return step, [float(step(x, labels=y)) for _ in range(3)]
+
+    step_off, losses_off = run(False, P("dp"))
+    step_on, losses_on = run(True, P("dp"))
+    assert not step_off.param_gather_buckets
+    # the tiny cap actually split the gathers into multiple buckets
+    assert len(step_on.param_gather_buckets) > 1
+    assert losses_on == losses_off
+
+    # with the batch ALSO split over the sharding axis the replication
+    # constraint changes how GSPMD partitions the activations around it
+    # (fp-level reassociation only)
+    _, off2 = run(False, P(("dp", "sharding")))
+    _, on2 = run(True, P(("dp", "sharding")))
+    np.testing.assert_allclose(on2, off2, rtol=1e-6)
+
+
+def test_stage3_prefetch_defaults_to_overlap_env(monkeypatch):
+    """param_prefetch=None follows PADDLE_TPU_TP_OVERLAP, and non-stage-3
+    runs never build gather buckets."""
+    from paddle_tpu.parallel import collective_matmul as cm
+
+    def build(level, **kw):
+        model, opt = _make_model_and_opt()
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+        return TrainStep(model, _loss_fn, opt, mesh=_mesh(),
+                         batch_spec=P(("dp", "sharding")), **kw)
+
+    monkeypatch.setenv(cm.ENV_OVERLAP, "0")
+    assert not build("p_g_os").param_gather_buckets
+    monkeypatch.setenv(cm.ENV_OVERLAP, "1")
+    assert build("p_g_os").param_gather_buckets
+    # stage 2 stores params full: nothing to prefetch even when forced on
+    assert not build("os_g", param_prefetch=True).param_gather_buckets
+
+
 def test_save_group_sharded_model(tmp_path):
     from paddle_tpu.distributed.sharding import save_group_sharded_model
     model, opt = _make_model_and_opt()

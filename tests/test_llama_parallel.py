@@ -78,6 +78,29 @@ def test_hybrid_pp_mp_dp(ref_run):
     np.testing.assert_allclose(a1, l1, rtol=2e-3)
 
 
+def test_dp_mp_tp_overlap_fused_ffn(ref_run, monkeypatch):
+    """dp=2 mp=2 with PADDLE_TPU_TP_OVERLAP=1: the decoder MLP runs the
+    fused column->swiglu->row ring island (tp.fused_ffn.plans must tick)
+    and the losses still match the serial reference at the hybrid
+    tolerance."""
+    from paddle_tpu.observability import trace as obs
+    from paddle_tpu.parallel import collective_matmul as cm
+
+    cfg, ids, labels, l0, l1 = ref_run
+    par = ParallelConfig(dp=2, mp=2, use_flash=False, remat=False)
+    monkeypatch.setenv(cm.ENV_OVERLAP, "1")
+    cm.clear_plan_cache()
+    obs.reset_counters()
+    try:
+        a0, a1 = _run2(cfg, par, ids, labels)
+    finally:
+        cm.clear_plan_cache()
+    assert obs.counters().get("tp.fused_ffn.plans", 0) >= 1, \
+        "fused-FFN overlap island never planned"
+    np.testing.assert_allclose(a0, l0, rtol=2e-4)
+    np.testing.assert_allclose(a1, l1, rtol=2e-3)
+
+
 def test_remat_matches(ref_run):
     cfg, ids, labels, l0, l1 = ref_run
     par = ParallelConfig(use_flash=False, remat=True)

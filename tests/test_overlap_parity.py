@@ -216,6 +216,418 @@ def test_bucket_planning():
     assert plan_grad_buckets(shapes, 128)[0] == ["big"]
 
 
+def test_bucket_planning_edge_cases():
+    from paddle_tpu.distributed.sharding_utils import (bucket_bytes,
+                                                       plan_grad_buckets)
+    # a single oversized grad is its own (only) bucket, not dropped
+    only_big = {"w": ((1000, 1000), 4)}
+    assert plan_grad_buckets(only_big, 128) == [["w"]]
+    assert bucket_bytes(only_big, [["w"]]) == [4_000_000]
+    # empty shapes dict -> no buckets (and bucket_bytes agrees)
+    assert plan_grad_buckets({}, 128) == []
+    assert bucket_bytes({}, []) == []
+    # reverse=False walks FORWARD (param-creation) order — the stage-3
+    # param-gather prefetch planning order
+    fwd = {f"p{i}": ((4, 4), 4) for i in range(4)}
+    assert plan_grad_buckets(fwd, 128, reverse=False) == [
+        ["p0", "p1"], ["p2", "p3"]]
+    # zero-dim (scalar) params: 0 dims -> itemsize bytes, packed normally
+    scalars = {"s0": ((), 4), "s1": ((), 4), "s2": ((), 4)}
+    assert plan_grad_buckets(scalars, 8, reverse=False) == [
+        ["s0", "s1"], ["s2"]]
+    assert bucket_bytes(scalars, [["s0", "s1"], ["s2"]]) == [8, 4]
+
+
+# ---------------------------------------------------------------------------
+# Chunked per-hop ring tiles (mp>2) + the PR-3 overlap surfaces
+# ---------------------------------------------------------------------------
+
+def _tp_loss_grads_chunked(kernel, mesh, n, in_specs, x, w, nchunks):
+    import functools
+    f = shard_map(functools.partial(kernel, n=n, axis_name="mp",
+                                    nchunks=nchunks),
+                  mesh=mesh, in_specs=in_specs, out_specs=P(),
+                  axis_names=frozenset(["mp"]), check_vma=False)
+
+    def loss(a, b):
+        o = f(a, b)
+        return jnp.sum(o * jnp.cos(o)), o
+
+    (l, o), g = jax.jit(
+        jax.value_and_grad(loss, argnums=(0, 1), has_aux=True))(x, w)
+    return (np.asarray(l), np.asarray(o),
+            jax.tree_util.tree_map(np.asarray, g))
+
+
+@needs_devices
+@pytest.mark.parametrize("nchunks", [2, 4])
+def test_chunked_allreduce_ring_bitwise_vs_unchunked(nchunks):
+    """Hop sub-tiling splits transfer granularity only (disjoint row slices
+    reassembled by concat): chunked == unchunked BIT-FOR-BIT at mp=4,
+    forward and backward."""
+    mp = 4
+    mesh = Mesh(np.array(jax.devices("cpu")[:mp]), ("mp",))
+    rng = np.random.RandomState(4)
+    t, k, out = 64, 32 * mp, 48
+    x = jax.device_put(jnp.asarray(rng.randn(t, k), jnp.float32),
+                       NamedSharding(mesh, P(None, "mp")))
+    w = jax.device_put(jnp.asarray(rng.randn(k, out), jnp.float32),
+                       NamedSharding(mesh, P("mp", None)))
+    specs = (P(None, "mp"), P("mp", None))
+    un = _tp_loss_grads_chunked(cm.ring_allreduce_matmul, mesh, mp, specs,
+                                x, w, 1)
+    ch = _tp_loss_grads_chunked(cm.ring_allreduce_matmul, mesh, mp, specs,
+                                x, w, nchunks)
+    assert _leaves_equal(un, ch)
+
+
+@needs_devices
+def test_chunked_allgather_ring_bitwise_vs_blocking():
+    """The all-gather ring has no cross-rank reduction: chunked stays
+    bitwise against the FUSED all-gather at mp=4 (forward and backward)."""
+    mp = 4
+    mesh = Mesh(np.array(jax.devices("cpu")[:mp]), ("mp",))
+    rng = np.random.RandomState(5)
+    t, k, out = 64, 32, 48 * mp
+    x = jnp.asarray(rng.randn(t, k), jnp.float32)
+    w = jax.device_put(jnp.asarray(rng.randn(k, out), jnp.float32),
+                       NamedSharding(mesh, P(None, "mp")))
+    specs = (P(), P(None, "mp"))
+    ch = _tp_loss_grads_chunked(cm.ring_allgather_matmul, mesh, mp, specs,
+                                x, w, 4)
+    blk = _tp_loss_grads(cm.blocking_allgather_matmul, mesh, mp, specs, x, w)
+    assert _leaves_equal(ch, blk)
+
+
+@needs_devices
+def test_mp2_ring_stays_unchunked_and_bitwise():
+    """resolve_chunks pins mp<=2 to one tile per hop, and the mp=2 ring
+    (the bitwise-vs-blocking contract) is unaffected by the chunk knob."""
+    assert cm.resolve_chunks(2, 4096) == 1
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("mp",))
+    rng = np.random.RandomState(6)
+    t, k, out = 64, 64, 48
+    x = jax.device_put(jnp.asarray(rng.randn(t, k), jnp.float32),
+                       NamedSharding(mesh, P(None, "mp")))
+    w = jax.device_put(jnp.asarray(rng.randn(k, out), jnp.float32),
+                       NamedSharding(mesh, P("mp", None)))
+    specs = (P(None, "mp"), P("mp", None))
+    os.environ[cm.ENV_CHUNKS] = "8"
+    try:
+        ring = _tp_loss_grads(cm.ring_allreduce_matmul, mesh, 2, specs, x, w)
+        blk = _tp_loss_grads(cm.blocking_allreduce_matmul, mesh, 2, specs,
+                             x, w)
+    finally:
+        del os.environ[cm.ENV_CHUNKS]
+    assert _leaves_equal(ring, blk)
+
+
+def test_resolve_chunks():
+    # auto: ~min_chunk rows per sub-tile, snapped to a divisor
+    os.environ[cm.ENV_MIN_CHUNK] = "64"
+    try:
+        assert cm.resolve_chunks(4, 256) == 4
+        assert cm.resolve_chunks(4, 64) == 1
+        assert cm.resolve_chunks(8, 96) == 1   # 96//64 -> 1
+        assert cm.resolve_chunks(4, 192) == 3  # 192//64=3 divides
+    finally:
+        del os.environ[cm.ENV_MIN_CHUNK]
+    # explicit knob wins when it divides, falls back to 1 when it doesn't
+    os.environ[cm.ENV_CHUNKS] = "4"
+    try:
+        assert cm.resolve_chunks(4, 256) == 4
+        assert cm.resolve_chunks(4, 6) == 1
+        assert cm.resolve_chunks(2, 256) == 1  # mp=2 always unchunked
+    finally:
+        del os.environ[cm.ENV_CHUNKS]
+    # 'auto'/'' mean auto, not an error
+    os.environ[cm.ENV_CHUNKS] = "auto"
+    try:
+        assert cm.overlap_chunks() is None
+    finally:
+        del os.environ[cm.ENV_CHUNKS]
+
+
+@pytest.mark.parametrize("var,fn", [
+    (cm.ENV_MIN_CHUNK, cm.min_chunk),
+    (cm.ENV_CHUNKS, cm.overlap_chunks),
+])
+@pytest.mark.parametrize("bad", ["banana", "12.5", "0", "-3"])
+def test_env_parsing_rejects_junk(var, fn, bad):
+    """Junk or non-positive values raise a ValueError NAMING the variable,
+    not an opaque int() traceback."""
+    os.environ[var] = bad
+    try:
+        with pytest.raises(ValueError, match=var):
+            fn()
+    finally:
+        del os.environ[var]
+
+
+def test_env_parsing_defaults():
+    os.environ.pop(cm.ENV_MIN_CHUNK, None)
+    os.environ.pop(cm.ENV_CHUNKS, None)
+    assert cm.min_chunk() == 64
+    assert cm.overlap_chunks() is None
+    os.environ[cm.ENV_MIN_CHUNK] = " 32 "
+    try:
+        assert cm.min_chunk() == 32
+    finally:
+        del os.environ[cm.ENV_MIN_CHUNK]
+
+
+@needs_devices
+def test_plans_are_memoized():
+    """Same (shapes, mesh, kwargs, overlap env) -> the SAME plan object (no
+    island rebuild, no tp.*.plans re-count); changing a knob or shape
+    misses."""
+    from paddle_tpu.observability import trace as obs
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("mp",))
+    os.environ[cm.ENV_MIN_CHUNK] = "16"
+    try:
+        cm.clear_plan_cache()
+        obs.reset_counters()
+        p1 = cm.plan_column_parallel((64, 32), (32, 64), mesh)
+        p2 = cm.plan_column_parallel((64, 32), (32, 64), mesh)
+        assert p1 is not None and p1 is p2
+        assert obs.counters().get("tp.column_parallel.plans") == 1
+        p3 = cm.plan_column_parallel((128, 32), (32, 64), mesh)
+        assert p3 is not None and p3 is not p1
+        # env knobs key the cache: flipping MIN_CHUNK must re-plan
+        os.environ[cm.ENV_MIN_CHUNK] = "8"
+        assert cm.plan_column_parallel((64, 32), (32, 64), mesh) is not p1
+        r1 = cm.plan_row_parallel((64, 32), (32, 64), mesh)
+        assert r1 is cm.plan_row_parallel((64, 32), (32, 64), mesh)
+    finally:
+        del os.environ[cm.ENV_MIN_CHUNK]
+        cm.clear_plan_cache()
+
+
+def _fused_ffn_blocking_island(mesh, n, bax=None):
+    """Blocking twin of plan_fused_ffn: same island layout, same local
+    column matmuls + activation, fused psum instead of the ring."""
+    def body(x, w_cols, w_row, b_cols):
+        hs = [x @ w for w in w_cols]
+        if b_cols:
+            hs = [h + b for h, b in zip(hs, b_cols)]
+        h = cm.swiglu(*hs)
+        return jax.lax.psum(h @ w_row, "mp")
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(bax, None), (P(None, "mp"),) * 2, P("mp", None), ()),
+        out_specs=P(bax, None), axis_names=frozenset(mesh.axis_names),
+        check_vma=False)
+
+
+@needs_devices
+@pytest.mark.parametrize("mp", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_fused_ffn_parity(mp):
+    """Single-island column->swiglu->row vs the blocking twin: bitwise at
+    mp=2 (two-term ring sum), fp tolerance at mp=4 (reassociation)."""
+    mesh = Mesh(np.array(jax.devices("cpu")[:mp]), ("mp",))
+    rng = np.random.RandomState(7)
+    t, k, inter = 64, 32, 32 * mp
+    os.environ[cm.ENV_OVERLAP] = "1"
+    os.environ[cm.ENV_MIN_CHUNK] = "8"
+    try:
+        cm.clear_plan_cache()
+        plan = cm.plan_fused_ffn((t, k), (k, inter), (inter, k), mesh,
+                                 n_cols=2, activation=cm.swiglu,
+                                 batch_axis=None)
+        assert plan is not None
+    finally:
+        del os.environ[cm.ENV_OVERLAP]
+        del os.environ[cm.ENV_MIN_CHUNK]
+    x = jnp.asarray(rng.randn(t, k), jnp.float32)
+    wg = jnp.asarray(rng.randn(k, inter) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.randn(k, inter) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.randn(inter, k) * 0.1, jnp.float32)
+    blk = _fused_ffn_blocking_island(mesh, mp)
+
+    def l_ring(a, g, u, d):
+        o = plan(a, (g, u), d)
+        return jnp.sum(o * jnp.cos(o))
+
+    def l_blk(a, g, u, d):
+        o = blk(a, (g, u), d, ())
+        return jnp.sum(o * jnp.cos(o))
+
+    ring = jax.jit(jax.value_and_grad(l_ring, argnums=(0, 1, 2, 3)))(
+        x, wg, wu, wd)
+    ref = jax.jit(jax.value_and_grad(l_blk, argnums=(0, 1, 2, 3)))(
+        x, wg, wu, wd)
+    if mp == 2:
+        assert _leaves_equal(ring, ref)
+    else:
+        for r, b in zip(jax.tree_util.tree_leaves(ring),
+                        jax.tree_util.tree_leaves(ref)):
+            np.testing.assert_allclose(r, b, rtol=1e-3, atol=1e-4)
+
+
+@needs_devices
+def test_vocab_embed_ring_exact():
+    """Masked local lookup + reduce ring: every row is non-zero on exactly
+    one vocab shard, so the ring sum is EXACT (forward bitwise vs dense
+    lookup; table grads match the dense scatter-add)."""
+    mp = 4
+    mesh = Mesh(np.array(jax.devices("cpu")[:mp]), ("mp",))
+    rng = np.random.RandomState(8)
+    V, H, B, S = 32, 16, 4, 16
+    os.environ[cm.ENV_OVERLAP] = "1"
+    os.environ[cm.ENV_MIN_CHUNK] = "8"
+    try:
+        cm.clear_plan_cache()
+        plan = cm.plan_vocab_parallel_embedding((B, S), (V, H), mesh,
+                                                batch_axis=None)
+        assert plan is not None
+    finally:
+        del os.environ[cm.ENV_OVERLAP]
+        del os.environ[cm.ENV_MIN_CHUNK]
+    tab = jnp.asarray(rng.randn(V, H), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+    out = jax.jit(lambda i, w: plan(i, w))(ids, tab)
+    assert np.array_equal(np.asarray(out), np.asarray(tab)[np.asarray(ids)])
+    g_ring = jax.jit(jax.grad(lambda w: jnp.sum(jnp.sin(plan(ids, w)))))(tab)
+    g_ref = jax.jit(jax.grad(lambda w: jnp.sum(jnp.sin(w[ids]))))(tab)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+@needs_devices
+def test_parallel_ce_ring_parity():
+    """Ring-gathered (max, sumexp, picked) stats vs the replicated-logits
+    logsumexp: fp tolerance (the log-sum is re-associated); the picked
+    logit lives on one rank so its gathered sum is exact."""
+    mp = 4
+    mesh = Mesh(np.array(jax.devices("cpu")[:mp]), ("mp",))
+    rng = np.random.RandomState(9)
+    B, S, V = 4, 8, 64
+    os.environ[cm.ENV_OVERLAP] = "1"
+    os.environ[cm.ENV_MIN_CHUNK] = "8"
+    try:
+        cm.clear_plan_cache()
+        plan = cm.plan_parallel_cross_entropy((B, S, V), mesh,
+                                              batch_axis=None)
+        assert plan is not None
+    finally:
+        del os.environ[cm.ENV_OVERLAP]
+        del os.environ[cm.ENV_MIN_CHUNK]
+    logits = jnp.asarray(rng.randn(B, S, V), jnp.float32)
+    lbl = jnp.asarray(rng.randint(0, V, (B, S)), jnp.int32)
+
+    def ref(lg):
+        l32 = lg.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(l32, axis=-1)
+        return lse - jnp.take_along_axis(l32, lbl[..., None], -1)[..., 0]
+
+    loss = jax.jit(lambda lg: plan(lg, lbl))(logits)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref(logits)),
+                               rtol=1e-5, atol=1e-6)
+    g1 = jax.jit(jax.grad(lambda lg: jnp.sum(plan(lg, lbl))))(logits)
+    g2 = jax.jit(jax.grad(lambda lg: jnp.sum(ref(lg))))(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _gpt2_mlp_losses(overlap):
+    """Train a lone GPT2MLP through TrainStep at mp=2 (the same harness the
+    fleet parity tests use) with the fused-FFN island on or off."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt2 import GPT2Config, GPT2MLP
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.set_device("cpu")
+    if overlap:
+        os.environ[cm.ENV_OVERLAP] = "1"
+        os.environ[cm.ENV_MIN_CHUNK] = "8"
+    cm.clear_plan_cache()
+    try:
+        paddle.seed(13)
+        cfg = GPT2Config(vocab_size=64, hidden_size=32, num_layers=1,
+                         num_heads=2, max_position=32, intermediate_size=64,
+                         dropout=0.0)
+        model = GPT2MLP(cfg)
+        opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]).reshape(1, 2),
+                    ("dp", "mp"))
+        step = TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt,
+                         mesh=mesh, batch_spec=P("dp"))
+        rng = np.random.RandomState(10)
+        x = paddle.to_tensor(rng.randn(4, 16, 32).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 16, 32).astype(np.float32))
+        return [float(step(x, labels=y)) for _ in range(3)]
+    finally:
+        if overlap:
+            del os.environ[cm.ENV_OVERLAP]
+            del os.environ[cm.ENV_MIN_CHUNK]
+        cm.clear_plan_cache()
+
+
+@needs_devices
+def test_gpt2_mlp_fused_overlap_matches_blocking():
+    """GPT2MLP trained through TrainStep must produce the same losses with
+    the fused-FFN island on vs off at mp=2 (bitwise ring degree; only fp
+    noise from GSPMD partitioning differences is tolerated)."""
+    base = _gpt2_mlp_losses(False)
+    fused = _gpt2_mlp_losses(True)
+    np.testing.assert_allclose(fused, base, rtol=2e-6, atol=1e-7)
+
+
+def _sp_ffn_losses(overlap):
+    """Column->gelu->Row SP pair through fused_sequence_parallel_ffn, fused
+    island on (overlap env) or the layer-by-layer fallback."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+    from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import \
+        fused_sequence_parallel_ffn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import AdamW
+
+    class SPBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc_in = ColumnParallelLinear(32, 64, gather_output=False)
+            self.fc_out = RowParallelLinear(64, 32, input_is_parallel=True)
+
+        def forward(self, x):
+            return fused_sequence_parallel_ffn(self.fc_in, self.fc_out, x)
+
+    paddle.set_device("cpu")
+    if overlap:
+        os.environ[cm.ENV_OVERLAP] = "1"
+        os.environ[cm.ENV_MIN_CHUNK] = "8"
+    cm.clear_plan_cache()
+    try:
+        paddle.seed(17)
+        model = SPBlock()
+        opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]).reshape(1, 2),
+                    ("dp", "mp"))
+        step = TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt,
+                         mesh=mesh, batch_spec=P("dp"))
+        rng = np.random.RandomState(18)
+        x = paddle.to_tensor(rng.randn(4, 16, 32).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 16, 32).astype(np.float32))
+        return [float(step(x, labels=y)) for _ in range(3)]
+    finally:
+        if overlap:
+            del os.environ[cm.ENV_OVERLAP]
+            del os.environ[cm.ENV_MIN_CHUNK]
+        cm.clear_plan_cache()
+
+
+@needs_devices
+def test_sequence_parallel_fused_ffn_matches_fallback():
+    """fused_sequence_parallel_ffn: the single-island route must match the
+    layer-by-layer SP fallback at mp=2."""
+    base = _sp_ffn_losses(False)
+    fused = _sp_ffn_losses(True)
+    np.testing.assert_allclose(fused, base, rtol=2e-6, atol=1e-7)
+
+
 # ---------------------------------------------------------------------------
 # PP: async-p2p schedule vs blocking schedule
 # ---------------------------------------------------------------------------
