@@ -22,6 +22,21 @@ def mosaic_trace_ctx():
     return enable_x64(False)
 
 
+def cost_estimate(flops, transcendentals=0, bytes_accessed=0):
+    """``pl.CostEstimate`` for a ``pallas_call`` site, clamped to ints.
+
+    Without it, XLA costs a custom call at zero FLOPs, so StepMetrics MFU
+    (observability) under-reports every kernel-backed step. Values are
+    ESTIMATES for attribution, not exact op counts — kernels pass the
+    matmul/exp/traffic totals of the tile schedule they actually run
+    (live tiles only for the varlen flat schedules). The AST lint
+    tests/test_pallas_cost_lint.py keeps every kernel site honest."""
+    from jax.experimental import pallas as pl
+    return pl.CostEstimate(flops=max(int(flops), 0),
+                           transcendentals=max(int(transcendentals), 0),
+                           bytes_accessed=max(int(bytes_accessed), 0))
+
+
 def interpret_mode() -> bool:
     """Pallas kernels must run interpreted off-TPU. The axon TPU plugin stays
     the default backend even when work is pinned to host CPU devices (tests,

@@ -45,6 +45,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import cost_estimate as _cost_estimate
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
 from .flash_attention import softmax_mode
@@ -54,6 +55,29 @@ _LOG2E = 1.4426950408889634
 # lanes per T tile: 512 bf16 lanes x KVD sublanes keeps each DMA big
 # enough to stream at full HBM rate while bounding VMEM at long caches
 DECODE_BLOCK_T = 512
+
+# cap on the double-buffered k+v cache windows of one grid step
+# (4 * block_t * per_lane_bytes must fit): the fixed 512-lane tile
+# overflowed scoped VMEM for WIDE slabs — hd64 b8 (b=8, kvd=1024 bf16,
+# 16 KB/lane) wants 32 MB of windows at 512 lanes vs the ~16 MB default
+# window. 12 MB leaves headroom for q/scratch/out and compiler temps.
+_DECODE_WINDOW_BUDGET = 12 * 1024 * 1024
+
+
+def _fit_block_t(T, per_lane_bytes):
+    """Lanes per T tile: short caches take 128 (the pos-clamp skips
+    dead-tile DMA at tile granularity, so finer tiles track the live
+    prefix closely — a [KVD, 128] bf16 tile is still a full-rate DMA);
+    long caches start at DECODE_BLOCK_T and HALVE until the
+    double-buffered k+v windows fit the VMEM budget, then halve again
+    until the extent divides (cache extents are 128-multiples, so 128
+    always divides)."""
+    lanes = 128 if T <= 2048 else DECODE_BLOCK_T
+    while lanes > 128 and 4 * lanes * per_lane_bytes > _DECODE_WINDOW_BUDGET:
+        lanes //= 2
+    while T % lanes:
+        lanes //= 2
+    return lanes
 
 
 def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
@@ -132,19 +156,15 @@ def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
 
 
 
-def _tile_plan(T, layer, pos):
+def _tile_plan(T, layer, pos, per_lane_bytes):
     """Shared tiling prologue for both slab kernels: (block_t, n_t, lp,
     live_map) or None for ragged (non-128-multiple) cache extents —
-    ONE copy so the two entry points can never diverge in tiling."""
+    ONE copy so the two entry points can never diverge in tiling.
+    per_lane_bytes = b * kvd * cache-itemsize, the bytes one T lane
+    contributes to a cache window (_fit_block_t sizes against it)."""
     if T % 128:
         return None
-    # small tiles for short caches: the pos-clamp skips dead-tile DMA at
-    # tile granularity, so finer tiles track the live prefix closely
-    # (a [KVD, 128] bf16 tile is 256KB — still a full-rate DMA); larger
-    # caches take 512 lanes to bound grid length
-    block_t = 128 if T <= 2048 else DECODE_BLOCK_T
-    while T % block_t:
-        block_t //= 2
+    block_t = _fit_block_t(T, per_lane_bytes)
     lp = jnp.stack([jnp.asarray(layer, jnp.int32),
                     jnp.asarray(pos, jnp.int32)])
 
@@ -279,7 +299,8 @@ def decode_attend_update_slab(q_bd, new_k, new_v, k_cache, v_cache,
     otherwise). Returns (attn [B, NH, KVD] f32, k_cache, v_cache)."""
     b, nh, kvd = q_bd.shape
     L, _, _, T = k_cache.shape
-    plan = _tile_plan(T, layer, pos)
+    it = jnp.dtype(k_cache.dtype).itemsize
+    plan = _tile_plan(T, layer, pos, b * kvd * it)
     if plan is None:
         return None
     block_t, n_t, lp, live_map = plan
@@ -321,6 +342,10 @@ def decode_attend_update_slab(q_bd, new_k, new_v, k_cache, v_cache,
             # operand indices count scalar-prefetch first: 0=lp, 1=q,
             # 2=new_k, 3=new_v, 4=k_cache, 5=v_cache
             input_output_aliases={4: 1, 5: 2},
+            cost_estimate=_cost_estimate(
+                flops=4 * b * nh * kvd * T,
+                transcendentals=b * nh * T,
+                bytes_accessed=2 * b * kvd * (T + block_t) * it),
             interpret=_interpret(),
         )(lp, q_bd, new_k, new_v, k_cache, v_cache)
     return out, kc, vc
@@ -334,7 +359,8 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
     128-multiple (caller falls back to its XLA path)."""
     b, nh, kvd = q_bd.shape
     L, _, _, T = k_cache.shape
-    plan = _tile_plan(T, layer, pos)
+    it = jnp.dtype(k_cache.dtype).itemsize
+    plan = _tile_plan(T, layer, pos, b * kvd * it)
     if plan is None:
         return None  # ragged cache: caller falls back to the XLA path
     block_t, n_t, lp, live_map = plan
@@ -362,6 +388,12 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
                 ],
             ),
             out_shape=jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+            # block-diagonal padded FLOPs are real MXU work (decode is
+            # bytes-bound, so they are free in time but not in count)
+            cost_estimate=_cost_estimate(
+                flops=4 * b * b * nh * kvd * T,
+                transcendentals=b * nh * T,
+                bytes_accessed=2 * b * kvd * T * it),
             interpret=_interpret(),
         )(lp, q_bd, k_cache, v_cache)
     return out

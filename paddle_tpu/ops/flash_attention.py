@@ -41,6 +41,7 @@ _LOG2E = 1.4426950408889634
 _LN2 = 0.6931471805599453
 
 
+from ._common import cost_estimate as _cost_estimate
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
 from .._compat import tpu_compiler_params as _tpu_compiler_params
@@ -51,6 +52,17 @@ def _fit_block(block, n):
     requires lane-tile-aligned vector loads; min(block, n) could yield e.g.
     300 which fails to legalize)."""
     return min(block, -(-n // 128) * 128)
+
+
+def _attn_cost(bh, sp, skp, d, itemsize, causal, matmuls, extra_bytes=0):
+    """pl.CostEstimate for a dense-attention kernel: `matmuls` [Sq, Sk]·D
+    contractions over the (clamped-to-half under causal) score area, one
+    exp per score, and the q/k/v/o-sized HBM traffic."""
+    cf = 0.5 if causal else 1.0
+    return _cost_estimate(
+        flops=matmuls * 2 * bh * sp * skp * d * cf,
+        transcendentals=bh * sp * skp * cf,
+        bytes_accessed=bh * (2 * sp + 2 * skp) * d * itemsize + extra_bytes)
 
 
 def _pad_rows(x, multiple):
@@ -498,6 +510,8 @@ def _flash_fwd_stream(qp, kp, vp, causal, block_q, block_k, sk,
                 pltpu.VMEM((block_q, 128), jnp.float32),
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
+            cost_estimate=_attn_cost(bh, sp, skp, d, qp.dtype.itemsize,
+                                     causal, matmuls=2),
             interpret=_interpret(),
         )(*args)
 
@@ -566,6 +580,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
                 jax.ShapeDtypeStruct(qp.shape, q.dtype),
                 jax.ShapeDtypeStruct((bh, 1, sp), jnp.float32),
             ],
+            cost_estimate=_attn_cost(bh, sp, skp, d, q.dtype.itemsize,
+                                     causal, matmuls=2),
             interpret=_interpret(),
         )(*args)
     return o[:, :s], lse.reshape(bh, sp)[:, :s]
@@ -938,6 +954,9 @@ def _bwd_fused_stream_chunk(qp, kp, vp, dop, lse3, delta3, causal,
             # scratch and halves the dq-partial traffic vs bkdma=2048
             compiler_params=_tpu_compiler_params(
                 vmem_limit_bytes=48 * 1024 * 1024),
+            cost_estimate=_attn_cost(
+                bh, sp, skp, d, qp.dtype.itemsize, causal, matmuls=5,
+                extra_bytes=n_k * bh * sp * d * qp.dtype.itemsize),
             interpret=_interpret(),
         )(*args)
     # Σ_j ds̃·K (scale applied by the caller after cross-chunk
@@ -1023,6 +1042,8 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                     jax.ShapeDtypeStruct(kp.shape, kp.dtype),
                     jax.ShapeDtypeStruct(vp.shape, vp.dtype),
                 ],
+                cost_estimate=_attn_cost(bh, sp, skp, d, item, causal,
+                                         matmuls=4),
                 interpret=_interpret(),
             )(*args)
 
@@ -1051,6 +1072,8 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                 out_specs=pl.BlockSpec((1, block_q, d),
                                        lambda b, i: (b, i, 0)),
                 out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
+                cost_estimate=_attn_cost(bh, sp, skp, d, item, causal,
+                                         matmuls=3),
                 interpret=_interpret(),
             )(*args)
     return dq, dk, dv

@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .._compat import tpu_compiler_params as _tpu_compiler_params
+from ._common import cost_estimate as _cost_estimate
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
 from .flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, _LN2, \
@@ -68,13 +70,6 @@ def _live_col_tiles(cu_rows, cu_cols, n_tiles, block_rows, block_cols,
     hi = ((jnp.maximum(cu_cols[seg1 + 1], cu_cols[seg1] + 1) - 1)
           // block_cols).astype(jnp.int32)
     return lo, jnp.maximum(hi, lo)
-
-
-def _clamped_col(lo, hi, i, j):
-    """Column tile for inner-grid step j of row tile i: lo[i] + j clamped
-    to hi[i] — steps beyond the live range re-present the hi tile, so
-    Mosaic skips their DMA; the kernel gates their compute."""
-    return jnp.minimum(lo[i] + j, hi[i])
 
 
 def _tile_mask(s, cq_ref, ck_ref, causal):
@@ -160,34 +155,140 @@ def _fwd_kernel_varlen(qi_ref, ki_ref, first_ref, last_ref, live_ref,
     def _finalize():
         m = m_s[:, :1]
         l = l_s[:, :1]
-        o_ref[0] = (acc_s[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30))).T
+        # a row with NO live key (cross-attn q segment whose k side is
+        # empty) ends with m == -1e30 (the mask overwrite value): its
+        # online softmax degenerated to p=1 over masked slots. Its true
+        # output is all-padding -> 0, and its lse must be a value that
+        # makes the backward's p = exp(s + bias - lse) vanish (bias is
+        # -1e30, so any lse >> -1e30 does; 0 keeps it finite).
+        dead = m <= -1e29
+        o_ref[0] = jnp.where(
+            dead, 0.0,
+            acc_s[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            dead, 0.0, m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
-def _bwd_dkv_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
-                           lse_ref, delta_ref, cq_ref, ck_ref, dk_ref,
-                           dv_ref, dk_s, dv_s, *, block_q, causal, scale,
-                           n_q, self_attn):
-    """Streaming dK/dV: grid (H, n_k, n_q); same split-kernel FA2
-    shape the dense backward used before its fused rewrite (see
-    flash_attention._bwd_fused_kernel_stream), with the code mask. lo/hi are
-    the live Q-tile bounds per k tile (causal start folded in by the
-    caller). Padding q rows need no mask: their do (and hence delta) are
-    zero-padded, so their contributions to dk/dv vanish identically."""
+def _bwd_bounds(cu_q, cu_k, n_k, block_q, block_k, tk, causal, self_attn):
+    """Live Q-tile [lo, hi] per K tile (the backward's k-major
+    orientation), with the causal START folded in for self-attention
+    packing: k tile j only receives gradient from q rows at or past its
+    own diagonal, so the live run begins at max(segment start,
+    (j*block_k)//block_q). For self-attention this is EXACTLY the
+    transpose of _fwd_bounds' live set (j*block_k <= (i+1)*block_q - 1
+    iff (j*block_k)//block_q <= i), so the flat backward walks the same
+    live pairs as the forward, k-major."""
+    lo, hi = _live_col_tiles(cu_k, cu_q, n_k, block_k, block_q, tk)
+    if causal and self_attn:
+        j = jnp.arange(n_k, dtype=jnp.int32)
+        lo = jnp.maximum(lo, ((j * block_k) // block_q).astype(jnp.int32))
+        hi = jnp.maximum(hi, lo)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _bwd_fused_kernel_varlen(ki_ref, qi_ref, first_ref, last_ref, live_ref,
+                             q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             delta_ref, cq_ref, ck_ref, dq_ref, dk_ref,
+                             dv_ref, dk_s, dv_s, dq_s, *, causal, scale,
+                             nh, block_q, block_k, tp):
+    """Fused dK/dV/dQ in ONE streaming pass per live tile: FLAT grid
+    (H/nh, n_flat) in k-major order (_flat_schedule over the per-k-tile
+    live q ranges), the varlen analogue of the dense path's
+    _bwd_fused_kernel_stream. Each live (k-tile, q-tile) pair fetches
+    q/do/lse/delta and k/v ONCE and runs all five matmuls (s, dv, dp,
+    dk, dq) — the split two-kernel scheme fetched every block twice and
+    ran seven matmuls (s and dp recomputed in the dq kernel).
+
+    This is also the rows-stacked head-fusion port to the backward
+    (cf. _fwd_kernel_varlen_stacked): `nh` heads ride one grid step, the
+    segment mask is built ONCE per step as an additive f32 bias (it is
+    head-independent), and short-segment packs amortize the per-step
+    fixed cost across heads. Adding -1e30 to a finite masked score is
+    bitwise-identical in f32 to overwriting it with -1e30 (|s| < 1e23
+    is absorbed; +0.0 is exact), so the fused kernel matches the split
+    kernels bit-for-bit at equal block sizes.
+
+    dK/dV accumulate in scratch across a k tile's consecutive live steps
+    (first/last flags) exactly like the split kernel. dQ accumulates in
+    a PERSISTENT full-length scratch (dq_s, [nh*tp, d] f32, zeroed once
+    at step 0): a q tile's steps are NOT consecutive in k-major order,
+    so the running partial is re-written to the dq out block on every
+    live step — the grid is sequential, so the final write-back of each
+    presented block (the tile's LAST visit) carries the complete sum.
+    Padding q rows need no epilogue: their do/delta are zero-padded, so
+    dk/dv contributions vanish; pad k columns mask against every real q
+    row via the codes."""
     import numpy as np
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    bk = k_ref.shape[1]
-    bq_i, bk_i = np.int32(block_q), np.int32(bk)
+    s_idx = pl.program_id(1)
+    bq = np.int32(block_q)
 
-    @pl.when(qi == 0)
+    @pl.when(s_idx == 0)
+    def _init_dq():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    @pl.when(first_ref[s_idx] == 1)
+    def _init_dkv():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    @pl.when(live_ref[s_idx] == 1)
+    def _compute():
+        qi = qi_ref[s_idx]
+        cq = cq_ref[:, :1]
+        ck = ck_ref[:1, :]
+        same = (cq ^ ck) < POS_LIMIT
+        ok = same & (cq >= ck) if causal else same
+        bias = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)
+        for hh in range(nh):
+            qb = q_ref[hh]
+            kb = k_ref[hh]
+            vb = v_ref[hh]
+            dob = do_ref[hh]
+            lseb = lse_ref[hh, 0, :]
+            deltab = delta_ref[hh, 0, :]
+            sl = slice(hh * block_k, (hh + 1) * block_k)
+            s = jnp.dot(qb, kb.T,
+                        preferred_element_type=jnp.float32) * scale + bias
+            p = jnp.exp(s - lseb[:, None])
+            p_lo = p.astype(vb.dtype)
+            dv_s[sl] = dv_s[sl] + jnp.dot(
+                p_lo.T, dob, preferred_element_type=jnp.float32)
+            dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - deltab[:, None]) * scale).astype(vb.dtype)
+            dk_s[sl] = dk_s[sl] + jnp.dot(
+                ds.T, qb, preferred_element_type=jnp.float32)
+            row = qi * bq + np.int32(hh * tp)
+            dq_new = dq_s[pl.ds(row, block_q), :] + jnp.dot(
+                ds, kb, preferred_element_type=jnp.float32)
+            dq_s[pl.ds(row, block_q), :] = dq_new
+            dq_ref[hh] = dq_new.astype(dq_ref.dtype)
+
+    @pl.when(last_ref[s_idx] == 1)
+    def _flush_dkv():
+        for hh in range(nh):
+            sl = slice(hh * block_k, (hh + 1) * block_k)
+            dk_ref[hh] = dk_s[sl].astype(dk_ref.dtype)
+            dv_ref[hh] = dv_s[sl].astype(dv_ref.dtype)
+
+
+def _bwd_dkv_flat_kernel(ki_ref, qi_ref, first_ref, last_ref, live_ref,
+                         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         cq_ref, ck_ref, dk_ref, dv_ref, dk_s, dv_s, *,
+                         causal, scale):
+    """Split-kernel dK/dV on the FLAT k-major live-tile schedule: grid
+    (H, n_flat), one live (k-tile, q-tile) pair per step. Fallback for
+    shapes where the fused kernel's persistent dQ scratch does not fit
+    scoped VMEM (_bwd_fused_nh == 0 — very long packed streams); still
+    skips every dead tile the old rectangular (H, n_k, n_q) grid burned
+    a predicated step on."""
+    s_idx = pl.program_id(1)
+
+    @pl.when(first_ref[s_idx] == 1)
     def _init():
         dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
         dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
 
-    needed = qi <= hi_ref[ki] - lo_ref[ki]
-
-    @pl.when(needed)
+    @pl.when(live_ref[s_idx] == 1)
     def _compute():
         k = k_ref[0]
         v = v_ref[0]
@@ -206,31 +307,25 @@ def _bwd_dkv_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
         dk_s[...] = dk_s[...] + jnp.dot(ds.T, qb,
                                         preferred_element_type=jnp.float32)
 
-    @pl.when(qi == np.int32(n_q - 1))
+    @pl.when(last_ref[s_idx] == 1)
     def _finalize():
         dk_ref[0] = dk_s[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
-                          lse_ref, delta_ref, cq_ref, ck_ref, dq_ref, dq_s,
-                          *, block_k, causal, scale, n_k, self_attn):
-    """Streaming dQ: grid (H, n_q, n_k); split-kernel FA2 dQ (cf.
-    flash_attention._bwd_fused_kernel_stream) with the code mask; lo/hi are
-    the live k-tile bounds per q tile."""
-    import numpy as np
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    bq = q_ref.shape[1]
-    bq_i, bk_i = np.int32(bq), np.int32(block_k)
+def _bwd_dq_flat_kernel(qi_ref, ki_ref, first_ref, last_ref, live_ref,
+                        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        cq_ref, ck_ref, dq_ref, dq_s, *, causal, scale):
+    """Split-kernel dQ on the FLAT q-major live-tile schedule (the same
+    _flat_schedule arrays the forward runs): grid (H, n_flat). Fallback
+    companion of _bwd_dkv_flat_kernel."""
+    s_idx = pl.program_id(1)
 
-    @pl.when(ki == 0)
+    @pl.when(first_ref[s_idx] == 1)
     def _init():
         dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
 
-    needed = ki <= hi_ref[qi] - lo_ref[qi]
-
-    @pl.when(needed)
+    @pl.when(live_ref[s_idx] == 1)
     def _compute():
         qb = q_ref[0]
         dob = do_ref[0]
@@ -246,9 +341,46 @@ def _bwd_dq_kernel_varlen(lo_ref, hi_ref, q_ref, k_ref, v_ref, do_ref,
         dq_s[...] = dq_s[...] + jnp.dot(ds, kb,
                                         preferred_element_type=jnp.float32)
 
-    @pl.when(ki == np.int32(n_k - 1))
+    @pl.when(last_ref[s_idx] == 1)
     def _finalize():
         dq_ref[0] = dq_s[...].astype(dq_ref.dtype)
+
+
+# Scoped-VMEM plan for the fused backward. The persistent dQ accumulator
+# (nh * padded_total_q rows of f32) is the big consumer, so the head
+# grouping is fitted per SHAPE, not just per dtype; the Mosaic scoped-
+# VMEM window is raised accordingly (the dense fused backward already
+# runs at 48 MB — see flash_attention._bwd_fused_stream_chunk).
+_FUSED_BWD_VMEM_BUDGET = 52 * 1024 * 1024
+_BWD_VMEM_LIMIT = 80 * 1024 * 1024
+
+
+def _bwd_fused_vmem_bytes(nh, itemsize, bq, bk, d, tp):
+    """Estimated scoped-VMEM footprint of one fused-backward grid step:
+    f32 scratch (persistent dq + dk/dv accumulators) plus double-buffered
+    in/out blocks."""
+    scratch = 4 * (nh * tp * d + 2 * nh * bk * d)
+    blocks = (2 * nh * bq * d * itemsize      # q, do
+              + 2 * nh * bk * d * itemsize    # k, v
+              + 2 * nh * bq * 4               # lse, delta
+              + bq * 128 * 4 + 8 * bk * 4     # code tiles
+              + nh * bq * d * itemsize        # dq
+              + 2 * nh * bk * d * itemsize)   # dk, dv
+    temps = 4 * bq * bk * 4                   # s/p/dp/ds tiles
+    return scratch + 2 * blocks + temps
+
+
+def _bwd_fused_nh(h, itemsize, d, bq, bk, tp):
+    """Heads fused per fused-backward grid step: largest power-of-two
+    divisor of h whose footprint (incl. the [nh*tp, d] persistent dQ
+    scratch) fits the budget. Returns 0 when not even nh=1 fits — the
+    caller falls back to the split flat kernels, which stream dQ through
+    a per-tile scratch instead."""
+    for cand in (8, 4, 2, 1):
+        if h % cand == 0 and _bwd_fused_vmem_bytes(
+                cand, itemsize, bq, bk, d, tp) <= _FUSED_BWD_VMEM_BUDGET:
+            return cand
+    return 0
 
 
 def _fwd_kernel_varlen_stacked(qi_ref, ki_ref, first_ref, last_ref, live_ref,
@@ -366,6 +498,7 @@ def _flash_varlen_fwd_stacked(q, k, v, cu_q, causal, scale, block_q,
     h, t, d = q.shape
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, t)
+    it = jnp.dtype(q.dtype).itemsize
     q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     qp, _ = _pad_rows(q, block_q)
     kp, _ = _pad_rows(k, block_k)
@@ -420,6 +553,11 @@ def _flash_varlen_fwd_stacked(q, k, v, cu_q, causal, scale, block_q,
                 jax.ShapeDtypeStruct(qp.shape, q.dtype),
                 jax.ShapeDtypeStruct((h, 1, tp), jnp.float32),
             ],
+            cost_estimate=_cost_estimate(
+                flops=4 * h * n_flat * block_q * block_k * d,
+                transcendentals=h * n_flat * block_q * block_k,
+                bytes_accessed=(h * n_flat * (block_q + 2 * block_k) * d
+                                * it + h * tp * d * it)),
             interpret=_interpret(),
         )(qi_a, ki_a, first_a, last_a, live_a, qp, kp, vp, cq2d, ck2d)
     return o[:, :t], lse.reshape(h, tp)[:, :t]
@@ -453,9 +591,10 @@ def _codes_from_cu(cu, total):
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _flash_varlen(q, k, v, cu_q, cu_k, causal, scale, block_q, block_k,
-                  self_attn, max_seqlen, n_flat_hint=None, stacked=False):
+                  self_attn, max_seqlen, n_flat_hint=None, stacked=False,
+                  n_flat_bwd_hint=None):
     o, _ = _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale,
                                   block_q, block_k, self_attn, max_seqlen,
                                   n_flat_hint, stacked)
@@ -503,6 +642,7 @@ def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
                                          n_flat_hint)
     h, t, d = q.shape
     tk = k.shape[1]
+    it = jnp.dtype(q.dtype).itemsize
     if not self_attn:
         max_seqlen = None  # the static span bound is unsound cross-attn
     block_q = _fit_block(block_q, t)
@@ -563,6 +703,11 @@ def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
                 jax.ShapeDtypeStruct(qp.shape, q.dtype),
                 jax.ShapeDtypeStruct((h, 1, tp), jnp.float32),
             ],
+            cost_estimate=_cost_estimate(
+                flops=4 * h * n_flat * block_q * block_k * d,
+                transcendentals=h * n_flat * block_q * block_k,
+                bytes_accessed=(h * n_flat * (block_q + 2 * block_k) * d
+                                * it + h * tp * d * it)),
             interpret=_interpret(),
         )(qi_a, ki_a, first_a, last_a, live_a, qp, kp, vp, cq2d, ck2d)
     return o[:, :t], lse.reshape(h, tp)[:, :t]
@@ -570,20 +715,100 @@ def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
 
 def _flash_varlen_fwd(q, k, v, cu_q, cu_k, causal, scale, block_q,
                       block_k, self_attn, max_seqlen, n_flat_hint=None,
-                      stacked=False):
+                      stacked=False, n_flat_bwd_hint=None):
     o, lse = _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale,
                                     block_q, block_k, self_attn, max_seqlen,
                                     n_flat_hint, stacked)
     return o, (q, k, v, cu_q, cu_k, o, lse)
 
 
+def _bwd_fused_call(qp, kp, vp, dop, lse3, delta3, cq2d, ck2d, ki_a, qi_a,
+                    first_a, last_a, live_a, n_flat, nh, block_q, block_k,
+                    causal, scale):
+    """pallas_call plumbing for _bwd_fused_kernel_varlen: grid
+    (H/nh, n_flat), five scalar-prefetched schedule arrays feeding every
+    index map, nh heads per block."""
+    h, tp, d = qp.shape
+    tkp = kp.shape[1]
+    it = jnp.dtype(qp.dtype).itemsize
+    kernel = functools.partial(_bwd_fused_kernel_varlen, causal=causal,
+                               scale=scale, nh=nh, block_q=block_q,
+                               block_k=block_k, tp=tp)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(h // nh, n_flat),
+            in_specs=[
+                pl.BlockSpec((nh, block_q, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, qi[s], 0)),
+                pl.BlockSpec((nh, block_k, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, ki[s], 0)),
+                pl.BlockSpec((nh, block_k, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, ki[s], 0)),
+                pl.BlockSpec((nh, block_q, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, qi[s], 0)),
+                pl.BlockSpec((nh, 1, block_q),
+                             lambda g, s, ki, qi, f, l, lv: (g, 0, qi[s])),
+                pl.BlockSpec((nh, 1, block_q),
+                             lambda g, s, ki, qi, f, l, lv: (g, 0, qi[s])),
+                pl.BlockSpec((block_q, 128),
+                             lambda g, s, ki, qi, f, l, lv: (qi[s], 0)),
+                pl.BlockSpec((8, block_k),
+                             lambda g, s, ki, qi, f, l, lv: (0, ki[s])),
+            ],
+            out_specs=[
+                pl.BlockSpec((nh, block_q, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, qi[s], 0)),
+                pl.BlockSpec((nh, block_k, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, ki[s], 0)),
+                pl.BlockSpec((nh, block_k, d),
+                             lambda g, s, ki, qi, f, l, lv: (g, ki[s], 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((nh * block_k, d), jnp.float32),
+                pltpu.VMEM((nh * block_k, d), jnp.float32),
+                pltpu.VMEM((nh * tp, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, qp.dtype),
+            jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+            jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+        ],
+        compiler_params=_tpu_compiler_params(
+            vmem_limit_bytes=_BWD_VMEM_LIMIT),
+        cost_estimate=_cost_estimate(
+            flops=10 * h * n_flat * block_q * block_k * d,
+            transcendentals=h * n_flat * block_q * block_k,
+            bytes_accessed=(2 * h * n_flat * (block_q + block_k) * d * it
+                            + h * (tp + 2 * tkp) * d * it)),
+        interpret=_interpret(),
+    )(ki_a, qi_a, first_a, last_a, live_a, qp, kp, vp, dop, lse3, delta3,
+      cq2d, ck2d)
+
+
 def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
-                      max_seqlen, n_flat_hint, stacked, res, do):
+                      max_seqlen, n_flat_hint, stacked, n_flat_bwd_hint,
+                      res, do):
+    """Flat-scheduled varlen backward: one k-major live-tile schedule
+    drives a FUSED dK/dV/dQ kernel when the persistent dQ scratch fits
+    VMEM (_bwd_fused_nh), else the split flat kernels (dK/dV k-major,
+    dQ on the forward's q-major schedule). Either way every grid step is
+    a live (q-tile, k-tile) pair — the old rectangular (H, n_k, n_q) /
+    (H, n_q, n_k) grids burned a fixed-cost predicated step on every
+    dead tile, which dominated short-segment packs ~30:1."""
     q, k, v, cu_q, cu_k, o, lse = res
     h, t, d = q.shape
     tk = k.shape[1]
     if not self_attn:
         max_seqlen = None  # see _inner_steps: bound unsound cross-attn
+    if stacked and self_attn:
+        # the stacked forward ran at the stacked tiling; keep the
+        # backward on the same blocks so short-segment packs get the
+        # same quadratic dead-area savings (1024^2 tiles on 512-token
+        # segments are 75% dead even inside live tiles)
+        block_q, block_k = STACKED_BLOCK_Q, STACKED_BLOCK_K
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, tk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
@@ -601,99 +826,282 @@ def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
     cq2d, _ = _expand_codes(code_q, tp)
     _, ck2d = _expand_codes(code_k, tkp)
     n_q, n_k = tp // block_q, tkp // block_k
-    n_q_inner = _inner_steps(n_q, block_k, block_q, max_seqlen)
-    n_k_inner = _inner_steps(n_k, block_q, block_k, max_seqlen)
-    cc = causal and self_attn
+    it = jnp.dtype(q.dtype).itemsize
 
-    # dK/dV: grid (h, n_k, n_q) — live Q-tile bounds per k tile; under
-    # causal self-attention the live range STARTS at the diagonal
-    lo_q, hi_q = _live_col_tiles(cu_k, cu_q, n_k, block_k, block_q, tk)
-    if cc:
-        j = jnp.arange(n_k, dtype=jnp.int32)
-        lo_q = jnp.maximum(lo_q, ((j * block_k) // block_q).astype(jnp.int32))
-        hi_q = jnp.maximum(hi_q, lo_q)
-    lo_q = lo_q.astype(jnp.int32)
-    hi_q = hi_q.astype(jnp.int32)
-    q_map = lambda b, j, i, lo_, hi_: (b, _clamped_col(lo_, hi_, j, i), 0)
-    stat_map = lambda b, j, i, lo_, hi_: (b, 0, _clamped_col(lo_, hi_, j, i))
-    cq_map = lambda b, j, i, lo_, hi_: (_clamped_col(lo_, hi_, j, i), 0)
+    # k-major live-tile schedule (dK/dV accumulation order); same static
+    # bound + concrete-cu hint scheme as the forward grid
+    lo_q, hi_q = _bwd_bounds(cu_q, cu_k, n_k, block_q, block_k, tk,
+                             causal, self_attn)
+    n_flat = n_k * _inner_steps(n_q, block_k, block_q, max_seqlen)
+    if n_flat_bwd_hint is not None:
+        n_flat = min(n_flat, n_flat_bwd_hint)
+    ki_a, qi_a, first_a, last_a, live_a = _flat_schedule(lo_q, hi_q, n_k,
+                                                         n_flat)
+    nh = _bwd_fused_nh(h, it, d, block_q, block_k, tp)
     with _mosaic_ctx():
-        dk, dv = pl.pallas_call(
-            functools.partial(_bwd_dkv_kernel_varlen, block_q=block_q,
-                              causal=causal, scale=scale, n_q=n_q_inner,
-                              self_attn=self_attn),
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(h, n_k, n_q_inner),
-                in_specs=[
-                    pl.BlockSpec((1, block_q, d), q_map),
-                    pl.BlockSpec((1, block_k, d),
-                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
-                    pl.BlockSpec((1, block_k, d),
-                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
-                    pl.BlockSpec((1, block_q, d), q_map),
-                    pl.BlockSpec((1, 1, block_q), stat_map),
-                    pl.BlockSpec((1, 1, block_q), stat_map),
-                    pl.BlockSpec((block_q, 128), cq_map),
-                    pl.BlockSpec((8, block_k),
-                                 lambda b, j, i, lo_, hi_: (0, j)),
+        if nh:
+            dq, dk, dv = _bwd_fused_call(
+                qp, kp, vp, dop, lse3, delta3, cq2d, ck2d, ki_a, qi_a,
+                first_a, last_a, live_a, n_flat, nh, block_q, block_k,
+                causal, scale)
+            if not self_attn:
+                # k-major presentation only reaches q tiles inside some
+                # k tile's live range; a cross-attn pack can LEAD/TRAIL
+                # with q segments that have zero k tokens, whose dq HBM
+                # blocks are then never written. Their true gradient is
+                # zero (no keys -> masked-to-zero output), so zero any
+                # uncovered tile in-graph. Self-attention needs no fix:
+                # its k-major live set is the transpose of the forward's
+                # q-major set, which presents every q tile.
+                i = jnp.arange(n_q, dtype=jnp.int32)
+                cover = jnp.any((i[None, :] >= lo_q[:, None])
+                                & (i[None, :] <= hi_q[:, None]), axis=0)
+                dq = jnp.where(jnp.repeat(cover, block_q)[None, :, None],
+                               dq, 0).astype(qp.dtype)
+        else:
+            dk, dv = pl.pallas_call(
+                functools.partial(_bwd_dkv_flat_kernel, causal=causal,
+                                  scale=scale),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=5,
+                    grid=(h, n_flat),
+                    in_specs=[
+                        pl.BlockSpec(
+                            (1, block_q, d),
+                            lambda b, s, ki, qi, f, l, lv: (b, qi[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_k, d),
+                            lambda b, s, ki, qi, f, l, lv: (b, ki[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_k, d),
+                            lambda b, s, ki, qi, f, l, lv: (b, ki[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_q, d),
+                            lambda b, s, ki, qi, f, l, lv: (b, qi[s], 0)),
+                        pl.BlockSpec(
+                            (1, 1, block_q),
+                            lambda b, s, ki, qi, f, l, lv: (b, 0, qi[s])),
+                        pl.BlockSpec(
+                            (1, 1, block_q),
+                            lambda b, s, ki, qi, f, l, lv: (b, 0, qi[s])),
+                        pl.BlockSpec(
+                            (block_q, 128),
+                            lambda b, s, ki, qi, f, l, lv: (qi[s], 0)),
+                        pl.BlockSpec(
+                            (8, block_k),
+                            lambda b, s, ki, qi, f, l, lv: (0, ki[s])),
+                    ],
+                    out_specs=[
+                        pl.BlockSpec(
+                            (1, block_k, d),
+                            lambda b, s, ki, qi, f, l, lv: (b, ki[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_k, d),
+                            lambda b, s, ki, qi, f, l, lv: (b, ki[s], 0)),
+                    ],
+                    scratch_shapes=[
+                        pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32),
+                    ],
+                ),
+                out_shape=[
+                    jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                    jax.ShapeDtypeStruct(vp.shape, v.dtype),
                 ],
-                out_specs=[
-                    pl.BlockSpec((1, block_k, d),
-                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
-                    pl.BlockSpec((1, block_k, d),
-                                 lambda b, j, i, lo_, hi_: (b, j, 0)),
-                ],
-                scratch_shapes=[
-                    pltpu.VMEM((block_k, d), jnp.float32),
-                    pltpu.VMEM((block_k, d), jnp.float32),
-                ],
-            ),
-            out_shape=[
-                jax.ShapeDtypeStruct(kp.shape, k.dtype),
-                jax.ShapeDtypeStruct(vp.shape, v.dtype),
-            ],
-            interpret=_interpret(),
-        )(lo_q, hi_q, qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
+                cost_estimate=_cost_estimate(
+                    flops=8 * h * n_flat * block_q * block_k * d,
+                    transcendentals=h * n_flat * block_q * block_k,
+                    bytes_accessed=(2 * h * n_flat * (block_q + block_k)
+                                    * d * it + 2 * h * tkp * d * it)),
+                interpret=_interpret(),
+            )(ki_a, qi_a, first_a, last_a, live_a, qp, kp, vp, dop, lse3,
+              delta3, cq2d, ck2d)
 
-        lo_k, hi_k = _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t,
-                                 causal, self_attn)
-        kv_map = lambda b, i, j, lo_, hi_: (b, _clamped_col(lo_, hi_, i, j),
-                                            0)
-        ck_map = lambda b, i, j, lo_, hi_: (0, _clamped_col(lo_, hi_, i, j))
-        dq = pl.pallas_call(
-            functools.partial(_bwd_dq_kernel_varlen, block_k=block_k,
-                              causal=causal, scale=scale, n_k=n_k_inner,
-                              self_attn=self_attn),
-            grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=2,
-                grid=(h, n_q, n_k_inner),
-                in_specs=[
-                    pl.BlockSpec((1, block_q, d),
-                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
-                    pl.BlockSpec((1, block_k, d), kv_map),
-                    pl.BlockSpec((1, block_k, d), kv_map),
-                    pl.BlockSpec((1, block_q, d),
-                                 lambda b, i, j, lo_, hi_: (b, i, 0)),
-                    pl.BlockSpec((1, 1, block_q),
-                                 lambda b, i, j, lo_, hi_: (b, 0, i)),
-                    pl.BlockSpec((1, 1, block_q),
-                                 lambda b, i, j, lo_, hi_: (b, 0, i)),
-                    pl.BlockSpec((block_q, 128),
-                                 lambda b, i, j, lo_, hi_: (i, 0)),
-                    pl.BlockSpec((8, block_k), ck_map),
-                ],
-                out_specs=pl.BlockSpec((1, block_q, d),
-                                       lambda b, i, j, lo_, hi_: (b, i, 0)),
-                scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-            ),
-            out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
-            interpret=_interpret(),
-        )(lo_k, hi_k, qp, kp, vp, dop, lse3, delta3, cq2d, ck2d)
+            # dQ rides the forward's q-major schedule (same bounds, same
+            # hint): every q tile is presented, so no coverage fix needed
+            lo_k, hi_k = _fwd_bounds(cu_q, cu_k, n_q, block_q, block_k, t,
+                                     causal, self_attn)
+            n_flat_q = n_q * _inner_steps(n_k, block_q, block_k,
+                                          max_seqlen)
+            if n_flat_hint is not None:
+                n_flat_q = min(n_flat_q, n_flat_hint)
+            qi_b, ki_b, first_b, last_b, live_b = _flat_schedule(
+                lo_k, hi_k, n_q, n_flat_q)
+            dq = pl.pallas_call(
+                functools.partial(_bwd_dq_flat_kernel, causal=causal,
+                                  scale=scale),
+                grid_spec=pltpu.PrefetchScalarGridSpec(
+                    num_scalar_prefetch=5,
+                    grid=(h, n_flat_q),
+                    in_specs=[
+                        pl.BlockSpec(
+                            (1, block_q, d),
+                            lambda b, s, qi, ki, f, l, lv: (b, qi[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_k, d),
+                            lambda b, s, qi, ki, f, l, lv: (b, ki[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_k, d),
+                            lambda b, s, qi, ki, f, l, lv: (b, ki[s], 0)),
+                        pl.BlockSpec(
+                            (1, block_q, d),
+                            lambda b, s, qi, ki, f, l, lv: (b, qi[s], 0)),
+                        pl.BlockSpec(
+                            (1, 1, block_q),
+                            lambda b, s, qi, ki, f, l, lv: (b, 0, qi[s])),
+                        pl.BlockSpec(
+                            (1, 1, block_q),
+                            lambda b, s, qi, ki, f, l, lv: (b, 0, qi[s])),
+                        pl.BlockSpec(
+                            (block_q, 128),
+                            lambda b, s, qi, ki, f, l, lv: (qi[s], 0)),
+                        pl.BlockSpec(
+                            (8, block_k),
+                            lambda b, s, qi, ki, f, l, lv: (0, ki[s])),
+                    ],
+                    out_specs=pl.BlockSpec(
+                        (1, block_q, d),
+                        lambda b, s, qi, ki, f, l, lv: (b, qi[s], 0)),
+                    scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+                ),
+                out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                cost_estimate=_cost_estimate(
+                    flops=6 * h * n_flat_q * block_q * block_k * d,
+                    transcendentals=h * n_flat_q * block_q * block_k,
+                    bytes_accessed=(2 * h * n_flat_q * (block_q + block_k)
+                                    * d * it + h * tp * d * it)),
+                interpret=_interpret(),
+            )(qi_b, ki_b, first_b, last_b, live_b, qp, kp, vp, dop, lse3,
+              delta3, cq2d, ck2d)
     return dq[:, :t], dk[:, :tk], dv[:, :tk], None, None
 
 
 _flash_varlen.defvjp(_flash_varlen_fwd, _flash_varlen_bwd)
+
+
+def _host_bounds(cu_rows, cu_cols, n_tiles, block_rows, block_cols,
+                 total_rows):
+    """Pure-NUMPY mirror of _live_col_tiles: jnp ops issued during an
+    enclosing trace are staged even on concrete inputs, so the wrapper's
+    schedule sizing must not touch jnp."""
+    import numpy as np
+    i = np.arange(n_tiles)
+    r0 = np.clip(i * block_rows, 0, total_rows - 1)
+    r1 = np.clip((i + 1) * block_rows - 1, 0, total_rows - 1)
+    seg0 = np.searchsorted(cu_rows, r0, side="right") - 1
+    seg1 = np.searchsorted(cu_rows, r1, side="right") - 1
+    lo = cu_cols[seg0] // block_cols
+    hi = (np.maximum(cu_cols[seg1 + 1], cu_cols[seg1] + 1) - 1) // block_cols
+    return lo, np.maximum(hi, lo)
+
+
+def _host_schedule(cuq_np, cuk_np, tq, tk, bq, bk, causal, self_attn):
+    """Live (q-tile, k-tile) pair counts for BOTH flat-grid orientations
+    at a concrete cu: q-major (forward / split dQ, _fwd_bounds' causal
+    diagonal clamp) and k-major (backward dK/dV + fused kernel,
+    _bwd_bounds' diagonal start). Returns
+    (n_live_fwd, n_live_bwd, n_q, n_k)."""
+    import numpy as np
+    n_q = -(-tq // bq)
+    n_k = -(-tk // bk)
+    lo, hi = _host_bounds(cuq_np, cuk_np, n_q, bq, bk, tq)
+    if causal and self_attn:
+        i = np.arange(n_q)
+        hi = np.maximum(np.minimum(hi, ((i + 1) * bq - 1) // bk), lo)
+    n_live_fwd = int(np.sum(hi - lo + 1))
+    lo2, hi2 = _host_bounds(cuk_np, cuq_np, n_k, bk, bq, tk)
+    if causal and self_attn:
+        j = np.arange(n_k)
+        lo2 = np.maximum(lo2, (j * bk) // bq)
+        hi2 = np.maximum(hi2, lo2)
+    n_live_bwd = int(np.sum(hi2 - lo2 + 1))
+    return n_live_fwd, n_live_bwd, n_q, n_k
+
+
+def _pow2_hint(n_live):
+    """Flat-grid length for a measured live-pair count: next power of two
+    (>= 8) so repacked batches of similar size reuse compiled programs."""
+    h = 8
+    while h < n_live:
+        h *= 2
+    return h
+
+
+def _host_plan(cuq_np, cuk_np, tq, tk, h, d, itemsize, causal, self_attn,
+               block_q, block_k, max_seqlen=None):
+    """Concrete-cu kernel plan: stacked-path selection, fitted blocks,
+    and per-orientation schedule sizes. `flat` is the grid the flat
+    schedule actually runs (live count pow2-rounded, capped by the
+    static bound); `rect` is what the old rectangular grid would have
+    burned — the gap is all dead steps.
+
+    Short-segment packs (mean segment < 1024 tokens) at the DEFAULT
+    blocks go to the rows-stacked head-fused tiling: small tiles cut the
+    dead-area waste of 1024^2 tiles quadratically, and stacking pays the
+    serial softmax-chain latency once per chunk instead of once per
+    (chunk, head). The stacked kernel must also FIT scoped VMEM at this
+    dtype (f32 doubles the block bytes — advisor r4: nh=8 f32 was a
+    compile-time OOM) and needs >= 2 fused heads to amortize anything.
+    Callers passing EXPLICIT block sizes keep the streaming kernel with
+    exactly those blocks (tuning stays honored)."""
+    stacked = False
+    if self_attn and len(cuq_np) > 1 \
+            and (block_q, block_k) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K):
+        mean_seg = tq / (len(cuq_np) - 1)
+        nh_fit = _stacked_nh(h, itemsize, d,
+                             _fit_block(STACKED_BLOCK_Q, tq),
+                             _fit_block(STACKED_BLOCK_K, tk))
+        stacked = bool(mean_seg < 1024) and nh_fit >= 2
+    if stacked:
+        bq = _fit_block(STACKED_BLOCK_Q, tq)
+        bk = _fit_block(STACKED_BLOCK_K, tk)
+    else:
+        bq, bk = _fit_block(block_q, tq), _fit_block(block_k, tk)
+    live_fwd, live_bwd, n_q, n_k = _host_schedule(
+        cuq_np, cuk_np, tq, tk, bq, bk, causal, self_attn)
+    if not self_attn:
+        max_seqlen = None  # see _inner_steps
+    rect_fwd = n_q * _inner_steps(n_k, bq, bk, max_seqlen)
+    rect_bwd = n_k * _inner_steps(n_q, bk, bq, max_seqlen)
+    return {
+        "stacked": stacked,
+        "block_q": int(bq),
+        "block_k": int(bk),
+        "fwd": {"live": live_fwd, "rect": int(rect_fwd),
+                "flat": int(min(_pow2_hint(live_fwd), rect_fwd)),
+                "flat_hint": _pow2_hint(live_fwd)},
+        "bwd": {"live": live_bwd, "rect": int(rect_bwd),
+                "flat": int(min(_pow2_hint(live_bwd), rect_bwd)),
+                "flat_hint": _pow2_hint(live_bwd)},
+    }
+
+
+def varlen_schedule_stats(cu_q, cu_k, heads, head_dim, *, causal,
+                          self_attn=True, dtype=jnp.bfloat16,
+                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                          max_seqlen=None):
+    """Dead-vs-live grid-step accounting for a concrete pack: what the
+    flat live-tile schedule runs vs what the rectangular grids burned.
+    All values are plain ints/bools (JSON-ready — bench.py records this
+    in BENCH_DETAIL.json)."""
+    import numpy as np
+    cuq_np = np.asarray(cu_q)
+    cuk_np = cuq_np if self_attn else np.asarray(cu_k)
+    tq, tk = int(cuq_np[-1]), int(cuk_np[-1])
+    plan = _host_plan(cuq_np, cuk_np, tq, tk, heads, head_dim,
+                      jnp.dtype(dtype).itemsize, causal, self_attn,
+                      block_q, block_k,
+                      int(max_seqlen) if max_seqlen else None)
+    out = {"stacked": bool(plan["stacked"]),
+           "block_q": plan["block_q"], "block_k": plan["block_k"]}
+    for pss in ("fwd", "bwd"):
+        p = plan[pss]
+        out[pss] = {"live_tiles": p["live"],
+                    "flat_steps": p["flat"],
+                    "rect_steps": p["rect"],
+                    "dead_steps_flat": p["flat"] - p["live"],
+                    "dead_steps_rect": p["rect"] - p["live"]}
+    return out
 
 
 def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
@@ -738,65 +1146,29 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
         else:
             max_seqlen = None
     n_flat_hint = None
+    n_flat_bwd_hint = None
     stacked = False
     if not isinstance(cu_q, jax.core.Tracer) \
             and not isinstance(cu_k, jax.core.Tracer):
         # cu concrete here (it becomes a tracer at the custom_vjp
-        # boundary): measure the actual live-pair count so the forward's
-        # flat grid is sized to the work, not the worst-case bound.
-        # Pure NUMPY host mirror of _live_col_tiles/_fwd_bounds — jnp ops
-        # issued during an enclosing trace are staged even on concrete
-        # inputs. Rounded to a power of two so repacked batches reuse
-        # compiled programs.
+        # boundary): measure the actual live-pair counts so BOTH flat
+        # grids (forward q-major, backward k-major) are sized to the
+        # work, not the worst-case static bound — the grid's ~1.3 µs
+        # fixed cost per step is what dominates short-sequence packs,
+        # and the static bound is ~4x over-provisioned for them.
         import numpy as np
-        cuq_np = np.asarray(cu_q)
-        cuk_np = np.asarray(cu_k)
-        if self_attn and len(cuq_np) > 1 \
-                and (block_q, block_k) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K):
-            # short-segment packs (mean segment < 1024 tokens) go to the
-            # rows-stacked head-fused kernel: small tiles cut the
-            # dead-area waste of 1024^2 tiles quadratically, and stacking
-            # pays the serial softmax-chain latency once per chunk
-            # instead of once per (chunk, head). Long-segment packs keep
-            # the per-head streaming kernel (full-rate 1024^2 matmuls).
-            # Callers passing EXPLICIT block sizes get the streaming
-            # kernel with exactly those blocks (tuning stays honored).
-            # The stacked kernel must also FIT scoped VMEM at this dtype
-            # (f32 doubles the block bytes — advisor r4: nh=8 f32 was a
-            # compile-time OOM) and needs >=2 fused heads to amortize
-            # anything; otherwise keep the streaming kernel.
-            mean_seg = tq / (len(cuq_np) - 1)
-            nh_fit = _stacked_nh(q.shape[1], jnp.dtype(q.dtype).itemsize,
-                                 q.shape[2],
-                                 _fit_block(STACKED_BLOCK_Q, tq),
-                                 _fit_block(STACKED_BLOCK_K, tk))
-            stacked = bool(mean_seg < 1024) and nh_fit >= 2
-        if stacked:
-            bq2 = _fit_block(STACKED_BLOCK_Q, tq)
-            bk2 = _fit_block(STACKED_BLOCK_K, tk)
-        else:
-            bq2, bk2 = _fit_block(block_q, tq), _fit_block(block_k, tk)
-        n_q = -(-tq // bq2)
-        i = np.arange(n_q)
-        r0 = np.clip(i * bq2, 0, tq - 1)
-        r1 = np.clip((i + 1) * bq2 - 1, 0, tq - 1)
-        seg0 = np.searchsorted(cuq_np, r0, side="right") - 1
-        seg1 = np.searchsorted(cuq_np, r1, side="right") - 1
-        lo = cuk_np[seg0] // bk2
-        hi = (np.maximum(cuk_np[seg1 + 1], cuk_np[seg1] + 1) - 1) // bk2
-        hi = np.maximum(hi, lo)
-        if causal and self_attn:
-            diag = ((i + 1) * bq2 - 1) // bk2
-            hi = np.maximum(np.minimum(hi, diag), lo)
-        n_live = int(np.sum(hi - lo + 1))
-        n_flat_hint = 8
-        while n_flat_hint < n_live:
-            n_flat_hint *= 2
+        plan = _host_plan(np.asarray(cu_q), np.asarray(cu_k), tq, tk, h, d,
+                          jnp.dtype(q.dtype).itemsize, causal,
+                          bool(self_attn), block_q, block_k,
+                          int(max_seqlen) if max_seqlen else None)
+        stacked = plan["stacked"]
+        n_flat_hint = plan["fwd"]["flat_hint"]
+        n_flat_bwd_hint = plan["bwd"]["flat_hint"]
     qh = q.transpose(1, 0, 2)
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)
     o = _flash_varlen(qh, kh, vh, cu_q, cu_k, causal, float(scale),
                       block_q, block_k, bool(self_attn),
                       int(max_seqlen) if max_seqlen else None, n_flat_hint,
-                      stacked)
+                      stacked, n_flat_bwd_hint)
     return o.transpose(1, 0, 2)

@@ -14,6 +14,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+from ._common import cost_estimate as _cost_estimate
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
 
@@ -39,6 +40,11 @@ def _rms_fwd_impl(x2d, w, eps, block_rows):
             ],
             out_specs=pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
             out_shape=jax.ShapeDtypeStruct((n, h), x2d.dtype),
+            # square + mean-acc + two scale muls per element; rsqrt per row
+            cost_estimate=_cost_estimate(
+                flops=4 * n * h,
+                transcendentals=n,
+                bytes_accessed=2 * n * h * jnp.dtype(x2d.dtype).itemsize),
             interpret=_interpret(),
         )(x2d, w.reshape(1, h))
 

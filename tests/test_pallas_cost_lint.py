@@ -1,0 +1,64 @@
+"""Static lint: every ``pl.pallas_call(...)`` site in ``paddle_tpu/ops/``
+must pass ``cost_estimate=`` so XLA's cost model sees kernel FLOPs. A
+custom call without one is costed at ZERO, which silently deflates the
+StepMetrics MFU attribution for every kernel-backed step (observability).
+Pattern follows tests/test_comm_span_lint.py."""
+import ast
+import os
+
+import pytest
+
+OPS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "paddle_tpu", "ops")
+
+
+def _pallas_calls(tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None)
+        if name == "pallas_call":
+            yield node
+
+
+def _py_files():
+    for root, _dirs, files in os.walk(OPS):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def test_every_pallas_call_passes_cost_estimate():
+    offenders = []
+    seen = 0
+    for path in _py_files():
+        with open(path) as fh:
+            src = fh.read()
+        if "pallas_call" not in src:
+            continue
+        tree = ast.parse(src, filename=path)
+        for call in _pallas_calls(tree):
+            seen += 1
+            if not any(kw.arg == "cost_estimate" for kw in call.keywords):
+                offenders.append(f"{os.path.relpath(path, OPS)}:"
+                                 f"{call.lineno}")
+    # flash fwd/bwd, varlen fwd/bwd (streaming + stacked + fused + split),
+    # decode slab x2, rms_norm: the ops package holds >= 10 kernel sites
+    assert seen >= 10, f"lint found only {seen} pallas_call sites"
+    assert not offenders, (
+        "pallas_call sites missing cost_estimate=: " + ", ".join(offenders))
+
+
+def test_lint_catches_a_missing_cost_estimate():
+    """The lint itself must flag a bare pallas_call (guard against the AST
+    walk silently matching nothing)."""
+    tree = ast.parse("pl.pallas_call(kernel, grid=(4,))(x)\n")
+    calls = list(_pallas_calls(tree))
+    assert len(calls) == 1
+    assert not any(kw.arg == "cost_estimate" for kw in calls[0].keywords)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

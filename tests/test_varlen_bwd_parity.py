@@ -1,0 +1,177 @@
+"""Varlen BACKWARD grad parity vs the dense masked reference across
+adversarial pack layouts (PR 4 numerics contract — see PARITY.md).
+
+The fused flat-schedule backward replaced the rectangular dKV/dQ grids;
+these tests pin its gradients on exactly the layouts that stress the
+live-tile schedule: single-token segments, segment ends on tile
+boundaries, a padded tail, empty pack entries, and cross-attention
+packs whose k side has zero-token segments (the dq coverage fix).
+Tolerances are pinned: fwd 2e-4, grads 2e-3 (f32 inputs, CPU interpret).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (configures CPU default device in tests)
+from paddle_tpu.ops import flash_varlen as fv
+from paddle_tpu.ops.flash_varlen import flash_varlen_attention
+
+D = 32
+SCALE = 1.0 / np.sqrt(D)
+GRAD_TOL = 2e-3
+
+LAYOUTS = {
+    # every segment is one token: every live tile is almost all dead area
+    "single_token": [1] * 9,
+    # segment ends exactly on 128-tile boundaries: first/last flags flip
+    # at every tile edge, no partial tiles
+    "tile_boundary": [128, 256, 128],
+    # total 161 -> padded to 256: a trailing tile that is >half padding
+    "pad_tail": [100, 61],
+    # zero-length pack entries between real segments
+    "empty_segments": [64, 0, 100, 0, 31],
+    # pathological mix: singletons around tile-sized and tile-crossing
+    "mixed": [1, 128, 3, 257, 1],
+}
+
+
+def _packed(lens, heads, rng):
+    total = sum(lens)
+    x = rng.randn(total, heads, D).astype(np.float32)
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(cu)
+
+
+def _ref_loss(cu, causal, scale):
+    cu_np = np.asarray(cu)
+
+    def loss(q, k, v):
+        outs = []
+        for b in range(len(cu_np) - 1):
+            lo, hi = int(cu_np[b]), int(cu_np[b + 1])
+            if lo == hi:
+                continue
+            qs, ks, vs = q[lo:hi], k[lo:hi], v[lo:hi]
+            logits = jnp.einsum("qhd,khd->hqk", qs, ks) * scale
+            if causal:
+                m = jnp.tril(jnp.ones((hi - lo, hi - lo), bool))
+                logits = jnp.where(m[None], logits, -1e30)
+            p = jax.nn.softmax(logits, axis=-1)
+            outs.append(jnp.einsum("hqk,khd->qhd", p, vs))
+        return (jnp.concatenate(outs, 0) ** 2).sum()
+
+    return loss
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("name", sorted(LAYOUTS))
+def test_bwd_parity_adversarial_layouts(name, causal):
+    lens = LAYOUTS[name]
+    rng = np.random.RandomState(sum(map(ord, name)) % 1000)
+    q, cu = _packed(lens, 2, rng)
+    k, _ = _packed(lens, 2, rng)
+    v, _ = _packed(lens, 2, rng)
+
+    def loss(q, k, v):
+        o = flash_varlen_attention(q, k, v, cu, cu, SCALE, causal,
+                                   self_attn=True, block_q=128, block_k=128)
+        return (o ** 2).sum()
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(_ref_loss(cu, causal, SCALE), argnums=(0, 1, 2))(q, k, v)
+    # the reference skips empty segments, but they hold no tokens so the
+    # packed grad arrays line up 1:1
+    for g, r, nm in zip(got, want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=GRAD_TOL, atol=GRAD_TOL,
+                                   err_msg=f"{name} d{nm}")
+
+
+def test_bwd_cross_attn_empty_k_segment_dq_is_zero():
+    """Cross-attn q tiles whose segment has ZERO k tokens are never
+    presented by the k-major fused schedule — their dq comes from the
+    in-graph coverage fix and must be exactly zero (which IS the true
+    gradient: their output is all-padding)."""
+    lens_q, lens_k = [40, 8, 30], [64, 0, 32]
+    rng = np.random.RandomState(29)
+    q, cu_q = _packed(lens_q, 2, rng)
+    k, cu_k = _packed(lens_k, 2, rng)
+    v, _ = _packed(lens_k, 2, rng)
+
+    def loss(q, k, v):
+        o = flash_varlen_attention(q, k, v, cu_q, cu_k, SCALE, False,
+                                   self_attn=False, block_q=128, block_k=128)
+        return (o ** 2).sum()
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert np.isfinite(np.asarray(gq)).all()
+    # segment 1 (q rows 40:48) attends to zero keys -> dq exactly 0
+    np.testing.assert_array_equal(np.asarray(gq[40:48]), 0.0)
+    # the populated segments still get real gradients
+    assert float(jnp.abs(gq[:40]).max()) > 0
+    assert float(jnp.abs(gq[48:]).max()) > 0
+
+    def ref(q, k, v):
+        outs = []
+        cuq_np, cuk_np = np.asarray(cu_q), np.asarray(cu_k)
+        for b in range(len(lens_q)):
+            qs = q[int(cuq_np[b]):int(cuq_np[b + 1])]
+            ks = k[int(cuk_np[b]):int(cuk_np[b + 1])]
+            vs = v[int(cuk_np[b]):int(cuk_np[b + 1])]
+            if ks.shape[0] == 0:
+                outs.append(jnp.zeros_like(qs))
+                continue
+            p = jax.nn.softmax(
+                jnp.einsum("qhd,khd->hqk", qs, ks) * SCALE, axis=-1)
+            outs.append(jnp.einsum("hqk,khd->qhd", p, vs))
+        return (jnp.concatenate(outs, 0) ** 2).sum()
+
+    want = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for g, r, nm in zip((gq, gk, gv), want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=GRAD_TOL, atol=GRAD_TOL,
+                                   err_msg=f"d{nm}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_bitwise_equals_split_fallback(causal):
+    """The fused one-pass backward must be BITWISE equal to the two-kernel
+    split fallback at the same blocks (the bias add is absorbed
+    identically in f32; matmul order per tile is identical). Forcing the
+    split path via the VMEM budget knob keeps blocks and schedule fixed
+    so only the fusion differs."""
+    lens = [60, 130, 200, 40]
+    rng = np.random.RandomState(31)
+    q, cu = _packed(lens, 2, rng)
+    k, _ = _packed(lens, 2, rng)
+    v, _ = _packed(lens, 2, rng)
+
+    def loss(q, k, v):
+        o = flash_varlen_attention(q, k, v, cu, cu, SCALE, causal,
+                                   self_attn=True, block_q=128, block_k=128)
+        return (o.astype(jnp.float32) ** 2).sum()
+
+    g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    budget = fv._FUSED_BWD_VMEM_BUDGET
+    try:
+        fv._FUSED_BWD_VMEM_BUDGET = 0   # nothing fits -> split kernels
+        g_split = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fv._FUSED_BWD_VMEM_BUDGET = budget
+    for gf, gs, nm in zip(g_fused, g_split, "qkv"):
+        np.testing.assert_array_equal(np.asarray(gf), np.asarray(gs),
+                                      err_msg=f"d{nm}")
+
+
+def test_bwd_fused_nh_selection_pins():
+    """Head-fusion grouping for the fused backward: bench shape groups 4
+    heads, long packs fall back to split (nh=0), tiny packs group all 8."""
+    # bench pack shape: h=8, bf16, 512x512 stacked blocks, 16k tokens
+    assert fv._bwd_fused_nh(8, 2, 128, 512, 512, 16384) == 4
+    # 128k-token pack: the dq scratch alone blows the budget -> split
+    assert fv._bwd_fused_nh(8, 2, 128, 1024, 1024, 131072) == 0
+    # small pack, small head_dim: everything fits, fuse all heads
+    assert fv._bwd_fused_nh(8, 4, 32, 128, 128, 1024) == 8
+    # grouping must divide h
+    assert fv._bwd_fused_nh(6, 4, 32, 128, 128, 1024) in (1, 2, 6)
