@@ -329,6 +329,149 @@ def bench_moe(dev):
     }
 
 
+def bench_moe_dropless(dev):
+    """The dropless counterpart of bench_moe on the SAME config: ragged
+    grouped-GEMM expert compute (dispatch_mode='ragged', no capacity
+    buckets, zero drops) with param-dtype optimizer moments
+    (multi_precision=False) so the bf16 expert moments stream at half
+    the bytes. Reports active-parameter MFU plus the pad-waste stats
+    that replace the capacity factor: tile-alignment padding is bounded
+    by one MXU row tile per expert, vs cf=1.25's unconditional 25%."""
+    import jax as _jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.ernie_moe import ErnieMoEConfig, build_train_step
+    cfg = ErnieMoEConfig(vocab_size=8192, hidden_size=1024,
+                         intermediate_size=4096, num_hidden_layers=8,
+                         num_attention_heads=8, num_experts=8, moe_topk=2,
+                         capacity_factor=1.25, moe_every=2,
+                         max_position_embeddings=512, dtype=jnp.bfloat16)
+    B, S = 8, 512
+    step, p, o = build_train_step(cfg, ep_degree=1, lr=1e-4,
+                                  dispatch_mode="ragged",
+                                  multi_precision=False, with_stats=True)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int32)
+    for _ in range(3):
+        p, o, loss, aux = step(p, o, ids, labels)
+    _jax.device_get(loss)
+    state = {"p": p, "o": o}
+
+    def run():
+        state["p"], state["o"], loss, aux = step(state["p"], state["o"],
+                                                 ids, labels)
+        _jax.device_get(loss)
+
+    ms = trace_device_ms(run, "jit_step(", reps=5)
+    if ms is not None:
+        dt = ms / 1e3
+    else:
+        n, trials, dt = 10, 3, 1e9
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                p, o, loss, aux = step(p, o, ids, labels)
+            _jax.device_get(loss)
+            dt = min(dt, (time.perf_counter() - t0) / n)
+    p, o = state["p"], state["o"]
+    p, o, loss, aux = step(p, o, ids, labels)
+    st = _jax.device_get(aux)
+    live = float(st["moe_live_rows"])
+    padded = float(st["moe_padded_rows"])
+    tok_s = B * S / dt
+    c = cfg
+    n_dense = sum(1 for i in range(c.num_hidden_layers)
+                  if (i % c.moe_every) != (c.moe_every - 1))
+    n_moe = c.num_hidden_layers - n_dense
+    ffn = 2 * c.hidden_size * c.intermediate_size
+    active = (c.vocab_size * c.hidden_size
+              + c.num_hidden_layers * 4 * c.hidden_size ** 2
+              + n_dense * ffn
+              + n_moe * (c.moe_topk * ffn + c.hidden_size * c.num_experts))
+    fpt = 6.0 * active + 12 * c.num_hidden_layers * c.hidden_size * S
+    del p, o
+    return {
+        "active_mfu": round(tok_s * fpt / peak_flops(dev), 4),
+        "tokens_per_sec_per_chip": round(tok_s, 1),
+        "step_time_s": round(dt, 4),
+        "experts": c.num_experts, "topk": c.moe_topk,
+        "dispatch_mode": "ragged",
+        "multi_precision": False,
+        "moe_dropped_tokens": float(st["moe_dropped_tokens"]),
+        "moe_routed_tokens": float(st["moe_routed_tokens"]),
+        # pad-waste: dead rows the ragged schedule computes (tile
+        # alignment only; <= one row tile per expert per MoE layer) as a
+        # fraction of the expert-buffer rows — the number that replaces
+        # the capacity path's unconditional cf-1 = 25% bucket padding
+        "pad_rows_per_step": padded,
+        "pad_waste_frac": round(padded / max(live + padded, 1.0), 4),
+        "expert_rows_per_layer_mean": [
+            round(float(x) / max(n_moe, 1), 1)
+            for x in np.asarray(st["moe_expert_rows"])],
+        "dominant_cost": "ragged grouped-GEMM expert FFNs over the "
+                         "expert-sorted token buffer (gmm fwd + dX/dW on "
+                         "one flat row-tile schedule); zero drops, pad "
+                         "bounded by one 128-row tile per expert; bf16 "
+                         "AdamW moments (multi_precision=False) halve "
+                         "optimizer streaming vs the capacity rung",
+    }
+
+
+def decode_pair_stack_ab(dev, config_hd64):
+    """hd64_b8 floor-gap attempt (ISSUE satellite): A/B the standalone
+    slab decode kernel with PADDLE_TPU_DECODE_HD64_STACK on/off. The
+    pair-stacked variant packs two head_dim-64 heads per 128-lane tile:
+    NH/2 fewer padded MXU FLOPs and an NH/2 thinner per-lane window, so
+    the fitter keeps the full 512-lane T tile where the wide slab drops
+    to fragmented 128-lane DMAs. Recorded either way; the baseline block
+    choice stays the default unless the env flag asks for the stack."""
+    import os
+
+    import jax.numpy as jnp
+    from paddle_tpu._compat import enable_x64
+    from paddle_tpu.ops.decode_attention import decode_attention_slab
+    c = config_hd64
+    B, NH, HD = 8, c.num_attention_heads, c.head_dim
+    KVD = NH * HD
+    L, T, pos = 2, 4096, 4095
+    it = jnp.dtype(c.dtype).itemsize
+    rng = np.random.RandomState(9)
+    q = np.zeros((B, NH, KVD), np.float32)
+    for h in range(NH):   # head-block-diagonal, as the slab caller builds
+        q[:, h, h * HD:(h + 1) * HD] = rng.randn(B, HD) * 0.1
+    qs = jnp.asarray(q, c.dtype)
+    kc = jnp.asarray(rng.randn(L, B, KVD, T), c.dtype)
+    vc = jnp.asarray(rng.randn(L, B, KVD, T), c.dtype)
+    res = {"batch": B, "num_heads": NH, "head_dim": HD, "cache_T": T}
+    key = "PADDLE_TPU_DECODE_HD64_STACK"
+    prev = os.environ.get(key)
+    try:
+        for name, flag in (("baseline_ms", "0"), ("pair_stack_ms", "1")):
+            os.environ[key] = flag
+            # x64 off for the whole jit trace+lower: the package enables
+            # x64 globally, but under jit the pallas index maps lower
+            # OUTSIDE the kernel's own mosaic_trace_ctx and 64-bit index
+            # constants leak in (eager calls lower inside the ctx)
+            with enable_x64(False):
+                ms = device_time_ms(
+                    lambda q, k, v: decode_attention_slab(q, k, v, 1, pos),
+                    (qs, kc, vc), f"hd64slab{flag}")
+            res[name] = round(ms, 3)
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+    res["pair_stack_speedup"] = round(
+        res["baseline_ms"] / max(res["pair_stack_ms"], 1e-9), 3)
+    # the floor for this kernel is streaming one layer's k+v cache once
+    bw = next((v for k_, v in HBM_BW.items()
+               if k_ in getattr(dev, "device_kind", "cpu").lower()),
+              HBM_BW["cpu"])
+    res["cache_stream_floor_ms"] = round(2 * B * KVD * T * it / bw * 1e3, 3)
+    return res
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -430,6 +573,9 @@ def main():
             }
     if on_tpu:
         decode["measured_hbm_gbs"] = round(measured_hbm_bw(dev) / 1e9, 1)
+        if config_hd64 is not None:
+            decode["hd64_pair_stack_ab"] = decode_pair_stack_ab(
+                dev, config_hd64)
     detail["decode"] = decode
 
     if on_tpu:
@@ -554,6 +700,7 @@ def main():
         ms_vb = device_time_ms(vlbwd, (qv, kv, vv), "pvbwd")
         fl_vl = sum(2 * 2 * 8 * L * L * 128 / 2 for L in vl_lens)
         detail["moe"] = bench_moe(dev)
+        detail["moe_dropless"] = bench_moe_dropless(dev)
         from paddle_tpu.ops.flash_varlen import varlen_schedule_stats
         vl_sched = varlen_schedule_stats(
             np.asarray(cu_vl), np.asarray(cu_vl), 8, 128,
@@ -603,6 +750,14 @@ def main():
         rungs["hd64_mfu"] = detail["hd64_shape"]["mfu"]
     if "moe" in detail:
         rungs["moe_active_mfu"] = detail["moe"]["active_mfu"]
+    if "moe_dropless" in detail:
+        rungs["moe_dropless_active_mfu"] = \
+            detail["moe_dropless"]["active_mfu"]
+        rungs["moe_dropless_pad_waste"] = \
+            detail["moe_dropless"]["pad_waste_frac"]
+    if "decode" in detail and "hd64_pair_stack_ab" in detail["decode"]:
+        rungs["decode_hd64_pair_stack_speedup"] = \
+            detail["decode"]["hd64_pair_stack_ab"]["pair_stack_speedup"]
     if "long_seq_flash_fwd" in detail:
         ls = detail["long_seq_flash_fwd"]
         rungs["flash_fwd_eff_32k"] = ls["S32768"]["attn_eff"]

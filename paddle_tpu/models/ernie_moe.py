@@ -19,7 +19,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.moe import moe_dispatch_combine, zero_routing_stats
+from ..parallel.moe import (RATIO_STAT_KEYS, default_dispatch_mode,
+                            moe_dispatch_combine, zero_routing_stats)
 from ..ops.rms_norm import fused_rms_norm
 from .llama import _adamw_init, _adamw_update
 
@@ -39,6 +40,9 @@ class ErnieMoEConfig:
     max_position_embeddings: int = 512
     layer_norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
+    # "capacity" (reference drop parity, default) | "ragged" (dropless
+    # grouped-GEMM) | None -> PADDLE_TPU_MOE_DROPLESS env default
+    dispatch_mode: Optional[str] = None
 
     @property
     def head_dim(self):
@@ -158,7 +162,7 @@ def _attn_and_norm(p, h, config: ErnieMoEConfig):
 
 
 def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
-             mesh=None, with_stats=False):
+             mesh=None, with_stats=False, dispatch_mode="capacity"):
     c = config
     hid = x_.shape[-1]
     tokens = x_.reshape(-1, hid)
@@ -174,39 +178,45 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
         # [T,D] partials over 'ep'. Capacity is per-dp-shard (the
         # reference's MoE also sizes capacity from the local batch);
         # with no drops this is numerically identical to serial, which
-        # the ep-vs-serial tests assert. The one-hot einsum fallback
-        # below stays for mesh-less callers.
+        # the ep-vs-serial tests assert. dispatch_mode="ragged" swaps
+        # the local expert compute for the DROPLESS grouped-GEMM path
+        # (moe_ragged_dispatch_local) — the combine psum is unchanged.
+        # The one-hot einsum fallback below stays for mesh-less callers.
         from .._compat import shard_map
-        from ..parallel.moe import moe_slot_dispatch_local
+        from ..parallel.moe import (moe_ragged_dispatch_local,
+                                    moe_slot_dispatch_local)
 
         def island(tok, gate, w1, w2):
             logits = tok.astype(jnp.float32) @ gate
-            res = moe_slot_dispatch_local(
-                tok, logits, expert_fn, (w1, w2), c.num_experts,
-                axis_name="ep", k=c.moe_topk,
-                capacity_factor=c.capacity_factor,
-                return_stats=with_stats)
+            if dispatch_mode == "ragged":
+                res = moe_ragged_dispatch_local(
+                    tok, logits, w1, w2, c.num_experts,
+                    axis_name="ep", k=c.moe_topk,
+                    return_stats=with_stats)
+            else:
+                res = moe_slot_dispatch_local(
+                    tok, logits, expert_fn, (w1, w2), c.num_experts,
+                    axis_name="ep", k=c.moe_topk,
+                    capacity_factor=c.capacity_factor,
+                    return_stats=with_stats)
             # aux is computed from LOCAL tokens: average over dp so the
             # P() out-spec is genuinely replicated (the standard
             # data-parallel MoE aux — per-shard balance loss, averaged)
             if with_stats:
                 out, aux, st = res
-                # stats are per-dp-shard (identical across ep): counts sum
-                # over dp (whole-batch totals), ratios average over dp
-                st = {"moe_dropped_tokens":
-                          lax.psum(st["moe_dropped_tokens"], "dp"),
-                      "moe_routed_tokens":
-                          lax.psum(st["moe_routed_tokens"], "dp"),
-                      "moe_load_imbalance":
-                          lax.pmean(st["moe_load_imbalance"], "dp"),
-                      "moe_capacity_util":
-                          lax.pmean(st["moe_capacity_util"], "dp")}
+                # stats are per-dp-shard (ep-replicated by each path):
+                # counts sum over dp (whole-batch totals), ratio keys
+                # average over dp
+                st = {k_: (lax.pmean(v, "dp") if k_ in RATIO_STAT_KEYS
+                           else lax.psum(v, "dp"))
+                      for k_, v in st.items()}
                 return out, lax.pmean(aux, "dp"), st
             out, aux = res
             return out, lax.pmean(aux, "dp")
 
-        stats_spec = jax.tree_util.tree_map(lambda _: P(),
-                                            zero_routing_stats())
+        stats_spec = jax.tree_util.tree_map(
+            lambda _: P(), zero_routing_stats(dispatch_mode,
+                                              c.num_experts))
         out_specs = ((P("dp", None), P(), stats_spec) if with_stats
                      else (P("dp", None), P()))
         res = shard_map(
@@ -224,7 +234,8 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
                                    c.num_experts, k=c.moe_topk,
                                    capacity_factor=c.capacity_factor,
                                    use_onehot=use_onehot,
-                                   return_stats=with_stats)
+                                   return_stats=with_stats,
+                                   dispatch_mode=dispatch_mode)
         out, aux = res[0], res[1]
         stats = res[2] if with_stats else None
     out = out.reshape(x_.shape).astype(x_.dtype)
@@ -233,19 +244,23 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
     return out, aux.astype(jnp.float32)
 
 
-def _dense_ffn(p, x_, config: ErnieMoEConfig, with_stats=False):
+def _dense_ffn(p, x_, config: ErnieMoEConfig, with_stats=False,
+               dispatch_mode="capacity"):
     out = (jax.nn.gelu(x_ @ p["w1"]) @ p["w2"]).astype(x_.dtype)
     if with_stats:
-        return out, jnp.zeros((), jnp.float32), zero_routing_stats()
+        # zero stats must match the MoE branch's key set (lax.cond pytree)
+        return out, jnp.zeros((), jnp.float32), zero_routing_stats(
+            dispatch_mode, config.num_experts)
     return out, jnp.zeros((), jnp.float32)
 
 
 def _layer_static(p, h, is_moe, config: ErnieMoEConfig, use_onehot=False,
-                  mesh=None, with_stats=False):
+                  mesh=None, with_stats=False, dispatch_mode="capacity"):
     """One decoder layer with a STATIC moe/dense choice (no lax.cond)."""
     h, x = _attn_and_norm(p, h, config)
-    res = (_moe_ffn(p, x, config, use_onehot, mesh, with_stats) if is_moe
-           else _dense_ffn(p, x, config, with_stats))
+    res = (_moe_ffn(p, x, config, use_onehot, mesh, with_stats,
+                    dispatch_mode) if is_moe
+           else _dense_ffn(p, x, config, with_stats, dispatch_mode))
     if with_stats:
         ffn_out, aux, stats = res
         return h + ffn_out, aux, stats
@@ -254,14 +269,15 @@ def _layer_static(p, h, is_moe, config: ErnieMoEConfig, use_onehot=False,
 
 
 def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False,
-           mesh=None, with_stats=False):
+           mesh=None, with_stats=False, dispatch_mode="capacity"):
     c = config
 
     def moe_branch(x_):
-        return _moe_ffn(p, x_, c, use_onehot, mesh, with_stats)
+        return _moe_ffn(p, x_, c, use_onehot, mesh, with_stats,
+                        dispatch_mode)
 
     def dense_branch(x_):
-        return _dense_ffn(p, x_, c, with_stats)
+        return _dense_ffn(p, x_, c, with_stats, dispatch_mode)
 
     h, x = _attn_and_norm(p, h, c)
     is_moe = (layer_idx % c.moe_every) == (c.moe_every - 1)
@@ -275,7 +291,8 @@ def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False,
 
 
 def moe_loss(params, ids, labels, config: ErnieMoEConfig,
-             use_onehot=False, mesh=None, with_stats=False):
+             use_onehot=False, mesh=None, with_stats=False,
+             dispatch_mode="capacity"):
     # use_onehot marks ep>1: WITH a mesh the slot-schedule shard_map
     # island runs (see _moe_ffn); the one-hot einsum only serves
     # mesh-less callers as a fallback
@@ -302,7 +319,7 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
             p0, p1 = lp
             h, aux0 = _layer_static(p0, h, False, c)
             res = _layer_static(p1, h, True, c, use_onehot, mesh,
-                                with_stats)
+                                with_stats, dispatch_mode)
             if with_stats:
                 h, aux1, stats = res
                 return h, (aux0 + aux1,
@@ -323,7 +340,7 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
             h = carry
             idx, layer_params = inp
             res = _layer(layer_params, h, idx, c, use_onehot, mesh,
-                         with_stats)
+                         with_stats, dispatch_mode)
             if with_stats:
                 h, aux, stats = res
                 return h, (aux, jax.lax.stop_gradient(stats))
@@ -339,15 +356,11 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
             (layer_stats["moe_routed_tokens"]
              + layer_stats["moe_dropped_tokens"] > 0)
             .astype(jnp.float32).sum(), 1.0)
-        stats = {
-            "moe_dropped_tokens": layer_stats["moe_dropped_tokens"].sum(),
-            "moe_routed_tokens": layer_stats["moe_routed_tokens"].sum(),
-            # ratios averaged over the layers that actually routed
-            "moe_load_imbalance":
-                layer_stats["moe_load_imbalance"].sum() / n_moe,
-            "moe_capacity_util":
-                layer_stats["moe_capacity_util"].sum() / n_moe,
-        }
+        # generic over the key set (capacity vs ragged): counts sum over
+        # layers, ratio keys average over the layers that actually routed
+        stats = {k: (v.sum(0) / n_moe if k in RATIO_STAT_KEYS
+                     else v.sum(0))
+                 for k, v in layer_stats.items()}
     else:
         auxes = ys
     x = fused_rms_norm(h, params["final_ln"], c.layer_norm_eps)
@@ -366,14 +379,30 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
 def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
                      dp_degree: int = 1, mesh: Optional[Mesh] = None,
                      lr: float = 3e-4, seed: int = 0,
-                     with_stats: bool = False):
+                     with_stats: bool = False,
+                     dispatch_mode: Optional[str] = None,
+                     multi_precision: bool = True):
     """EP x DP training step; experts sharded over 'ep', batch over 'dp'.
 
     with_stats=True: the step's 4th output becomes a dict
-    ``{"lm_loss": ..., "moe_dropped_tokens": ..., "moe_routed_tokens": ...,
-    "moe_load_imbalance": ..., "moe_capacity_util": ...}`` of on-device f32
-    scalars (aggregated over layers and the dp axis) instead of the bare
-    lm_loss — routing telemetry rides the step outputs, no extra sync."""
+    ``{"lm_loss": ..., **routing_stats}`` of on-device f32 values
+    (aggregated over layers and the dp axis) instead of the bare
+    lm_loss — routing telemetry rides the step outputs, no extra sync.
+    The stats key set follows the dispatch mode (capacity: drops /
+    routed / imbalance / capacity-util scalars; ragged: explicit
+    drops=0, live/padded rows, [E] per-expert group sizes).
+
+    dispatch_mode: "capacity" (default), "ragged" (dropless grouped
+    GEMM), or None -> config.dispatch_mode -> PADDLE_TPU_MOE_DROPLESS
+    env default.
+
+    multi_precision: True (reference default) keeps f32 AdamW moments;
+    False stores moments in each param's dtype — on a bf16 expert stack
+    that halves the optimizer HBM streaming the r5 verdict flagged."""
+    if dispatch_mode is None:
+        dispatch_mode = config.dispatch_mode
+    if dispatch_mode is None:
+        dispatch_mode = default_dispatch_mode()
     if mesh is None and ep_degree * dp_degree > 1:
         from ..distributed.fleet.topology import _pick_devices
         devs = _pick_devices(ep_degree * dp_degree)
@@ -386,7 +415,7 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
         params = jax.tree_util.tree_map(
             lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
             params, pspecs, is_leaf=lambda x: not isinstance(x, dict))
-    opt = _adamw_init(params)
+    opt = _adamw_init(params, multi_precision=multi_precision)
 
     use_onehot = ep_degree > 1
     moe_mesh = mesh if ep_degree > 1 else None
@@ -394,7 +423,7 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
     def step(p, o, ids, labels):
         (loss, aux), grads = jax.value_and_grad(
             moe_loss, has_aux=True)(p, ids, labels, config, use_onehot,
-                                    moe_mesh, with_stats)
+                                    moe_mesh, with_stats, dispatch_mode)
         new_p, new_o = _adamw_update(p, grads, o, lr)
         if with_stats:
             lm_loss, stats = aux

@@ -38,6 +38,7 @@ Mosaic sees an unchanged block index and skips the copy).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +63,15 @@ DECODE_BLOCK_T = 512
 # 16 KB/lane) wants 32 MB of windows at 512 lanes vs the ~16 MB default
 # window. 12 MB leaves headroom for q/scratch/out and compiler temps.
 _DECODE_WINDOW_BUDGET = 12 * 1024 * 1024
+
+
+def hd64_stack_mode():
+    """PADDLE_TPU_DECODE_HD64_STACK=1 opts decode_attention_slab into the
+    PAIR-STACKED hd64 kernel (two head_dim-64 heads per 128-lane MXU
+    tile; see _kernel_pair). Default 0 keeps the batch-block-diagonal
+    kernel — the r5-measured block choice stays the fallback."""
+    return os.environ.get("PADDLE_TPU_DECODE_HD64_STACK", "0").strip() \
+        in ("1", "true", "yes", "on")
 
 
 def _fit_block_t(T, per_lane_bytes):
@@ -154,6 +164,93 @@ def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
             o_ref[bi] = big[bi * nh:(bi + 1) * nh,
                             bi * kvd:(bi + 1) * kvd]
 
+
+
+def _kernel_pair(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s,
+                 *, block_t, n_t, nb, online=False):
+    """PAIR-STACKED hd64 variant: grid (n_pairs, n_t). Each step handles
+    ONE 128-sublane cache band = two head_dim-64 heads of every batch.
+    The batch-block-diagonal query is [B*2, B*128] instead of
+    [B*NH, B*KVD], cutting the padded MXU FLOPs by NH/2 (8x at nh=16)
+    AND shrinking the per-lane window footprint by NH/2 — at hd64_b8
+    the full 512-lane T tile fits the VMEM budget again where the wide
+    slab had to drop to fragmented 128-lane DMAs (the 1.36x-of-floor r5
+    gap). Cache bytes are unchanged: each band streams exactly once."""
+    import numpy as np
+    p_id = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = lp_ref[1]
+    two = q_ref.shape[1]          # = 2 heads per band
+    band = q_ref.shape[2]         # = 128 lanes
+    start = j * np.int32(block_t)
+
+    @pl.when(j == 0)
+    def _build_qdiag():
+        # per-pair batch-block-diagonal queries [B*2, B*128], rebuilt at
+        # each pair's first T tile (scratch persists across pairs)
+        qd_s[...] = jnp.zeros(qd_s.shape, qd_s.dtype)
+        for bi in range(nb):
+            qd_s[bi * two:(bi + 1) * two,
+                 bi * band:(bi + 1) * band] = q_ref[bi]
+
+    def scores():
+        k = k_ref[0].reshape(nb * band, block_t)
+        s = jax.lax.dot_general(
+            qd_s[...], k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [B*2, Tt]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(t <= pos, s, -1e30)
+
+    def pv(p):
+        v = v_ref[0].reshape(nb * band, block_t)
+        return jax.lax.dot_general(
+            p, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [B*2, B*128]
+
+    @pl.when(j == 0)
+    def _first():
+        s = scores()
+        base = s.max(axis=-1, keepdims=True)
+        p = jnp.exp2(s - base)
+        b_s[...] = jnp.broadcast_to(base, b_s.shape)
+        l_s[...] = jnp.broadcast_to(p.sum(axis=-1, keepdims=True),
+                                    l_s.shape)
+        acc_s[...] = pv(p.astype(v_ref.dtype))
+
+    @pl.when(jnp.logical_and(j > 0, start <= pos))
+    def _more():
+        s = scores()
+        if online:
+            m_prev = b_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            b_s[...] = jnp.broadcast_to(m_new, b_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + pv(p.astype(v_ref.dtype))
+        else:
+            p = jnp.exp2(s - b_s[:, :1])
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + pv(p.astype(v_ref.dtype))
+
+    @pl.when(j == np.int32(n_t - 1))
+    def _fin():
+        # out rows span the FULL kvd width: the pair only computes its
+        # own 128-column band (the diagonal block the caller's eye
+        # contraction keeps); off-band columns are explicit zeros — the
+        # caller multiplies them by zero, so they must be finite, and
+        # no other grid step ever presents these out rows
+        big = acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        kvd = o_ref.shape[2]
+        for bi in range(nb):
+            row = lax.dynamic_update_slice(
+                jnp.zeros((two, kvd), jnp.float32),
+                big[bi * two:(bi + 1) * two,
+                    bi * band:(bi + 1) * band],
+                (0, p_id * np.int32(band)))
+            o_ref[bi] = row
 
 
 def _tile_plan(T, layer, pos, per_lane_bytes):
@@ -360,6 +457,10 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
     b, nh, kvd = q_bd.shape
     L, _, _, T = k_cache.shape
     it = jnp.dtype(k_cache.dtype).itemsize
+    if (hd64_stack_mode() and nh > 0 and kvd == nh * 64
+            and nh % 2 == 0 and T % 128 == 0):
+        return _decode_attention_slab_pair(q_bd, k_cache, v_cache,
+                                           layer, pos)
     plan = _tile_plan(T, layer, pos, b * kvd * it)
     if plan is None:
         return None  # ragged cache: caller falls back to the XLA path
@@ -392,6 +493,73 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
             # bytes-bound, so they are free in time but not in count)
             cost_estimate=_cost_estimate(
                 flops=4 * b * b * nh * kvd * T,
+                transcendentals=b * nh * T,
+                bytes_accessed=2 * b * kvd * T * it),
+            interpret=_interpret(),
+        )(lp, q_bd, k_cache, v_cache)
+    return out
+
+
+def _decode_attention_slab_pair(q_bd, k_cache, v_cache, layer, pos):
+    """hd64 pair-stacked slab attention (PADDLE_TPU_DECODE_HD64_STACK=1):
+    same contract as decode_attention_slab, requiring head_dim == 64,
+    even NH, and a 128-multiple cache extent (the caller checks).
+
+    Grid (n_pairs, n_t), t minor: each pair's 128-sublane k/v band
+    streams through all T tiles before the next pair starts; windows are
+    [B, 128, block_t] so _fit_block_t sizes against B*128*itemsize per
+    lane — NH/2 times thinner than the full slab, which is what lets
+    hd64_b8 keep the full 512-lane DMA tile."""
+    b, nh, kvd = q_bd.shape
+    L, _, _, T = k_cache.shape
+    it = jnp.dtype(k_cache.dtype).itemsize
+    n_pairs = nh // 2
+    block_t = _fit_block_t(T, b * 128 * it)
+    n_t = T // block_t
+    lp = jnp.stack([jnp.asarray(layer, jnp.int32),
+                    jnp.asarray(pos, jnp.int32)])
+
+    def live_map(p, j, lp_ref):
+        # clamp dead T tiles to the last live one (DMA elided); the
+        # sublane index picks the pair's 128-row cache band
+        jmax = lp_ref[1] // block_t
+        return (lp_ref[0], 0, p, jnp.minimum(j, jmax))
+
+    def q_map(p, j, lp_ref):
+        # q_bd is head-block-diagonal, so pair p's live columns are
+        # exactly the p-th 128-lane band: block (0, p, p)
+        return (0, p, p)
+
+    def o_map(p, j, lp_ref):
+        # full-width rows per pair (off-band columns zeroed in-kernel)
+        return (0, p, 0)
+
+    kernel = functools.partial(_kernel_pair, block_t=block_t, n_t=n_t,
+                               nb=b, online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_pairs, n_t),
+                in_specs=[
+                    pl.BlockSpec((b, 2, 128), q_map),
+                    pl.BlockSpec((1, b, 128, block_t), live_map),
+                    pl.BlockSpec((1, b, 128, block_t), live_map),
+                ],
+                out_specs=pl.BlockSpec((b, 2, kvd), o_map),
+                scratch_shapes=[
+                    pltpu.VMEM((b * 2, b * 128), q_bd.dtype),
+                    pltpu.VMEM((b * 2, 128), jnp.float32),
+                    pltpu.VMEM((b * 2, 128), jnp.float32),
+                    pltpu.VMEM((b * 2, b * 128), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+            # batch-diagonal padding is x B on a [2, 128] q block: NH/2
+            # fewer padded FLOPs than the full-slab block-diagonal form
+            cost_estimate=_cost_estimate(
+                flops=8 * b * b * kvd * T,
                 transcendentals=b * nh * T,
                 bytes_accessed=2 * b * kvd * T * it),
             interpret=_interpret(),

@@ -2,7 +2,10 @@
 
 Update rules are pure jnp functions jit-cached per parameter shape; states are
 fp32 regardless of param dtype (bf16-safe, like the reference's
-multi-precision kernels).
+multi-precision kernels). Adam/AdamW expose the reference's
+``multi_precision`` knob directly (default True = f32 moments + master
+weights; False narrows the stored moments to the param dtype, halving
+optimizer HBM streaming on bf16 stacks — update math stays f32).
 """
 from __future__ import annotations
 
@@ -57,10 +60,17 @@ class Momentum(Optimizer):
 
 
 class Adam(Optimizer):
+    """multi_precision (reference adam kernels' MultiPrecision attr)
+    defaults to TRUE here: f32 moments regardless of param dtype plus f32
+    master weights for bf16/fp16 params. multi_precision=False stores
+    the moments in each PARAM's dtype — half the optimizer HBM traffic
+    on a bf16 stack; the update still computes in f32 and only the
+    stored state narrows (update-parity test-asserted)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, name=None,
-                 multi_precision=False, amsgrad=False):
+                 multi_precision=True, amsgrad=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
@@ -68,12 +78,13 @@ class Adam(Optimizer):
         self._decoupled_wd = False  # Adam applies wd as L2 into grad
 
     def _create_accumulators(self, p):
-        st = {"moment1": jnp.zeros(p._data.shape, jnp.float32),
-              "moment2": jnp.zeros(p._data.shape, jnp.float32),
+        mdt = jnp.float32 if self._multi_precision else p._data.dtype
+        st = {"moment1": jnp.zeros(p._data.shape, mdt),
+              "moment2": jnp.zeros(p._data.shape, mdt),
               "beta1_pow": jnp.ones((), jnp.float32),
               "beta2_pow": jnp.ones((), jnp.float32)}
         if self._amsgrad:
-            st["moment2_max"] = jnp.zeros(p._data.shape, jnp.float32)
+            st["moment2_max"] = jnp.zeros(p._data.shape, mdt)
         return st
 
     def _update(self, p, g, state, lr, wd, group):
@@ -84,17 +95,21 @@ class Adam(Optimizer):
         b1, b2 = self._beta1, self._beta2
         b1p = state["beta1_pow"] * b1
         b2p = state["beta2_pow"] * b2
-        m = b1 * state["moment1"] + (1 - b1) * g32
-        v = b2 * state["moment2"] + (1 - b2) * g32 * g32
+        # math in f32 always; storage follows the accumulator dtype (f32
+        # under multi_precision — a no-op cast, bit-identical to before)
+        mdt = state["moment1"].dtype
+        m = b1 * _f32(state["moment1"]) + (1 - b1) * g32
+        v = b2 * _f32(state["moment2"]) + (1 - b2) * g32 * g32
         m_hat = m / (1 - b1p)
         if self._amsgrad:
-            v_max = jnp.maximum(state["moment2_max"], v)
+            v_max = jnp.maximum(_f32(state["moment2_max"]), v)
             v_hat = v_max / (1 - b2p)
-            new_state = {"moment1": m, "moment2": v, "moment2_max": v_max,
+            new_state = {"moment1": m.astype(mdt), "moment2": v.astype(mdt),
+                         "moment2_max": v_max.astype(mdt),
                          "beta1_pow": b1p, "beta2_pow": b2p}
         else:
             v_hat = v / (1 - b2p)
-            new_state = {"moment1": m, "moment2": v,
+            new_state = {"moment1": m.astype(mdt), "moment2": v.astype(mdt),
                          "beta1_pow": b1p, "beta2_pow": b2p}
         upd = m_hat / (jnp.sqrt(v_hat) + self._eps)
         if wd and self._decoupled_wd:
@@ -103,12 +118,14 @@ class Adam(Optimizer):
 
 
 class AdamW(Adam):
-    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py).
+    Shares Adam's ``multi_precision`` moment-dtype knob (default True:
+    f32 moments)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None,
+                 lazy_mode=False, multi_precision=True, name=None,
                  amsgrad=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, name,

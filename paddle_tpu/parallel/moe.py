@@ -12,6 +12,7 @@ explicit all-to-all moe_shard_map_dispatch remain as alternates.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +20,20 @@ from jax import lax
 
 from .._compat import axis_size as _axis_size
 from ..observability import trace as _obs
+
+
+def default_dispatch_mode():
+    """Dispatch mode from the environment: PADDLE_TPU_MOE_DROPLESS=1 turns
+    on the ragged grouped-GEMM path; unset/0 keeps the capacity slot
+    schedule (reference drop parity)."""
+    v = os.environ.get("PADDLE_TPU_MOE_DROPLESS", "").strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return "ragged"
+    if v in ("", "0", "false", "no", "off"):
+        return "capacity"
+    raise ValueError(
+        f"PADDLE_TPU_MOE_DROPLESS={v!r}: expected a boolean "
+        "(1/0/true/false/yes/no/on/off)")
 
 
 def _gshard_aux_loss(probs, E):
@@ -132,6 +147,56 @@ def topk_route(logits, k: int, capacity: int, drop_capacity=None):
     return slot.astype(jnp.int32), weight, aux_loss
 
 
+def ragged_buffer_rows(T, k, E, tile_rows):
+    """Static row count of the dropless expert-sorted token buffer.
+
+    Each expert's group is padded up to a tile boundary (at most
+    tile_rows-1 dead rows per expert), so round_up(T*k) + E*tile_rows
+    always covers the dynamic sum of aligned group sizes. Rows past the
+    last group are dead tail tiles the kernel zero-fills."""
+    return _round_up(T * k, tile_rows) + E * tile_rows
+
+
+def ragged_route(logits, k: int, tile_rows: int):
+    """DROPLESS routing into a tile-aligned expert-sorted buffer.
+
+    logits [T, E] fp32. Returns (slot [T*k] int32, weight [T, k] f32,
+    aux_loss, counts [E] int32, n_rows static int). Every (token, choice)
+    pair gets a row: slot = group_offset[expert] + queue position, where
+    group offsets come from the cumsum of tile-ROUNDED per-expert counts
+    (so each expert's rows start MXU-tile-aligned and the grouped-matmul
+    grid needs no intra-tile group switches). No capacity, no trash slot
+    for routed pairs — the only dead rows are the per-expert alignment
+    pads and the static tail, and those read the sentinel zero row.
+
+    Queue positions are the same token-major cumsum ``topk_route`` uses,
+    and the combine-weight formula is copied verbatim (with every pair
+    valid), so a no-drop capacity run and a ragged run see bit-identical
+    weights."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gates, experts = lax.top_k(probs, k)            # [T, k] each
+    aux_loss = _gshard_aux_loss(probs, E)
+
+    e_flat = experts.reshape(-1)                    # [T*k] token-major
+    oh = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)  # [T*k, E] (tiny)
+    pos = (jnp.cumsum(oh, axis=0) - oh)             # exclusive prefix count
+    pos = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
+    counts = oh.sum(axis=0).astype(jnp.int32)       # [E] group sizes
+    offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(_round_up(counts, tile_rows)).astype(jnp.int32)])
+    slot = offsets[e_flat] + pos
+
+    # same renormalization dance as topk_route with valid == all-true so
+    # the no-drop capacity weights match bitwise
+    g = gates
+    denom = jnp.maximum(g.sum(-1, keepdims=True), 1e-9)
+    weight = g / denom * gates.sum(-1, keepdims=True)
+    n_rows = ragged_buffer_rows(T, k, E, tile_rows)
+    return slot.astype(jnp.int32), weight, aux_loss, counts, n_rows
+
+
 # ---------------------------------------------------------------------------
 # Routing statistics (on-device, returned as auxiliary outputs — telemetry
 # reads them AFTER the step, never syncing inside it). All values are f32
@@ -181,16 +246,54 @@ def routing_stats_onehot(dispatch, k, drop_capacity=None):
             "moe_capacity_util": util}
 
 
-def zero_routing_stats():
-    """The stats pytree with all-zero values (layers without MoE / masking)."""
+def routing_stats_ragged(counts, k, tile_rows):
+    """Per-step routing stats for the DROPLESS ragged path.
+
+    counts: [E] int32 per-expert group sizes from ``ragged_route``.
+    Dropless means drops are structurally zero — moe_dropped_tokens is an
+    explicit 0 (not a fabricated capacity number), and the vacuous
+    capacity-utilization stat is replaced by the quantities that matter
+    for a ragged schedule: live vs tile-alignment-padded rows and the
+    per-expert group sizes themselves."""
+    counts_f = counts.astype(jnp.float32)
+    E = counts.shape[0]
+    live = counts_f.sum()
+    padded = _round_up(counts, tile_rows).astype(jnp.float32).sum() - live
+    mean = jnp.maximum(live / E, 1e-9)
+    return {"moe_dropped_tokens": jnp.zeros((), jnp.float32),
+            "moe_routed_tokens": live,
+            "moe_load_imbalance": counts_f.max() / mean,
+            "moe_live_rows": live,
+            "moe_padded_rows": padded,
+            "moe_expert_rows": counts_f}
+
+
+#: stats keys that are RATIOS — aggregate by averaging (over dp shards
+#: and over MoE layers); every other key is a count and sums.
+RATIO_STAT_KEYS = ("moe_load_imbalance", "moe_capacity_util")
+
+
+def zero_routing_stats(mode: str = "capacity", num_experts: int = 0):
+    """The stats pytree with all-zero values (layers without MoE / masking).
+
+    ``mode`` selects the key set ("capacity" default — the historical
+    4-scalar dict — or "ragged"); ragged needs ``num_experts`` for the
+    [E] per-expert group-size vector so dense/MoE lax.cond branches agree
+    on structure."""
     z = jnp.zeros((), jnp.float32)
+    if mode == "ragged":
+        return {"moe_dropped_tokens": z, "moe_routed_tokens": z,
+                "moe_load_imbalance": z, "moe_live_rows": z,
+                "moe_padded_rows": z,
+                "moe_expert_rows": jnp.zeros((num_experts,), jnp.float32)}
     return {"moe_dropped_tokens": z, "moe_routed_tokens": z,
             "moe_load_imbalance": z, "moe_capacity_util": z}
 
 
 def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
                          k=2, capacity_factor=1.25, use_onehot=False,
-                         strict_capacity=False, return_stats=False):
+                         strict_capacity=False, return_stats=False,
+                         dispatch_mode=None, act=jax.nn.gelu):
     """MoE dispatch/combine. x [T, D] tokens, expert_params stacked [E, ...].
 
     Default path (single-device / ep=1): SLOT SCHEDULE — each routed
@@ -215,7 +318,25 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
 
     return_stats=True appends a ``routing_stats`` dict as a third output
     (on-device f32 scalars: drops, load imbalance, capacity utilization)
-    for step telemetry; default keeps the 2-tuple API."""
+    for step telemetry; default keeps the 2-tuple API.
+
+    dispatch_mode selects "capacity" (default; also the
+    PADDLE_TPU_MOE_DROPLESS=0 env default) or "ragged" — the DROPLESS
+    grouped-GEMM path (moe_ragged_dispatch_combine). Ragged requires
+    ``expert_params`` to be the 2-tuple of stacked FFN weights
+    ``(w1 [E,H,I], w2 [E,I,H])`` with ``act`` between them (expert_fn is
+    ignored: the grouped kernel needs the matmul structure, not an opaque
+    callable)."""
+    if dispatch_mode is None:
+        dispatch_mode = default_dispatch_mode()
+    if dispatch_mode == "ragged":
+        w1, w2 = expert_params
+        return moe_ragged_dispatch_combine(
+            x, gate_logits, w1, w2, num_experts, k=k, act=act,
+            return_stats=return_stats)
+    if dispatch_mode != "capacity":
+        raise ValueError(f"unknown dispatch_mode {dispatch_mode!r} "
+                         "(expected 'capacity' or 'ragged')")
     T, D = x.shape
     capacity, ref_cap = moe_capacity(T, k, num_experts, capacity_factor)
     drop_cap = ref_cap if strict_capacity else capacity
@@ -254,6 +375,52 @@ def moe_dispatch_combine(x, gate_logits, expert_fn, expert_params, num_experts,
     if return_stats:
         return out, aux, routing_stats(slot, E, capacity, k,
                                        drop_capacity=drop_cap)
+    return out, aux
+
+
+def moe_ragged_dispatch_combine(x, gate_logits, w1, w2, num_experts, k=2,
+                                act=jax.nn.gelu, tile_rows=None,
+                                return_stats=False):
+    """DROPLESS MoE: ragged grouped-GEMM expert compute (MegaBlocks-style).
+
+    x [T, D] tokens; w1 [E, D, I] / w2 [E, I, D] stacked expert FFN
+    weights. Routing (``ragged_route``) lays every (token, choice) pair
+    into a tile-aligned expert-sorted buffer — no capacity buckets, no
+    drops; padding is bounded by one MXU row tile per expert plus a
+    static tail. The expert FFN then runs as two Pallas grouped matmuls
+    over ONE fixed grid of row tiles whose per-tile expert/live flags
+    come from the group boundaries (SMEM scalar prefetch) — each
+    expert's rows are computed exactly once, on real data.
+
+    Dispatch/combine reuse the slot schedule's gather-only custom vjps
+    (`_dispatch_rows`/`_combine_rows`) with the sentinel row mapping the
+    alignment pads and static tail to zeros.
+
+    return_stats=True appends ``routing_stats_ragged`` (explicit
+    drops=0, live-vs-padded rows, per-expert group sizes)."""
+    from ..ops.grouped_matmul import TILE_ROWS, grouped_matmul, tile_schedule
+    if tile_rows is None:
+        tile_rows = TILE_ROWS
+    T, D = x.shape
+    E = num_experts
+    slot, weight, aux, counts, n_rows = ragged_route(gate_logits, k,
+                                                     tile_rows)
+    sched = tile_schedule(counts, n_rows // tile_rows, tile_rows)[:4]
+
+    token_of_pair = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    inv = jnp.full((n_rows + 1,), T, jnp.int32).at[slot].set(
+        token_of_pair, mode="drop")
+    pair_inv = jnp.full((n_rows + 1,), T * k, jnp.int32).at[slot].set(
+        jnp.arange(T * k, dtype=jnp.int32), mode="drop")
+
+    xd = _dispatch_rows(x, inv, slot, k)            # [n_rows, D]
+    h = act(grouped_matmul(xd, w1, sched, tile_rows))
+    y = grouped_matmul(h, w2, sched, tile_rows)     # [n_rows, D']
+    d_out = y.shape[-1]
+    picked = _combine_rows(y, slot, pair_inv).reshape(T, k, d_out)
+    out = jnp.einsum("tk,tkd->td", weight.astype(picked.dtype), picked)
+    if return_stats:
+        return out, aux, routing_stats_ragged(counts, k, tile_rows)
     return out, aux
 
 
@@ -368,6 +535,91 @@ def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
         return out, aux, routing_stats(
             slot, E, capacity, k,
             drop_capacity=ref_cap if strict_capacity else capacity)
+    return out, aux
+
+
+def moe_ragged_dispatch_local(x, gate_logits, w1_local, w2_local,
+                              num_experts, axis_name="ep", k=2,
+                              act=jax.nn.gelu, tile_rows=None,
+                              return_stats=False):
+    """DROPLESS ragged MoE INSIDE a manual shard_map over `axis_name`:
+    the ragged analogue of moe_slot_dispatch_local. Each ep shard
+    computes the full top-k routing over its (dp-local, ep-replicated)
+    tokens, keeps only the pairs routed to its LOCAL experts, lays them
+    into a local tile-aligned ragged buffer (group boundaries over
+    E/n local experts), runs the two grouped matmuls, and the combine
+    psums [T, D] partials over 'ep' exactly as the slot schedule does —
+    the collective is unchanged, only the expert compute is ragged.
+
+    Because routing is dropless, shard outputs are equivalent to the
+    serial ragged path regardless of load skew (no per-shard capacity
+    semantics to diverge; test-asserted at ep=2).
+
+    return_stats: group sizes/imbalance are computed from the GLOBAL
+    per-expert counts (identical on every ep shard); padded rows differ
+    per shard (each pads its own local groups) and are psum'd over 'ep'
+    so the returned stats are ep-replicated like the slot path's."""
+    from ..ops.grouped_matmul import TILE_ROWS, grouped_matmul, tile_schedule
+    if tile_rows is None:
+        tile_rows = TILE_ROWS
+    n = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    T, D = x.shape
+    E = num_experts
+    e_local = E // n
+
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    gates, experts = lax.top_k(probs, k)
+    aux = _gshard_aux_loss(probs, E)
+    e_flat = experts.reshape(-1)                    # [T*k] token-major
+
+    # local-expert group layout: pairs owned by this shard bucket by
+    # LOCAL expert id; remote pairs go to a trash bucket whose queue we
+    # never materialize (slot -> the sentinel row n_rows)
+    le = e_flat - idx * e_local
+    mine = (le >= 0) & (le < e_local)
+    le_t = jnp.where(mine, le, e_local)             # e_local = trash bucket
+    oh = jax.nn.one_hot(le_t, e_local + 1, dtype=jnp.int32)
+    pos = (jnp.cumsum(oh, axis=0) - oh)
+    pos = jnp.take_along_axis(pos, le_t[:, None], axis=1)[:, 0]
+    counts = oh.sum(axis=0)[:e_local].astype(jnp.int32)
+    offsets = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(_round_up(counts, tile_rows)).astype(jnp.int32)])
+    # worst case every pair is local -> same static bound as serial with
+    # E/n groups
+    n_rows = ragged_buffer_rows(T, k, e_local, tile_rows)
+    slot = jnp.where(mine, offsets[le_t] + pos, n_rows).astype(jnp.int32)
+    sched = tile_schedule(counts, n_rows // tile_rows, tile_rows)[:4]
+
+    token_of_pair = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    inv = jnp.full((n_rows + 1,), T, jnp.int32).at[slot].set(
+        token_of_pair, mode="drop")
+    pair_inv = jnp.full((n_rows + 1,), T * k, jnp.int32).at[slot].set(
+        jnp.arange(T * k, dtype=jnp.int32), mode="drop")
+
+    xd = _dispatch_rows(x, inv, slot, k)
+    h = act(grouped_matmul(xd, w1_local, sched, tile_rows))
+    y = grouped_matmul(h, w2_local, sched, tile_rows)
+    d_out = y.shape[-1]
+    picked = _combine_rows(y, slot, pair_inv).reshape(T, k, d_out)
+
+    # same combine-weight formula as ragged_route (all pairs valid);
+    # remote pairs zeroed so the psum sums each pair exactly once
+    denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    weight = gates / denom * gates.sum(-1, keepdims=True)
+    w = weight * mine.reshape(T, k)
+    partial = jnp.einsum("tk,tkd->td", w.astype(picked.dtype), picked)
+    with _obs.comm_span("moe.combine_psum",
+                        nbytes=partial.size * partial.dtype.itemsize):
+        out = lax.psum(partial, axis_name)
+    if return_stats:
+        g_counts = jax.nn.one_hot(e_flat, E, dtype=jnp.int32).sum(axis=0)
+        st = routing_stats_ragged(g_counts.astype(jnp.int32), k, tile_rows)
+        local_pad = (_round_up(counts, tile_rows).astype(jnp.float32).sum()
+                     - counts.astype(jnp.float32).sum())
+        st["moe_padded_rows"] = lax.psum(local_pad, axis_name)
+        return out, aux, st
     return out, aux
 
 

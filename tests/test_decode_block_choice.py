@@ -84,3 +84,65 @@ def test_slab_attention_correct_at_fitted_tile():
     p /= p.sum(-1, keepdims=True)
     ref = np.einsum("bht,bct->bhc", p, vc[layer][:, :, :pos + 1])
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)
+
+
+def test_pair_stacked_hd64_matches_diagonal_bands(monkeypatch):
+    """PADDLE_TPU_DECODE_HD64_STACK=1 packs two head_dim-64 heads per
+    128-lane band: each pair writes its own diagonal band exactly and
+    zeros elsewhere (the slab caller's eye contraction only consumes the
+    per-head diagonal blocks, so off-band values just need to be finite).
+    The diagonal bands must match the per-head softmax reference."""
+    from paddle_tpu.ops.decode_attention import _LOG2E, hd64_stack_mode
+    monkeypatch.setenv("PADDLE_TPU_DECODE_HD64_STACK", "1")
+    assert hd64_stack_mode()
+    L, B, NH, HD, T, pos = 2, 8, 4, 64, 4096, 700
+    KVD = NH * HD
+    rng = np.random.RandomState(5)
+    q = rng.randn(B, NH, KVD).astype(np.float32) * 0.1
+    # the slab caller hands the kernel a head-block-diagonal query: head h
+    # only has live columns in its own 64-lane band
+    qbd = np.zeros_like(q)
+    for h in range(NH):
+        qbd[:, h, h * HD:(h + 1) * HD] = q[:, h, h * HD:(h + 1) * HD]
+    kc = rng.randn(L, B, KVD, T).astype(np.float32)
+    vc = rng.randn(L, B, KVD, T).astype(np.float32)
+    layer = 1
+    qs = jnp.asarray(qbd * (_LOG2E / (HD ** 0.5)))
+    out = decode_attention_slab(qs, jnp.asarray(kc), jnp.asarray(vc),
+                                layer, pos)
+    assert out is not None
+    out = np.asarray(out)
+    s = np.einsum("bhc,bct->bht", qbd,
+                  kc[layer][:, :, :pos + 1]) / (HD ** 0.5)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bht,bct->bhc", p, vc[layer][:, :, :pos + 1])
+    for h in range(NH):
+        lo = (h // 2) * 128
+        np.testing.assert_allclose(out[:, h, lo:lo + 128],
+                                   ref[:, h, lo:lo + 128],
+                                   rtol=2e-3, atol=2e-3)
+        off = np.delete(out[:, h], np.s_[lo:lo + 128], axis=-1)
+        assert (off == 0).all(), f"head {h}: off-band must be zeros"
+
+
+def test_pair_stacked_falls_back_when_unsuited(monkeypatch):
+    """The pair path only engages for even-head hd64 slabs; odd head
+    counts or non-64 head dims must take the baseline kernel (which this
+    exercises end-to-end via its full-width output)."""
+    from paddle_tpu.ops.decode_attention import _LOG2E
+    monkeypatch.setenv("PADDLE_TPU_DECODE_HD64_STACK", "1")
+    L, B, NH, HD, T, pos = 2, 4, 2, 128, 2048, 300   # hd128: no stacking
+    KVD = NH * HD
+    rng = np.random.RandomState(7)
+    q = rng.randn(B, NH, KVD).astype(np.float32) * 0.1
+    kc = rng.randn(L, B, KVD, T).astype(np.float32)
+    vc = rng.randn(L, B, KVD, T).astype(np.float32)
+    qs = jnp.asarray(q * (_LOG2E / (HD ** 0.5)))
+    out = decode_attention_slab(qs, jnp.asarray(kc), jnp.asarray(vc), 0, pos)
+    assert out is not None
+    s = np.einsum("bhc,bct->bht", q, kc[0][:, :, :pos + 1]) / (HD ** 0.5)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bht,bct->bhc", p, vc[0][:, :, :pos + 1])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-3, atol=2e-3)

@@ -68,6 +68,45 @@ def test_multi_precision_master_weights():
     assert len(o._master_weights) == 1  # fp32 master kept
 
 
+def test_multi_precision_moment_dtype_and_parity():
+    """multi_precision=False stores Adam moments in the PARAM dtype
+    (optimizer HBM halves on bf16); True (the default) keeps f32 moments.
+    The update math is f32 either way, so a few steps on a bf16 param
+    must agree within bf16 rounding of the moments."""
+    import jax.numpy as jnp
+
+    def run(multi_precision):
+        paddle.seed(0)
+        m = nn.Linear(4, 4, bias_attr=False)
+        m.bfloat16()
+        o = opt.AdamW(learning_rate=1e-2, parameters=m.parameters(),
+                      multi_precision=multi_precision)
+        x = paddle.ones([2, 4]).astype("bfloat16")
+        for _ in range(5):
+            m(x).astype("float32").sum().backward()
+            o.step()
+            o.clear_grad()
+        st = list(o._accumulators.values())[0]
+        return m.weight.numpy().astype(np.float32), st
+
+    w_hi, st_hi = run(True)
+    w_lo, st_lo = run(False)
+    assert st_hi["moment1"].dtype == jnp.float32
+    assert st_lo["moment1"].dtype == jnp.bfloat16
+    # bf16 moments round each step; updates stay within a few bf16 ulps
+    np.testing.assert_allclose(w_lo, w_hi, rtol=2e-2, atol=2e-2)
+    # f32-param models are unaffected by the knob: moments match exactly
+    def run_f32(mp):
+        paddle.seed(0)
+        m = _one_param_model(1.0)
+        o = opt.Adam(learning_rate=0.1, parameters=m.parameters(),
+                     multi_precision=mp)
+        for _ in range(3):
+            _step(m, o, 0.5)
+        return m.weight.numpy()
+    np.testing.assert_array_equal(run_f32(True), run_f32(False))
+
+
 def test_param_groups():
     a, b = nn.Linear(2, 2), nn.Linear(2, 2)
     o = opt.SGD(learning_rate=0.1, parameters=[
