@@ -250,3 +250,11 @@ def get_num_bytes_of_data_type(dtype) -> int:
 __all__ = ["Config", "Predictor", "PredictorPool", "create_predictor",
            "get_version", "get_num_bytes_of_data_type", "PrecisionType",
            "PlaceType"]
+
+
+# --- continuous-batching serving engine (paged KV cache) -------------------
+from .kv_cache import BlockPool, pad_table  # noqa: E402
+from .engine import (InferenceEngine, Request, ServeConfig)  # noqa: E402
+
+__all__ += ["BlockPool", "pad_table", "InferenceEngine", "Request",
+            "ServeConfig"]

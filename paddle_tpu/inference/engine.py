@@ -1,0 +1,443 @@
+"""Continuous-batching serving engine (iteration-level scheduling).
+
+Orca-style iteration-level scheduling (Yu et al., OSDI '22) over the
+vLLM paged KV cache (Kwon et al., SOSP '23), restated for TPU static
+shapes: every device program in the serving hot path comes from ONE
+compiled step family per bucketed shape —
+
+  - ``paged_decode_step`` at batch buckets (1, 2, 4, ..., max_batch):
+    one token for every RUNNING sequence through the fused
+    paged-attention update kernel (ops/paged_attention.py);
+  - ``paged_prefill_chunk`` at the fixed chunk bucket: one slice of
+    ONE admitted prompt, interleaved with the decode batches so long
+    prompts never head-of-line-block token generation.
+
+Recompiles are therefore bounded by ``len(decode_buckets) + 1`` and
+counted (``serve.compile.*`` counters + StepMetrics.record_compile).
+
+Scheduling per ``step()`` iteration:
+  1. admit waiting requests while the free-block budget covers their
+     prompt (plus one decode block of headroom);
+  2. run one prefill chunk for the oldest admitted prompt, allocating
+     its blocks lazily per chunk;
+  3. run one decode batch over all RUNNING sequences, allocating each
+     sequence's next block as it crosses a block boundary and
+     PREEMPTING-BY-EVICTION (youngest RUNNING sequence back to the
+     waiting queue, blocks freed, recompute-on-readmission) when the
+     pool runs dry.
+
+Telemetry: queue depth, batch occupancy, block-pool utilization and
+prefill-vs-decode time share per iteration through StepMetrics, with
+comm_span/counter markers on every scheduling event.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
+                            _jitted_paged_prefill, init_paged_kv_pool)
+from ..observability.metrics import StepMetrics
+from ..observability.trace import comm_span, record_counter
+from .kv_cache import BlockPool, pad_table
+
+WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
+    "finished"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``arrival`` is seconds from engine start
+    (wall mode) or the iteration index (deterministic replay mode)."""
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    request_id: Optional[int] = None
+    eos_id: Optional[int] = None
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    block_size: int = 128
+    num_blocks: int = 64          # includes the reserved null block 0
+    max_batch: int = 8
+    prefill_chunk: int = 64
+    max_seq_len: int = 1024       # bounds the block-table width
+    decode_buckets: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.decode_buckets is None:
+            b, buckets = 1, []
+            while b < self.max_batch:
+                buckets.append(b)
+                b *= 2
+            self.decode_buckets = tuple(buckets) + (self.max_batch,)
+        self.decode_buckets = tuple(sorted(set(self.decode_buckets)))
+        if self.decode_buckets[-1] != self.max_batch:
+            raise ValueError("largest decode bucket must equal max_batch")
+
+    @property
+    def max_nb(self) -> int:
+        return -(-self.max_seq_len // self.block_size)
+
+
+class _Seq:
+    """Scheduler-side sequence state. Invariant while RUNNING:
+    n_cached == len(tokens) - 1, and the next decode feeds tokens[-1]
+    at position n_cached."""
+
+    def __init__(self, req: Request, now: float):
+        self.req = req
+        self.tokens: List[int] = [int(t) for t in req.prompt]
+        self.n_prompt = len(self.tokens)
+        self.n_cached = 0
+        self.blocks: List[int] = []
+        self.state = WAITING
+        self.arrival = now
+        self.order = 0                 # submission sequence number
+        self.first_token_t: Optional[float] = None
+        self.token_times: List[float] = []
+        self.n_preempted = 0
+
+    @property
+    def generated(self) -> List[int]:
+        return self.tokens[self.n_prompt:]
+
+    @property
+    def prefill_target(self) -> int:
+        # fresh prompts cache every prompt token and sample from the
+        # final chunk's logits; a preempted sequence re-caches all but
+        # its newest (never-fed) token and resumes decoding instead
+        return len(self.tokens) - (1 if self.generated else 0)
+
+    def done(self) -> bool:
+        g = self.generated
+        return (len(g) >= self.req.max_new_tokens
+                or (self.req.eos_id is not None and g
+                    and g[-1] == self.req.eos_id))
+
+
+class InferenceEngine:
+    """Continuous-batching engine over a paged KV cache.
+
+    >>> eng = InferenceEngine(params, config, ServeConfig())
+    >>> stats = eng.run([Request(prompt, max_new_tokens=32), ...])
+
+    Greedy decoding; one engine owns its device pools, so drive it from
+    a single thread."""
+
+    def __init__(self, params: Dict[str, Any], config: LlamaConfig,
+                 serve: Optional[ServeConfig] = None,
+                 telemetry: Optional[StepMetrics] = None,
+                 record_events: bool = False):
+        self.params = params
+        self.config = config
+        self.serve = serve or ServeConfig()
+        self.pool = BlockPool(self.serve.num_blocks, self.serve.block_size)
+        self.k_pool, self.v_pool = init_paged_kv_pool(
+            config, self.serve.num_blocks, self.serve.block_size)
+        self.metrics = telemetry
+        self.record_events = record_events
+        self.events: List[Tuple] = []
+        self.waiting: List[_Seq] = []
+        self.active: List[_Seq] = []      # PREFILL + RUNNING, FCFS order
+        self.finished: List[_Seq] = []
+        self.iteration = 0
+        self.preemptions = 0
+        self._last_tokens = 0
+        self._rid = itertools.count()
+        self._seqno = itertools.count()
+        self._frozen = _freeze_config(config)
+        self._compiled: Dict[Tuple, float] = {}
+        self._clock = 0.0
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _event(self, *ev):
+        if self.record_events:
+            self.events.append((self.iteration,) + tuple(ev))
+
+    def _alloc_for(self, seq: _Seq, n_tokens: int) -> bool:
+        """Grow ``seq`` to cover ``n_tokens`` cached tokens; False (and
+        no change) when the pool is dry."""
+        need = self.pool.blocks_for(n_tokens) - len(seq.blocks)
+        if need <= 0:
+            return True
+        got = self.pool.alloc(need)
+        if got is None:
+            return False
+        seq.blocks.extend(got)
+        record_counter("serve.blocks_alloc", need)
+        return True
+
+    def _release(self, seq: _Seq):
+        if seq.blocks:
+            record_counter("serve.blocks_free", len(seq.blocks))
+            self.pool.free(seq.blocks)
+            seq.blocks = []
+
+    def _evict_one(self, protect: Optional[_Seq] = None) -> bool:
+        """Preempt the YOUNGEST running sequence: free its blocks and
+        push it to the FRONT of the waiting queue for recompute-style
+        readmission (its generated tokens are kept; the KV prefix is
+        re-prefilled)."""
+        victims = [s for s in self.active
+                   if s.state == RUNNING and s is not protect]
+        if not victims:
+            return False
+        # ties on arrival (e.g. a burst submitted at the same instant)
+        # break toward the latest-submitted sequence, deterministically
+        victim = max(victims, key=lambda s: (s.arrival, s.order))
+        self.active.remove(victim)
+        self._release(victim)
+        victim.state = WAITING
+        victim.n_cached = 0
+        victim.n_preempted += 1
+        self.waiting.insert(0, victim)
+        self.preemptions += 1
+        record_counter("serve.preempt")
+        self._event("evict", victim.req.request_id)
+        return True
+
+    def _mark_compiled(self, kind: str, key, t_call: float):
+        if (kind, key) not in self._compiled:
+            self._compiled[(kind, key)] = t_call
+            record_counter(f"serve.compile.{kind}")
+            if self.metrics is not None:
+                self.metrics.record_compile(compile_s=t_call)
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, req: Request):
+        if req.request_id is None:
+            req.request_id = next(self._rid)
+        worst = len(req.prompt) + req.max_new_tokens
+        if worst > self.serve.max_seq_len:
+            raise ValueError(
+                f"request {req.request_id}: prompt+max_new_tokens {worst} "
+                f"exceeds max_seq_len {self.serve.max_seq_len}")
+        if self.pool.blocks_for(worst) > self.serve.num_blocks - 1:
+            raise ValueError(
+                f"request {req.request_id} can never fit the pool "
+                f"({worst} tokens > {self.serve.num_blocks - 1} blocks)")
+        if not len(req.prompt):
+            raise ValueError(f"request {req.request_id}: empty prompt")
+        seq = _Seq(req, self._clock)
+        seq.order = next(self._seqno)
+        self.waiting.append(seq)
+        self._event("submit", req.request_id)
+
+    def step(self) -> List[_Seq]:
+        """One scheduler iteration: admit, one prefill chunk, one decode
+        batch. Returns sequences that finished this iteration."""
+        self.iteration += 1
+        self._last_tokens = 0
+        t_iter = time.perf_counter()
+        self._admit()
+        t_adm = time.perf_counter()
+        ran_prefill = self._prefill_chunk()
+        t_pre = time.perf_counter()
+        done = self._decode_batch()
+        t_dec = time.perf_counter()
+        for seq in done:
+            self._event("finish", seq.req.request_id, len(seq.generated))
+        if self.metrics is not None:
+            n_run = sum(1 for s in self.active if s.state == RUNNING)
+            self.metrics.step(
+                step_time_s=t_dec - t_iter,
+                tokens=self._last_tokens,
+                queue_depth=len(self.waiting),
+                n_running=n_run,
+                n_prefill=sum(1 for s in self.active
+                              if s.state == PREFILL),
+                batch_occupancy=n_run / self.serve.max_batch,
+                pool_utilization=self.pool.utilization,
+                prefill_ms=(t_pre - t_adm) * 1e3 if ran_prefill else 0.0,
+                decode_ms=(t_dec - t_pre) * 1e3,
+            )
+        return done
+
+    def idle(self) -> bool:
+        return not self.waiting and not self.active
+
+    # -- scheduler phases ---------------------------------------------------
+
+    def _admit(self):
+        while self.waiting and len(self.active) < self.serve.max_batch:
+            seq = self.waiting[0]
+            need = self.pool.blocks_for(seq.prefill_target) + 1
+            if not self.pool.can_alloc(need):
+                break
+            self.waiting.pop(0)
+            seq.state = PREFILL
+            seq.n_cached = 0
+            self.active.append(seq)
+            record_counter("serve.admit")
+            self._event("admit", seq.req.request_id)
+
+    def _prefill_chunk(self) -> bool:
+        seq = next((s for s in self.active if s.state == PREFILL), None)
+        if seq is None:
+            return False
+        c = self.serve.prefill_chunk
+        n_live = min(c, seq.prefill_target - seq.n_cached)
+        if not self._alloc_for(seq, seq.n_cached + n_live):
+            # pool dry mid-prompt: steal from the youngest decoder; if
+            # there is none, stall — decode progress will free blocks
+            if not (self._evict_one(protect=seq)
+                    and self._alloc_for(seq, seq.n_cached + n_live)):
+                return False
+        ids = np.zeros((c,), np.int32)
+        ids[:n_live] = seq.tokens[seq.n_cached:seq.n_cached + n_live]
+        table = pad_table(seq.blocks, self.serve.max_nb)
+        fn = _jitted_paged_prefill(self._frozen)
+        key = ("prefill", c)
+        t0 = time.perf_counter()
+        with comm_span("serve.prefill",
+                       nbytes=int(n_live) * 4):
+            logits, self.k_pool, self.v_pool = fn(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(table), np.int32(seq.n_cached),
+                jnp.asarray(ids), np.int32(n_live))
+            logits = np.asarray(logits)   # sync: honest phase timing
+        self._mark_compiled(*key, time.perf_counter() - t0)
+        seq.n_cached += n_live
+        if seq.n_cached == seq.prefill_target:
+            if not seq.generated:
+                # fresh prompt: the final chunk's logits sample the
+                # first new token (greedy)
+                seq.tokens.append(int(logits.argmax(-1)))
+                seq.first_token_t = self._now()
+                seq.token_times.append(seq.first_token_t)
+                self._last_tokens += 1
+            seq.state = RUNNING
+        return True
+
+    def _decode_batch(self) -> List[_Seq]:
+        # grow each row across its block boundary, evicting youngest-
+        # first when the pool runs dry (an evicted row drops out of the
+        # batch by losing RUNNING state); with nothing evictable the row
+        # stalls an iteration instead — finishing rows free its blocks
+        ready: List[_Seq] = []
+        for seq in [s for s in self.active if s.state == RUNNING]:
+            if seq.state != RUNNING:
+                continue
+            ok = self._alloc_for(seq, seq.n_cached + 1)
+            while not ok and self._evict_one(protect=seq):
+                ok = self._alloc_for(seq, seq.n_cached + 1)
+            if ok:
+                ready.append(seq)
+            else:
+                record_counter("serve.decode_stall")
+        rows = [s for s in ready if s.state == RUNNING]
+        if not rows:
+            return []
+        bucket = next(b for b in self.serve.decode_buckets
+                      if b >= len(rows))
+        toks = np.zeros((bucket,), np.int32)
+        positions = np.zeros((bucket,), np.int32)
+        tables = np.zeros((bucket, self.serve.max_nb), np.int32)
+        for i, seq in enumerate(rows):
+            toks[i] = seq.tokens[-1]
+            positions[i] = seq.n_cached
+            tables[i] = pad_table(seq.blocks, self.serve.max_nb)
+        fn = _jitted_paged_decode(self._frozen)
+        key = ("decode", bucket)
+        t0 = time.perf_counter()
+        with comm_span("serve.decode", nbytes=bucket * 4):
+            logits, self.k_pool, self.v_pool = fn(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(toks))
+            next_tok = np.asarray(logits).argmax(-1)
+        self._mark_compiled(*key, time.perf_counter() - t0)
+        self._last_tokens += len(rows)
+        done = []
+        now = self._now()
+        for i, seq in enumerate(rows):
+            seq.n_cached += 1
+            seq.tokens.append(int(next_tok[i]))
+            if seq.first_token_t is None:
+                seq.first_token_t = now
+            seq.token_times.append(now)
+            if seq.done():
+                seq.state = FINISHED
+                self.active.remove(seq)
+                self._release(seq)
+                self.finished.append(seq)
+                record_counter("serve.finish")
+                done.append(seq)
+        return done
+
+    # -- driving loops ------------------------------------------------------
+
+    def _now(self) -> float:
+        return self._clock
+
+    def run(self, requests: Sequence[Request],
+            deterministic: bool = False, max_iterations: int = 100000
+            ) -> Dict[str, Any]:
+        """Drive the engine until every request finishes.
+
+        Wall mode (default): ``arrival`` is seconds from start; the
+        engine clock is wall time and idle gaps are slept through.
+        Deterministic mode: ``arrival`` is an ITERATION index and the
+        clock counts iterations — replaying the same trace must
+        reproduce the same event log and tokens bit-for-bit
+        (scheduling never consults wall time)."""
+        pending = sorted(requests, key=lambda r: r.arrival)
+        t0 = time.perf_counter()
+        while pending or not self.idle():
+            if self.iteration >= max_iterations:
+                raise RuntimeError("engine exceeded max_iterations")
+            self._clock = (float(self.iteration) if deterministic
+                           else time.perf_counter() - t0)
+            while pending and pending[0].arrival <= self._clock:
+                self.submit(pending.pop(0))
+            if self.idle() and pending:
+                if deterministic:
+                    self.iteration += 1
+                else:
+                    time.sleep(min(
+                        pending[0].arrival - self._clock, 0.01))
+                continue
+            self.step()
+            if not deterministic:
+                self._clock = time.perf_counter() - t0
+        return self.stats()
+
+    def stats(self) -> Dict[str, Any]:
+        """Throughput/latency aggregates over finished requests (times
+        in the engine clock: seconds in wall mode, iterations in
+        deterministic mode)."""
+        seqs = self.finished
+        gen = sum(len(s.generated) for s in seqs)
+        ttfts = [s.first_token_t - s.arrival for s in seqs
+                 if s.first_token_t is not None]
+        gaps: List[float] = []
+        for s in seqs:
+            gaps.extend(np.diff(s.token_times).tolist())
+        span = (max((s.token_times[-1] for s in seqs if s.token_times),
+                    default=0.0)
+                - min((s.arrival for s in seqs), default=0.0))
+        pct = (lambda a, q: float(np.percentile(a, q)) if a else None)
+        return {
+            "requests": len(seqs),
+            "generated_tokens": gen,
+            "elapsed_s": span,
+            "tokens_per_sec": gen / span if span > 0 else None,
+            "ttft_p50_s": pct(ttfts, 50),
+            "ttft_p99_s": pct(ttfts, 99),
+            "tpot_p50_s": pct(gaps, 50),
+            "tpot_p99_s": pct(gaps, 99),
+            "preemptions": self.preemptions,
+            "iterations": self.iteration,
+            "compiles": {f"{k}_{v}": round(t, 3)
+                         for (k, v), t in sorted(self._compiled.items())},
+            "pool_blocks": self.serve.num_blocks - 1,
+        }

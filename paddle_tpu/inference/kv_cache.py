@@ -1,0 +1,91 @@
+"""Block-table paged KV cache: host-side allocator + device pools.
+
+The vLLM PagedAttention memory model (Kwon et al., SOSP '23) restated
+for TPU static shapes: the device holds ONE preallocated pool per
+layer-stacked k/v ([L, num_blocks, KV*HD, block_size], see
+models/llama.py init_paged_kv_pool), sequences own disjoint sets of
+blocks named by per-sequence int32 block tables, and every alloc/free
+decision happens HERE on the host — the device path never reshapes,
+never compacts, never copies a cache.
+
+Block 0 is the reserved NULL block: it is never allocated, every
+padding row of a bucketed decode batch points its whole table at it,
+and the fused update kernel scribbles padding rows' (masked) garbage
+columns there. That keeps the kernel total — every row writes — while
+live blocks stay bit-exact.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class BlockPool:
+    """Free-list allocator over ``num_blocks`` fixed-size blocks.
+
+    O(1) alloc/free via a LIFO free list; all-or-nothing allocation so
+    a failed admission never leaks partial sets. Block 0 is reserved
+    (the null block) and never handed out."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"BlockPool needs >= 2 blocks (one is the reserved null "
+                f"block), got {num_blocks}")
+        if block_size < 1 or block_size % 128:
+            raise ValueError(
+                f"block_size must be a positive multiple of 128 (TPU lane "
+                f"tiling), got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # LIFO keeps recently-freed (cache-warm) blocks in circulation
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        return self.used_blocks / max(self.num_blocks - 1, 1)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None (and no state change) if the pool is dry."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        got = self._free[-n:] if n else []
+        del self._free[len(self._free) - n:]
+        return got
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 1 <= b < self.num_blocks:
+                raise ValueError(f"free of out-of-range block {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks a sequence of ``n_tokens`` occupies."""
+        return -(-max(n_tokens, 0) // self.block_size)
+
+
+def pad_table(blocks: List[int], max_nb: int) -> np.ndarray:
+    """A sequence's block list as a fixed-width table row; unallocated
+    slots point at the null block."""
+    if len(blocks) > max_nb:
+        raise ValueError(
+            f"sequence holds {len(blocks)} blocks > table width {max_nb}")
+    row = np.zeros((max_nb,), np.int32)
+    row[:len(blocks)] = blocks
+    return row

@@ -861,6 +861,206 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
                                         "pos": pos + 1}
 
 
+def init_paged_kv_pool(config: LlamaConfig, num_blocks: int,
+                       block_size: int):
+    """Paged KV pool for the serving engine: k and v
+    [L, num_blocks, KV*HD, block_size] — each block is a time-in-lanes
+    slab fragment, so the paged kernel's per-block dots are the same
+    [KVD, bs] shapes the contiguous slab kernel tiles into. Block 0 is
+    reserved as the null block (see inference/kv_cache.py): padding
+    rows scribble there and live tables never reference it."""
+    c = config
+    kvd = c.num_key_value_heads * c.head_dim
+    shape = (c.num_hidden_layers, num_blocks, kvd, block_size)
+    return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+
+def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
+                            ids, config: LlamaConfig):
+    """One decode step over a PAGED cache: ids [B] i32, tables
+    [B, max_nb] i32 block tables, positions [B] i32 = the slot each
+    row's new token occupies (== its cached length; the block holding
+    it must already be in the table). Per-row rope phases come from
+    ``positions`` so every sequence in the batch can sit at a different
+    depth — the whole point of continuous batching. Padding rows point
+    their tables at null block 0 with positions 0.
+
+    Returns (logits [B, vocab] f32, k_pool, v_pool). The pools ride
+    the layer scan as carries and the Pallas kernel updates them
+    in-place through input_output_aliases, so no per-layer cache copy
+    exists (the conservative-aliasing trap documented in
+    ops/decode_attention.py STATUS)."""
+    from ..ops.paged_attention import _LOG2E, paged_attend_update
+    c = config
+    b = ids.shape[0]
+    hd = c.head_dim
+    h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)  # [B, H]
+    cos, sin = build_rope_cache(b, hd, base=c.rope_theta,
+                                position_ids=positions[:, None])  # [B,1,·]
+
+    def layer_step(carry, xs):
+        h, kp, vp = carry
+        p, layer = xs
+        x = fused_rms_norm(h[:, None], p["input_norm"], c.rms_norm_eps)
+        if "qkv_proj" in p:
+            ratio = c.num_attention_heads // c.num_key_value_heads
+            nkv = _mat_out_dim(p["qkv_proj"]) // hd // (ratio + 2)
+            nh = nkv * ratio
+            qkv = _mat(x, p["qkv_proj"])
+            q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+            q = q.reshape(b, 1, nh, hd)
+            k = k.reshape(b, 1, nkv, hd)
+            v = v.reshape(b, 1, nkv, hd)
+        else:
+            nh = _mat_out_dim(p["q_proj"]) // hd
+            nkv = _mat_out_dim(p["k_proj"]) // hd
+            q = _mat(x, p["q_proj"]).reshape(b, 1, nh, hd)
+            k = _mat(x, p["k_proj"]).reshape(b, 1, nkv, hd)
+            v = _mat(x, p["v_proj"]).reshape(b, 1, nkv, hd)
+        kvd = nkv * hd
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        layer_i = jnp.asarray(layer, jnp.int32)
+        rep = nh // nkv
+        qg = q[:, 0].reshape(b, nkv, rep, hd)
+        # block-diagonal q (see llama_decode_step): the paged kernel
+        # reads whole [KVD, bs] slab fragments per sequence
+        eye = jnp.eye(nkv, dtype=qg.dtype)
+        q_bd = jnp.einsum("bgrd,ge->bgred", qg, eye).reshape(b, nh, kvd)
+        qs = (q_bd.astype(jnp.float32)
+              * (_LOG2E / (hd ** 0.5))).astype(q_bd.dtype)
+        attn_full, kp, vp = paged_attend_update(
+            qs, k.reshape(b, kvd).astype(kp.dtype),
+            v.reshape(b, kvd).astype(vp.dtype), kp, vp,
+            tables, positions, layer_i)
+        attn = jnp.einsum("bgred,ge->bgrd",
+                          attn_full.reshape(b, nkv, rep, nkv, hd),
+                          eye.astype(attn_full.dtype)).astype(c.dtype)
+        attn_out = _mat(attn.reshape(b, nh * hd), p["o_proj"])
+        h = h + attn_out
+        x2 = fused_rms_norm(h[:, None], p["post_norm"], c.rms_norm_eps)[:, 0]
+        gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
+        h = h + _mat(gated, p["down_proj"])
+        return (h, kp, vp), None
+
+    n_layers = k_pool.shape[0]
+    (h, k_pool, v_pool), _ = lax.scan(
+        layer_step, (h, k_pool, v_pool),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)))
+    logits = llama_logits(params, h[:, None], config)[:, 0]
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
+def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
+                              ids, n_live, config: LlamaConfig):
+    """One chunked-prefill slice for ONE sequence: ids [C] i32 padded
+    to the chunk bucket, n_live (traced) real tokens, start (traced) =
+    tokens already cached from earlier chunks. Scatters the chunk's KV
+    into the sequence's blocks (padding tokens land in null block 0),
+    attends each chunk token over cached-prefix + chunk causally via
+    the gathered-context XLA path, and returns the logits of the LAST
+    REAL token ([vocab] f32 — only meaningful on the final chunk) plus
+    the updated pools."""
+    c = config
+    C = ids.shape[0]
+    hd = c.head_dim
+    bs = k_pool.shape[-1]
+    max_nb = table_row.shape[0]
+    T = max_nb * bs
+    h = jnp.take(params["embed"], ids, axis=0)[None].astype(c.dtype)
+    pidx = start + jnp.arange(C, dtype=jnp.int32)          # [C] positions
+    cos, sin = build_rope_cache(C, hd, base=c.rope_theta,
+                                position_ids=pidx)         # [C, hd/2]
+    live = jnp.arange(C, dtype=jnp.int32) < n_live
+    bid = jnp.where(live, table_row[jnp.clip(pidx // bs, 0, max_nb - 1)],
+                    0).astype(jnp.int32)
+    col = pidx % bs
+
+    def layer_step(carry, xs):
+        h, kp, vp = carry
+        p, layer = xs
+        x = fused_rms_norm(h, p["input_norm"], c.rms_norm_eps)
+        if "qkv_proj" in p:
+            ratio = c.num_attention_heads // c.num_key_value_heads
+            nkv = _mat_out_dim(p["qkv_proj"]) // hd // (ratio + 2)
+            nh = nkv * ratio
+            qkv = _mat(x, p["qkv_proj"])
+            q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+            q = q.reshape(1, C, nh, hd)
+            k = k.reshape(1, C, nkv, hd)
+            v = v.reshape(1, C, nkv, hd)
+        else:
+            nh = _mat_out_dim(p["q_proj"]) // hd
+            nkv = _mat_out_dim(p["k_proj"]) // hd
+            q = _mat(x, p["q_proj"]).reshape(1, C, nh, hd)
+            k = _mat(x, p["k_proj"]).reshape(1, C, nkv, hd)
+            v = _mat(x, p["v_proj"]).reshape(1, C, nkv, hd)
+        kvd = nkv * hd
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        # scatter the chunk's KV columns into their blocks ([C]-indexed
+        # rows over the [NP, KVD, bs] pool slab: one scatter per layer)
+        kp = kp.at[layer, bid, :, col].set(
+            k.reshape(C, kvd).astype(kp.dtype))
+        vp = vp.at[layer, bid, :, col].set(
+            v.reshape(C, kvd).astype(vp.dtype))
+        # gather the sequence's context (prefix + this chunk) back to a
+        # contiguous slab; dead table slots read null-block garbage that
+        # the causal mask kills
+        kctx = jnp.transpose(kp[layer][table_row], (1, 0, 2)) \
+            .reshape(kvd, T)
+        vctx = jnp.transpose(vp[layer][table_row], (1, 0, 2)) \
+            .reshape(kvd, T)
+        rep = nh // nkv
+        qg = q[0].reshape(C, nkv, rep, hd)
+        kg = kctx.reshape(nkv, hd, T)
+        vg = vctx.reshape(nkv, hd, T)
+        s = jnp.einsum("cgrd,gdt->cgrt", qg, kg,
+                       preferred_element_type=jnp.float32) / (hd ** 0.5)
+        t = jnp.arange(T, dtype=jnp.int32)
+        s = jnp.where((t[None, :] <= pidx[:, None])[:, None, None, :],
+                      s, -1e30)
+        probs = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
+        attn = jnp.einsum("cgrt,gdt->cgrd", probs, vg,
+                          preferred_element_type=jnp.float32).astype(c.dtype)
+        attn_out = _mat(attn.reshape(1, C, nh * hd), p["o_proj"])
+        h = h + attn_out
+        x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
+        gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
+        h = h + _mat(gated, p["down_proj"])
+        return (h, kp, vp), None
+
+    n_layers = k_pool.shape[0]
+    (h, k_pool, v_pool), _ = lax.scan(
+        layer_step, (h, k_pool, v_pool),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)))
+    h_last = lax.dynamic_slice_in_dim(h[0], n_live - 1, 1, 0)[None]
+    logits = llama_logits(params, h_last, config)[0, 0]
+    return logits.astype(jnp.float32), k_pool, v_pool
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_decode(frozen):
+    config = LlamaConfig(*frozen)
+
+    def paged_decode_fn(params, kp, vp, tables, positions, ids):
+        return llama_paged_decode_step(params, kp, vp, tables, positions,
+                                       ids, config)
+    paged_decode_fn.__name__ = "paged_decode_step"
+    return jax.jit(paged_decode_fn, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_prefill(frozen):
+    config = LlamaConfig(*frozen)
+
+    def paged_prefill_fn(params, kp, vp, table_row, start, ids, n_live):
+        return llama_paged_prefill_chunk(params, kp, vp, table_row,
+                                         start, ids, n_live, config)
+    paged_prefill_fn.__name__ = "paged_prefill_chunk"
+    return jax.jit(paged_prefill_fn, donate_argnums=(1, 2))
+
+
 def generate_scan(params, cache, first_token, num_tokens,
                   config: LlamaConfig):
     """Generate ``num_tokens`` greedily INSIDE one jit: lax.scan over decode

@@ -40,6 +40,8 @@ from __future__ import annotations
 import functools
 import os
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -74,16 +76,52 @@ def hd64_stack_mode():
         in ("1", "true", "yes", "on")
 
 
-def _fit_block_t(T, per_lane_bytes):
+def _env_block_t():
+    """Validated PADDLE_TPU_DECODE_BLOCK_T override (None when unset).
+    The r5 hd64_b8 rung sat at 1.36x of the bytes floor with the
+    budget-fitted tile; the override lets the bench A/B-sweep tile sizes
+    without editing the fitter (the winner then moves the default)."""
+    raw = os.environ.get("PADDLE_TPU_DECODE_BLOCK_T")
+    if raw is None or not raw.strip():
+        return None
+    try:
+        val = int(raw.strip())
+    except ValueError:
+        raise ValueError(
+            f"PADDLE_TPU_DECODE_BLOCK_T={raw!r}: expected an integer "
+            "number of lanes (a power of two >= 128)")
+    if val < 128 or val & (val - 1):
+        raise ValueError(
+            f"PADDLE_TPU_DECODE_BLOCK_T={val}: must be a power of two "
+            ">= 128")
+    return val
+
+
+def _fit_block_t(T, per_lane_bytes, n_windows=4):
     """Lanes per T tile: short caches take 128 (the pos-clamp skips
     dead-tile DMA at tile granularity, so finer tiles track the live
     prefix closely — a [KVD, 128] bf16 tile is still a full-rate DMA);
     long caches start at DECODE_BLOCK_T and HALVE until the
-    double-buffered k+v windows fit the VMEM budget, then halve again
+    double-buffered cache windows fit the VMEM budget, then halve again
     until the extent divides (cache extents are 128-multiples, so 128
-    always divides)."""
+    always divides).
+
+    n_windows is the per-grid-step cache-window count the budget guards:
+    4 for the read-only kernels (k+v, double-buffered); the fused
+    attend+update kernel ALSO holds the two aliased k/v out windows, so
+    it sizes against 6 — the r5 fitter under-counted those and could
+    overcommit scoped VMEM on the update path at fat per-lane footprints.
+    PADDLE_TPU_DECODE_BLOCK_T overrides the choice (still clipped to a
+    divisor of T so the grid stays exact)."""
+    forced = _env_block_t()
+    if forced is not None:
+        lanes = forced
+        while T % lanes and lanes > 128:
+            lanes //= 2
+        return lanes
     lanes = 128 if T <= 2048 else DECODE_BLOCK_T
-    while lanes > 128 and 4 * lanes * per_lane_bytes > _DECODE_WINDOW_BUDGET:
+    while lanes > 128 and \
+            n_windows * lanes * per_lane_bytes > _DECODE_WINDOW_BUDGET:
         lanes //= 2
     while T % lanes:
         lanes //= 2
@@ -119,7 +157,7 @@ def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
             qd_s[...], k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [B*NH, Tt]
         t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        return jnp.where(t <= pos, s, -1e30)
+        return jnp.where(t <= pos, s, jnp.float32(-1e30))
 
     def pv(p):
         v = v_ref[0].reshape(nb * kvd, block_t)
@@ -159,7 +197,7 @@ def _kernel(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s, *,
 
     @pl.when(j == np.int32(n_t - 1))
     def _fin():
-        big = acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        big = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
         for bi in range(nb):
             o_ref[bi] = big[bi * nh:(bi + 1) * nh,
                             bi * kvd:(bi + 1) * kvd]
@@ -199,7 +237,7 @@ def _kernel_pair(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s,
             qd_s[...], k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [B*2, Tt]
         t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        return jnp.where(t <= pos, s, -1e30)
+        return jnp.where(t <= pos, s, jnp.float32(-1e30))
 
     def pv(p):
         v = v_ref[0].reshape(nb * band, block_t)
@@ -242,7 +280,7 @@ def _kernel_pair(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s,
         # contraction keeps); off-band columns are explicit zeros — the
         # caller multiplies them by zero, so they must be finite, and
         # no other grid step ever presents these out rows
-        big = acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        big = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
         kvd = o_ref.shape[2]
         for bi in range(nb):
             row = lax.dynamic_update_slice(
@@ -253,22 +291,24 @@ def _kernel_pair(lp_ref, q_ref, k_ref, v_ref, o_ref, qd_s, l_s, b_s, acc_s,
             o_ref[bi] = row
 
 
-def _tile_plan(T, layer, pos, per_lane_bytes):
+def _tile_plan(T, layer, pos, per_lane_bytes, n_windows=4):
     """Shared tiling prologue for both slab kernels: (block_t, n_t, lp,
     live_map) or None for ragged (non-128-multiple) cache extents —
     ONE copy so the two entry points can never diverge in tiling.
     per_lane_bytes = b * kvd * cache-itemsize, the bytes one T lane
-    contributes to a cache window (_fit_block_t sizes against it)."""
+    contributes to a cache window (_fit_block_t sizes against it);
+    n_windows is the kernel's cache-window count (4 read-only, 6 for
+    the fused update with its aliased out windows)."""
     if T % 128:
         return None
-    block_t = _fit_block_t(T, per_lane_bytes)
+    block_t = _fit_block_t(T, per_lane_bytes, n_windows)
     lp = jnp.stack([jnp.asarray(layer, jnp.int32),
                     jnp.asarray(pos, jnp.int32)])
 
     def live_map(j, lp_ref):
         # clamp to the last live tile: dead tiles re-present the same
         # block index and Mosaic skips their DMA
-        jmax = lp_ref[1] // block_t
+        jmax = lp_ref[1] // np.int32(block_t)
         return (lp_ref[0], 0, 0, jnp.minimum(j, jmax))
 
     return block_t, T // block_t, lp, live_map
@@ -318,7 +358,7 @@ def _kernel_update(lp_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref,
                 preferred_element_type=jnp.float32))
         s = jnp.concatenate(rows, axis=0)          # [B*NH, Tt]
         t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(t <= pos, s, -1e30)
+        s = jnp.where(t <= pos, s, jnp.float32(-1e30))
         alpha = None
         if first:
             bvec = s.max(axis=-1, keepdims=True)
@@ -379,7 +419,7 @@ def _kernel_update(lp_ref, q_ref, nk_ref, nv_ref, k_ref, v_ref,
 
     @pl.when(j == np.int32(n_t - 1))
     def _fin():
-        big = acc_s[...] / jnp.maximum(l_s[:, :1], 1e-30)
+        big = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
         for bi in range(nb):
             o_ref[bi] = big[bi * nh:(bi + 1) * nh]
 
@@ -397,13 +437,14 @@ def decode_attend_update_slab(q_bd, new_k, new_v, k_cache, v_cache,
     b, nh, kvd = q_bd.shape
     L, _, _, T = k_cache.shape
     it = jnp.dtype(k_cache.dtype).itemsize
-    plan = _tile_plan(T, layer, pos, b * kvd * it)
+    # 6 windows: double-buffered k+v in (4) + the aliased k/v outs (2)
+    plan = _tile_plan(T, layer, pos, b * kvd * it, n_windows=6)
     if plan is None:
         return None
     block_t, n_t, lp, live_map = plan
 
     def pos_map(j, lp_ref):
-        return (lp_ref[0], 0, 0, lp_ref[1] // block_t)
+        return (lp_ref[0], 0, 0, lp_ref[1] // np.int32(block_t))
 
     kernel = functools.partial(_kernel_update, block_t=block_t, n_t=n_t,
                                nb=b, online=softmax_mode() == "online")
@@ -522,7 +563,7 @@ def _decode_attention_slab_pair(q_bd, k_cache, v_cache, layer, pos):
     def live_map(p, j, lp_ref):
         # clamp dead T tiles to the last live one (DMA elided); the
         # sublane index picks the pair's 128-row cache band
-        jmax = lp_ref[1] // block_t
+        jmax = lp_ref[1] // np.int32(block_t)
         return (lp_ref[0], 0, p, jnp.minimum(j, jmax))
 
     def q_map(p, j, lp_ref):

@@ -1,0 +1,401 @@
+"""Ragged paged decode attention over a block-table KV pool.
+
+The serving engine (paddle_tpu/inference/) keeps the KV cache as a pool
+of fixed-size blocks [L, NP, KVD, block_size] plus per-sequence int32
+block tables — the vLLM PagedAttention layout (Kwon et al., SOSP '23)
+restated for TPU static shapes. The kernel walks a FLAT schedule of
+live (sequence, block) pairs built host/trace-side with the same
+cumsum + searchsorted group-boundary trick as grouped_matmul's
+tile_schedule: dead table slots are never stepped, dead grid steps
+re-present the last live block index so Mosaic elides their DMA, and
+all per-step bounds arrive via SMEM scalar prefetch.
+
+Numerics contract (see PARITY.md): the kernel runs the EXACT op
+sequence of decode_attention._kernel per sequence — tile-0-anchored
+exp2 softmax (or the PADDLE_TPU_FLASH_SOFTMAX=online recurrence),
+q PRE-SCALED by scale*log2(e), finalize acc / max(l, 1e-30) — so at
+B=1 with block_size == the slab kernel's T tile (128) the output is
+BITWISE-equal to decode_attention_slab on a contiguous layout, and a
+fragmented block table is bitwise-equal to a contiguous one at any
+batch (the schedule changes only WHERE a block lives, never the op
+order).
+
+paged_attend_update fuses the new token's KV write into the walk (the
+pool aliases through the custom call, mirroring
+decode_attend_update_slab): the schedule is built over len+1 positions
+so the newest block is always the sequence's last live tile, the new
+column is merged there, and that step's scores read the just-written
+tile back from the aliased out refs.
+
+Layouts:
+  q_bd    [B, NH, KVD]          pre-scaled block-diagonal queries
+  pools   [L, NP, KVD, bs]      k and v block pools, time in lanes
+  tables  [B, max_nb] int32     pool block ids per sequence slot
+  lengths [B] int32             live tokens (read path) / positions [B]
+                                of the NEW token (update path)
+Block 0 of the pool is reserved as a null block by the engine: padding
+rows point every table slot at it, so their (masked) garbage never
+lands in a live block.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import cost_estimate as _cost_estimate
+from ._common import interpret_mode as _interpret
+from ._common import mosaic_trace_ctx as _mosaic_ctx
+from .flash_attention import softmax_mode
+
+_LOG2E = 1.4426950408889634
+
+# sched row indices (one [N_FIELDS, n_steps] i32 scalar-prefetch array)
+_SEQ, _BLK, _START, _FIRST, _LAST, _LIVE, _POS, _COL, _UBLK = range(9)
+N_FIELDS = 9
+
+
+def paged_schedule(lengths, tables, n_steps, block_size):
+    """Flat live-block schedule: [N_FIELDS, n_steps] i32.
+
+    lengths [B] live token counts (a 0 row is skipped entirely),
+    tables [B, max_nb]. Walks sequence s's ceil(lengths[s]/block_size)
+    blocks in table order; steps past the live total repeat the LAST
+    live step's (seq, blk) so their block windows re-present unchanged
+    indices and Mosaic skips the copy — the grouped_matmul
+    tile_schedule trick, keyed by sequence instead of expert. Works on
+    traced values (pure jnp)."""
+    B, max_nb = tables.shape
+    bs = jnp.int32(block_size)
+    lens = jnp.maximum(lengths.astype(jnp.int32), 0)
+    counts = (lens + bs - 1) // bs
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(counts).astype(jnp.int32)])
+    total = offsets[-1]
+    step = jnp.arange(n_steps, dtype=jnp.int32)
+    # clamp flat index so dead steps REPLAY the final live step exactly
+    fs = jnp.minimum(step, jnp.maximum(total - 1, 0))
+    seq = jnp.clip(jnp.searchsorted(offsets, fs, side="right") - 1,
+                   0, B - 1).astype(jnp.int32)
+    inner = fs - offsets[seq]
+    blk = tables[seq, jnp.clip(inner, 0, max_nb - 1)].astype(jnp.int32)
+    live = (step < total).astype(jnp.int32)
+    first = ((inner == 0) & (step < total)).astype(jnp.int32)
+    last = ((fs == offsets[seq + 1] - 1) & (step < total)).astype(jnp.int32)
+    pos = lens[seq] - 1
+    last_slot = jnp.clip((lens[seq] - 1) // bs, 0, max_nb - 1)
+    col = pos - ((lens[seq] - 1) // bs) * bs
+    ublk = tables[seq, last_slot].astype(jnp.int32)
+    return jnp.stack([seq, blk, inner * bs, first, last, live,
+                      pos, col, ublk])
+
+
+def paged_schedule_stats(lengths, tables, n_steps, block_size):
+    """Host-side occupancy of a schedule: dict with live/dead step
+    counts and the pool-block touch count (telemetry + bench)."""
+    import numpy as np
+    lens = np.maximum(np.asarray(lengths, np.int64), 0)
+    counts = (lens + block_size - 1) // block_size
+    total = int(counts.sum())
+    return {"n_steps": int(n_steps), "live_steps": min(total, int(n_steps)),
+            "dead_steps": max(int(n_steps) - total, 0),
+            "overflow_steps": max(total - int(n_steps), 0)}
+
+
+def _paged_kernel(lp_ref, sc_ref, q_ref, k_ref, v_ref, o_ref,
+                  l_s, b_s, acc_s, *, block_size, online=False):
+    j = pl.program_id(0)
+    pos = sc_ref[_POS, j]
+    start = sc_ref[_START, j]
+
+    def scores():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [NH, bs]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(t <= pos, s, jnp.float32(-1e30))
+
+    def pv(p):
+        return jax.lax.dot_general(
+            p, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [NH, KVD]
+
+    @pl.when(sc_ref[_FIRST, j] == np.int32(1))
+    def _first():
+        s = scores()
+        base = s.max(axis=-1, keepdims=True)
+        p = jnp.exp2(s - base)
+        b_s[...] = jnp.broadcast_to(base, b_s.shape)
+        l_s[...] = jnp.broadcast_to(p.sum(axis=-1, keepdims=True),
+                                    l_s.shape)
+        acc_s[...] = pv(p.astype(v_ref.dtype))
+
+    @pl.when(jnp.logical_and(sc_ref[_LIVE, j] == np.int32(1),
+                             sc_ref[_FIRST, j] == np.int32(0)))
+    def _more():
+        s = scores()
+        if online:
+            m_prev = b_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            b_s[...] = jnp.broadcast_to(m_new, b_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + pv(p.astype(v_ref.dtype))
+        else:
+            p = jnp.exp2(s - b_s[:, :1])
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + pv(p.astype(v_ref.dtype))
+
+    @pl.when(sc_ref[_LAST, j] == np.int32(1))
+    def _fin():
+        o_ref[0] = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
+
+
+def paged_attention(q_bd, k_pool, v_pool, tables, lengths, layer, *,
+                    n_steps=None):
+    """Read-only paged decode attention for one layer.
+
+    q_bd [B, NH, KVD] PRE-SCALED by scale*log2(e); pools
+    [L, NP, KVD, bs]; tables [B, max_nb] i32; lengths [B] i32 live
+    token counts (every attended row must have lengths >= 1 — a 0 row
+    is skipped and its output left unwritten). Returns [B, NH, KVD]
+    f32. n_steps defaults to B * max_nb (the worst case); pass the
+    engine's bucketed bound to shrink the grid."""
+    b, nh, kvd = q_bd.shape
+    L, NP, _, bs = k_pool.shape
+    B, max_nb = tables.shape
+    if n_steps is None:
+        n_steps = B * max_nb
+    it = jnp.dtype(k_pool.dtype).itemsize
+    sched = paged_schedule(lengths, tables, n_steps, bs)
+    lp = jnp.asarray([layer], jnp.int32)
+
+    def kv_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_BLK, j], 0, 0)
+
+    def q_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0, 0)
+
+    kernel = functools.partial(_paged_kernel, block_size=bs,
+                               online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_steps,),
+                in_specs=[
+                    pl.BlockSpec((1, nh, kvd), q_map),
+                    pl.BlockSpec((1, 1, kvd, bs), kv_map),
+                    pl.BlockSpec((1, 1, kvd, bs), kv_map),
+                ],
+                out_specs=pl.BlockSpec((1, nh, kvd), q_map),
+                scratch_shapes=[
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+            cost_estimate=_cost_estimate(
+                flops=4 * nh * kvd * bs * n_steps,
+                transcendentals=nh * bs * n_steps,
+                bytes_accessed=2 * kvd * bs * it * n_steps),
+            interpret=_interpret(),
+        )(lp, sched, q_bd, k_pool, v_pool)
+    return out
+
+
+def _paged_update_kernel(lp_ref, sc_ref, q_ref, nk_ref, nv_ref,
+                         k_ref, v_ref, o_ref, ko_ref, vo_ref,
+                         l_s, b_s, acc_s, *, block_size, online=False):
+    j = pl.program_id(0)
+    pos = sc_ref[_POS, j]
+    start = sc_ref[_START, j]
+    col = sc_ref[_COL, j]
+    first = sc_ref[_FIRST, j] == np.int32(1)
+    upd = sc_ref[_LAST, j] == np.int32(1)   # the new token's block IS the last
+    kvd = q_ref.shape[2]
+    lane = lax.broadcasted_iota(jnp.int32, (kvd, block_size), 1)
+
+    def merged(tile_ref, new_ref):
+        # minor-dim insert goes through f32 (Mosaic bf16 limitation,
+        # same as decode_attention._kernel_update)
+        new32 = new_ref[0].astype(jnp.float32)[:, None]
+        return jnp.where(lane == col, new32,
+                         tile_ref[0, 0].astype(jnp.float32)) \
+            .astype(tile_ref.dtype)
+
+    @pl.when(upd)
+    def _write_cache():
+        # full tile written every update step: the aliased out window
+        # starts uninitialized, so every lane must be defined before
+        # the flush at the next sequence boundary
+        ko_ref[0, 0] = merged(k_ref, nk_ref)
+        vo_ref[0, 0] = merged(v_ref, nv_ref)
+
+    def chain(k_at, v_at, is_first):
+        s = jax.lax.dot_general(
+            q_ref[0], k_at, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [NH, bs]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(t <= pos, s, jnp.float32(-1e30))
+        alpha = None
+        if is_first:
+            bvec = s.max(axis=-1, keepdims=True)
+            b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
+        elif online:
+            m_prev = b_s[:, :1]
+            bvec = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - bvec)
+            b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
+        else:
+            bvec = b_s[:, :1]
+        p = jnp.exp2(s - bvec)
+        psum = jnp.broadcast_to(p.sum(axis=-1, keepdims=True), l_s.shape)
+        d = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_at, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if is_first:
+            l_s[...] = psum
+            acc_s[...] = d
+        elif online:
+            l_s[...] = l_s[...] * alpha + psum
+            acc_s[...] = acc_s[...] * alpha + d
+        else:
+            l_s[...] = l_s[...] + psum
+            acc_s[...] = acc_s[...] + d
+
+    # 4-way branch: (first tile?) x (update tile?) — the update tile
+    # reads the just-merged slabs back from the aliased out refs
+    @pl.when(jnp.logical_and(first, upd))
+    def _first_updated():
+        chain(ko_ref[0, 0], vo_ref[0, 0], True)
+
+    @pl.when(jnp.logical_and(first, jnp.logical_not(upd)))
+    def _first_raw():
+        chain(k_ref[0, 0], v_ref[0, 0], True)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(first), upd))
+    def _more_updated():
+        chain(ko_ref[0, 0], vo_ref[0, 0], False)
+
+    @pl.when(jnp.logical_and(
+            jnp.logical_not(first),
+            jnp.logical_and(sc_ref[_LIVE, j] == np.int32(1), jnp.logical_not(upd))))
+    def _more_raw():
+        chain(k_ref[0, 0], v_ref[0, 0], False)
+
+    @pl.when(sc_ref[_LAST, j] == np.int32(1))
+    def _fin():
+        o_ref[0] = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
+
+
+def paged_attend_update(q_bd, new_k, new_v, k_pool, v_pool, tables,
+                        positions, layer, *, n_steps=None):
+    """Fused pool-update + paged attention for one decode layer: writes
+    each sequence's new k/v column IN PLACE (the pools alias through
+    the custom call) and attends over the prefix INCLUDING it.
+
+    q_bd [B, NH, KVD] pre-scaled; new_k/new_v [B, KVD]; positions [B]
+    i32 = the NEW token's position per row (its block must already be
+    in the table). Every row writes — padding rows must point their
+    tables at the reserved null block 0 with positions 0. Returns
+    (attn [B, NH, KVD] f32, k_pool, v_pool)."""
+    b, nh, kvd = q_bd.shape
+    L, NP, _, bs = k_pool.shape
+    B, max_nb = tables.shape
+    if n_steps is None:
+        n_steps = B * max_nb
+    it = jnp.dtype(k_pool.dtype).itemsize
+    # schedule over len+1 so the written position's block is the walk's
+    # last live tile even when it was freshly allocated
+    sched = paged_schedule(positions + 1, tables, n_steps, bs)
+    lp = jnp.asarray([layer], jnp.int32)
+
+    def kv_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_BLK, j], 0, 0)
+
+    def q_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0, 0)
+
+    def new_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0)
+
+    def upd_map(j, lp_ref, sc_ref):
+        # constant per sequence: the block holding the new column; the
+        # buffer is fully written on the seq's last live step, then
+        # flushes when the presented index moves to the next sequence
+        return (lp_ref[0], sc_ref[_UBLK, j], 0, 0)
+
+    kernel = functools.partial(_paged_update_kernel, block_size=bs,
+                               online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        out, kp, vp = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_steps,),
+                in_specs=[
+                    pl.BlockSpec((1, nh, kvd), q_map),
+                    pl.BlockSpec((1, kvd), new_map),
+                    pl.BlockSpec((1, kvd), new_map),
+                    pl.BlockSpec((1, 1, kvd, bs), kv_map),
+                    pl.BlockSpec((1, 1, kvd, bs), kv_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, nh, kvd), q_map),
+                    pl.BlockSpec((1, 1, kvd, bs), upd_map),
+                    pl.BlockSpec((1, 1, kvd, bs), upd_map),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+                jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            ],
+            # operand indices count scalar-prefetch first: 0=lp,
+            # 1=sched, 2=q, 3=new_k, 4=new_v, 5=k_pool, 6=v_pool
+            input_output_aliases={5: 1, 6: 2},
+            cost_estimate=_cost_estimate(
+                flops=4 * nh * kvd * bs * n_steps,
+                transcendentals=nh * bs * n_steps,
+                bytes_accessed=(2 * kvd * bs * it * n_steps
+                                + 4 * b * kvd * bs * it)),
+            interpret=_interpret(),
+        )(lp, sched, q_bd, new_k, new_v, k_pool, v_pool)
+    return out, kp, vp
+
+
+def paged_attention_xla(q, k_pool, v_pool, tables, lengths, layer,
+                        scale):
+    """Plain-XLA reference: q [B, NH, KVD] UNSCALED, standard e-base
+    softmax in f32. Gathers each table's blocks into a contiguous
+    [B, KVD, max_nb*bs] view — the layout-parity oracle for the
+    kernels (allclose, not bitwise: different exponent base)."""
+    B, max_nb = tables.shape
+    bs = k_pool.shape[-1]
+    kc = jnp.transpose(k_pool[layer][tables], (0, 2, 1, 3)) \
+        .reshape(B, k_pool.shape[2], max_nb * bs)
+    vc = jnp.transpose(v_pool[layer][tables], (0, 2, 1, 3)) \
+        .reshape(B, v_pool.shape[2], max_nb * bs)
+    s = jnp.einsum("bhc,bct->bht", q.astype(jnp.float32),
+                   kc.astype(jnp.float32)) * scale
+    t = jnp.arange(max_nb * bs)[None, None, :]
+    s = jnp.where(t < lengths[:, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bht,bct->bhc", p, vc.astype(jnp.float32))

@@ -51,6 +51,35 @@ def test_fitted_windows_meet_budget():
             assert 4 * bt * per_lane <= _DECODE_WINDOW_BUDGET
 
 
+def test_update_window_count_shrinks_tile_sooner():
+    # the fused attend+update kernel holds 6 cache windows (k+v double-
+    # buffered in + the aliased k/v outs): at a footprint where 4 windows
+    # of a 512-lane tile just fit, 6 must drop a halving step
+    per_lane = _DECODE_WINDOW_BUDGET // (4 * 512)   # 4-window exact fit
+    assert _fit_block_t(8192, per_lane, n_windows=4) == 512
+    assert _fit_block_t(8192, per_lane, n_windows=6) == 256
+
+
+def test_env_override_forces_tile(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DECODE_BLOCK_T", "256")
+    # overrides both the short-cache 128 default and the budget fit
+    assert _fit_block_t(2048, 16 * 1024) == 256
+    assert _fit_block_t(8192, 16 * 1024, n_windows=6) == 256
+    # still clipped to a divisor of the cache extent
+    monkeypatch.setenv("PADDLE_TPU_DECODE_BLOCK_T", "512")
+    assert _fit_block_t(2048 + 256, 1) == 256
+
+
+def test_env_override_rejects_junk(monkeypatch):
+    import pytest
+    for junk in ("banana", "100", "384", "-512", "0"):
+        monkeypatch.setenv("PADDLE_TPU_DECODE_BLOCK_T", junk)
+        with pytest.raises(ValueError, match="PADDLE_TPU_DECODE_BLOCK_T"):
+            _fit_block_t(4096, 1024)
+    monkeypatch.setenv("PADDLE_TPU_DECODE_BLOCK_T", "")
+    assert _fit_block_t(4096, 2 * 1024) == DECODE_BLOCK_T  # unset-ish
+
+
 def test_ragged_cache_returns_none():
     assert _tile_plan(257, 0, 10, 16 * 1024) is None
 

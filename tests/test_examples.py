@@ -34,6 +34,12 @@ def test_serve_int8():
     assert "continuation:" in _run("serve_int8.py")
 
 
+def test_serve_continuous():
+    out = _run("serve_continuous.py")
+    assert "throughput:" in out
+    assert "pool leak-free: True" in out
+
+
 def test_dygraph_train():
     out = _run("dygraph_train.py")
     assert "step 15: loss" in out
