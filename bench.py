@@ -886,8 +886,10 @@ def main():
         import jax as _jax
         from paddle_tpu.ops import flash_attention as _fa
         long_seq = {}
-        for s_long in (16384, 32768):
-            bh, d_ = 8, 128
+        for s_long in (16384, 32768, 131072):
+            # 131072 halves bh: 8 heads of q/k/v/do + f32 grads at 128k
+            # rows would not leave room for the dq streaming partials
+            bh, d_ = (8, 128) if s_long <= 32768 else (4, 128)
             rng2 = np.random.RandomState(1)
             q = jnp.asarray(rng2.randn(bh, s_long, d_).astype(np.float32),
                             dtype=jnp.bfloat16)
@@ -910,13 +912,26 @@ def main():
             ms_f = device_time_ms(fwd, (q, k, v), f"lsfwd{s_long}")
             ms_b = device_time_ms(bwd, (q, k, v), f"lsbwd{s_long}")
             fl = 2 * 2 * bh * s_long * s_long * d_ / 2  # causal half
+            # static schedule record for the r7 fused flat backward: which
+            # path ran, blocks, and the fetch-once contract (r05 split-
+            # kernel baseline for comparison: bwd_eff=0.599 at S=32768)
+            sched = _fa.dense_bwd_schedule_stats(
+                bh, s_long, s_long, d_, jnp.bfloat16, True, 1024, 1024)
             long_seq[f"S{s_long}"] = {
                 "ms": round(ms_f, 1),
                 "attn_eff": round(fl / (ms_f / 1e3) / peak_flops(dev), 3),
                 "bwd_ms": round(ms_b, 1),
                 # bwd does ~2.5x the fwd FLOPs (5 matmuls vs 2)
                 "bwd_eff": round(2.5 * fl / (ms_b / 1e3) / peak_flops(dev), 3),
+                "bwd_schedule": {k: v for k, v in sched.items()
+                                 if k not in ("bh", "seq_q", "seq_k",
+                                              "head_dim", "mode")},
             }
+        long_seq["bwd_baseline_r05"] = {
+            "bwd_eff_s32768": 0.599,
+            "note": "split dkv+dq kernel pair (each block fetched twice, "
+                    "7 matmuls/pair) before the r7 fused flat rewrite",
+        }
         detail["long_seq_flash_fwd"] = long_seq
 
         # context-parallel strategy compare at 32k, sep=4: per-chip COMPUTE
@@ -963,7 +978,9 @@ def main():
             "ring_worst_rank_ms": round(ms_ring, 2),
             "ulysses_ms": round(ms_uly, 2),
             "note": "compute proxy on one chip; ring overlaps ppermute "
-                    "with block compute, Ulysses adds 2 all_to_alls",
+                    "with block compute, Ulysses adds 2 all_to_alls. Real "
+                    "sep=4 collective rung: cp_compare_sep4 in the "
+                    "multichip dryrun (MULTICHIP json tail)",
         }
 
         # packed varlen attention (kernel-backed flash on the packed
@@ -1063,10 +1080,13 @@ def main():
             detail["decode"]["hd64_pair_stack_ab"]["pair_stack_speedup"]
     if "long_seq_flash_fwd" in detail:
         ls = detail["long_seq_flash_fwd"]
-        rungs["flash_fwd_eff_32k"] = ls["S32768"]["attn_eff"]
-        rungs["flash_bwd_eff_32k"] = ls["S32768"]["bwd_eff"]
-        rungs["flash_fwd_eff_16k"] = ls["S16384"]["attn_eff"]
-        rungs["flash_bwd_eff_16k"] = ls["S16384"]["bwd_eff"]
+        # guarded per-rung: a partial long_seq run (e.g. 131k OOM-skipped)
+        # must not take down the whole rung report
+        for s_key, tag in (("S16384", "16k"), ("S32768", "32k"),
+                           ("S131072", "128k")):
+            if s_key in ls:
+                rungs[f"flash_fwd_eff_{tag}"] = ls[s_key]["attn_eff"]
+                rungs[f"flash_bwd_eff_{tag}"] = ls[s_key]["bwd_eff"]
     if "decode" in detail and "flagship_b8" in detail["decode"]:
         rungs["decode_flagship_b8_x_floor"] = \
             detail["decode"]["flagship_b8"]["x_of_floor"]

@@ -78,7 +78,11 @@ class ParallelConfig:
     mp: int = 1
     pp: int = 1
     sharding: int = 1   # ZeRO/FSDP degree over the 'sharding' axis
-    sep: int = 1        # context parallel (ring attention)
+    sep: int = 1        # context parallel (ring or ulysses attention)
+    # context-parallel strategy: 'ring' (KV rotation) or 'ulysses'
+    # (all-to-all heads<->sequence; needs num_heads % sep == 0).
+    # None = follow PADDLE_TPU_SEP_STRATEGY (default 'ring').
+    sep_strategy: Optional[str] = None
     microbatches: int = 1
     remat: bool = True
     # 'full' recomputes the whole block; 'dots' saves matmul outputs and
@@ -349,8 +353,21 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
 
     if parallel.sep > 1 and in_shard_map:
         from ..parallel.ring_attention import ring_attention
-        attn = ring_attention(q, k, v, axis_name="sep", causal=True,
-                              impl="flash" if use_flash else "xla")
+        from ..parallel.ulysses_attention import (resolve_sep_strategy,
+                                                  ulysses_attention)
+        if resolve_sep_strategy(parallel.sep_strategy) == "ulysses":
+            if use_flash:
+                attn = ulysses_attention(q, k, v, axis_name="sep",
+                                         causal=True)
+            else:
+                from ..nn.functional.attention import _xla_sdpa
+                attn = ulysses_attention(
+                    q, k, v, axis_name="sep", causal=True,
+                    attn_fn=lambda qg, kg, vg: _xla_sdpa(
+                        qg, kg, vg, is_causal=True))
+        else:
+            attn = ring_attention(q, k, v, axis_name="sep", causal=True,
+                                  impl="flash" if use_flash else "xla")
     elif use_flash:
         attn = flash_attention_bshd(q, k, v, causal=True)
     else:
@@ -1320,6 +1337,21 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
     if use_flash is None:
         from ..ops._common import interpret_mode
         use_flash = not interpret_mode()
+
+    if parallel.sep > 1:
+        # validate the strategy (env or config field) BEFORE any tracing so
+        # a typo'd PADDLE_TPU_SEP_STRATEGY fails with the variable named,
+        # not deep inside the shard_map island
+        from ..parallel.ulysses_attention import resolve_sep_strategy
+        if (resolve_sep_strategy(parallel.sep_strategy) == "ulysses"
+                and config.num_attention_heads % parallel.sep):
+            raise ValueError(
+                f"ulysses sep strategy needs num_heads % sep == 0 for the "
+                f"all-to-all head split; got num_heads="
+                f"{config.num_attention_heads}, sep={parallel.sep}. Pick a "
+                f"sep degree dividing the head count or select the ring "
+                f"strategy (sep_strategy='ring' / PADDLE_TPU_SEP_STRATEGY="
+                f"ring).")
 
     params = init_llama_params(config, seed)
     pspecs = param_pspecs(config, parallel)

@@ -99,7 +99,10 @@ def _mask_scores(s, row0, col0, causal, row_limit=None, col_limit=None):
     if causal:
         t = rows >= cols
         ok = t if ok is None else ok & t
-    return jnp.where(ok, s, -1e30)
+    # strong f32 scalar: a weak Python literal re-canonicalizes to f64
+    # when a consumer jit lowers under the package-global x64 (the MLIR
+    # verifier rejects it — see the decode/paged strong-typing note)
+    return jnp.where(ok, s, jnp.float32(-1e30))
 
 
 def _tri_mask_const(block_q, block_k):
@@ -976,6 +979,296 @@ def _bwd_fused_stream_chunk(qp, kp, vp, dop, lse3, delta3, causal,
     return dq, dk, dv
 
 
+# Escape hatch for the default fused flat-schedule backward (r7): 'auto'
+# runs the one-pass k-major kernel whenever its scratch fits the budget
+# (below); 'split' forces the legacy dispatch — the two resident kernels
+# (or the dq-partials streaming pass over the residency ceiling). The
+# split resident pair is the bitwise-pinned reference the parity tests
+# compare against. Read per call so tests can flip it via monkeypatched
+# env (house pattern: ValueError names the variable).
+ENV_FLASH_BWD = "PADDLE_TPU_FLASH_BWD"
+
+
+def dense_bwd_mode() -> str:
+    """'auto' (fused flat pass when its scratch fits) or 'split' (legacy
+    two-kernel/dq-partials dispatch)."""
+    mode = os.environ.get(ENV_FLASH_BWD, "auto").strip().lower()
+    if mode not in ("auto", "split"):
+        raise ValueError(
+            f"{ENV_FLASH_BWD} must be 'auto' or 'split', got {mode!r}")
+    return mode
+
+
+def _dense_bwd_lo(n_q, n_k, causal, block_q, block_k):
+    """Per-k-tile first live q-tile index (numpy, trace-time static): under
+    causal, k tile j only receives gradient from q tiles at/past its own
+    diagonal — i >= (j·bk)//bq, exactly the transpose of the forward's
+    live set (j·bk <= (i+1)·bq − 1). K tiles past the last q row clamp to
+    a single all-masked pair: its p is exactly 0, so dk/dv finalize to
+    the zeros the split kernels produce and dq gains nothing, but the
+    out blocks are still written (never garbage)."""
+    import numpy as np
+    if not causal:
+        return np.zeros(n_k, dtype=np.int64)
+    j = np.arange(n_k, dtype=np.int64)
+    return np.minimum((j * block_k) // block_q, n_q - 1)
+
+
+def _dense_bwd_schedule(n_q, n_k, causal, block_q, block_k):
+    """K-major flat schedule over the live (k-tile, q-tile) pairs of a
+    DENSE backward — the static-shape analogue of flash_varlen's
+    _flat_schedule (no cu; bounds are closed-form, so the arrays are
+    concrete at trace time). Returns int32 (ki, qi, first, last) scalar-
+    prefetch arrays and n_flat; every step is live."""
+    import numpy as np
+    lo = _dense_bwd_lo(n_q, n_k, causal, block_q, block_k)
+    spans = n_q - lo
+    cum = np.concatenate([[0], np.cumsum(spans)])
+    n_flat = int(cum[-1])
+    s = np.arange(n_flat, dtype=np.int64)
+    ki = np.searchsorted(cum, s, side="right") - 1
+    qi = lo[ki] + (s - cum[ki])
+    first = (s == cum[ki]).astype(np.int32)
+    last = (s == cum[ki + 1] - 1).astype(np.int32)
+    # int32: the package runs with x64 on, and int64 scalar-prefetch
+    # operands break Mosaic's SMEM lowering
+    return (jnp.asarray(ki, jnp.int32), jnp.asarray(qi, jnp.int32),
+            jnp.asarray(first, jnp.int32), jnp.asarray(last, jnp.int32),
+            n_flat)
+
+
+def _bwd_fused_flat_kernel(ki_ref, qi_ref, first_ref, last_ref,
+                           q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dq_ref, dk_ref, dv_ref, dk_s, dv_s, dq_s, *,
+                           block_q, block_k, causal, scale, q_len, seq_q,
+                           kv_len, seq_k):
+    """Fused dK/dV/dQ in ONE pass per (k-tile, q-tile) pair: FLAT grid
+    (bh, n_flat) in k-major order — the dense port of flash_varlen's
+    _bwd_fused_kernel_varlen. Each pair fetches q/do/lse/delta and k/v
+    ONCE and runs all five FA2 matmuls (S=QKᵀ, dP=dO·Vᵀ, dV=PᵀdO,
+    dK+=dS̃ᵀQ̃, dQ+=dS̃·K) — the split two-kernel scheme fetched every
+    block twice and ran seven (S and dP recomputed in the dq kernel),
+    capping backward efficiency at 5/7 of forward.
+
+    dK/dV accumulate in scratch across a k tile's consecutive steps
+    (first/last flags). dQ accumulates in a PERSISTENT full-length
+    scratch (dq_s, [seq_q, d] f32, zeroed at step 0 of each bh): a q
+    tile's steps are NOT consecutive in k-major order, so the running
+    partial is re-written to the dq out block on every step — the grid
+    is sequential, so the final write-back of each presented block (the
+    tile's LAST visit) carries the complete sum. Within one q tile the
+    k contributions arrive in increasing j and within one k tile the q
+    contributions in increasing i — the SAME f32 accumulation orders as
+    the split kernels' inner loops, and _mask_scores' -1e30 overwrite
+    on always-masked tiles is a p == 0 no-op — so the fused pass is
+    bitwise-equal to the split pair at equal block sizes (pinned in
+    tests). q arrives pre-scaled (see _bwd_dkv_kernel): the deferred
+    ·scale rides each dq write-back, ·ln2 undoes q̃'s log2e on dK."""
+    import numpy as np
+    s_idx = pl.program_id(1)
+    bq_i, bk_i = np.int32(block_q), np.int32(block_k)
+    mask_q = q_len != seq_q
+    mask_kv = kv_len != seq_k
+
+    @pl.when(s_idx == 0)
+    def _init_dq():
+        dq_s[...] = jnp.zeros(dq_s.shape, jnp.float32)
+
+    @pl.when(first_ref[s_idx] == 1)
+    def _init_dkv():
+        dk_s[...] = jnp.zeros(dk_s.shape, jnp.float32)
+        dv_s[...] = jnp.zeros(dv_s.shape, jnp.float32)
+
+    qi = qi_ref[s_idx]
+    ki = ki_ref[s_idx]
+    qb = q_ref[0]
+    kb = k_ref[0]
+    vb = v_ref[0]
+    dob = do_ref[0]
+    lseb = lse_ref[0, 0, :]
+    deltab = delta_ref[0, 0, :]
+    s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32)
+    # iota mask on every step (no masked/unmasked split): the bwd is
+    # MXU-bound — the VPU has slack — and interior tiles' where() is a
+    # bitwise no-op (see _bwd_fused_kernel_stream)
+    s = _mask_scores(s, qi * bq_i, ki * bk_i, causal,
+                     row_limit=q_len if mask_q else None,
+                     col_limit=kv_len if mask_kv else None)
+    p = jnp.exp2(s - lseb[:, None])
+    p_lo = p.astype(vb.dtype)
+    dv_s[...] = dv_s[...] + jnp.dot(p_lo.T, dob,
+                                    preferred_element_type=jnp.float32)
+    dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+    ds = (p * (dp - deltab[:, None])).astype(vb.dtype)
+    dk_s[...] = dk_s[...] + jnp.dot(ds.T, qb,
+                                    preferred_element_type=jnp.float32)
+    row = qi * bq_i
+    dq_new = dq_s[pl.ds(row, block_q), :] + jnp.dot(
+        ds, kb, preferred_element_type=jnp.float32)
+    dq_s[pl.ds(row, block_q), :] = dq_new
+    dq_ref[0] = (dq_new * scale).astype(dq_ref.dtype)
+
+    @pl.when(last_ref[s_idx] == 1)
+    def _flush_dkv():
+        # q̃ carries an extra log2e (log2-domain scores); undo it on dK
+        dk_ref[0] = (dk_s[...] * _LN2).astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+# Scoped-VMEM plan for the fused flat backward (same budget split as the
+# varlen port it mirrors): the persistent [seq_q, d] f32 dQ accumulator is
+# the big consumer, so block sizes are fitted per SHAPE and the Mosaic
+# scoped-VMEM window is raised past the 16M guardrail accordingly.
+_FLAT_BWD_VMEM_BUDGET = 52 * 1024 * 1024
+_FLAT_BWD_VMEM_LIMIT = 80 * 1024 * 1024
+
+
+def _bwd_flat_vmem_bytes(bq, bk, sp, d, itemsize):
+    """Estimated scoped-VMEM footprint of one fused-flat grid step: f32
+    scratch (persistent dq + dk/dv accumulators) plus the 6 live input
+    windows and 3 out blocks (double-buffered) and the f32 score-tile
+    temporaries."""
+    scratch = 4 * (sp * d + 2 * bk * d)
+    blocks = (2 * bq * d * itemsize      # q, do
+              + 2 * bk * d * itemsize    # k, v
+              + 2 * bq * 4               # lse, delta
+              + bq * d * itemsize        # dq
+              + 2 * bk * d * itemsize)   # dk, dv
+    temps = 4 * bq * bk * 4              # s/p/dp/ds tiles
+    return scratch + 2 * blocks + temps
+
+
+def _shrink_block(b, n):
+    """Next-smaller 128-aligned divisor of n below b (n is 128-aligned)."""
+    b -= 128
+    while b > 128 and n % b:
+        b -= 128
+    return max(b, 128)
+
+
+def _fit_bwd_flat_blocks(block_q, block_k, sp, skp, d, itemsize):
+    """_fit_block_t-style fitter (see decode_attention) for the fused flat
+    backward: shrink the larger block side until the grid step fits the
+    scoped-VMEM budget — hd >= 128 at big tiles would otherwise overrun
+    scoped VMEM. Returns (block_q, block_k) or None when even 128x128
+    does not fit (the [sp, d] dq scratch alone is over budget — very
+    long sequences stay on the dq-partials streaming pass)."""
+    bq, bk = block_q, block_k
+    while _bwd_flat_vmem_bytes(bq, bk, sp, d, itemsize) \
+            > _FLAT_BWD_VMEM_BUDGET:
+        if bq <= 128 and bk <= 128:
+            return None
+        if bq >= bk and bq > 128:
+            bq = _shrink_block(bq, sp)
+        else:
+            bk = _shrink_block(bk, skp)
+    return bq, bk
+
+
+def _bwd_fused_flat_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
+                         block_q, block_k, q_len, kv_len):
+    """One fused-flat pallas_call over the whole padded backward: grid
+    (bh, n_flat) with the (ki, qi, first, last) schedule scalar-prefetched.
+    Each q/k/v/do block is fetched exactly once (the flat order revisits
+    no pair), vs twice for the split pair — at S=32k this halves the HBM
+    read traffic and removes the dq-partials reduction kernel, the lever
+    behind the r05 bwd_eff=0.599 -> >=0.7 target."""
+    bh, sp, d = qp.shape
+    skp = kp.shape[1]
+    it = qp.dtype.itemsize
+    n_q, n_k = sp // block_q, skp // block_k
+    ki_a, qi_a, first_a, last_a, n_flat = _dense_bwd_schedule(
+        n_q, n_k, causal, block_q, block_k)
+    kernel = functools.partial(_bwd_fused_flat_kernel, block_q=block_q,
+                               block_k=block_k, causal=causal, scale=scale,
+                               q_len=q_len, seq_q=sp, kv_len=kv_len,
+                               seq_k=skp)
+    with _mosaic_ctx():
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=4,
+                grid=(bh, n_flat),
+                in_specs=[
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, s, ki, qi, f, l: (b, qi[s], 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, s, ki, qi, f, l: (b, ki[s], 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, s, ki, qi, f, l: (b, ki[s], 0)),
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, s, ki, qi, f, l: (b, qi[s], 0)),
+                    pl.BlockSpec((1, 1, block_q),
+                                 lambda b, s, ki, qi, f, l: (b, 0, qi[s])),
+                    pl.BlockSpec((1, 1, block_q),
+                                 lambda b, s, ki, qi, f, l: (b, 0, qi[s])),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, block_q, d),
+                                 lambda b, s, ki, qi, f, l: (b, qi[s], 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, s, ki, qi, f, l: (b, ki[s], 0)),
+                    pl.BlockSpec((1, block_k, d),
+                                 lambda b, s, ki, qi, f, l: (b, ki[s], 0)),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((block_k, d), jnp.float32),
+                    pltpu.VMEM((block_k, d), jnp.float32),
+                    pltpu.VMEM((sp, d), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct(qp.shape, qp.dtype),
+                jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                jax.ShapeDtypeStruct(vp.shape, vp.dtype),
+            ],
+            compiler_params=_tpu_compiler_params(
+                vmem_limit_bytes=_FLAT_BWD_VMEM_LIMIT),
+            cost_estimate=_cost_estimate(
+                flops=10 * bh * n_flat * block_q * block_k * d,
+                transcendentals=bh * n_flat * block_q * block_k,
+                bytes_accessed=(bh * n_flat
+                                * (2 * block_q + 2 * block_k) * d * it
+                                + bh * (sp + 2 * skp) * d * it)),
+            interpret=_interpret(),
+        )(ki_a, qi_a, first_a, last_a, qp, kp, vp, dop, lse3, delta3)
+    return dq, dk, dv
+
+
+def dense_bwd_schedule_stats(bh, sq, sk, d, dtype, causal,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K):
+    """Which backward path _bwd_pallas_calls would run for this shape and
+    its flat-schedule geometry — static (no tracing); recorded in
+    BENCH_DETAIL next to the bwd_eff rungs."""
+    item = jnp.dtype(dtype).itemsize
+    block_q, block_k = _small_d_blocks(d, block_q, block_k)
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
+    sp = -(-sq // block_q) * block_q
+    skp = -(-sk // block_k) * block_k
+    stats = {"mode": dense_bwd_mode(), "bh": bh, "seq_q": sq, "seq_k": sk,
+             "head_dim": d}
+    fit = (_fit_bwd_flat_blocks(block_q, block_k, sp, skp, d, item)
+           if stats["mode"] == "auto" else None)
+    if fit is not None:
+        bq, bk = fit
+        n_q, n_k = sp // bq, skp // bk
+        lo = _dense_bwd_lo(n_q, n_k, causal, bq, bk)
+        n_flat = int(n_q * n_k - lo.sum())
+        stats.update(path="fused_flat", block_q=bq, block_k=bk,
+                     n_flat=n_flat, dead_pairs=n_q * n_k - n_flat,
+                     fetches_per_block_pair=1, matmuls_per_pair=5,
+                     dq_scratch_bytes=4 * sp * d)
+    elif (2 * sp * d * item > STREAM_KV_BYTES
+          or 2 * skp * d * item > STREAM_KV_BYTES):
+        stats.update(path="fused_stream", block_q=block_q, block_k=block_k,
+                     fetches_per_block_pair=1, matmuls_per_pair=5)
+    else:
+        stats.update(path="split_resident", block_q=block_q,
+                     block_k=block_k, fetches_per_block_pair=2,
+                     matmuls_per_pair=7)
+    return stats
 
 
 def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
@@ -987,10 +1280,12 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
     kernels see q̃ = scale·q and compute dK = ds̃ᵀq̃ exactly; dQ applies
     the single deferred scale to its accumulator.
 
-    Over the VMEM residency budget on either side, the fused one-pass
-    streaming kernel handles everything; under it, two resident kernels
-    (dK/dV over k tiles, dQ over q tiles) keep the whole opposing side
-    in VMEM."""
+    Dispatch (r7): the fused FLAT k-major pass (_bwd_fused_flat_call) is
+    the default whenever its scratch fits the fitted blocks; past that
+    (very long S) the dq-partials streaming pass takes over; the split
+    resident pair (dK/dV over k tiles, dQ over q tiles, whole opposing
+    side in VMEM) remains as the bitwise-pinned PADDLE_TPU_FLASH_BWD=
+    split fallback and the sub-residency leg of that mode."""
     bh, sp, d = qp.shape
     skp = kp.shape[1]
     item = kp.dtype.itemsize
@@ -1000,6 +1295,17 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
     if not q_prescaled:
         qp = (qp.astype(jnp.float32) * (scale * _LOG2E)).astype(qp.dtype)
     lse3 = lse3 * _LOG2E
+    if dense_bwd_mode() == "auto":
+        # DEFAULT (r7): one fused k-major pass, each q/k/v/do block fetched
+        # once feeding all five matmuls; bitwise-equal to the split pair at
+        # equal blocks. Skipped only when even 128x128 tiles can't fit the
+        # persistent [sp, d] dq scratch (very long S falls through to the
+        # dq-partials streaming pass) or PADDLE_TPU_FLASH_BWD=split.
+        fit = _fit_bwd_flat_blocks(block_q, block_k, sp, skp, d, item)
+        if fit is not None:
+            return _bwd_fused_flat_call(qp, kp, vp, dop, lse3, delta3,
+                                        causal, scale, fit[0], fit[1],
+                                        q_len, kv_len)
     if (2 * sp * d * item > STREAM_KV_BYTES
             or 2 * skp * d * item > STREAM_KV_BYTES):
         # the fused kernel streams both sides and does 5 matmuls per tile

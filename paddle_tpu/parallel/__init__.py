@@ -3,5 +3,7 @@ API surface is implemented on (pipeline, ring attention, MoE dispatch, FSDP
 sharding rules). Everything here is pure jax — shard_map/pjit-composable."""
 from .pipeline import (build_pipeline_loss_fn, last_stage_value, microbatch,
                        pipeline_apply, stack_stage_params)
-from .ring_attention import ring_attention, ulysses_attention
+from .ring_attention import ring_attention
+from .ulysses_attention import (ENV_SEP_STRATEGY, SEP_STRATEGIES,
+                                resolve_sep_strategy, ulysses_attention)
 from .moe import moe_dispatch_combine

@@ -1,0 +1,190 @@
+"""Fused flat-schedule dense flash backward (r7): one k-major pass per
+live (k-tile, q-tile) pair, each q/k/v/do block fetched once feeding all
+five FA2 matmuls. Pins:
+
+- fused == split resident pair BITWISE at equal block sizes (same f32
+  accumulation orders; the split pair is the PADDLE_TPU_FLASH_BWD=split
+  escape hatch) across causal/non-causal, hd64/hd128, cross lengths;
+- ragged (padded) shapes vs the XLA reference;
+- the _fit_block_t-style scratch fitter and the schedule geometry
+  (fetch-once: no (k, q) pair is ever revisited).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.ops import flash_attention as fa
+
+
+def _mk(shape, seed):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+
+def _grads(q, k, v, w, causal, scale, block=128):
+    def loss(q, k, v):
+        return jnp.sum(
+            fa._flash_attention(q, k, v, causal, scale, block, block)
+            .astype(jnp.float32) * w)
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+def _ref_grads(q, k, v, w, causal, scale):
+    def loss(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+        if causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            rows = jnp.arange(sq)[:, None]
+            cols = jnp.arange(sk)[None, :]
+            s = jnp.where(rows >= cols, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.sum(jnp.einsum("bqk,bkd->bqd", p, v) * w)
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal,d,sq,sk", [(False, 64, 256, 256),
+                                            (False, 128, 256, 384),
+                                            (True, 64, 256, 384),
+                                            (True, 128, 256, 256)])
+def test_fused_flat_matches_split_bitwise(monkeypatch, causal, d, sq, sk):
+    """The split resident pair is the bitwise-pinned fallback: at equal
+    block sizes the flat pass accumulates every dq/dk/dv sum in the SAME
+    f32 order (dq over increasing k tiles, dk/dv over increasing q
+    tiles), so the grads must be identical to the bit."""
+    q, k, v = _mk((2, sq, d), 0), _mk((2, sk, d), 1), _mk((2, sk, d), 2)
+    w = _mk((2, sq, d), 3)  # non-uniform cotangent
+    monkeypatch.setenv(fa.ENV_FLASH_BWD, "auto")
+    auto = _grads(q, k, v, w, causal, 1.0 / d ** 0.5)
+    monkeypatch.setenv(fa.ENV_FLASH_BWD, "split")
+    split = _grads(q, k, v, w, causal, 1.0 / d ** 0.5)
+    for a, b, name in zip(auto, split, "qkv"):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"d{name} not bitwise")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(320, 320), (320, 200), (128, 512)])
+def test_fused_flat_ragged_vs_reference(monkeypatch, causal, sq, sk):
+    """Ragged lengths exercise BOTH the row_limit and col_limit legs of the
+    flat kernel's mask (the split kernels each apply only one side)."""
+    monkeypatch.setenv(fa.ENV_FLASH_BWD, "auto")
+    d = 64
+    q, k, v = _mk((2, sq, d), 4), _mk((2, sk, d), 5), _mk((2, sk, d), 6)
+    w = _mk((2, sq, d), 7)
+    got = _grads(q, k, v, w, causal, 0.125)
+    ref = _ref_grads(q, k, v, w, causal, 0.125)
+    for g, r, name in zip(got, ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
+
+
+def test_fused_flat_is_default_path(monkeypatch):
+    """auto mode routes residency-sized shapes through the flat pass (the
+    split kernels no longer run unless pinned)."""
+    calls = {"flat": 0}
+    orig = fa._bwd_fused_flat_call
+
+    def spy(*a, **kw):
+        calls["flat"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(fa, "_bwd_fused_flat_call", spy)
+    q, k, v = _mk((1, 256, 64), 8), _mk((1, 256, 64), 9), _mk((1, 256, 64), 10)
+    w = _mk((1, 256, 64), 11)
+    _grads(q, k, v, w, True, 0.125)
+    assert calls == {"flat": 1}
+
+
+def test_env_flash_bwd_validated():
+    os.environ[fa.ENV_FLASH_BWD] = "fused"
+    try:
+        with pytest.raises(ValueError, match="PADDLE_TPU_FLASH_BWD"):
+            fa.dense_bwd_mode()
+    finally:
+        del os.environ[fa.ENV_FLASH_BWD]
+
+
+# --- schedule geometry -----------------------------------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_q,n_k", [(4, 4), (3, 7), (8, 2)])
+def test_dense_bwd_schedule_fetch_once(causal, n_q, n_k):
+    """Every scheduled (k, q) pair is distinct — each block pair is fetched
+    exactly once — and the flags bracket each k tile's consecutive run."""
+    ki, qi, first, last, n_flat = fa._dense_bwd_schedule(
+        n_q, n_k, causal, 128, 128)
+    ki, qi = np.asarray(ki), np.asarray(qi)
+    first, last = np.asarray(first), np.asarray(last)
+    assert len(ki) == n_flat
+    pairs = set(zip(ki.tolist(), qi.tolist()))
+    assert len(pairs) == n_flat  # no pair revisited
+    # k-major: ki non-decreasing, qi increasing within a k tile
+    assert (np.diff(ki) >= 0).all()
+    for j in range(n_k):
+        sel = qi[ki == j]
+        assert (np.diff(sel) == 1).all()
+        assert first[ki == j][0] == 1 and last[ki == j][-1] == 1
+        assert first[ki == j][1:].sum() == 0 and last[ki == j][:-1].sum() == 0
+    if causal:
+        # live set is the transpose of the forward's causal live set,
+        # clamped so every k tile still flushes its (zero) dk/dv block
+        for j, i in pairs:
+            assert i >= min((j * 128) // 128, n_q - 1)
+    else:
+        assert n_flat == n_q * n_k
+
+
+# --- VMEM fitter -----------------------------------------------------------
+
+def test_fit_bwd_flat_blocks_shrinks_for_large_heads():
+    """hd=128 at S=64k over-runs the budget at 1024x1024 tiles; the fitter
+    must shrink (to sp-dividing, 128-aligned blocks), not overrun."""
+    sp = 64 * 1024
+    assert fa._bwd_flat_vmem_bytes(1024, 1024, sp, 128, 2) \
+        > fa._FLAT_BWD_VMEM_BUDGET
+    fit = fa._fit_bwd_flat_blocks(1024, 1024, sp, sp, 128, 2)
+    assert fit is not None
+    bq, bk = fit
+    assert bq < 1024 or bk < 1024
+    assert bq % 128 == 0 and bk % 128 == 0
+    assert sp % bq == 0 and sp % bk == 0
+    assert fa._bwd_flat_vmem_bytes(bq, bk, sp, 128, 2) \
+        <= fa._FLAT_BWD_VMEM_BUDGET
+
+
+def test_fit_bwd_flat_blocks_gives_up_when_dq_scratch_too_big():
+    """At S=128k, d=128 the persistent [sp, d] f32 dq scratch alone
+    (64 MB) exceeds the budget: no block size helps -> None (the caller
+    falls through to the dq-partials streaming pass)."""
+    sp = 128 * 1024
+    assert fa._fit_bwd_flat_blocks(1024, 1024, sp, sp, 128, 2) is None
+
+
+def test_fit_bwd_flat_blocks_keeps_fitting_blocks():
+    # comfortably-fitting shape: blocks come back untouched
+    assert fa._fit_bwd_flat_blocks(128, 128, 256, 256, 64, 4) == (128, 128)
+
+
+# --- schedule stats (BENCH_DETAIL contract) --------------------------------
+
+def test_dense_bwd_schedule_stats_paths(monkeypatch):
+    monkeypatch.setenv(fa.ENV_FLASH_BWD, "auto")
+    s32 = fa.dense_bwd_schedule_stats(8, 32768, 32768, 128, jnp.bfloat16,
+                                      True)
+    assert s32["path"] == "fused_flat"
+    assert s32["fetches_per_block_pair"] == 1
+    assert s32["matmuls_per_pair"] == 5
+    n_q = 32768 // s32["block_q"]
+    n_k = 32768 // s32["block_k"]
+    assert 0 < s32["n_flat"] <= n_q * n_k
+    s128 = fa.dense_bwd_schedule_stats(4, 131072, 131072, 128, jnp.bfloat16,
+                                       True)
+    assert s128["path"] == "fused_stream"  # dq scratch over budget
+    monkeypatch.setenv(fa.ENV_FLASH_BWD, "split")
+    sp = fa.dense_bwd_schedule_stats(2, 512, 512, 64, jnp.float32, True)
+    assert sp["path"] == "split_resident"
+    assert sp["fetches_per_block_pair"] == 2

@@ -67,9 +67,11 @@ def _flash_grads(q, k, v, causal, scale):
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("s", [256, 320])  # 320: ragged (pads to 384)
-def test_stream_bwd_matches_reference(force_stream, causal, s):
-    """Both sides over budget -> both grads streamed (now the fused one-pass
-    kernel: _bwd_fused_stream_call)."""
+def test_stream_bwd_matches_reference(force_stream, monkeypatch, causal, s):
+    """Both sides over budget -> both grads streamed (the dq-partials
+    kernel: _bwd_fused_stream_call; env pin keeps it under test now that
+    the flat pass is the default — see test_flash_bwd_fused.py)."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BWD", "split")
     rng = np.random.RandomState(3)
     q = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
     k = jnp.asarray(rng.randn(2, s, 64).astype(np.float32))
@@ -83,18 +85,27 @@ def test_stream_bwd_matches_reference(force_stream, causal, s):
 
 @pytest.mark.parametrize("sq,sk", [(128, 512), (512, 128)])
 def test_stream_bwd_mixed_sides(monkeypatch, sq, sk):
-    """Only ONE side over budget (cross-attention, unequal lengths): the
-    fused one-pass backward must be used (5 matmuls per tile pair), never
-    the resident two-kernel path that recomputes S and dP."""
+    """Only ONE side over the residency budget (cross-attention, unequal
+    lengths): a FUSED one-pass backward must be used (5 matmuls per tile
+    pair), never the resident two-kernel path that recomputes S and dP.
+    In the default mode that is the flat k-major pass; under
+    PADDLE_TPU_FLASH_BWD=split the dq-partials streaming pass takes
+    over for the same shapes."""
     monkeypatch.setattr(fa, "STREAM_KV_BYTES", 2 * 256 * 64 * 4)  # 256 rows f32
-    calls = {"fused": 0}
-    orig = fa._bwd_fused_stream_call
+    calls = {"flat": 0, "stream": 0}
+    orig_flat = fa._bwd_fused_flat_call
+    orig_stream = fa._bwd_fused_stream_call
 
-    def spy(*a, **kw):
-        calls["fused"] += 1
-        return orig(*a, **kw)
+    def spy_flat(*a, **kw):
+        calls["flat"] += 1
+        return orig_flat(*a, **kw)
 
-    monkeypatch.setattr(fa, "_bwd_fused_stream_call", spy)
+    def spy_stream(*a, **kw):
+        calls["stream"] += 1
+        return orig_stream(*a, **kw)
+
+    monkeypatch.setattr(fa, "_bwd_fused_flat_call", spy_flat)
+    monkeypatch.setattr(fa, "_bwd_fused_stream_call", spy_stream)
     rng = np.random.RandomState(4)
     q = jnp.asarray(rng.randn(1, sq, 64).astype(np.float32))
     k = jnp.asarray(rng.randn(1, sk, 64).astype(np.float32))
@@ -103,16 +114,22 @@ def test_stream_bwd_mixed_sides(monkeypatch, sq, sk):
     # trace time; disable_jit would also work but hits a 0.4.x pallas_call
     # infinite recursion (impl re-binds under disabled jit)
     got = _flash_grads(q, k, v, False, 0.125)
+    assert calls == {"flat": 1, "stream": 0}
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BWD", "split")
+    got_split = _flash_grads(q, k, v, False, 0.125)
+    assert calls == {"flat": 1, "stream": 1}
     ref = _ref_grads(q, k, v, False, 0.125)
-    for g, r, name in zip(got, ref, "qkv"):
+    for g, gs, r, name in zip(got, got_split, ref, "qkv"):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=2e-3, atol=2e-3, err_msg=name)
-    assert calls == {"fused": 1}
+        np.testing.assert_allclose(np.asarray(gs), np.asarray(r),
+                                   rtol=2e-3, atol=2e-3, err_msg=name)
 
 
-def test_stream_bwd_causal_long(force_stream):
+def test_stream_bwd_causal_long(force_stream, monkeypatch):
     """Causal streamed backward with the clamped (DMA-skipping) index maps
-    at a multi-tile size."""
+    at a multi-tile size (env pin: see test_stream_bwd_matches_reference)."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BWD", "split")
     rng = np.random.RandomState(5)
     s = 512
     q = jnp.asarray(rng.randn(1, s, 64).astype(np.float32))
@@ -143,7 +160,10 @@ def test_stream_matches_resident_kernel(force_stream):
 
 def test_fused_bwd_kv_chunking_matches_unchunked(monkeypatch):
     """Long-S guard: when n_kdma exceeds _BWD_MAX_DQ_PARTIALS the kv dim is
-    chunked at the XLA level; numerics must be identical to one chunk."""
+    chunked at the XLA level; numerics must be identical to one chunk.
+    PADDLE_TPU_FLASH_BWD=split keeps the dq-partials streaming pass under
+    test now that the flat pass is the default for shapes this small."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BWD", "split")
     monkeypatch.setattr(fa, "STREAM_KV_BYTES", 2 * 256 * 64 * 4)
     rng = np.random.RandomState(7)
     s = 1024
