@@ -760,6 +760,24 @@ def bench_serve_continuous(dev, config, on_tpu):
     return out
 
 
+def _static_analysis_record():
+    """Per-rule finding counts from paddle_tpu.analysis — the bench
+    record carries the lint posture of the tree the numbers came from
+    (a weak-scalar or host-sync regression shows up next to the MFU it
+    distorted)."""
+    try:
+        from paddle_tpu.analysis import run as run_analysis
+        report = run_analysis()
+    except Exception as exc:  # the record is telemetry, never a gate
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    return {
+        "rules": report.to_json()["rules"],
+        "total_active": len(report.active),
+        "total_suppressed": len(report.suppressed),
+        "total_allowlisted": len(report.allowlisted),
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1040,6 +1058,8 @@ def main():
                 dev, long_seq["S16384"]["ms"],
                 long_seq["S16384"]["bwd_ms"]),
         }
+
+    detail["static_analysis"] = _static_analysis_record()
 
     # The driver records a BOUNDED TAIL of stdout: round 4's single giant
     # JSON line was truncated mid-object and the official record had

@@ -1,0 +1,328 @@
+"""``paddle_tpu.analysis`` — repo-specific static analysis.
+
+A rule-registry framework (AST-based; the checked modules are never
+imported) that turns the bug classes this repo has actually hit into
+enforced lint rules:
+
+    PTA001 weak-scalar       untyped int/float literals at known weak-type
+                             sinks in ops/ and parallel/ (the PR-6/PR-7
+                             x64 re-canonicalization / MLIR-verifier class)
+    PTA002 vmem-budget       static per-pallas_call VMEM estimate from
+                             BlockSpec block shapes, unless the site
+                             routes through a registered fitter (_fit_*)
+    PTA003 cost-estimate     every pallas_call in ops/ passes
+                             cost_estimate= (MFU attribution, PR 4)
+    PTA004 comm-span-nbytes  every comm_span(...) passes nbytes= (PR 3)
+    PTA005 env-knobs         every PADDLE_TPU_* read goes through the
+                             paddle_tpu.envs validated-getter registry
+    PTA006 host-sync         .item()/np.asarray/jax.device_get/... in the
+                             hot-path modules (PR-2 zero-host-syncs bar)
+
+Findings can be suppressed inline with a REASONED noqa::
+
+    x = np.asarray(cu)  # noqa: PTA006 -- host-side plan on concrete cu
+
+(a reason after ``--`` is mandatory; a bare ``# noqa: PTA006`` suppresses
+the finding but raises a PTA000 "suppression lacks a reason" finding in
+its place) or via the per-rule allowlist file ``allowlist.json`` next to
+this module (whole-file grants, each with a reason).
+
+CLI::
+
+    python -m paddle_tpu.analysis [--strict] [--rule PTA001] [--json] [paths]
+
+``--strict`` exits non-zero when any active (unsuppressed, unallowlisted)
+finding remains — the tier-1 gate (tests/test_static_analysis.py) and the
+multichip-dryrun preamble both run in this mode.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from . import _astutil
+
+__all__ = ["Finding", "Module", "Rule", "Report", "run", "all_rules",
+           "register", "REPO_ROOT", "DEFAULT_ALLOWLIST"]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "allowlist.json")
+
+# `# noqa: PTA001 -- reason` (multiple codes comma-separated). The reason
+# is MANDATORY; a reasonless suppression trades the finding for a PTA000.
+_NOQA_RE = re.compile(
+    r"#\s*noqa:\s*(?P<codes>PTA\d{3}(?:\s*,\s*PTA\d{3})*)"
+    r"(?:\s*--\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    status: str = "active"     # active | suppressed | allowlisted
+    reason: str = ""           # the suppression/allowlist reason
+
+    def format(self) -> str:
+        tag = "" if self.status == "active" else f" [{self.status}]"
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}{tag} " \
+               f"{self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file: AST with parent links plus the noqa map."""
+
+    def __init__(self, source: str, rel: str, path: Optional[str] = None):
+        self.source = source
+        self.rel = rel.replace(os.sep, "/")
+        self.path = path
+        self.tree = _astutil.link_parents(ast.parse(source, filename=rel))
+        self.noqa: Dict[int, Tuple[Tuple[str, ...], str]] = {}
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _NOQA_RE.search(line)
+            if m:
+                codes = tuple(c.strip()
+                              for c in m.group("codes").split(","))
+                self.noqa[lineno] = (codes, m.group("reason") or "")
+
+    @classmethod
+    def from_file(cls, path: str, root: str) -> "Module":
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        return cls(source, os.path.relpath(path, root), path=path)
+
+    @classmethod
+    def from_source(cls, source: str, rel: str = "<synthetic>.py"
+                    ) -> "Module":
+        return cls(source, rel)
+
+
+class Rule:
+    """Base class. Subclasses set ``code``/``title``/``rationale`` and the
+    repo-relative ``scope`` prefixes they sweep, then yield Findings from
+    ``check_module`` (per file) and ``finalize`` (repo-level properties
+    such as coverage floors — only run on full-default scans)."""
+
+    code = "PTA000"
+    title = ""
+    rationale = ""
+    scope: Tuple[str, ...] = ("paddle_tpu/",)
+    exclude: Tuple[str, ...] = ()
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def in_scope(self, rel: str) -> bool:
+        if any(rel.startswith(p) for p in self.exclude):
+            return False
+        return any(rel.startswith(p) for p in self.scope)
+
+    def check_module(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, module: Module, node, message: str) -> Finding:
+        return Finding(self.code, module.rel, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), message)
+
+
+_RULE_CLASSES: Dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the registry (keyed by code)."""
+    _RULE_CLASSES[cls.code] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, type]:
+    from . import rules as _rules  # noqa: F401  (registration side effect)
+    return dict(sorted(_RULE_CLASSES.items()))
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    rules: List[str]                      # codes that ran
+    titles: Dict[str, str]
+
+    @property
+    def active(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "active"]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "suppressed"]
+
+    @property
+    def allowlisted(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "allowlisted"]
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        out = {code: {"active": 0, "suppressed": 0, "allowlisted": 0}
+               for code in self.rules}
+        for f in self.findings:
+            out.setdefault(f.rule, {"active": 0, "suppressed": 0,
+                                    "allowlisted": 0})[f.status] += 1
+        return out
+
+    def to_json(self) -> dict:
+        counts = self.counts()
+        return {
+            "rules": {code: dict(counts[code],
+                                 title=self.titles.get(code, ""))
+                      for code in sorted(counts)},
+            "total_active": len(self.active),
+            "total_suppressed": len(self.suppressed),
+            "total_allowlisted": len(self.allowlisted),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def render_text(self, show_all: bool = False) -> str:
+        lines = []
+        shown = self.findings if show_all else self.active
+        for f in sorted(shown, key=lambda f: (f.rule, f.path, f.line)):
+            lines.append(f.format())
+        counts = self.counts()
+        for code in sorted(counts):
+            c = counts[code]
+            title = self.titles.get(code, "")
+            lines.append(f"{code} {title}: active={c['active']} "
+                         f"suppressed={c['suppressed']} "
+                         f"allowlisted={c['allowlisted']}")
+        lines.append(f"static-analysis: {len(self.rules)} rules, "
+                     f"{len(self.active)} active, "
+                     f"{len(self.suppressed)} suppressed, "
+                     f"{len(self.allowlisted)} allowlisted")
+        return "\n".join(lines)
+
+
+def _collect_py(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(os.path.abspath(p))
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(dirpath, f)))
+    return sorted(set(out))
+
+
+def _load_allowlist(path: Optional[str]):
+    """{(code, rel-path): reason} from the JSON allowlist; entries missing
+    a reason are returned separately so they can surface as PTA000."""
+    grants: Dict[Tuple[str, str], str] = {}
+    unreasoned: List[Tuple[str, str]] = []
+    if path is None or not os.path.exists(path):
+        return grants, unreasoned
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    for code, entries in (data.get("rules") or {}).items():
+        for entry in entries:
+            rel = entry.get("path", "").replace(os.sep, "/")
+            reason = (entry.get("reason") or "").strip()
+            if not reason:
+                unreasoned.append((code, rel))
+            grants[(code, rel)] = reason
+    return grants, unreasoned
+
+
+def run(paths: Optional[List[str]] = None,
+        rules: Optional[List[str]] = None,
+        root: Optional[str] = None,
+        allowlist: Optional[str] = DEFAULT_ALLOWLIST,
+        respect_scope: bool = True,
+        with_floors: Optional[bool] = None) -> Report:
+    """Run the selected rules and return a :class:`Report`.
+
+    paths: files/dirs to sweep (default: the paddle_tpu package).
+    rules: rule codes to run (default: all registered).
+    respect_scope: apply each rule's scope prefixes (turn off to point a
+        rule at fixture files outside its normal scope).
+    with_floors: run repo-level finalize() checks (coverage floors);
+        defaults to True exactly when scanning the default paths.
+    """
+    root = os.path.abspath(root or REPO_ROOT)
+    default_scan = paths is None
+    if default_scan:
+        paths = [os.path.join(root, "paddle_tpu")]
+    if with_floors is None:
+        with_floors = default_scan
+
+    classes = all_rules()
+    codes = list(classes) if rules is None else list(rules)
+    unknown = [c for c in codes if c not in classes]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}; "
+                         f"known: {', '.join(classes)}")
+
+    modules = []
+    for path in _collect_py(paths):
+        try:
+            modules.append(Module.from_file(path, root))
+        except SyntaxError as exc:
+            modules_rel = os.path.relpath(path, root).replace(os.sep, "/")
+            raise SyntaxError(
+                f"static analysis cannot parse {modules_rel}: {exc}")
+
+    grants, unreasoned = _load_allowlist(allowlist)
+    allow_rel = os.path.relpath(allowlist, root).replace(os.sep, "/") \
+        if allowlist else "allowlist.json"
+
+    findings: List[Finding] = []
+    titles: Dict[str, str] = {}
+    for code in codes:
+        rule = classes[code](root)
+        titles[code] = rule.title
+        raw: List[Finding] = []
+        for mod in modules:
+            if respect_scope and not rule.in_scope(mod.rel):
+                continue
+            raw.extend(rule.check_module(mod))
+        if with_floors:
+            raw.extend(rule.finalize())
+        findings.extend(raw)
+
+    # suppression + allowlist pass
+    noqa_by_rel = {m.rel: m.noqa for m in modules}
+    out: List[Finding] = []
+    meta: List[Finding] = []
+    for f in findings:
+        noqa = noqa_by_rel.get(f.path, {}).get(f.line)
+        if noqa is not None and f.rule in noqa[0]:
+            codes_at_line, reason = noqa
+            f.status = "suppressed"
+            f.reason = reason
+            if not reason:
+                meta.append(Finding(
+                    "PTA000", f.path, f.line, f.col,
+                    f"suppression of {f.rule} lacks a reason — write "
+                    f"'# noqa: {f.rule} -- <why>'"))
+        elif (f.rule, f.path) in grants:
+            f.status = "allowlisted"
+            f.reason = grants[(f.rule, f.path)]
+        out.append(f)
+    for code, rel in unreasoned:
+        meta.append(Finding(
+            "PTA000", allow_rel, 0, 0,
+            f"allowlist entry ({code}, {rel}) lacks a reason"))
+    if meta:
+        titles["PTA000"] = "reasonless suppression"
+    report_rules = codes + (["PTA000"] if meta else [])
+    return Report(out + meta, report_rules, titles)

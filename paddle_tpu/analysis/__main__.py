@@ -1,0 +1,68 @@
+"""CLI for ``paddle_tpu.analysis``.
+
+    python -m paddle_tpu.analysis [--strict] [--rule PTA001] [--json] [paths]
+
+Exit status: 0 when no active findings (or not --strict); 1 when --strict
+and active findings remain; 2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_ALLOWLIST, all_rules, run
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="repo-specific static analysis (AST-based; never "
+                    "imports the checked modules)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to sweep (default: the "
+                             "paddle_tpu package)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 if any active finding remains")
+    parser.add_argument("--rule", action="append", dest="rules",
+                        metavar="PTA###",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the machine-readable findings record")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list registered rules and exit")
+    parser.add_argument("--no-scope", action="store_true",
+                        help="ignore per-rule scope prefixes (fixture runs)")
+    parser.add_argument("--no-floors", action="store_true",
+                        help="skip repo-level coverage-floor checks")
+    parser.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                        help="allowlist JSON path (default: the in-package "
+                             "allowlist.json)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, cls in all_rules().items():
+            print(f"{code} {cls.title}: {cls.rationale}")
+        return 0
+
+    try:
+        report = run(paths=args.paths or None,
+                     rules=args.rules,
+                     allowlist=args.allowlist,
+                     respect_scope=not args.no_scope,
+                     with_floors=False if args.no_floors else None)
+    except (ValueError, SyntaxError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+    if args.strict and report.active:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

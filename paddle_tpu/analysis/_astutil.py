@@ -1,0 +1,203 @@
+"""Shared AST helpers for the static-analysis rules.
+
+Everything here operates on plain ``ast`` trees — the checked modules are
+never imported, so rules run identically whether or not jax (or the
+repo's native runtime) is importable.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+PARENT_ATTR = "_pta_parent"
+
+
+def link_parents(tree: ast.AST) -> ast.AST:
+    """Attach a ``_pta_parent`` attribute to every node."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            setattr(child, PARENT_ATTR, node)
+    return tree
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, PARENT_ATTR, None)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jnp.full' for Attribute chains rooted at a Name; None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_ident(call: ast.Call) -> Optional[str]:
+    """Last path segment of the callee: pl.pallas_call -> 'pallas_call'."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def call_root(call: ast.Call) -> Optional[str]:
+    """First path segment of the callee: jnp.full -> 'jnp'."""
+    fn = call.func
+    while isinstance(fn, ast.Attribute):
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def keyword(call: ast.Call, name: str) -> Optional[ast.keyword]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+def number_of(node: ast.AST):
+    """(value, True) when the node is a bare int/float literal, unwrapping
+    unary +/-; (None, False) otherwise. bools are NOT numbers here."""
+    neg = False
+    while isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        if isinstance(node.op, ast.USub):
+            neg = not neg
+        node = node.operand
+    if isinstance(node, ast.Constant) and isinstance(
+            node.value, (int, float)) and not isinstance(node.value, bool):
+        return (-node.value if neg else node.value), True
+    return None, False
+
+
+def is_bare_number(node: ast.AST) -> bool:
+    return number_of(node)[1]
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def iter_calls(tree: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def enclosing_function(node: ast.AST):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef (or None)."""
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parent(cur)
+    return None
+
+
+def numpy_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the numpy module ('np', '_np', 'numpy', ...)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def envs_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the paddle_tpu.envs module."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if node.level > 0 or mod == "paddle_tpu" or \
+                    mod.endswith(".paddle_tpu"):
+                for a in node.names:
+                    if a.name == "envs":
+                        out.add(a.asname or "envs")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "paddle_tpu.envs" or a.name.endswith(".envs"):
+                    out.add(a.asname or "envs")
+    return out
+
+
+class ConstEnv:
+    """Best-effort constant folder over one function's (and the module's)
+    straight-line ``name = <literal expr>`` assignments. Supports ints
+    through +,-,*,//,**, min/max and tuple unpacking — enough to resolve
+    the literal BlockSpec shapes the VMEM rule prices. Anything else
+    resolves to None ("unknown"), never a wrong number."""
+
+    def __init__(self, module_tree: ast.AST, func: Optional[ast.AST] = None):
+        self._env: Dict[str, ast.AST] = {}
+        self._collect(module_tree, toplevel_only=True)
+        if func is not None:
+            self._collect(func, toplevel_only=False)
+        self._resolving: Set[str] = set()
+
+    def _collect(self, tree, toplevel_only):
+        nodes = tree.body if toplevel_only else ast.walk(tree)
+        for node in nodes:
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._env[tgt.id] = node.value
+                elif isinstance(tgt, ast.Tuple) and isinstance(
+                        node.value, ast.Tuple) and \
+                        len(tgt.elts) == len(node.value.elts):
+                    for t, v in zip(tgt.elts, node.value.elts):
+                        if isinstance(t, ast.Name):
+                            self._env[t.id] = v
+
+    def resolve(self, node: ast.AST):
+        """int/float value of the expression, or None when unknown."""
+        val, ok = number_of(node)
+        if ok:
+            return val
+        if isinstance(node, ast.Name):
+            if node.id in self._resolving or node.id not in self._env:
+                return None
+            self._resolving.add(node.id)
+            try:
+                return self.resolve(self._env[node.id])
+            finally:
+                self._resolving.discard(node.id)
+        if isinstance(node, ast.BinOp):
+            lhs = self.resolve(node.left)
+            rhs = self.resolve(node.right)
+            if lhs is None or rhs is None:
+                return None
+            try:
+                if isinstance(node.op, ast.Add):
+                    return lhs + rhs
+                if isinstance(node.op, ast.Sub):
+                    return lhs - rhs
+                if isinstance(node.op, ast.Mult):
+                    return lhs * rhs
+                if isinstance(node.op, ast.FloorDiv):
+                    return lhs // rhs
+                if isinstance(node.op, ast.Pow):
+                    return lhs ** rhs
+            except (ZeroDivisionError, OverflowError):
+                return None
+            return None
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("min", "max") and not node.keywords:
+            vals = [self.resolve(a) for a in node.args]
+            if any(v is None for v in vals) or not vals:
+                return None
+            return (min if node.func.id == "min" else max)(vals)
+        return None

@@ -1,0 +1,7 @@
+"""Rule modules — importing this package registers every rule."""
+from . import pta001_weak_scalar  # noqa: F401
+from . import pta002_vmem_budget  # noqa: F401
+from . import pta003_cost_estimate  # noqa: F401
+from . import pta004_comm_span  # noqa: F401
+from . import pta005_env_knobs  # noqa: F401
+from . import pta006_host_sync  # noqa: F401

@@ -1,0 +1,131 @@
+"""PTA001: weak-typed Python scalars at known weak-type sinks.
+
+The bug class: the package enables x64 globally (Paddle's int64 default),
+so a bare Python literal flowing into a jax op is a WEAK f64/i64 scalar.
+Inside a Pallas kernel body that is usually harmless at trace time — the
+strong operand wins the promotion — but when the kernel is lowered again
+under a consumer jit (shard_map islands, the serving engine's compiled
+families), the constant can be re-canonicalized to f64/i64 and trip the
+MLIR verifier. This bit PR 6 (decode_attention/paged_attention scalar
+args) and PR 7 (_mask_scores' bare ``-1e30``) in consecutive rounds.
+
+The rule flags bare int/float literals in ops/ and parallel/ at the sinks
+the class has actually used:
+
+  * ``where(cond, x, <literal>)`` / ``where(cond, <literal>, y)``
+    (and ``lax.select``) — the _mask_scores shape;
+  * ``full``/``full_like`` fill values without an explicit ``dtype=``;
+  * ``asarray``/``array`` of a literal without an explicit dtype;
+  * float literals with |v| >= 1e6 anywhere else (mask constants passed
+    as scalar args) unless already wrapped in a dtype constructor.
+
+Fix by wrapping: ``jnp.float32(-1e30)`` / ``np.int32(0)`` (bitwise
+identical for exactly-representable values, and strongly typed so x64
+cannot re-canonicalize them).
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Rule, register
+from .._astutil import (call_ident, call_root, is_bare_number, iter_calls,
+                        keyword, number_of, parent)
+
+# dtype constructors that make a literal strongly typed
+_CASTERS = frozenset({
+    "float32", "float64", "float16", "bfloat16",
+    "int8", "int16", "int32", "int64", "uint8", "uint32", "uint64",
+})
+
+# sinks whose literal args the x64 class has actually hit
+_WHERE_LIKE = frozenset({"where", "select"})
+_FULL_LIKE = frozenset({"full", "full_like"})
+_ASARRAY_LIKE = frozenset({"asarray", "array"})
+
+_BIG_FLOAT = 1e6  # mask constants (-1e30, 1e9, ...) are never "just math"
+
+
+def _wrap_hint(value):
+    if isinstance(value, float):
+        return f"jnp.float32({value!r})"
+    return f"np.int32({value!r})"
+
+
+@register
+class WeakScalarRule(Rule):
+    code = "PTA001"
+    title = "weak-scalar"
+    rationale = ("bare Python literals are weak-typed under the package-"
+                 "global x64 and re-canonicalize to f64/i64 when kernels "
+                 "lower under consumer jits (PR-6/PR-7 MLIR-verifier "
+                 "class)")
+    scope = ("paddle_tpu/ops/", "paddle_tpu/parallel/")
+
+    def check_module(self, module):
+        flagged = set()
+        for call in iter_calls(module.tree):
+            ident = call_ident(call)
+            if ident in _WHERE_LIKE:
+                for arg in call.args[1:3]:
+                    val, ok = number_of(arg)
+                    if ok:
+                        flagged.add(id(arg))
+                        yield self.finding(
+                            module, arg,
+                            f"weak {type(val).__name__} literal {val!r} as "
+                            f"a {ident}() branch; wrap it "
+                            f"({_wrap_hint(val)}) so the package-global "
+                            f"x64 cannot re-canonicalize it")
+            elif ident in _FULL_LIKE:
+                if len(call.args) >= 2 and is_bare_number(call.args[1]) \
+                        and len(call.args) < 3 \
+                        and keyword(call, "dtype") is None:
+                    val, _ = number_of(call.args[1])
+                    yield self.finding(
+                        module, call.args[1],
+                        f"weak {type(val).__name__} literal {val!r} as "
+                        f"{ident}() fill value without dtype=; pass an "
+                        f"explicit dtype or wrap it ({_wrap_hint(val)})")
+            elif ident in _ASARRAY_LIKE:
+                if call.args and is_bare_number(call.args[0]) \
+                        and len(call.args) < 2 \
+                        and keyword(call, "dtype") is None:
+                    val, _ = number_of(call.args[0])
+                    yield self.finding(
+                        module, call.args[0],
+                        f"weak {type(val).__name__} literal {val!r} in "
+                        f"{ident}() without dtype=; it canonicalizes to "
+                        f"f64/i64 under x64")
+        # big float constants anywhere else (scalar-arg class): literal
+        # mask values must ride wrapped in a dtype constructor
+        for node in ast.walk(module.tree):
+            # a Constant under a unary +/- is visited via its UnaryOp
+            if isinstance(node, ast.Constant) and \
+                    isinstance(parent(node), ast.UnaryOp):
+                continue
+            val, ok = number_of(node)
+            if not ok or not isinstance(val, float) or abs(val) < _BIG_FLOAT:
+                continue
+            if id(node) in flagged:
+                continue
+            # walk out of the unary +/- wrapper to the real parent
+            outer = node
+            p = parent(outer)
+            while isinstance(p, ast.UnaryOp):
+                outer = p
+                p = parent(outer)
+            if isinstance(p, ast.Call):
+                ident = call_ident(p)
+                if ident in _CASTERS or ident in _WHERE_LIKE \
+                        or ident in _FULL_LIKE or ident in _ASARRAY_LIKE:
+                    continue  # wrapped, or already handled above
+                if keyword(p, "dtype") is not None and call_root(p) in (
+                        "np", "jnp", "numpy"):
+                    continue  # np/jnp ctor with explicit dtype
+            if isinstance(node, ast.Constant) and isinstance(p, ast.Expr):
+                continue  # docstring-adjacent bare constant statement
+            yield self.finding(
+                module, outer,
+                f"weak float mask constant {val!r} outside a dtype "
+                f"constructor; wrap it ({_wrap_hint(val)}) before it "
+                f"flows into a kernel")

@@ -1,0 +1,57 @@
+"""PTA003: every ``pallas_call`` site in ops/ passes ``cost_estimate=``.
+
+A custom call without a cost estimate is costed at ZERO by XLA's cost
+model, silently deflating the StepMetrics MFU attribution for every
+kernel-backed step (the PR-2 observability contract; estimates attached
+in PR 4). Migrated from tests/test_pallas_cost_lint.py — that test is now
+a thin shim over this rule.
+
+The finalize() coverage floor guards the rule itself: if the AST walk
+ever stops seeing the known kernel population (>= MIN_SITES sites), the
+rule fails loudly instead of silently matching nothing.
+"""
+from __future__ import annotations
+
+from .. import Finding, Rule, register
+from .._astutil import call_ident, iter_calls, keyword
+
+# flash fwd/bwd (resident, streaming, fused flat, split pair), varlen
+# fwd/bwd (streaming + stacked + fused + split), decode slabs, rms_norm,
+# grouped matmul x3, paged attention read + fused update
+MIN_SITES = 12
+
+
+@register
+class CostEstimateRule(Rule):
+    code = "PTA003"
+    title = "cost-estimate"
+    rationale = ("pallas_call without cost_estimate= is costed at zero "
+                 "FLOPs, deflating StepMetrics MFU (PR-2/PR-4 "
+                 "observability contract)")
+    scope = ("paddle_tpu/ops/",)
+
+    min_sites = MIN_SITES
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.sites_seen = 0
+
+    def check_module(self, module):
+        for call in iter_calls(module.tree):
+            if call_ident(call) != "pallas_call":
+                continue
+            self.sites_seen += 1
+            if keyword(call, "cost_estimate") is None:
+                yield self.finding(
+                    module, call,
+                    "pallas_call without cost_estimate=; XLA costs the "
+                    "custom call at zero FLOPs and StepMetrics MFU "
+                    "under-attributes the step")
+
+    def finalize(self):
+        if self.sites_seen < self.min_sites:
+            yield Finding(
+                self.code, "paddle_tpu/ops/", 0, 0,
+                f"coverage floor: found only {self.sites_seen} "
+                f"pallas_call sites (expected >= {self.min_sites}); the "
+                f"AST walk may be silently matching nothing")

@@ -1,0 +1,46 @@
+"""PTA004: every ``comm_span(...)`` call site passes ``nbytes=``.
+
+A span with no byte count shows up as a hole in the per-hop/per-bucket
+traffic accounting the benches and the multichip dryrun assert on (the
+PR-3 telemetry contract). Migrated from tests/test_comm_span_lint.py —
+that test is now a thin shim over this rule.
+"""
+from __future__ import annotations
+
+from .. import Finding, Rule, register
+from .._astutil import call_ident, iter_calls, keyword
+
+
+@register
+class CommSpanRule(Rule):
+    code = "PTA004"
+    title = "comm-span-nbytes"
+    rationale = ("comm_span without nbytes= leaves a hole in the per-hop "
+                 "traffic attribution the benches and dryrun assert on "
+                 "(PR-3 telemetry contract)")
+    scope = ("paddle_tpu/",)
+    exclude = ("paddle_tpu/analysis/",)
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.sites_seen = 0
+
+    def check_module(self, module):
+        # only call sites count; the def site in observability/trace.py
+        # never appears as a Call node
+        for call in iter_calls(module.tree):
+            if call_ident(call) != "comm_span":
+                continue
+            self.sites_seen += 1
+            if keyword(call, "nbytes") is None:
+                yield self.finding(
+                    module, call,
+                    "comm_span without nbytes=; the span's traffic volume "
+                    "is unattributed in the step telemetry")
+
+    def finalize(self):
+        if self.sites_seen < 1:
+            yield Finding(
+                self.code, "paddle_tpu/", 0, 0,
+                "coverage floor: found no comm_span call sites at all; "
+                "the AST walk may be silently matching nothing")

@@ -1,0 +1,123 @@
+"""PTA005: every ``PADDLE_TPU_*`` read goes through ``paddle_tpu.envs``.
+
+PR 3 hardened env parsing per site; PR 6/PR 7 added more knobs with more
+one-off parsers. ``paddle_tpu/envs.py`` is now the single registry —
+(name, type, default, validator, doc) — and this rule enforces it
+statically, without importing either side:
+
+  * raw ``os.environ.get``/``os.getenv``/``os.environ[...]`` reads of a
+    ``PADDLE_TPU_*`` key anywhere in the package (outside envs.py) are
+    flagged — they bypass validation and the documented-knob table;
+  * any exact ``PADDLE_TPU_*`` string literal naming a knob that is NOT
+    registered in envs.py is flagged as undocumented (this catches both
+    ``envs.get("PADDLE_TPU_TYPO")`` and a new module inventing a knob
+    without registering it);
+  * registered knobs whose ``doc=`` is empty are flagged at their
+    registration line.
+
+The registry is read by PARSING envs.py (the `_register(...)` calls use
+literal names and docs), keeping the rule import-free.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .. import Finding, Rule, register
+from .._astutil import call_ident, dotted_name, iter_calls, str_const
+
+_KNOB_RE = re.compile(r"^PADDLE_TPU_[A-Z0-9_]*[A-Z0-9]$")
+
+
+def _load_registry(root):
+    """{name: (lineno, doc)} parsed statically from paddle_tpu/envs.py."""
+    path = os.path.join(root, "paddle_tpu", "envs.py")
+    out = {}
+    if not os.path.exists(path):
+        return out
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    for call in iter_calls(tree):
+        if call_ident(call) != "_register" or not call.args:
+            continue
+        name = str_const(call.args[0])
+        if name is None:
+            continue
+        doc = ""
+        for kw in call.keywords:
+            if kw.arg == "doc":
+                # literal str or implicit-concat BinOp of literals
+                parts = [str_const(n) or ""
+                         for n in ast.walk(kw.value)
+                         if isinstance(n, ast.Constant)]
+                doc = "".join(parts)
+        out[name] = (call.lineno, doc.strip())
+    return out
+
+
+def _environ_read(call):
+    """True for os.environ.get(...) / os.getenv(...) call shapes."""
+    name = dotted_name(call.func) or ""
+    if name in ("os.getenv", "getenv"):
+        return True
+    return name.endswith("environ.get") or name == "environ.get"
+
+
+@register
+class EnvKnobRule(Rule):
+    code = "PTA005"
+    title = "env-knob-registry"
+    rationale = ("raw PADDLE_TPU_* environ reads bypass the envs.py "
+                 "validated-getter registry (typed defaults, ValueError "
+                 "naming the variable, documented knob table)")
+    scope = ("paddle_tpu/",)
+    exclude = ("paddle_tpu/envs.py", "paddle_tpu/analysis/")
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.registry = _load_registry(root)
+
+    def check_module(self, module):
+        # (a) raw environ reads of PADDLE_TPU_* keys
+        for call in iter_calls(module.tree):
+            if not _environ_read(call) or not call.args:
+                continue
+            key = str_const(call.args[0])
+            if key is not None and key.startswith("PADDLE_TPU_"):
+                yield self.finding(
+                    module, call,
+                    f"raw environ read of {key}; route it through "
+                    f"paddle_tpu.envs.get({key!r}) (validated getter "
+                    f"registry)")
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                target = dotted_name(node.value) or ""
+                if target.endswith("environ"):
+                    key = str_const(node.slice)
+                    if key is not None and key.startswith("PADDLE_TPU_"):
+                        yield self.finding(
+                            module, node,
+                            f"raw os.environ[{key!r}] read; route it "
+                            f"through paddle_tpu.envs.get({key!r})")
+        # (b) undocumented knobs: exact PADDLE_TPU_* literals that name a
+        # knob missing from the envs.py registry
+        for node in ast.walk(module.tree):
+            lit = str_const(node)
+            if lit is None or not _KNOB_RE.match(lit):
+                continue
+            if lit not in self.registry:
+                yield self.finding(
+                    module, node,
+                    f"undocumented env knob {lit}: register it in "
+                    f"paddle_tpu/envs.py (name, type, default, "
+                    f"validator, doc)")
+
+    def finalize(self):
+        for name, (lineno, doc) in sorted(self.registry.items()):
+            if not doc:
+                yield Finding(
+                    self.code, "paddle_tpu/envs.py", lineno, 0,
+                    f"registered knob {name} has an empty doc string; "
+                    f"every knob must be documented")

@@ -1,0 +1,86 @@
+"""PTA006: no host syncs in the hot-path modules.
+
+PR 2's bar — zero host syncs on the train step (enforced dynamically by
+the conftest transfer guard) — extends to the serving engine's decode
+loop: one stray ``.item()`` / ``np.asarray`` / ``jax.device_get`` in a
+per-step path serializes the device queue against Python. The dynamic
+guard only sees paths a test exercises; this rule sweeps all of jit/,
+parallel/, ops/ and inference/ statically.
+
+Sinks flagged:
+  * ``.item()`` / ``.tolist()`` / ``.numpy()`` method calls;
+  * ``np.asarray(...)`` / ``np.array(...)`` (numpy aliases resolved from
+    the module's imports) — device arrays cross to host here;
+  * ``jax.device_get`` / ``block_until_ready``;
+  * ``float(...)``/``int(...)`` whose argument contains a jnp/lax call
+    (a traced value being pulled to a Python scalar).
+
+Host-side planning and checkpoint I/O are legitimately host-bound: those
+sites carry a reasoned ``# noqa: PTA006`` inline, or a whole-file grant
+in the allowlist (the legacy numpy predictor API).
+"""
+from __future__ import annotations
+
+import ast
+
+from .. import Rule, register
+from .._astutil import call_ident, call_root, dotted_name, iter_calls, \
+    numpy_aliases
+
+_SYNC_METHODS = frozenset({"item", "tolist", "numpy"})
+_NP_SINKS = frozenset({"asarray", "array"})
+_JAX_SINKS = frozenset({"device_get", "block_until_ready"})
+_TRACED_ROOTS = frozenset({"jnp", "lax"})
+
+
+def _contains_traced_call(node):
+    for call in iter_calls(node):
+        root = call_root(call)
+        if root in _TRACED_ROOTS or call_ident(call) in _JAX_SINKS:
+            return True
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    code = "PTA006"
+    title = "host-sync"
+    rationale = ("host syncs in per-step paths serialize the device "
+                 "queue against Python (PR-2 zero-host-syncs-on-step "
+                 "bar); the dynamic transfer guard only sees exercised "
+                 "paths")
+    scope = ("paddle_tpu/jit/", "paddle_tpu/parallel/",
+             "paddle_tpu/ops/", "paddle_tpu/inference/")
+
+    def check_module(self, module):
+        np_names = numpy_aliases(module.tree) | {"np"}
+        for call in iter_calls(module.tree):
+            ident = call_ident(call)
+            fn = call.func
+            if isinstance(fn, ast.Attribute) and not call.args \
+                    and not call.keywords and fn.attr in _SYNC_METHODS:
+                yield self.finding(
+                    module, call,
+                    f".{fn.attr}() forces a device->host sync; keep the "
+                    f"value on device or move the sync out of the hot "
+                    f"path")
+            elif ident in _NP_SINKS and call_root(call) in np_names:
+                name = dotted_name(fn) or ident
+                yield self.finding(
+                    module, call,
+                    f"{name}(...) pulls its operand to host (sync when "
+                    f"it is a device array); use jnp on device or move "
+                    f"host staging out of the step")
+            elif ident in _JAX_SINKS:
+                name = dotted_name(fn) or ident
+                yield self.finding(
+                    module, call,
+                    f"{name}(...) blocks on the device queue; hot-path "
+                    f"modules must stay async")
+            elif isinstance(fn, ast.Name) and fn.id in ("float", "int") \
+                    and len(call.args) == 1 \
+                    and _contains_traced_call(call.args[0]):
+                yield self.finding(
+                    module, call,
+                    f"{fn.id}() of a traced jnp/lax expression pulls it "
+                    f"to a Python scalar (host sync)")

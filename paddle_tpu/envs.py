@@ -1,0 +1,295 @@
+"""Central registry of ``PADDLE_TPU_*`` environment knobs.
+
+Every environment variable the package reads is declared here once, with
+its type, default, validator and a doc string — and read through
+:func:`get` so junk values always raise a ``ValueError`` naming the
+variable (the PR-3 "house pattern", previously re-implemented per site).
+The static-analysis rule PTA005 (``paddle_tpu.analysis``) enforces that
+no module reads ``os.environ``/``os.getenv`` for a ``PADDLE_TPU_*`` key
+directly, and that every knob named anywhere in the package is registered
+(and therefore documented) here.
+
+Values are parsed on every :func:`get` call — never cached — so tests can
+flip knobs via ``monkeypatch.setenv`` exactly as before. :func:`raw`
+returns the unparsed string (or None) for cache keys that must track the
+environment verbatim (e.g. the collective-matmul plan cache).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = ["Knob", "get", "raw", "knobs", "is_registered"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered environment variable."""
+    name: str
+    kind: str          # "bool" | "int" | "float" | "enum" | "str"
+    default: Any       # the parsed value returned when the var is unset
+    doc: str
+    parse: Callable[[Optional[str]], Any]  # raw (or None) -> value; raises
+    choices: Tuple[str, ...] = ()          # for kind == "enum"
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+
+def _register(name, kind, default, doc, parse, choices=()):
+    knob = Knob(name=name, kind=kind, default=default, doc=doc,
+                parse=parse, choices=choices)
+    _REGISTRY[name] = knob
+    return knob
+
+
+def get(name: str):
+    """Parsed, validated value of a registered knob (default when unset).
+
+    Raises ``KeyError`` for unregistered names and ``ValueError`` (naming
+    the variable) when the environment holds a junk value.
+    """
+    return _REGISTRY[name].parse(os.environ.get(name))
+
+
+def raw(name: str) -> Optional[str]:
+    """The unparsed environment string (None when unset) of a registered
+    knob — for cache keys that must follow the environment verbatim."""
+    _REGISTRY[name]  # KeyError on unregistered names, same as get()
+    return os.environ.get(name)
+
+
+def knobs() -> Tuple[Knob, ...]:
+    """All registered knobs, sorted by name (for docs and lint rules)."""
+    return tuple(sorted(_REGISTRY.values(), key=lambda k: k.name))
+
+
+def is_registered(name: str) -> bool:
+    return name in _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# parser factories (each returned parser takes the raw string-or-None)
+# ---------------------------------------------------------------------------
+
+def _truthy(truthy_values, unset="0"):
+    """Lenient boolean: membership in ``truthy_values`` after strip+lower;
+    anything else is False (these switches predate the strict pattern and
+    tests rely on '0'/'junk' reading as off)."""
+    def parse(value):
+        return (value if value is not None else unset).strip().lower() \
+            in truthy_values
+    return parse
+
+
+def _strict_bool(name):
+    """Strict boolean: unset/''/falsey spellings -> False, truthy -> True,
+    anything else raises naming the variable."""
+    def parse(value):
+        v = (value or "").strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("", "0", "false", "no", "off"):
+            return False
+        raise ValueError(
+            f"{name}={v!r}: expected a boolean "
+            "(1/0/true/false/yes/no/on/off)")
+    return parse
+
+
+def _positive_int(name, default, allow_auto=False):
+    """Strictly positive int; '' / 'auto' mean None when ``allow_auto``.
+    Error text matches the PR-3 collective_matmul pattern (pinned by
+    tests/test_overlap_parity.py)."""
+    def parse(value):
+        if value is None:
+            return default
+        s = value.strip().lower()
+        if allow_auto and s in ("", "auto"):
+            return None
+        try:
+            v = int(s)
+        except ValueError:
+            raise ValueError(
+                f"{name} must be a positive integer"
+                + (" or 'auto'" if allow_auto else "") + f", got {value!r}")
+        if v <= 0:
+            raise ValueError(f"{name} must be positive, got {value!r}")
+        return v
+    return parse
+
+
+def _positive_float(name, default):
+    def parse(value):
+        if value is None or not value.strip():
+            return default
+        try:
+            v = float(value.strip())
+        except ValueError:
+            raise ValueError(
+                f"{name} must be a number, got {value!r}")
+        if v <= 0:
+            raise ValueError(f"{name} must be positive, got {value!r}")
+        return v
+    return parse
+
+
+def _enum(name, choices, default):
+    def parse(value):
+        mode = (value if value is not None else default).strip().lower()
+        if mode not in choices:
+            raise ValueError(
+                f"{name} must be " + _spell(choices) + f", got {mode!r}")
+        return mode
+    return parse
+
+
+def _spell(choices):
+    if len(choices) == 2:
+        return f"'{choices[0]}' or '{choices[1]}'"
+    return "one of " + "/".join(choices)
+
+
+# ---------------------------------------------------------------------------
+# the registry — one _register() call per knob, literal name + doc so the
+# PTA005 rule can read this file statically (no import required)
+# ---------------------------------------------------------------------------
+
+_register(
+    "PADDLE_TPU_TP_OVERLAP", "bool", False,
+    doc="Turn on the collective-matmul ppermute ring so TP linears overlap "
+        "each hop's transfer with its partial matmul (PR 1); also the "
+        "default for the stage-3 param-gather prefetch (PR 3).",
+    parse=_truthy(("1", "true", "ring", "on")))
+
+_register(
+    "PADDLE_TPU_TP_OVERLAP_MIN_CHUNK", "int", 64,
+    doc="Smallest per-hop sub-tile (rows) the auto chunker targets when "
+        "splitting ring hops at mp>2 (PR 3). Positive integer.",
+    parse=_positive_int("PADDLE_TPU_TP_OVERLAP_MIN_CHUNK", 64))
+
+_register(
+    "PADDLE_TPU_TP_OVERLAP_CHUNKS", "int", None,
+    doc="Explicit per-hop sub-tile count for the chunked ring (PR 3); "
+        "''/'auto' lets the library target ~MIN_CHUNK rows per sub-tile.",
+    parse=_positive_int("PADDLE_TPU_TP_OVERLAP_CHUNKS", None,
+                        allow_auto=True))
+
+_register(
+    "PADDLE_TPU_PP_OVERLAP", "bool", False,
+    doc="Async 1F1B pipeline p2p sends (PR 1): issue each stage's send "
+        "one skew tick early so the transfer hides under compute.",
+    parse=_truthy(("1", "true", "on")))
+
+_register(
+    "PADDLE_TPU_GRAD_SYNC", "enum", "auto",
+    doc="Gradient-sync schedule for DataParallel / GroupSharded stage-1/2 "
+        "(PR 1): 'auto' (GSPMD implicit), 'explicit' (manual psum island) "
+        "or 'bucketed' (fused reverse-topological buckets).",
+    parse=_enum("PADDLE_TPU_GRAD_SYNC", ("auto", "explicit", "bucketed"),
+                "auto"),
+    choices=("auto", "explicit", "bucketed"))
+
+_register(
+    "PADDLE_TPU_DP_BUCKET_MB", "float", 25.0,
+    doc="Gradient-bucket size cap (MB) for grad_sync='bucketed' (PR 1). "
+        "Positive number.",
+    parse=_positive_float("PADDLE_TPU_DP_BUCKET_MB", 25.0))
+
+_register(
+    "PADDLE_TPU_TELEMETRY", "bool", False,
+    doc="Step-level telemetry switch (PR 2): StepMetrics interval timing, "
+        "comm/compute spans and counters. An explicit telemetry= argument "
+        "to jit.TrainStep wins over the env.",
+    parse=_truthy(("1", "true", "on", "yes")))
+
+_register(
+    "PADDLE_TPU_TELEMETRY_DIR", "str", None,
+    doc="Directory for the JSONL step-log exporter (PR 2); unset/empty "
+        "means no file output.",
+    parse=lambda value: value or None)
+
+_register(
+    "PADDLE_TPU_PEAK_FLOPS", "float", None,
+    doc="Per-chip peak FLOP/s override for MFU attribution (PR 2); unset "
+        "falls back to the PJRT device_kind table in observability."
+        "metrics.PEAK_FLOPS_TABLE.",
+    parse=_positive_float("PADDLE_TPU_PEAK_FLOPS", None))
+
+_register(
+    "PADDLE_TPU_FLASH_SOFTMAX", "enum", "auto",
+    doc="Flash-attention softmax recurrence: 'auto' (fixed-base wherever "
+        "its VMEM budget fits) or 'online' (unconditionally-stable "
+        "running-max recurrence, for heavy-tailed logits).",
+    parse=_enum("PADDLE_TPU_FLASH_SOFTMAX", ("auto", "online"), "auto"),
+    choices=("auto", "online"))
+
+_register(
+    "PADDLE_TPU_FLASH_BWD", "enum", "auto",
+    doc="Dense flash backward path (PR 7): 'auto' (fused k-major flat "
+        "pass when its scratch fits) or 'split' (bitwise-pinned legacy "
+        "two-kernel / dq-partials dispatch).",
+    parse=_enum("PADDLE_TPU_FLASH_BWD", ("auto", "split"), "auto"),
+    choices=("auto", "split"))
+
+_register(
+    "PADDLE_TPU_DECODE_HD64_STACK", "bool", False,
+    doc="Opt decode_attention_slab into the PAIR-STACKED hd64 kernel (two "
+        "head_dim-64 heads per 128-lane MXU tile, PR 5). Default keeps "
+        "the batch-block-diagonal kernel.",
+    parse=_truthy(("1", "true", "yes", "on")))
+
+
+def _parse_decode_block_t(value):
+    # exact messages pinned by tests/test_decode_block_choice.py
+    if value is None or not value.strip():
+        return None
+    try:
+        val = int(value.strip())
+    except ValueError:
+        raise ValueError(
+            f"PADDLE_TPU_DECODE_BLOCK_T={value!r}: expected an integer "
+            "number of lanes (a power of two >= 128)")
+    if val < 128 or val & (val - 1):
+        raise ValueError(
+            f"PADDLE_TPU_DECODE_BLOCK_T={val}: must be a power of two "
+            ">= 128")
+    return val
+
+
+_register(
+    "PADDLE_TPU_DECODE_BLOCK_T", "int", None,
+    doc="Forced decode-attention T tile (lanes), a power of two >= 128; "
+        "unset lets _fit_block_t size the tile to the VMEM window budget "
+        "(PR 6 bench A/B override).",
+    parse=_parse_decode_block_t)
+
+
+def _parse_moe_dropless(value):
+    # tri-state spelled as a boolean; exact message predates the registry
+    v = (value or "").strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return "ragged"
+    if v in ("", "0", "false", "no", "off"):
+        return "capacity"
+    raise ValueError(
+        f"PADDLE_TPU_MOE_DROPLESS={v!r}: expected a boolean "
+        "(1/0/true/false/yes/no/on/off)")
+
+
+_register(
+    "PADDLE_TPU_MOE_DROPLESS", "enum", "capacity",
+    doc="MoE dispatch default (PR 5): truthy selects the ragged "
+        "grouped-GEMM dropless path, falsy/unset the capacity slot "
+        "schedule (reference drop parity).",
+    parse=_parse_moe_dropless,
+    choices=("capacity", "ragged"))
+
+_register(
+    "PADDLE_TPU_SEP_STRATEGY", "enum", "ring",
+    doc="Context-parallel attention strategy for the llama sep axis "
+        "(PR 7): 'ring' (PR-1 ring attention) or 'ulysses' (head-sharded "
+        "all-to-all). ParallelConfig(sep_strategy=) wins over the env.",
+    parse=_enum("PADDLE_TPU_SEP_STRATEGY", ("ring", "ulysses"), "ring"),
+    choices=("ring", "ulysses"))

@@ -62,7 +62,7 @@ def _pred_value(x):
     """Concrete bool of an eager predicate."""
     data = getattr(x, "_data", x)
     if hasattr(data, "item"):
-        return bool(data.item())
+        return bool(data.item())  # noqa: PTA006 -- eager control-flow predicate is concrete by contract
     return bool(data)
 
 

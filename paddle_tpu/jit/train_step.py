@@ -20,6 +20,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import envs
 from .. import observability
 from ..distributed import sharding_utils
 from ..nn.layer.layers import Layer
@@ -207,7 +208,7 @@ class TrainStep:
         # overlaps the rest of backward. Opt-in (grad_sync=/env); only
         # activates when every non-trivial mesh axis is a data axis (dp/
         # sharding) — hybrid mp/pp/sep keeps the GSPMD path.
-        sync_mode = grad_sync or os.environ.get("PADDLE_TPU_GRAD_SYNC", "auto")
+        sync_mode = grad_sync or envs.get("PADDLE_TPU_GRAD_SYNC")
         reduce_axes = ()
         if sync_mode not in ("auto", "explicit", "bucketed"):
             raise ValueError(f"grad_sync must be auto/explicit/bucketed, "
@@ -227,8 +228,7 @@ class TrainStep:
             if grad_bucket_mb is None:
                 grad_bucket_mb = getattr(model, "_comm_buffer_mb", None)
             if grad_bucket_mb is None:
-                grad_bucket_mb = float(os.environ.get(
-                    "PADDLE_TPU_DP_BUCKET_MB", 25))
+                grad_bucket_mb = envs.get("PADDLE_TPU_DP_BUCKET_MB")
             shapes = {k: (tuple(params[k].shape), params[k].dtype.itemsize)
                       for k in trainable_keys}
             self.grad_buckets = sharding_utils.plan_grad_buckets(
@@ -508,7 +508,7 @@ class TrainStep:
         return lowered.compile().as_text()
 
     def _place_batch(self, x):
-        arr = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))  # noqa: PTA006 -- input boundary: stages the host batch, not a device pull
         if self.mesh is not None:
             if self.batch_spec is not None:
                 spec = list(self.batch_spec) + \

@@ -12,12 +12,12 @@ program's cost analysis, not per step. MFU is FLOPs-per-step over
 from __future__ import annotations
 
 import collections
-import os
 import time
 from typing import Dict, List, Optional
 
 import jax
 
+from .. import envs
 from . import trace as _trace
 
 ENV_PEAK_FLOPS = "PADDLE_TPU_PEAK_FLOPS"
@@ -42,9 +42,9 @@ PEAK_FLOPS_TABLE = (
 def peak_flops_per_device(device=None) -> Optional[float]:
     """Peak FLOP/s for one device, from ``PADDLE_TPU_PEAK_FLOPS`` (wins) or
     the device_kind table; None when the kind is unknown."""
-    env = os.environ.get(ENV_PEAK_FLOPS)
-    if env:
-        return float(env)
+    env = envs.get(ENV_PEAK_FLOPS)
+    if env is not None:
+        return env
     if device is None:
         devs = jax.devices()
         if not devs:

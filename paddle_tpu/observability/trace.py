@@ -20,11 +20,12 @@ Two mechanisms, both free at step time:
 from __future__ import annotations
 
 import contextlib
-import os
 import threading
 from typing import Dict, Optional
 
 import jax
+
+from .. import envs
 
 ENV_TELEMETRY = "PADDLE_TPU_TELEMETRY"
 ENV_TELEMETRY_DIR = "PADDLE_TPU_TELEMETRY_DIR"
@@ -36,12 +37,12 @@ def telemetry_enabled(explicit: Optional[bool] = None) -> bool:
     """Telemetry switch: an explicit argument wins, else ``PADDLE_TPU_TELEMETRY``."""
     if explicit is not None:
         return bool(explicit)
-    return os.environ.get(ENV_TELEMETRY, "0").lower() in _TRUTHY
+    return envs.get(ENV_TELEMETRY)
 
 
 def telemetry_dir() -> Optional[str]:
     """Step-log directory from ``PADDLE_TPU_TELEMETRY_DIR`` (None: no file)."""
-    return os.environ.get(ENV_TELEMETRY_DIR) or None
+    return envs.get(ENV_TELEMETRY_DIR)
 
 
 _counters: Dict[str, float] = {}

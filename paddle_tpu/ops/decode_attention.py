@@ -38,7 +38,6 @@ Mosaic sees an unchanged block index and skips the copy).
 from __future__ import annotations
 
 import functools
-import os
 
 import numpy as np
 
@@ -48,6 +47,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import envs
 from ._common import cost_estimate as _cost_estimate
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
@@ -72,8 +72,7 @@ def hd64_stack_mode():
     PAIR-STACKED hd64 kernel (two head_dim-64 heads per 128-lane MXU
     tile; see _kernel_pair). Default 0 keeps the batch-block-diagonal
     kernel — the r5-measured block choice stays the fallback."""
-    return os.environ.get("PADDLE_TPU_DECODE_HD64_STACK", "0").strip() \
-        in ("1", "true", "yes", "on")
+    return envs.get("PADDLE_TPU_DECODE_HD64_STACK")
 
 
 def _env_block_t():
@@ -81,20 +80,7 @@ def _env_block_t():
     The r5 hd64_b8 rung sat at 1.36x of the bytes floor with the
     budget-fitted tile; the override lets the bench A/B-sweep tile sizes
     without editing the fitter (the winner then moves the default)."""
-    raw = os.environ.get("PADDLE_TPU_DECODE_BLOCK_T")
-    if raw is None or not raw.strip():
-        return None
-    try:
-        val = int(raw.strip())
-    except ValueError:
-        raise ValueError(
-            f"PADDLE_TPU_DECODE_BLOCK_T={raw!r}: expected an integer "
-            "number of lanes (a power of two >= 128)")
-    if val < 128 or val & (val - 1):
-        raise ValueError(
-            f"PADDLE_TPU_DECODE_BLOCK_T={val}: must be a power of two "
-            ">= 128")
-    return val
+    return envs.get("PADDLE_TPU_DECODE_BLOCK_T")
 
 
 def _fit_block_t(T, per_lane_bytes, n_windows=4):

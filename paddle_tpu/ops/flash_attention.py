@@ -18,7 +18,6 @@ interpret=True under CPU so the same code runs in tests.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +40,7 @@ _LOG2E = 1.4426950408889634
 _LN2 = 0.6931471805599453
 
 
+from .. import envs
 from ._common import cost_estimate as _cost_estimate
 from ._common import interpret_mode as _interpret
 from ._common import mosaic_trace_ctx as _mosaic_ctx
@@ -114,7 +114,7 @@ def _tri_mask_const(block_q, block_k):
     attention nearly all of its 2x FLOP advantage) into one add."""
     r = jnp.arange(block_q)[:, None]
     c = jnp.arange(block_k)[None, :]
-    return jnp.where(r >= c, 0.0, -1e30).astype(jnp.float32)
+    return jnp.where(r >= c, jnp.float32(0.0), jnp.float32(-1e30))
 
 
 def _resident_loop_bounds(qi, bq_i, bk_i, seq_k, block_k, causal, mask_kv,
@@ -297,11 +297,7 @@ ENV_FLASH_SOFTMAX = "PADDLE_TPU_FLASH_SOFTMAX"
 
 def softmax_mode() -> str:
     """'auto' (fixed-base wherever its VMEM budget fits) or 'online'."""
-    mode = os.environ.get(ENV_FLASH_SOFTMAX, "auto").strip().lower()
-    if mode not in ("auto", "online"):
-        raise ValueError(
-            f"{ENV_FLASH_SOFTMAX} must be 'auto' or 'online', got {mode!r}")
-    return mode
+    return envs.get(ENV_FLASH_SOFTMAX)
 
 
 # scoped-VMEM budget for selecting the fixed-base resident kernel: its
@@ -972,7 +968,8 @@ def _bwd_fused_stream_chunk(qp, kp, vp, dop, lse3, delta3, causal,
             // block_q
         imin = ((col0_rows + jnp.arange(n_k, dtype=jnp.int32) * bkdma)
                 // block_q).reshape(n_k, 1, 1, 1)
-        dqp = jnp.where(row_tile >= imin, dqp.astype(jnp.float32), 0.0)
+        dqp = jnp.where(row_tile >= imin, dqp.astype(jnp.float32),
+                        jnp.float32(0.0))
         dq = jnp.sum(dqp, axis=0)
     else:
         dq = jnp.sum(dqp, axis=0, dtype=jnp.float32)
@@ -992,11 +989,7 @@ ENV_FLASH_BWD = "PADDLE_TPU_FLASH_BWD"
 def dense_bwd_mode() -> str:
     """'auto' (fused flat pass when its scratch fits) or 'split' (legacy
     two-kernel/dq-partials dispatch)."""
-    mode = os.environ.get(ENV_FLASH_BWD, "auto").strip().lower()
-    if mode not in ("auto", "split"):
-        raise ValueError(
-            f"{ENV_FLASH_BWD} must be 'auto' or 'split', got {mode!r}")
-    return mode
+    return envs.get(ENV_FLASH_BWD)
 
 
 def _dense_bwd_lo(n_q, n_k, causal, block_q, block_k):

@@ -83,7 +83,7 @@ def _tile_mask(s, cq_ref, ck_ref, causal):
     cqt = jnp.tile(cq, (1, bk // 128))      # [BQ, BK] lane-replicated
     same = (cqt ^ ck) < POS_LIMIT
     ok = same & (cqt >= ck) if causal else same
-    return jnp.where(ok, s, -1e30)
+    return jnp.where(ok, s, jnp.float32(-1e30))
 
 
 def _flat_schedule(lo, hi, n_q, n_flat):
@@ -161,12 +161,13 @@ def _fwd_kernel_varlen(qi_ref, ki_ref, first_ref, last_ref, live_ref,
         # output is all-padding -> 0, and its lse must be a value that
         # makes the backward's p = exp(s + bias - lse) vanish (bias is
         # -1e30, so any lse >> -1e30 does; 0 keeps it finite).
-        dead = m <= -1e29
+        dead = m <= jnp.float32(-1e29)
         o_ref[0] = jnp.where(
-            dead, 0.0,
+            dead, jnp.float32(0.0),
             acc_s[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
         lse_ref[0] = jnp.where(
-            dead, 0.0, m + jnp.log(jnp.maximum(l, 1e-30))).T
+            dead, jnp.float32(0.0),
+            m + jnp.log(jnp.maximum(l, 1e-30))).T
 
 
 def _bwd_bounds(cu_q, cu_k, n_k, block_q, block_k, tk, causal, self_attn):
@@ -238,7 +239,7 @@ def _bwd_fused_kernel_varlen(ki_ref, qi_ref, first_ref, last_ref, live_ref,
         ck = ck_ref[:1, :]
         same = (cq ^ ck) < POS_LIMIT
         ok = same & (cq >= ck) if causal else same
-        bias = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)
+        bias = jnp.where(ok, jnp.float32(0.0), jnp.float32(-1e30))
         for hh in range(nh):
             qb = q_ref[hh]
             kb = k_ref[hh]
@@ -418,7 +419,7 @@ def _fwd_kernel_varlen_stacked(qi_ref, ki_ref, first_ref, last_ref, live_ref,
         ck = ck_ref[:1, :]
         same = (cq ^ ck) < POS_LIMIT
         ok = same & (cq >= ck) if causal else same
-        bias = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)
+        bias = jnp.where(ok, jnp.float32(0.0), jnp.float32(-1e30))
         for hh in range(nh):
             s_s[hh * bq:(hh + 1) * bq] = jnp.dot(
                 q_ref[hh], k_ref[hh].T,
@@ -857,7 +858,7 @@ def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
                 cover = jnp.any((i[None, :] >= lo_q[:, None])
                                 & (i[None, :] <= hi_q[:, None]), axis=0)
                 dq = jnp.where(jnp.repeat(cover, block_q)[None, :, None],
-                               dq, 0).astype(qp.dtype)
+                               dq, jnp.zeros((), dq.dtype)).astype(qp.dtype)
         else:
             dk, dv = pl.pallas_call(
                 functools.partial(_bwd_dkv_flat_kernel, causal=causal,
@@ -1085,8 +1086,8 @@ def varlen_schedule_stats(cu_q, cu_k, heads, head_dim, *, causal,
     All values are plain ints/bools (JSON-ready — bench.py records this
     in BENCH_DETAIL.json)."""
     import numpy as np
-    cuq_np = np.asarray(cu_q)
-    cuk_np = cuq_np if self_attn else np.asarray(cu_k)
+    cuq_np = np.asarray(cu_q)  # noqa: PTA006 -- bench/telemetry helper on concrete cu, outside any step
+    cuk_np = cuq_np if self_attn else np.asarray(cu_k)  # noqa: PTA006 -- bench/telemetry helper on concrete cu, outside any step
     tq, tk = int(cuq_np[-1]), int(cuk_np[-1])
     plan = _host_plan(cuq_np, cuk_np, tq, tk, heads, head_dim,
                       jnp.dtype(dtype).itemsize, causal, self_attn,
@@ -1136,7 +1137,7 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
         concrete = not isinstance(cu_q, _jc.Tracer)
         if concrete:
             import numpy as _np
-            longest = int(_np.max(_np.diff(_np.asarray(cu_q))))
+            longest = int(_np.max(_np.diff(_np.asarray(cu_q))))  # noqa: PTA006 -- guarded to concrete (non-tracer) cu only
             if longest > int(max_seqlen):
                 raise ValueError(
                     f"flash_varlen_attention: max_seqlen={int(max_seqlen)} "
@@ -1157,7 +1158,7 @@ def flash_varlen_attention(q, k, v, cu_seqlens_q, cu_seqlens_k, scale,
         # fixed cost per step is what dominates short-sequence packs,
         # and the static bound is ~4x over-provisioned for them.
         import numpy as np
-        plan = _host_plan(np.asarray(cu_q), np.asarray(cu_k), tq, tk, h, d,
+        plan = _host_plan(np.asarray(cu_q), np.asarray(cu_k), tq, tk, h, d,  # noqa: PTA006 -- flat schedule is planned on host from concrete cu
                           jnp.dtype(q.dtype).itemsize, causal,
                           bool(self_attn), block_q, block_k,
                           int(max_seqlen) if max_seqlen else None)

@@ -100,7 +100,7 @@ def paged_schedule_stats(lengths, tables, n_steps, block_size):
     """Host-side occupancy of a schedule: dict with live/dead step
     counts and the pool-block touch count (telemetry + bench)."""
     import numpy as np
-    lens = np.maximum(np.asarray(lengths, np.int64), 0)
+    lens = np.maximum(np.asarray(lengths, np.int64), 0)  # noqa: PTA006 -- host-side schedule stats for telemetry, not a step path
     counts = (lens + block_size - 1) // block_size
     total = int(counts.sum())
     return {"n_steps": int(n_steps), "live_steps": min(total, int(n_steps)),

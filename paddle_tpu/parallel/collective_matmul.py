@@ -48,13 +48,13 @@ from __future__ import annotations
 
 import collections
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import envs
 from .._compat import shard_map
 from ..observability import trace as _obs
 
@@ -65,40 +65,17 @@ _DEFAULT_MIN_CHUNK = 64
 
 
 def overlap_enabled() -> bool:
-    return os.environ.get(ENV_OVERLAP, "0").lower() in ("1", "true", "ring",
-                                                        "on")
-
-
-def _env_positive_int(var, default, allow_auto=False):
-    """Parse an env var as a strictly positive int, with a clear error
-    naming the variable on junk/non-positive values (not a bare int()
-    traceback). ``allow_auto``: ''/'auto' means "let the library pick"
-    and returns None."""
-    raw = os.environ.get(var)
-    if raw is None:
-        return default
-    s = raw.strip().lower()
-    if allow_auto and s in ("", "auto"):
-        return None
-    try:
-        v = int(s)
-    except ValueError:
-        raise ValueError(
-            f"{var} must be a positive integer"
-            + (" or 'auto'" if allow_auto else "") + f", got {raw!r}")
-    if v <= 0:
-        raise ValueError(f"{var} must be positive, got {raw!r}")
-    return v
+    return envs.get(ENV_OVERLAP)
 
 
 def min_chunk() -> int:
-    return _env_positive_int(ENV_MIN_CHUNK, _DEFAULT_MIN_CHUNK)
+    return envs.get(ENV_MIN_CHUNK)
 
 
 def overlap_chunks():
     """Explicit per-hop sub-tile count from PADDLE_TPU_TP_OVERLAP_CHUNKS,
     or None for auto (target ~min_chunk() rows per sub-tile)."""
-    return _env_positive_int(ENV_CHUNKS, None, allow_auto=True)
+    return envs.get(ENV_CHUNKS)
 
 
 def resolve_chunks(n: int, rows: int) -> int:
@@ -426,7 +403,7 @@ def _memoized_plan(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         key = (fn.__name__, args, tuple(sorted(kwargs.items())),
-               os.environ.get(ENV_MIN_CHUNK), os.environ.get(ENV_CHUNKS))
+               envs.raw(ENV_MIN_CHUNK), envs.raw(ENV_CHUNKS))
         try:
             hash(key)
         except TypeError:
@@ -593,7 +570,7 @@ def plan_vocab_parallel_embedding(ids_shape, table_shape, mesh, mp_axis="mp",
         loc = ids.astype(jnp.int32) - r * vs
         ok = (loc >= 0) & (loc < vs)
         with jax.named_scope("vocab_embed.local_lookup"):
-            rows = jnp.take(table, jnp.where(ok, loc, 0), axis=0)
+            rows = jnp.take(table, jnp.where(ok, loc, jnp.int32(0)), axis=0)
             part = jnp.where(ok[..., None], rows, jnp.zeros((), rows.dtype))
         return ring_allreduce(part, n, mp_axis, nchunks)
 
@@ -649,8 +626,9 @@ def plan_parallel_cross_entropy(logits_shape, mesh, mp_axis="mp",
             picked = jnp.where(
                 ok,
                 jnp.take_along_axis(
-                    l32, jnp.where(ok, loc, 0)[..., None], axis=-1)[..., 0],
-                0.0)
+                    l32, jnp.where(ok, loc, jnp.int32(0))[..., None],
+                    axis=-1)[..., 0],
+                jnp.float32(0.0))
             stats = jnp.stack([m, s, picked], axis=-1)  # [t, 3]
         allst = ring_allgather(stats, n, mp_axis, nchunks)  # [n, t, 3]
         with jax.named_scope("parallel_ce.combine"):

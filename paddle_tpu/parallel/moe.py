@@ -12,12 +12,12 @@ explicit all-to-all moe_shard_map_dispatch remain as alternates.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import envs
 from .._compat import axis_size as _axis_size
 from ..observability import trace as _obs
 
@@ -26,14 +26,7 @@ def default_dispatch_mode():
     """Dispatch mode from the environment: PADDLE_TPU_MOE_DROPLESS=1 turns
     on the ragged grouped-GEMM path; unset/0 keeps the capacity slot
     schedule (reference drop parity)."""
-    v = os.environ.get("PADDLE_TPU_MOE_DROPLESS", "").strip().lower()
-    if v in ("1", "true", "yes", "on"):
-        return "ragged"
-    if v in ("", "0", "false", "no", "off"):
-        return "capacity"
-    raise ValueError(
-        f"PADDLE_TPU_MOE_DROPLESS={v!r}: expected a boolean "
-        "(1/0/true/false/yes/no/on/off)")
+    return envs.get("PADDLE_TPU_MOE_DROPLESS")
 
 
 def _gshard_aux_loss(probs, E):

@@ -18,7 +18,6 @@ Utilization is M/(M+S-1), identical to the reference's schedules.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Callable, Optional
 
 import jax
@@ -27,6 +26,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import envs
 from ..observability import trace as _obs
 
 ENV_PP_OVERLAP = "PADDLE_TPU_PP_OVERLAP"
@@ -36,7 +36,7 @@ def p2p_overlap_enabled(overlap: Optional[bool] = None) -> bool:
     """Async-p2p schedule switch: explicit arg wins, else the env flag."""
     if overlap is not None:
         return bool(overlap)
-    return os.environ.get(ENV_PP_OVERLAP, "0").lower() in ("1", "true", "on")
+    return envs.get(ENV_PP_OVERLAP)
 
 
 def stack_stage_params(per_stage_params):

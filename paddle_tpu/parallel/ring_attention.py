@@ -185,7 +185,7 @@ def _ring_attention_xla(q, k, v, axis_name, causal, scale):
         if causal:
             pos_k = src * Sq + jnp.arange(k_blk.shape[1])
             mask = (pos_q[:, None] >= pos_k[None, :])[None, None]
-            s = jnp.where(mask, s, -1e30)
+            s = jnp.where(mask, s, jnp.float32(-1e30))
         blk_max = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, blk_max)
         alpha = jnp.exp(m - new_m)

@@ -24,7 +24,6 @@ comm_span bytes like every other overlap site (tests/test_comm_span_lint).
 from __future__ import annotations
 
 import functools
-import os
 import warnings
 
 import jax
@@ -33,6 +32,7 @@ from jax import lax
 
 from .._compat import axis_size as _axis_size
 from ..observability import trace as _obs
+from .. import envs
 from ..ops.flash_attention import flash_block_bwd, flash_block_fwd
 
 # House pattern (cf. PADDLE_TPU_TP_OVERLAP_CHUNKS): validated on read, the
@@ -43,12 +43,7 @@ SEP_STRATEGIES = ("ring", "ulysses")
 
 def sep_strategy_default() -> str:
     """The env-selected strategy; read per call so tests can monkeypatch."""
-    raw = os.environ.get(ENV_SEP_STRATEGY, "ring").strip().lower()
-    if raw not in SEP_STRATEGIES:
-        raise ValueError(
-            f"{ENV_SEP_STRATEGY} must be one of {'/'.join(SEP_STRATEGIES)},"
-            f" got {raw!r}")
-    return raw
+    return envs.get(ENV_SEP_STRATEGY)
 
 
 def resolve_sep_strategy(value=None) -> str:
@@ -174,7 +169,7 @@ def _sdpa_full(q, k, v, causal, scale):
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = jnp.where(mask[None, None], s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,20 @@
+"""PTA001 positive fixture.
+
+`_mask_scores` below reproduces, byte for byte, the PR-7 regression this
+rule was built from: under the package-global x64 the bare ``-1e30``
+enters the kernel as a weak f64 scalar, a consumer jit re-canonicalizes
+it, and the Mosaic verifier rejects the lowered kernel on hardware.
+"""
+import jax.numpy as jnp
+
+
+def _mask_scores(s, mask):
+    return jnp.where(mask, s, -1e30)
+
+
+def _fill(shape):
+    return jnp.full(shape, -1e30)
+
+
+def _dead_rows(m):
+    return m <= -1e29

@@ -1,0 +1,19 @@
+"""PTA001 negative fixture: every scalar is dtype-anchored."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def _mask_scores(s, mask):
+    return jnp.where(mask, s, jnp.float32(-1e30))
+
+
+def _fill(shape):
+    return jnp.full(shape, -1e30, dtype=jnp.float32)
+
+
+def _dead_rows(m):
+    return m <= jnp.float32(-1e29)
+
+
+def _pick(ok, loc):
+    return jnp.where(ok, loc, np.int32(0))

@@ -1,0 +1,22 @@
+"""PTA002 positive fixture: constant BlockSpec windows statically price
+far over the VMEM budget (two 4096x8192 f32 windows, double-buffered =
+512 MiB) and nothing routes through a fitter."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+BLOCK_M = 4096
+BLOCK_N = 8192
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def run(x):
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((BLOCK_M, BLOCK_N), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((BLOCK_M, BLOCK_N), lambda i: (0, 0)),
+        out_shape=jnp.zeros((BLOCK_M, BLOCK_N), jnp.float32),
+    )(x)

@@ -1,0 +1,34 @@
+"""PTA002 negative fixture: one site fits the budget with constant
+blocks; the other routes its block sizes through a registered fitter
+(``_fit_block_t``), whose contract owns the sizing."""
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def run_small(x):
+    return pl.pallas_call(
+        kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jnp.zeros((1024, 128), jnp.float32),
+    )(x)
+
+
+def _fit_block_t(t):
+    return min(t, 256)
+
+
+def run_fitted(x, t):
+    block_t = _fit_block_t(t)
+    return pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((block_t, 65536), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((block_t, 65536), lambda i: (0, 0)),
+        out_shape=jnp.zeros((block_t, 65536), jnp.float32),
+    )(x)

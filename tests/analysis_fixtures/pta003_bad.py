@@ -1,0 +1,6 @@
+"""PTA003 positive fixture: a pallas_call with no cost_estimate=."""
+from jax.experimental import pallas as pl
+
+
+def run(kernel, x):
+    return pl.pallas_call(kernel, grid=(4,))(x)

@@ -1,0 +1,6 @@
+"""PTA003 negative fixture: the pallas_call carries cost_estimate=."""
+from jax.experimental import pallas as pl
+
+
+def run(kernel, x, est):
+    return pl.pallas_call(kernel, grid=(4,), cost_estimate=est)(x)

@@ -1,0 +1,7 @@
+"""PTA004 positive fixture: a comm_span with no nbytes=."""
+from paddle_tpu.observability.trace import comm_span
+
+
+def hop(x):
+    with comm_span("fixture.hop"):
+        return x

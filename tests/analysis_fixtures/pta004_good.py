@@ -1,0 +1,7 @@
+"""PTA004 negative fixture: the comm_span attributes its traffic."""
+from paddle_tpu.observability.trace import comm_span
+
+
+def hop(x):
+    with comm_span("fixture.hop", nbytes=x.nbytes):
+        return x

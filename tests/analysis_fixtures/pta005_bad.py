@@ -1,0 +1,15 @@
+"""PTA005 positive fixture: a raw environ read of a PADDLE_TPU_* key and
+a literal naming a knob that is not in the envs.py registry."""
+import os
+
+
+def overlap_enabled():
+    return os.environ.get("PADDLE_TPU_TP_OVERLAP", "0") == "1"
+
+
+def bucket_mb():
+    return float(os.environ["PADDLE_TPU_DP_BUCKET_MB"])
+
+
+def typo_knob(envs):
+    return envs.get("PADDLE_TPU_NOT_A_REGISTERED_KNOB")

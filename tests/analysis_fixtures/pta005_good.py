@@ -1,0 +1,15 @@
+"""PTA005 negative fixture: registered knobs read through the envs
+registry getters only."""
+from paddle_tpu import envs
+
+
+def overlap_enabled():
+    return envs.get("PADDLE_TPU_TP_OVERLAP")
+
+
+def bucket_mb():
+    return envs.get("PADDLE_TPU_DP_BUCKET_MB")
+
+
+def cache_key():
+    return envs.raw("PADDLE_TPU_TP_OVERLAP_CHUNKS")

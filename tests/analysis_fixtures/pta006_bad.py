@@ -1,0 +1,15 @@
+"""PTA006 positive fixture: every host-sync sink the rule knows."""
+import numpy as _onp
+
+import jax
+import jax.numpy as jnp
+
+
+def step(x):
+    loss = jnp.sum(x)
+    host = _onp.asarray(loss)
+    scalar = float(jnp.mean(x))
+    picked = x.item()
+    pulled = jax.device_get(x)
+    x.block_until_ready()
+    return host, scalar, picked, pulled

@@ -1,0 +1,9 @@
+"""PTA006 negative fixture: everything stays on device; float() of a
+plain Python expression is fine."""
+import jax.numpy as jnp
+
+
+def step(x, lr):
+    loss = jnp.sum(x)
+    scale = float(lr) * 0.5
+    return loss * scale, jnp.asarray([1, 2, 3])

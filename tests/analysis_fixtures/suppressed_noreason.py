@@ -1,0 +1,7 @@
+"""Suppression fixture: bare noqa — suppresses the finding but must
+surface an active PTA000 'lacks a reason' meta-finding."""
+import jax.numpy as jnp
+
+
+def _mask_scores(s, mask):
+    return jnp.where(mask, s, -1e30)  # noqa: PTA001

@@ -1,0 +1,6 @@
+"""Suppression fixture: reasoned noqa — finding suppressed, no PTA000."""
+import jax.numpy as jnp
+
+
+def _mask_scores(s, mask):
+    return jnp.where(mask, s, -1e30)  # noqa: PTA001 -- fixture exercising reasoned suppression

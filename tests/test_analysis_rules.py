@@ -1,0 +1,133 @@
+"""Per-rule unit tests for ``paddle_tpu.analysis`` against the checked-in
+fixtures under tests/analysis_fixtures/ — one positive and one negative
+fixture per rule, plus the suppression (noqa) and allowlist round-trips.
+
+The PTA001 positive fixture reproduces, byte for byte, the PR-7
+``_mask_scores`` regression (a bare ``-1e30`` where() branch under the
+package-global x64) that this suite was built from; its test is the
+regression lock."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.analysis import Module, all_rules, run
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+
+
+def _run_fixture(name, code, **kw):
+    return run(paths=[os.path.join(FIXTURES, name)], rules=[code],
+               respect_scope=False, with_floors=False, **kw)
+
+
+# (rule, expected minimum active findings in the positive fixture)
+POSITIVES = [("PTA001", 3), ("PTA002", 1), ("PTA003", 1),
+             ("PTA004", 1), ("PTA005", 3), ("PTA006", 5)]
+
+
+def test_all_six_rules_registered():
+    assert sorted(all_rules()) == ["PTA001", "PTA002", "PTA003",
+                                   "PTA004", "PTA005", "PTA006"]
+
+
+@pytest.mark.parametrize("code,min_hits", POSITIVES)
+def test_positive_fixture_is_flagged(code, min_hits):
+    rep = _run_fixture(f"pta{code[3:]}_bad.py", code)
+    assert len(rep.active) >= min_hits, \
+        f"{code} found {len(rep.active)} findings, expected >= {min_hits}"
+    assert all(f.rule == code for f in rep.active)
+
+
+@pytest.mark.parametrize("code", [c for c, _ in POSITIVES])
+def test_negative_fixture_is_clean(code):
+    rep = _run_fixture(f"pta{code[3:]}_good.py", code)
+    assert not rep.active, "\n".join(f.format() for f in rep.active)
+
+
+def test_pta001_flags_the_mask_scores_regression():
+    """The exact PR-7 bug shape — ``jnp.where(mask, s, -1e30)`` inside
+    ``_mask_scores`` — must be caught at its line."""
+    rep = _run_fixture("pta001_bad.py", "PTA001")
+    src = open(os.path.join(FIXTURES, "pta001_bad.py")).read()
+    lines = src.splitlines()
+    hit_lines = {f.line for f in rep.active}
+    mask_line = next(i for i, l in enumerate(lines, 1)
+                     if "jnp.where(mask, s, -1e30)" in l)
+    assert mask_line in hit_lines, \
+        f"_mask_scores -1e30 at line {mask_line} not flagged ({hit_lines})"
+    assert any("-1e+30" in f.message and "where()" in f.message
+               for f in rep.active)
+
+
+def test_pta002_fitter_exemption_and_budget_pricing():
+    rep = _run_fixture("pta002_bad.py", "PTA002")
+    assert len(rep.active) == 1
+    assert "512 MiB" in rep.active[0].message
+    # the fitted 65536-lane site in the good fixture would blow any
+    # budget if priced statically — the _fit_block_t routing exempts it
+    assert not _run_fixture("pta002_good.py", "PTA002").active
+
+
+def test_reasoned_noqa_suppresses_without_meta_finding():
+    rep = _run_fixture("suppressed_ok.py", "PTA001")
+    assert not rep.active
+    assert len(rep.suppressed) == 1
+    assert rep.suppressed[0].reason == \
+        "fixture exercising reasoned suppression"
+
+
+def test_reasonless_noqa_suppresses_but_raises_pta000():
+    rep = _run_fixture("suppressed_noreason.py", "PTA001")
+    assert len(rep.suppressed) == 1 and not rep.suppressed[0].reason
+    assert len(rep.active) == 1
+    meta = rep.active[0]
+    assert meta.rule == "PTA000" and "lacks a reason" in meta.message
+    assert meta.line == rep.suppressed[0].line
+
+
+def test_allowlist_round_trip(tmp_path):
+    allow = tmp_path / "allow.json"
+    allow.write_text(json.dumps({"rules": {"PTA001": [
+        {"path": "tests/analysis_fixtures/pta001_bad.py",
+         "reason": "fixture grant"}]}}))
+    rep = _run_fixture("pta001_bad.py", "PTA001", allowlist=str(allow))
+    assert not rep.active
+    assert rep.allowlisted and \
+        all(f.reason == "fixture grant" for f in rep.allowlisted)
+
+
+def test_unreasoned_allowlist_entry_raises_pta000(tmp_path):
+    allow = tmp_path / "allow.json"
+    allow.write_text(json.dumps({"rules": {"PTA001": [
+        {"path": "tests/analysis_fixtures/pta001_bad.py"}]}}))
+    rep = _run_fixture("pta001_bad.py", "PTA001", allowlist=str(allow))
+    assert [f.rule for f in rep.active] == ["PTA000"]
+    assert "lacks a reason" in rep.active[0].message
+
+
+def test_noqa_grammar_parses_codes_and_reason():
+    mod = Module.from_source(
+        "x = 1  # noqa: PTA001, PTA006 -- shared fixture line\n"
+        "y = 2  # noqa: PTA004\n")
+    assert mod.noqa[1] == (("PTA001", "PTA006"), "shared fixture line")
+    assert mod.noqa[2] == (("PTA004",), "")
+
+
+def test_unknown_rule_code_is_rejected():
+    with pytest.raises(ValueError, match="PTA999"):
+        run(rules=["PTA999"], with_floors=False)
+
+
+def test_json_record_shape():
+    rep = _run_fixture("pta001_bad.py", "PTA001")
+    rec = rep.to_json()
+    assert rec["total_active"] == len(rep.active)
+    assert rec["rules"]["PTA001"]["active"] == len(rep.active)
+    assert all({"rule", "path", "line", "col", "message", "status",
+                "reason"} <= set(f) for f in rec["findings"])
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -1,63 +1,32 @@
-"""Static lint: every ``comm_span(...)`` call site in ``paddle_tpu/`` must
-pass ``nbytes=`` so the step-level telemetry always attributes traffic volume
-— a span with no byte count shows up as a hole in the per-hop/per-bucket
-accounting the benches and the multichip dryrun assert on."""
-import ast
-import os
-
+"""Thin shim over ``paddle_tpu.analysis`` rule PTA004 (the lint's logic
+moved there): every ``comm_span(...)`` call site in ``paddle_tpu/`` must
+pass ``nbytes=`` so the step-level telemetry always attributes traffic
+volume — a span with no byte count shows up as a hole in the per-hop/
+per-bucket accounting the benches and the multichip dryrun assert on."""
 import pytest
 
-PKG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "paddle_tpu")
-
-
-def _comm_span_calls(tree):
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        name = fn.id if isinstance(fn, ast.Name) else (
-            fn.attr if isinstance(fn, ast.Attribute) else None)
-        if name == "comm_span":
-            yield node
-
-
-def _py_files():
-    for root, _dirs, files in os.walk(PKG):
-        for f in files:
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
+from paddle_tpu.analysis import Module, run
+from paddle_tpu.analysis.rules.pta004_comm_span import CommSpanRule
 
 
 def test_every_comm_span_passes_nbytes():
-    offenders = []
-    seen = 0
-    for path in _py_files():
-        with open(path) as fh:
-            src = fh.read()
-        if "comm_span" not in src:
-            continue
-        tree = ast.parse(src, filename=path)
-        for call in _comm_span_calls(tree):
-            # the observability module itself defines comm_span; only call
-            # sites with arguments count (the def site never appears as a
-            # Call node, so no special-casing needed there)
-            seen += 1
-            if not any(kw.arg == "nbytes" for kw in call.keywords):
-                offenders.append(f"{os.path.relpath(path, PKG)}:"
-                                 f"{call.lineno}")
-    assert seen > 0, "lint found no comm_span call sites at all"
-    assert not offenders, (
-        "comm_span call sites missing nbytes=: " + ", ".join(offenders))
+    # with_floors keeps the "at least one call site seen" floor from the
+    # pre-migration lint: finalize() fires if the walk matches nothing
+    report = run(rules=["PTA004"], with_floors=True)
+    assert not report.active, \
+        "\n".join(f.format() for f in report.active)
 
 
 def test_lint_catches_a_missing_nbytes():
-    """The lint itself must flag a bare comm_span call (guard against the
-    AST walk silently matching nothing)."""
-    tree = ast.parse("with comm_span('x.hop'):\n    pass\n")
-    calls = list(_comm_span_calls(tree))
-    assert len(calls) == 1
-    assert not any(kw.arg == "nbytes" for kw in calls[0].keywords)
+    """The rule itself must flag a bare comm_span call (guard against
+    the AST walk silently matching nothing)."""
+    mod = Module.from_source("with comm_span('x.hop'):\n    pass\n",
+                             rel="paddle_tpu/parallel/_synthetic.py")
+    rule = CommSpanRule(root=".")
+    findings = list(rule.check_module(mod))
+    assert len(findings) == 1
+    assert findings[0].rule == "PTA004"
+    assert "nbytes" in findings[0].message
 
 
 if __name__ == "__main__":

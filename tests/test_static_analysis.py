@@ -1,0 +1,64 @@
+"""Tier-1 gate: ``python -m paddle_tpu.analysis --strict`` must stay
+clean on the repo. Each registered rule is a separate parametrized case
+so a regression names the rule that caught it (all cases share ONE repo
+scan), and the CLI case drives the real argparse entry point in-process
+— the same code path the multichip-dryrun preamble and the console run."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu import envs
+from paddle_tpu.analysis import REPO_ROOT, all_rules, run
+from paddle_tpu.analysis.__main__ import main as cli_main
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    # one full default scan (all rules, floors on) shared by every case
+    return run()
+
+
+@pytest.mark.parametrize("code", sorted(all_rules()) + ["PTA000"])
+def test_repo_is_clean_per_rule(repo_report, code):
+    bad = [f for f in repo_report.active if f.rule == code]
+    assert not bad, "\n".join(f.format() for f in bad)
+
+
+def test_no_active_findings_at_all(repo_report):
+    assert not repo_report.active, \
+        "\n".join(f.format() for f in repo_report.active)
+
+
+def test_every_suppression_and_grant_carries_a_reason(repo_report):
+    for f in repo_report.suppressed + repo_report.allowlisted:
+        assert f.reason, f"{f.format()} suppressed without a reason"
+
+
+def test_cli_strict_exits_zero_and_emits_json(capsys):
+    rc = cli_main(["--strict", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    rec = json.loads(out)
+    assert rec["total_active"] == 0
+    assert set(rec["rules"]) >= set(all_rules())
+
+
+def test_cli_strict_fails_on_a_dirty_fixture(capsys):
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "analysis_fixtures", "pta001_bad.py")
+    rc = cli_main(["--strict", "--rule", "PTA001", "--no-scope",
+                   "--no-floors", fixture])
+    assert rc == 1
+    assert "PTA001" in capsys.readouterr().out
+
+
+def test_readme_documents_every_registered_knob():
+    with open(os.path.join(REPO_ROOT, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    missing = [k.name for k in envs.knobs() if k.name not in readme]
+    assert not missing, f"knobs missing from README.md: {missing}"
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
