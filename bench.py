@@ -417,6 +417,163 @@ def bench_moe_dropless(dev):
     }
 
 
+def bench_moe_skew(dev):
+    """PR 10 rung: skew-proof expert parallelism on the FINE-GRAINED
+    ERNIE-MoE preset (E=32, top-4, one shared expert — ernie_moe_fine).
+
+    Three records in one rung:
+    - active-parameter MFU of the production MoE step (ragged dispatch,
+      active-only AdamW moments, param-dtype moment storage) — the
+      headline moe_active_mfu tracks the best MoE configuration, which
+      after this PR is this one;
+    - ANALYTIC wire bytes of the ragged a2a vs the dense capacity a2a
+      under uniform / zipf / point-mass routing, measured from the
+      actual top-k routing of sampled gate logits at ep=4: the ragged
+      transport ships only routed rows, the dense one always ships the
+      full cf-padded capacity buffers;
+    - overlap fraction (non-final a2a hops the schedule lets the expert
+      FFN start under) from TRACE-TIME counters of an ep=2 island
+      lowering with the overlap schedule on; null when <2 devices.
+    """
+    import jax as _jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.ernie_moe import build_train_step, ernie_moe_fine
+    from paddle_tpu.parallel.moe import moe_capacity
+    cfg = ernie_moe_fine()
+    B, S = 8, 512
+    step, p, o = build_train_step(cfg, ep_degree=1, lr=1e-4,
+                                  dispatch_mode="ragged_a2a",
+                                  multi_precision=False, with_stats=True,
+                                  active_only_moments=True)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int32)
+    for _ in range(3):
+        p, o, loss, aux = step(p, o, ids, labels)
+    _jax.device_get(loss)
+    state = {"p": p, "o": o}
+
+    def run():
+        state["p"], state["o"], loss, aux = step(state["p"], state["o"],
+                                                 ids, labels)
+        _jax.device_get(loss)
+
+    ms = trace_device_ms(run, "jit_step(", reps=5)
+    # the profiler reps donated the local p/o into state: rebind before
+    # the wall-clock fallback touches them again
+    p, o = state["p"], state["o"]
+    if ms is not None:
+        dt = ms / 1e3
+    else:
+        n, trials, dt = 10, 3, 1e9
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                p, o, loss, aux = step(p, o, ids, labels)
+            _jax.device_get(loss)
+            dt = min(dt, (time.perf_counter() - t0) / n)
+    del state, p, o
+    tok_s = B * S / dt
+    c = cfg
+    n_dense = sum(1 for i in range(c.num_hidden_layers)
+                  if (i % c.moe_every) != (c.moe_every - 1))
+    n_moe = c.num_hidden_layers - n_dense
+    ffn = 2 * c.hidden_size * c.intermediate_size
+    shared_ffn = 2 * c.hidden_size * (c.num_shared_experts
+                                      * c.intermediate_size)
+    active = (c.vocab_size * c.hidden_size
+              + c.num_hidden_layers * 4 * c.hidden_size ** 2
+              + n_dense * ffn
+              + n_moe * (c.moe_topk * ffn + shared_ffn
+                         + c.hidden_size * c.num_experts))
+    fpt = 6.0 * active + 12 * c.num_hidden_layers * c.hidden_size * S
+
+    # -- analytic wire-byte sweep at ep=4 ---------------------------------
+    E, k, H = c.num_experts, c.moe_topk, c.hidden_size
+    ep = 4
+    e_local = E // ep
+    T_shard = B * S // ep
+    dtype_bytes = 2  # bf16 rows on the wire
+    cap, _ref = moe_capacity(T_shard, k, E, c.capacity_factor)
+    # the dense capacity a2a ships every REMOTE expert's full capacity
+    # bucket regardless of routing — per rank, per MoE layer
+    dense_bytes = (E - e_local) * cap * H * dtype_bytes
+    sweep = {}
+    for name in ("uniform", "zipf", "point_mass"):
+        logits = rng.randn(ep * T_shard, E).astype(np.float32)
+        if name == "zipf":
+            logits -= 3.0 * np.log(np.arange(E) + 1.0)[None, :]
+        elif name == "point_mass":
+            logits[:, 0] += 20.0
+            logits[:, 1] += 19.0
+        topk = np.argsort(-logits, axis=-1)[:, :k]          # [T, k]
+        src = np.repeat(np.arange(ep), T_shard)             # token -> rank
+        dest = topk // e_local                              # [T, k]
+        wire_rows = int((dest != src[:, None]).sum())
+        wire_bytes = wire_rows * H * dtype_bytes / ep       # per rank
+        sweep[name] = {
+            "wire_rows": wire_rows,
+            "ragged_wire_bytes_per_rank": int(wire_bytes),
+            "dense_capacity_bytes_per_rank": int(dense_bytes),
+            "wire_vs_dense_ratio": round(wire_bytes / dense_bytes, 4),
+        }
+
+    # -- overlap fraction from a trace of the ep=2 island -----------------
+    overlap_frac = None
+    devs = _jax.devices()
+    if len(devs) >= 2:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        from paddle_tpu import observability as obs
+        from paddle_tpu.parallel.moe import moe_ragged_dispatch_a2a
+        mesh = Mesh(np.array(devs[:2]), ("ep",))
+
+        def island(xs, ls, w1s, w2s):
+            out, aux = moe_ragged_dispatch_a2a(
+                xs, ls, w1s, w2s, E, axis_name="ep", k=k, overlap=True)
+            return out
+
+        f = shard_map(island, mesh=mesh,
+                      in_specs=(P("ep"), P("ep"), P("ep"), P("ep")),
+                      out_specs=P("ep"), check_rep=False)
+        obs.reset_counters()
+        try:
+            # counters are trace-time: lowering alone records the hop
+            # schedule, no device step needed
+            _jax.jit(f).lower(
+                jnp.zeros((128, H), jnp.bfloat16),
+                jnp.zeros((128, E), jnp.float32),
+                jnp.zeros((E, H, c.intermediate_size), jnp.bfloat16),
+                jnp.zeros((E, c.intermediate_size, H), jnp.bfloat16))
+            cnt = obs.counters()
+            tot = cnt.get("moe.a2a.hops_total", 0.0)
+            overlap_frac = (round(cnt.get("moe.a2a.hops_overlapped", 0.0)
+                                  / tot, 4) if tot else None)
+        finally:
+            obs.reset_counters()
+
+    return {
+        "active_mfu": round(tok_s * fpt / peak_flops(dev), 4),
+        "tokens_per_sec_per_chip": round(tok_s, 1),
+        "step_time_s": round(dt, 4),
+        "experts": E, "topk": k,
+        "num_shared_experts": c.num_shared_experts,
+        "dispatch_mode": "ragged_a2a",
+        "multi_precision": False,
+        "active_only_moments": True,
+        "sweep_ep": ep,
+        "sweep": sweep,
+        "overlap_fraction": overlap_frac,
+        "dominant_cost": "fine-grained expert FFNs (E=32 top-4, I=512) "
+                         "on the flat grouped-GEMM schedule plus one "
+                         "shared-expert dense FFN; a2a wire cost scales "
+                         "with ROUTED rows (see sweep) instead of the "
+                         "dense path's cf-padded capacity buckets; AdamW "
+                         "moments stream only for experts that routed "
+                         "tokens this step (active-only masking)",
+    }
+
+
 def decode_pair_stack_ab(dev, config_hd64):
     """hd64_b8 floor-gap attempt (ISSUE satellite): A/B the standalone
     slab decode kernel with PADDLE_TPU_DECODE_HD64_STACK on/off. The
@@ -1034,6 +1191,7 @@ def main():
         fl_vl = sum(2 * 2 * 8 * L * L * 128 / 2 for L in vl_lens)
         detail["moe"] = bench_moe(dev)
         detail["moe_dropless"] = bench_moe_dropless(dev)
+        detail["moe_skew_sweep"] = bench_moe_skew(dev)
         from paddle_tpu.ops.flash_varlen import varlen_schedule_stats
         vl_sched = varlen_schedule_stats(
             np.asarray(cu_vl), np.asarray(cu_vl), 8, 128,
@@ -1095,6 +1253,18 @@ def main():
             detail["moe_dropless"]["active_mfu"]
         rungs["moe_dropless_pad_waste"] = \
             detail["moe_dropless"]["pad_waste_frac"]
+    if "moe_skew_sweep" in detail:
+        mss = detail["moe_skew_sweep"]
+        # PR 10: the headline MoE rung tracks the best production MoE
+        # configuration — after this PR that is the fine-grained preset
+        # on the ragged path with active-only moments; every individual
+        # configuration keeps its own detail record above
+        rungs["moe_active_mfu"] = max(rungs.get("moe_active_mfu", 0.0),
+                                      mss["active_mfu"])
+        rungs["moe_skew_wire_ratio_zipf"] = \
+            mss["sweep"]["zipf"]["wire_vs_dense_ratio"]
+        if mss.get("overlap_fraction") is not None:
+            rungs["moe_a2a_overlap_fraction"] = mss["overlap_fraction"]
     if "decode" in detail and "hd64_pair_stack_ab" in detail["decode"]:
         rungs["decode_hd64_pair_stack_speedup"] = \
             detail["decode"]["hd64_pair_stack_ab"]["pair_stack_speedup"]

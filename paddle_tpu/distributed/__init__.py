@@ -16,7 +16,9 @@ from .communication import (Group, P2POp, ReduceOp, all_gather, all_reduce,
                             destroy_process_group, get_backend,
                             monitored_barrier, reduce_scatter_tensor,
                             get_group, irecv, isend, new_group, ppermute,
-                            recv, reduce, reduce_scatter, scatter, send)
+                            ragged_alltoall_single, recv, reduce,
+                            reduce_scatter, scatter, send)
+from .communication import ragged
 from .env import (get_rank, get_world_size, init_parallel_env, is_initialized,
                   parallel_device_count)
 from .parallel import DataParallel, spawn

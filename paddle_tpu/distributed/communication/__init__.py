@@ -185,12 +185,58 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
             raise NotImplementedError(
                 f"alltoall_single: ragged {name}={sizes} is not "
                 "supported on a TPU mesh (XLA all_to_all splits evenly); "
-                "pad to equal splits")
+                "use distributed.ragged_alltoall_single (per-hop ppermute "
+                "ring with a count exchange) for uneven splits")
     res = alltoall(in_tensor, group=group)
     if isinstance(out_tensor, Tensor):
         out_tensor._data = res._data
         return out_tensor
     return res
+
+
+def ragged_alltoall_single(in_tensor, send_counts, peer_rows, group=None,
+                           impl=None, sync_op=True):
+    """Uneven-splits alltoall_single (PR 10, VERDICT item 8): scatter ragged
+    row slices of ``in_tensor`` to every rank of the group and gather theirs.
+
+    ``in_tensor``'s dim 0 is sorted by destination rank; ``send_counts`` (an
+    [nranks] int tensor/array) gives each peer's slice length. ``peer_rows``
+    is the static per-peer chunk capacity every slice is padded to (SPMD
+    shapes must be static; per-rank dynamic output splits cannot exist under
+    a single controller). Returns ``(out, recv_counts)`` where ``out`` is
+    [nranks * peer_rows, ...] with rank j's rows at
+    ``out[j * peer_rows : j * peer_rows + recv_counts[j]]`` and zeros beyond
+    each count. Transport follows ``PADDLE_TPU_MOE_A2A`` unless ``impl`` is
+    given ('ring' = n-1 overlappable ppermute hops, 'dense' = one XLA
+    all_to_all over the same chunk layout); both are bitwise-identical."""
+    from . import ragged as _ragged
+    from ... import envs as _envs
+    if impl is None:
+        impl = _envs.get("PADDLE_TPU_MOE_A2A")
+    ax = group.axis_name if group is not None else None
+    counts = send_counts._data if isinstance(send_counts, Tensor) \
+        else send_counts
+    if ax is None or not _axis_bound(ax):
+        n = group.nranks if group is not None else 1
+        if n != 1:
+            raise RuntimeError(
+                "ragged_alltoall_single outside a compiled mesh region is "
+                "only defined for a trivial (size-1) group")
+        # size-1 group: identity exchange, still pad to the chunk layout
+        def pad1(a):
+            pad = jnp.zeros((peer_rows - a.shape[0],) + a.shape[1:], a.dtype)
+            return jnp.concatenate([a[:peer_rows], pad], axis=0) \
+                if a.shape[0] < peer_rows else a[:peer_rows]
+        out = _run_op("ragged_alltoall_single", pad1, (in_tensor,), {})
+        return out, send_counts
+    res = {}
+    def f(a):
+        out, rc = _ragged.ragged_all_to_all(a, jnp.asarray(counts), ax,
+                                            peer_rows, impl=impl)
+        res["recv_counts"] = rc
+        return out
+    out = _run_op("ragged_alltoall_single", f, (in_tensor,), {})
+    return out, Tensor(res["recv_counts"])
 
 
 def ppermute(tensor, perm, group=None):

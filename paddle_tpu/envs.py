@@ -287,6 +287,25 @@ _register(
     choices=("capacity", "ragged"))
 
 _register(
+    "PADDLE_TPU_MOE_A2A", "enum", "ring",
+    doc="Ragged expert-dispatch transport (PR 10): 'ring' moves each "
+        "destination's actual token rows over n-1 per-hop ppermutes "
+        "(overlappable with expert compute); 'dense' carries the SAME "
+        "tile-aligned chunk layout through one XLA all_to_all — the "
+        "bitwise-equal fallback with no per-hop overlap.",
+    parse=_enum("PADDLE_TPU_MOE_A2A", ("ring", "dense"), "ring"),
+    choices=("ring", "dense"))
+
+_register(
+    "PADDLE_TPU_MOE_A2A_OVERLAP", "bool", False,
+    doc="Overlap ragged expert-dispatch hops with expert compute "
+        "(PR 10): drop the blocking barrier so each chunk's grouped-GEMM "
+        "starts as soon as its hop lands, while later ppermute hops are "
+        "still in flight. Bitwise-equal to the blocking schedule "
+        "(identical per-chunk kernels, disjoint rows).",
+    parse=_strict_bool("PADDLE_TPU_MOE_A2A_OVERLAP"))
+
+_register(
     "PADDLE_TPU_SEP_STRATEGY", "enum", "ring",
     doc="Context-parallel attention strategy for the llama sep axis "
         "(PR 7): 'ring' (PR-1 ring attention) or 'ulysses' (head-sharded "

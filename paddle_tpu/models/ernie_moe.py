@@ -34,6 +34,10 @@ class ErnieMoEConfig:
     num_attention_heads: int = 12
     num_experts: int = 8
     moe_topk: int = 2
+    # shared experts (fine-grained MoE, PR 10): dense FFN expert(s) every
+    # token passes through IN ADDITION to its routed top-k experts —
+    # one fused [H, n_shared*I] matmul pair, replicated across the mesh
+    num_shared_experts: int = 0
     capacity_factor: float = 1.25
     moe_every: int = 2           # every k-th layer is MoE
     aux_loss_weight: float = 0.01
@@ -41,7 +45,9 @@ class ErnieMoEConfig:
     layer_norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     # "capacity" (reference drop parity, default) | "ragged" (dropless
-    # grouped-GEMM) | None -> PADDLE_TPU_MOE_DROPLESS env default
+    # grouped-GEMM, ep-replicated tokens + combine psum) | "ragged_a2a"
+    # (dropless + tokens sharded over ep with the ragged all-to-all
+    # dispatch, PR 10) | None -> PADDLE_TPU_MOE_DROPLESS env default
     dispatch_mode: Optional[str] = None
 
     @property
@@ -56,6 +62,29 @@ def ernie_moe_tiny():
                           dtype=jnp.float32)
 
 
+def ernie_moe_fine():
+    """Fine-grained + shared-expert preset (PR 10): many SMALL experts
+    (E=32, top-4, expert I = H/2) plus one always-on shared expert — the
+    regime where routing skew is the norm and the ragged a2a dispatch
+    matters most. Dispatches via "ragged_a2a" (tokens sharded over ep)."""
+    return ErnieMoEConfig(vocab_size=8192, hidden_size=1024,
+                          intermediate_size=512, num_hidden_layers=8,
+                          num_attention_heads=16, num_experts=32,
+                          moe_topk=4, num_shared_experts=1,
+                          max_position_embeddings=1024,
+                          dtype=jnp.bfloat16, dispatch_mode="ragged_a2a")
+
+
+def ernie_moe_fine_tiny():
+    """CPU-sized ernie_moe_fine: same shape family (fine-grained experts,
+    one shared expert, ragged_a2a dispatch) at dryrun/test scale."""
+    return ErnieMoEConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=32, num_hidden_layers=4,
+                          num_attention_heads=4, num_experts=8, moe_topk=2,
+                          num_shared_experts=1, max_position_embeddings=128,
+                          dtype=jnp.float32, dispatch_mode="ragged_a2a")
+
+
 def init_params(config: ErnieMoEConfig, seed: int = 0):
     c = config
     key = jax.random.PRNGKey(seed)
@@ -67,6 +96,14 @@ def init_params(config: ErnieMoEConfig, seed: int = 0):
 
     def rnd(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * std).astype(d)
+
+    def shared_block(n):
+        # keys derive from the (previously unused) ks[11] so adding
+        # shared experts never perturbs the existing parameter draws
+        sk1, sk2 = jax.random.split(ks[11])
+        si = c.num_shared_experts * c.intermediate_size
+        return {"s_w1": rnd(sk1, (n, c.hidden_size, si)),
+                "s_w2": rnd(sk2, (n, si, c.hidden_size))}
 
     def attn_block(n, k1, k2):
         return {
@@ -96,7 +133,8 @@ def init_params(config: ErnieMoEConfig, seed: int = 0):
                     "e_w1": rnd(ks[6], (n, E, c.hidden_size,
                                         c.intermediate_size)),
                     "e_w2": rnd(ks[7], (n, E, c.intermediate_size,
-                                        c.hidden_size))},
+                                        c.hidden_size)),
+                    **(shared_block(n) if c.num_shared_experts else {})},
         }
     else:
         layers = {
@@ -106,6 +144,7 @@ def init_params(config: ErnieMoEConfig, seed: int = 0):
             "gate": rnd(ks[5], (L, c.hidden_size, E)).astype(jnp.float32),
             "e_w1": rnd(ks[6], (L, E, c.hidden_size, c.intermediate_size)),
             "e_w2": rnd(ks[7], (L, E, c.intermediate_size, c.hidden_size)),
+            **(shared_block(L) if c.num_shared_experts else {}),
         }
     return {
         "embed": rnd(ks[0], (c.vocab_size, c.hidden_size)),
@@ -135,6 +174,10 @@ def param_pspecs(config, ep_degree: int, dp_degree: int = 1):
         "e_w1": P(None, ep, None, None),   # experts sharded over 'ep'
         "e_w2": P(None, ep, None, None),
     }
+    if config.num_shared_experts:
+        # shared experts run on every token on every rank: replicated
+        moe["s_w1"] = P(None, None, None)
+        moe["s_w2"] = P(None, None, None)
     if _split_stacks(config):
         layers = {"dense": {**attn, **dense}, "moe": {**attn, **moe}}
     else:
@@ -181,14 +224,25 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
         # the ep-vs-serial tests assert. dispatch_mode="ragged" swaps
         # the local expert compute for the DROPLESS grouped-GEMM path
         # (moe_ragged_dispatch_local) — the combine psum is unchanged.
+        # dispatch_mode="ragged_a2a" (PR 10) shards the TOKENS over ep
+        # too and moves only each destination's actual rows via the
+        # ragged all-to-all — no token replication, no combine psum.
         # The one-hot einsum fallback below stays for mesh-less callers.
         from .._compat import shard_map
-        from ..parallel.moe import (moe_ragged_dispatch_local,
+        from ..parallel.moe import (moe_ragged_dispatch_a2a,
+                                    moe_ragged_dispatch_local,
                                     moe_slot_dispatch_local)
+        a2a = dispatch_mode == "ragged_a2a"
+        tok_spec = P(("dp", "ep"), None) if a2a else P("dp", None)
 
         def island(tok, gate, w1, w2):
             logits = tok.astype(jnp.float32) @ gate
-            if dispatch_mode == "ragged":
+            if a2a:
+                res = moe_ragged_dispatch_a2a(
+                    tok, logits, w1, w2, c.num_experts,
+                    axis_name="ep", k=c.moe_topk,
+                    return_stats=with_stats)
+            elif dispatch_mode == "ragged":
                 res = moe_ragged_dispatch_local(
                     tok, logits, w1, w2, c.num_experts,
                     axis_name="ep", k=c.moe_topk,
@@ -199,29 +253,31 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
                     axis_name="ep", k=c.moe_topk,
                     capacity_factor=c.capacity_factor,
                     return_stats=with_stats)
-            # aux is computed from LOCAL tokens: average over dp so the
-            # P() out-spec is genuinely replicated (the standard
-            # data-parallel MoE aux — per-shard balance loss, averaged)
+            # aux is computed from LOCAL tokens: average over the axes
+            # the tokens shard over so the P() out-spec is genuinely
+            # replicated (per-shard balance loss, averaged)
+            aux_axes = ("dp", "ep") if a2a else "dp"
             if with_stats:
                 out, aux, st = res
-                # stats are per-dp-shard (ep-replicated by each path):
+                # stats are per-dp-shard (the local paths replicate
+                # them over ep; the a2a path psums over ep inside):
                 # counts sum over dp (whole-batch totals), ratio keys
                 # average over dp
                 st = {k_: (lax.pmean(v, "dp") if k_ in RATIO_STAT_KEYS
                            else lax.psum(v, "dp"))
                       for k_, v in st.items()}
-                return out, lax.pmean(aux, "dp"), st
+                return out, lax.pmean(aux, aux_axes), st
             out, aux = res
-            return out, lax.pmean(aux, "dp")
+            return out, lax.pmean(aux, aux_axes)
 
         stats_spec = jax.tree_util.tree_map(
             lambda _: P(), zero_routing_stats(dispatch_mode,
                                               c.num_experts))
-        out_specs = ((P("dp", None), P(), stats_spec) if with_stats
-                     else (P("dp", None), P()))
+        out_specs = ((tok_spec, P(), stats_spec) if with_stats
+                     else (tok_spec, P()))
         res = shard_map(
             island, mesh=mesh,
-            in_specs=(P("dp", None), P(None, None),
+            in_specs=(tok_spec, P(None, None),
                       P("ep", None, None), P("ep", None, None)),
             out_specs=out_specs,
             check_vma=False)(tokens, p["gate"], p["e_w1"], p["e_w2"])
@@ -229,15 +285,29 @@ def _moe_ffn(p, x_, config: ErnieMoEConfig, use_onehot=False,
         stats = res[2] if with_stats else None
     else:
         logits = tokens.astype(jnp.float32) @ p["gate"]
+        # mesh-less / ep=1 "ragged_a2a" degenerates to the serial ragged
+        # path (the a2a combine is bitwise-equal to it by construction);
+        # zero wire stats keep the key set consistent
+        serial_mode = ("ragged" if dispatch_mode == "ragged_a2a"
+                       else dispatch_mode)
         res = moe_dispatch_combine(tokens, logits, expert_fn,
                                    (p["e_w1"], p["e_w2"]),
                                    c.num_experts, k=c.moe_topk,
                                    capacity_factor=c.capacity_factor,
                                    use_onehot=use_onehot,
                                    return_stats=with_stats,
-                                   dispatch_mode=dispatch_mode)
+                                   dispatch_mode=serial_mode)
         out, aux = res[0], res[1]
         stats = res[2] if with_stats else None
+        if stats is not None and dispatch_mode == "ragged_a2a":
+            z = jnp.zeros((), jnp.float32)
+            stats = {**stats, "moe_a2a_wire_rows": z,
+                     "moe_a2a_buffer_rows": z}
+    if c.num_shared_experts:
+        # shared expert(s): a dense FFN every token passes through, added
+        # to the routed combine (fine-grained MoE; replicated weights)
+        shared = jax.nn.gelu(tokens @ p["s_w1"]) @ p["s_w2"]
+        out = out + shared.astype(out.dtype)
     out = out.reshape(x_.shape).astype(x_.dtype)
     if with_stats:
         return out, aux.astype(jnp.float32), stats
@@ -292,7 +362,7 @@ def _layer(p, h, layer_idx, config: ErnieMoEConfig, use_onehot=False,
 
 def moe_loss(params, ids, labels, config: ErnieMoEConfig,
              use_onehot=False, mesh=None, with_stats=False,
-             dispatch_mode="capacity"):
+             dispatch_mode="capacity", active_rows=False):
     # use_onehot marks ep>1: WITH a mesh the slot-schedule shard_map
     # island runs (see _moe_ffn); the one-hot einsum only serves
     # mesh-less callers as a fallback
@@ -301,7 +371,14 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
     # aggregates per-layer routing_stats over the MoE layers — counts
     # (dropped/routed) sum, ratios (imbalance/util) average. Stats are
     # lax.stop_gradient'd so the loss/grads are bit-identical either way.
+    #
+    # active_rows=True (PR 10): additionally return the PER-LAYER
+    # [n_moe_layers, E] routed-row counts (un-summed moe_expert_rows) as
+    # the last aux element, for the active-only optimizer masking in
+    # build_train_step. Requires a ragged dispatch mode whose stats
+    # carry moe_expert_rows.
     c = config
+    ws = with_stats or active_rows
     b, s = ids.shape
     h = (jnp.take(params["embed"], ids, axis=0)
          + params["pos"][:s][None]).astype(c.dtype)
@@ -319,8 +396,8 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
             p0, p1 = lp
             h, aux0 = _layer_static(p0, h, False, c)
             res = _layer_static(p1, h, True, c, use_onehot, mesh,
-                                with_stats, dispatch_mode)
-            if with_stats:
+                                ws, dispatch_mode)
+            if ws:
                 h, aux1, stats = res
                 return h, (aux0 + aux1,
                            jax.lax.stop_gradient(stats))
@@ -340,8 +417,8 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
             h = carry
             idx, layer_params = inp
             res = _layer(layer_params, h, idx, c, use_onehot, mesh,
-                         with_stats, dispatch_mode)
-            if with_stats:
+                         ws, dispatch_mode)
+            if ws:
                 h, aux, stats = res
                 return h, (aux, jax.lax.stop_gradient(stats))
             h, aux = res
@@ -350,8 +427,16 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
         idxs = jnp.arange(c.num_hidden_layers)
         h, ys = lax.scan(jax.checkpoint(body), h,
                          (idxs, params["layers"]))
-    if with_stats:
+    rows_pl = None
+    if ws:
         auxes, layer_stats = ys
+        if active_rows:
+            if "moe_expert_rows" not in layer_stats:
+                raise ValueError(
+                    "active_rows requires a dispatch mode whose stats "
+                    "carry moe_expert_rows (ragged / ragged_a2a), got "
+                    f"{dispatch_mode!r}")
+            rows_pl = layer_stats["moe_expert_rows"]  # [n_moe_layers, E]
         n_moe = jnp.maximum(
             (layer_stats["moe_routed_tokens"]
              + layer_stats["moe_dropped_tokens"] > 0)
@@ -371,9 +456,37 @@ def moe_loss(params, ids, labels, config: ErnieMoEConfig,
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     lm_loss = jnp.sum(jnp.where(mask, -picked, 0.0)) / jnp.maximum(mask.sum(), 1)
     total = lm_loss + c.aux_loss_weight * auxes.sum()
-    if with_stats:
-        return total, (lm_loss, stats)
-    return total, lm_loss
+    base = (lm_loss, stats) if with_stats else lm_loss
+    if active_rows:
+        return total, (base, rows_pl)
+    return total, base
+
+
+def _none_like(tree):
+    if isinstance(tree, dict):
+        return {k: _none_like(v) for k, v in tree.items()}
+    return None
+
+
+def _expert_row_masks(params, rows_pl):
+    """Masks pytree for ``_adamw_update(masks=)`` (PR 10): experts with
+    zero routed tokens this step keep their params and AdamW moments
+    bitwise-frozen (lazy/sparse-Adam; see llama._adamw_update).
+
+    ``rows_pl`` is the per-layer [n_moe_layers, E] routed-row counts from
+    ``moe_loss(active_rows=True)`` — its leading dim lines up with the
+    stacked expert weights, so ``rows_pl > 0`` broadcasts as the row
+    mask for ``e_w1``/``e_w2``. Every other leaf stays None (unmasked).
+    """
+    active = rows_pl > 0
+    masks = _none_like(params)
+    if "moe" in params["layers"]:  # split dense/moe pair stacks
+        masks["layers"]["moe"]["e_w1"] = active
+        masks["layers"]["moe"]["e_w2"] = active
+    else:
+        masks["layers"]["e_w1"] = active
+        masks["layers"]["e_w2"] = active
+    return masks
 
 
 def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
@@ -381,7 +494,8 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
                      lr: float = 3e-4, seed: int = 0,
                      with_stats: bool = False,
                      dispatch_mode: Optional[str] = None,
-                     multi_precision: bool = True):
+                     multi_precision: bool = True,
+                     active_only_moments: bool = False):
     """EP x DP training step; experts sharded over 'ep', batch over 'dp'.
 
     with_stats=True: the step's 4th output becomes a dict
@@ -398,7 +512,14 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
 
     multi_precision: True (reference default) keeps f32 AdamW moments;
     False stores moments in each param's dtype — on a bf16 expert stack
-    that halves the optimizer HBM streaming the r5 verdict flagged."""
+    that halves the optimizer HBM streaming the r5 verdict flagged.
+
+    active_only_moments: True (PR 10) masks the AdamW moment
+    read-modify-write for experts that routed ZERO tokens this step
+    (mask from the moe_expert_rows routing stats; requires a ragged
+    dispatch mode). Touched experts update bitwise-identically to the
+    full pass; untouched experts keep params AND moments frozen —
+    under skew this skips the moment streaming for cold experts."""
     if dispatch_mode is None:
         dispatch_mode = config.dispatch_mode
     if dispatch_mode is None:
@@ -423,8 +544,13 @@ def build_train_step(config: ErnieMoEConfig, ep_degree: int = 1,
     def step(p, o, ids, labels):
         (loss, aux), grads = jax.value_and_grad(
             moe_loss, has_aux=True)(p, ids, labels, config, use_onehot,
-                                    moe_mesh, with_stats, dispatch_mode)
-        new_p, new_o = _adamw_update(p, grads, o, lr)
+                                    moe_mesh, with_stats, dispatch_mode,
+                                    active_only_moments)
+        masks = None
+        if active_only_moments:
+            aux, rows_pl = aux
+            masks = _expert_row_masks(p, rows_pl)
+        new_p, new_o = _adamw_update(p, grads, o, lr, masks=masks)
         if with_stats:
             lm_loss, stats = aux
             return new_p, new_o, loss, {"lm_loss": lm_loss, **stats}

@@ -1297,10 +1297,22 @@ def _adamw_init(params, multi_precision=True):
     }
 
 
-def _adamw_update(params, grads, state, lr, b1=0.9, b2=0.95, eps=1e-8, wd=0.1):
+def _adamw_update(params, grads, state, lr, b1=0.9, b2=0.95, eps=1e-8, wd=0.1,
+                  masks=None):
+    """``masks`` (optional) is a pytree shaped like ``params`` whose leaves
+    are either None (update normally) or a bool array broadcastable over
+    the leaf's LEADING dims — False rows freeze: param AND moments pass
+    through bitwise-unchanged (select, not a zero-grad update, so frozen
+    moments do not decay and no moment read-modify-write bandwidth is
+    spent on them under XLA's fusion). PR 10 uses this with the
+    per-expert ``moe_expert_rows`` stats so only experts that actually
+    routed tokens this step stream their f32 AdamW moments; touched rows
+    are bitwise-identical to the unmasked update. The shared step count
+    ``t`` (and thus the bias-correction powers) still advances globally —
+    the standard lazy/sparse-Adam semantics."""
     t = state["t"] + 1
 
-    def upd(p, g, m, v):
+    def upd(p, g, m, v, mask):
         g32 = g.astype(jnp.float32)
         # compute in f32; store back in the state's dtype (f32 under
         # multi_precision — a no-op cast, bit-identical to the old path)
@@ -1310,6 +1322,13 @@ def _adamw_update(params, grads, state, lr, b1=0.9, b2=0.95, eps=1e-8, wd=0.1):
         v_hat = v_new / (1 - b2 ** t)
         p32 = p.astype(jnp.float32)
         p_new = p32 - lr * (m_hat / (jnp.sqrt(v_hat) + eps) + wd * p32)
+        if mask is not None:
+            keep = mask.reshape(mask.shape + (1,) * (p.ndim - mask.ndim))
+            # select (not multiply): frozen rows must be BITWISE the old
+            # values (f32<->storage round-trips are exact)
+            p_new = jnp.where(keep, p_new, p32)
+            m_new = jnp.where(keep, m_new, m.astype(jnp.float32))
+            v_new = jnp.where(keep, v_new, v.astype(jnp.float32))
         return p_new.astype(p.dtype), m_new.astype(m.dtype), \
             v_new.astype(v.dtype)
 
@@ -1317,7 +1336,13 @@ def _adamw_update(params, grads, state, lr, b1=0.9, b2=0.95, eps=1e-8, wd=0.1):
     flat_g = jax.tree_util.tree_leaves(grads)
     flat_m = jax.tree_util.tree_leaves(state["m"])
     flat_v = jax.tree_util.tree_leaves(state["v"])
-    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    if masks is None:
+        flat_k = [None] * len(flat_p)
+    else:
+        flat_k = jax.tree_util.tree_flatten(
+            masks, is_leaf=lambda x: x is None)[0]
+    out = [upd(p, g, m, v, kp) for p, g, m, v, kp
+           in zip(flat_p, flat_g, flat_m, flat_v, flat_k)]
     new_p = jax.tree_util.tree_unflatten(tree, [o[0] for o in out])
     new_m = jax.tree_util.tree_unflatten(tree, [o[1] for o in out])
     new_v = jax.tree_util.tree_unflatten(tree, [o[2] for o in out])

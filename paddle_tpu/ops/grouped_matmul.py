@@ -94,6 +94,20 @@ def tile_schedule(counts, n_tiles, tile_rows=TILE_ROWS):
     return (expert.astype(jnp.int32), live, first, last, offsets)
 
 
+def chunk_schedule(counts, chunk_rows, tile_rows=TILE_ROWS):
+    """Per-hop tile schedule for ONE ragged-a2a chunk (PR 10).
+
+    ``counts`` [E_local] are the group sizes a single source rank packed
+    into its ``chunk_rows``-row chunk with the same tile-aligned layout
+    ``tile_schedule`` derives (cumsum of tile-rounded counts), so sender
+    packing and receiver schedule agree by construction. Returns the
+    4-tuple ``(tile_expert, live, first, last)`` ``grouped_matmul``
+    consumes — one schedule per arrived chunk is what lets expert FFN
+    start on hop h's rows while hop h+1's ppermute is still in flight."""
+    assert chunk_rows % tile_rows == 0, (chunk_rows, tile_rows)
+    return tile_schedule(counts, chunk_rows // tile_rows, tile_rows)[:4]
+
+
 def _gmm_kernel(e_ref, lv_ref, f_ref, l_ref, x_ref, w_ref, o_ref, *,
                 out_dtype):
     t = pl.program_id(1)

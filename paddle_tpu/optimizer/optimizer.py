@@ -46,6 +46,7 @@ class Optimizer:
         self._multi_precision = multi_precision
         self._accumulators: Dict[str, Dict[str, jnp.ndarray]] = {}
         self._master_weights: Dict[str, jnp.ndarray] = {}
+        self._row_masks: Dict[str, jnp.ndarray] = {}
         self._step_count = 0
 
     @staticmethod
@@ -70,6 +71,26 @@ class Optimizer:
 
     def _create_accumulators(self, param: Parameter) -> Dict[str, jnp.ndarray]:
         raise NotImplementedError
+
+    def set_param_row_mask(self, param: Parameter, mask):
+        """Restrict the next ``step()``s to the ACTIVE leading rows of one
+        parameter (PR 10: active-only expert optimizer state).
+
+        ``mask`` is a bool array broadcastable over ``param``'s leading
+        dims (e.g. [E] for a stacked [E, H, I] expert weight, from the
+        ``moe_expert_rows`` routing stats). False rows are frozen by
+        SELECT: the param and every same-shaped accumulator (moments,
+        velocity, ...) pass through bitwise-unchanged — no decay, no
+        read-modify-write arithmetic — while True rows are bitwise-
+        identical to the unmasked update (lazy/sparse-Adam semantics;
+        scalar state like the beta powers still advances globally).
+        Pass ``None`` to clear. The mask persists until replaced, so
+        per-step callers should refresh it from each step's stats."""
+        key = param.name if hasattr(param, "name") else str(param)
+        if mask is None:
+            self._row_masks.pop(key, None)
+        else:
+            self._row_masks[key] = jnp.asarray(mask, bool)
 
     def _update(self, p, g, state, lr, wd, group):
         """Pure update rule on arrays. Returns (new_p, new_state)."""
@@ -113,6 +134,17 @@ class Optimizer:
         # per-parameter learning rate from ParamAttr
         lr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
         new_p, new_state = self._update(compute_p, g, state, lr, wd, group)
+        mask = self._row_masks.get(key)
+        if mask is not None:
+            keep = mask.reshape(mask.shape + (1,) * (new_p.ndim - mask.ndim))
+            # select (not multiply): frozen rows keep their exact bits in
+            # the param AND every same-shaped accumulator; scalar state
+            # (beta powers, step counts) advances globally
+            new_p = jnp.where(keep, new_p, compute_p)
+            new_state = {
+                n: (jnp.where(keep, v, state[n]).astype(v.dtype)
+                    if hasattr(v, "shape") and v.shape == new_p.shape else v)
+                for n, v in new_state.items()}
         if master is not None:
             self._master_weights[key] = new_p
             p._data = new_p.astype(p._data.dtype)

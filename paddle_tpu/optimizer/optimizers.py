@@ -65,7 +65,13 @@ class Adam(Optimizer):
     master weights for bf16/fp16 params. multi_precision=False stores
     the moments in each PARAM's dtype — half the optimizer HBM traffic
     on a bf16 stack; the update still computes in f32 and only the
-    stored state narrows (update-parity test-asserted)."""
+    stored state narrows (update-parity test-asserted).
+
+    ``set_param_row_mask`` (base class, PR 10) composes with both knobs:
+    on a stacked expert weight it freezes the moment read-modify-write
+    for experts with zero routed tokens this step — frozen moments are
+    bitwise-unchanged (NOT decayed: lazy/sparse-Adam semantics) and
+    touched experts are bitwise-identical to the unmasked update."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,

@@ -279,6 +279,12 @@ def zero_routing_stats(mode: str = "capacity", num_experts: int = 0):
                 "moe_load_imbalance": z, "moe_live_rows": z,
                 "moe_padded_rows": z,
                 "moe_expert_rows": jnp.zeros((num_experts,), jnp.float32)}
+    if mode == "ragged_a2a":
+        return {"moe_dropped_tokens": z, "moe_routed_tokens": z,
+                "moe_load_imbalance": z, "moe_live_rows": z,
+                "moe_padded_rows": z, "moe_a2a_wire_rows": z,
+                "moe_a2a_buffer_rows": z,
+                "moe_expert_rows": jnp.zeros((num_experts,), jnp.float32)}
     return {"moe_dropped_tokens": z, "moe_routed_tokens": z,
             "moe_load_imbalance": z, "moe_capacity_util": z}
 
@@ -464,6 +470,26 @@ def _combine_rows_bwd(pair_inv, g):
 _combine_rows.defvjp(_combine_rows_fwd, _combine_rows_bwd)
 
 
+# lax.optimization_barrier has no AD rule on 0.4.x; the blocking a2a
+# schedule needs a differentiable one. Identity either way — the barrier
+# only pins scheduling — and the cotangents are barriered too so the
+# backward pass keeps the same blocking shape.
+@jax.custom_vjp
+def _blocking_barrier(xs):
+    return lax.optimization_barrier(xs)
+
+
+def _blocking_barrier_fwd(xs):
+    return _blocking_barrier(xs), None
+
+
+def _blocking_barrier_bwd(_, g):
+    return (lax.optimization_barrier(g),)
+
+
+_blocking_barrier.defvjp(_blocking_barrier_fwd, _blocking_barrier_bwd)
+
+
 def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
                             num_experts, axis_name="ep", k=2,
                             capacity_factor=1.25, strict_capacity=False,
@@ -612,6 +638,185 @@ def moe_ragged_dispatch_local(x, gate_logits, w1_local, w2_local,
         local_pad = (_round_up(counts, tile_rows).astype(jnp.float32).sum()
                      - counts.astype(jnp.float32).sum())
         st["moe_padded_rows"] = lax.psum(local_pad, axis_name)
+        return out, aux, st
+    return out, aux
+
+
+def moe_ragged_dispatch_a2a(x, gate_logits, w1_local, w2_local, num_experts,
+                            axis_name="ep", k=2, act=jax.nn.gelu,
+                            tile_rows=None, a2a_impl=None, overlap=None,
+                            return_stats=False):
+    """Skew-proof expert parallelism: RAGGED all-to-all dispatch (PR 10).
+
+    Unlike ``moe_ragged_dispatch_local`` (ep-replicated tokens, [T, D]
+    combine psum), tokens here are SHARDED over ``axis_name``: x
+    [T_local, D] is this rank's slice, each rank owns E/n experts, and
+    every routed (token, choice) pair travels to its expert's owner and
+    its FFN output travels back — the reference's global_scatter /
+    global_gather, but with UNEVEN splits so wire bytes track the real
+    router distribution instead of a cf-padded capacity bucket.
+
+    Layout: pairs sort into per-DESTINATION chunks laid out HOP-major —
+    chunk h holds the rows for rank (me + h) % n, with the destination's
+    local-expert groups tile-aligned inside the chunk (the cumsum-of-
+    rounded-counts layout ``chunk_schedule`` re-derives on the receiver
+    from the exchanged counts, so sender packing and receiver schedule
+    agree with no index traffic). Every chunk is ``chunk_rows`` =
+    ``ragged_buffer_rows(T, k, E/n, tile_rows)`` rows — the worst case of
+    ALL local pairs addressing one rank — so adversarial skew can never
+    overflow a chunk: ragged mode has NO drops under ANY routing
+    (test-pinned; capacity-mode overflow semantics live in
+    ``moe_shard_map_dispatch``). Dead rows gather the sentinel zero row
+    and dead tiles are predicated off in the grouped kernel, so only the
+    schedule (not the values) sees the padding.
+
+    Transport (``a2a_impl``, default env ``PADDLE_TPU_MOE_A2A``):
+    'ring' walks n-1 ``ppermute`` hops (hop h = shift by h); 'dense'
+    ships the identical hop-major chunks through one XLA all_to_all.
+    ``overlap`` (default env ``PADDLE_TPU_MOE_A2A_OVERLAP``) drops the
+    blocking optimization_barrier in ring mode so the grouped-GEMM on
+    hop h's chunk is free to run while hop h+1's ppermute is in flight
+    — each chunk has its own ``chunk_schedule``, so no compute waits on
+    the last hop. All four {ring, dense} x {overlap, blocking} variants
+    run the identical per-chunk kernels on identical rows and are
+    BITWISE-equal (full-K dots, no cross-chunk reduction).
+
+    The combine is a row gather of the returned chunks weighted by this
+    rank's own gates — no psum; the output stays sharded like x.
+
+    return_stats=True appends the ragged stats dict (ep-global expert
+    counts — ``moe_expert_rows`` feeds active-only optimizer masking —
+    plus wire accounting: ``moe_a2a_wire_rows`` = real rows that crossed
+    the wire, ``moe_a2a_buffer_rows`` = chunk rows shipped incl. padding),
+    psum'd over ``axis_name`` so every ep rank reports the group total."""
+    from ..ops.grouped_matmul import (TILE_ROWS, chunk_schedule,
+                                      grouped_matmul)
+    if tile_rows is None:
+        tile_rows = TILE_ROWS
+    if a2a_impl is None:
+        a2a_impl = envs.get("PADDLE_TPU_MOE_A2A")
+    if a2a_impl not in ("ring", "dense"):
+        raise ValueError(f"unknown a2a_impl {a2a_impl!r} "
+                         "(expected 'ring' or 'dense')")
+    if overlap is None:
+        overlap = envs.get("PADDLE_TPU_MOE_A2A_OVERLAP")
+    from ..distributed.communication.ragged import exchange_counts, ring_hop
+    n = _axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    T, D = x.shape
+    E = num_experts
+    e_local = E // n
+
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    gates, experts = lax.top_k(probs, k)
+    aux = _gshard_aux_loss(probs, E)
+    e_flat = experts.reshape(-1)                    # [T*k] token-major
+
+    # queue position within the (destination, local-expert) group — the
+    # global expert id keys both, so the plain per-expert cumsum serves
+    oh = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)
+    pos = (jnp.cumsum(oh, axis=0) - oh)
+    pos = jnp.take_along_axis(pos, e_flat[:, None], axis=1)[:, 0]
+    counts = oh.sum(axis=0).astype(jnp.int32)       # [E] rows per expert
+    counts_mat = counts.reshape(n, e_local)         # [dest, local expert]
+    aligned = _round_up(counts_mat, tile_rows)
+    off_within = jnp.concatenate([
+        jnp.zeros((n, 1), jnp.int32),
+        jnp.cumsum(aligned, axis=1).astype(jnp.int32)[:, :-1]], axis=1)
+
+    # hop-major chunks: chunk h goes to rank (me + h) % n. chunk_rows is
+    # the all-pairs-to-one-rank worst case -> skew cannot overflow.
+    chunk_rows = ragged_buffer_rows(T, k, e_local, tile_rows)
+    dest = e_flat // e_local
+    le = e_flat % e_local
+    hop = (dest - me) % n
+    slot = (hop * chunk_rows + off_within[dest, le] + pos).astype(jnp.int32)
+    n_rows = n * chunk_rows
+
+    token_of_pair = jnp.repeat(jnp.arange(T, dtype=jnp.int32), k)
+    inv = jnp.full((n_rows + 1,), T, jnp.int32).at[slot].set(
+        token_of_pair, mode="drop")
+    pair_inv = jnp.full((n_rows + 1,), T * k, jnp.int32).at[slot].set(
+        jnp.arange(T * k, dtype=jnp.int32), mode="drop")
+
+    send = _dispatch_rows(x, inv, slot, k).reshape(n, chunk_rows, D)
+    # rows per my-local-expert each SOURCE rank is sending me
+    recv_counts = exchange_counts(counts_mat, axis_name,
+                                  name="moe.ragged_a2a.counts")
+
+    ring = a2a_impl == "ring" and n > 1
+    if ring:
+        chunks = [send[0]]
+        for h in range(1, n):
+            chunks.append(ring_hop(send[h], axis_name, h,
+                                   name="moe.ragged_a2a.hop"))
+    elif n > 1:
+        # dense fallback: same chunks, one collective. hop-major -> dest-
+        # major on the way out, source-major -> hop-major on the way in.
+        dest_major = jnp.roll(send, me, axis=0)
+        with _obs.comm_span("moe.ragged_a2a.dense",
+                            nbytes=send.size * send.dtype.itemsize):
+            recv_src = lax.all_to_all(dest_major, axis_name, split_axis=0,
+                                      concat_axis=0, tiled=True)
+        hop_major = jnp.roll(recv_src[::-1], me + 1, axis=0)
+        chunks = [hop_major[h] for h in range(n)]
+    else:
+        chunks = [send[0]]
+    overlapping = bool(overlap) and ring
+    if n > 1:
+        _obs.record_counter("moe.a2a.hops_total", n - 1)
+        if overlapping:
+            _obs.record_counter("moe.a2a.hops_overlapped", n - 1)
+        else:
+            # blocking schedule: no chunk's GEMM starts until every hop
+            # has landed (the barrier ties all chunks together)
+            chunks = list(_blocking_barrier(tuple(chunks)))
+
+    ys = []
+    for h in range(n):
+        src = (me - h) % n
+        cnts = jnp.take(recv_counts, src, axis=0)   # [e_local]
+        sched = chunk_schedule(cnts, chunk_rows, tile_rows)
+        hid = act(grouped_matmul(chunks[h], w1_local, sched, tile_rows))
+        ys.append(grouped_matmul(hid, w2_local, sched, tile_rows))
+
+    if ring:
+        ret = [ys[0]]
+        for h in range(1, n):
+            ret.append(ring_hop(ys[h], axis_name, -h,
+                                name="moe.ragged_a2a.ret_hop"))
+    elif n > 1:
+        stack_y = jnp.stack(ys)                     # [hop, chunk_rows, D']
+        tosrc = jnp.roll(stack_y[::-1], me + 1, axis=0)  # [source, ...]
+        with _obs.comm_span("moe.ragged_a2a.dense_ret",
+                            nbytes=stack_y.size * stack_y.dtype.itemsize):
+            ret_src = lax.all_to_all(tosrc, axis_name, split_axis=0,
+                                     concat_axis=0, tiled=True)
+        ret_hop = jnp.roll(ret_src, -me, axis=0)
+        ret = [ret_hop[h] for h in range(n)]
+    else:
+        ret = [ys[0]]
+
+    y_all = jnp.concatenate(ret, axis=0)            # [n_rows, D']
+    d_out = y_all.shape[-1]
+    picked = _combine_rows(y_all, slot, pair_inv).reshape(T, k, d_out)
+    # same combine-weight formula as ragged_route (every pair valid)
+    denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    weight = gates / denom * gates.sum(-1, keepdims=True)
+    out = jnp.einsum("tk,tkd->td", weight.astype(picked.dtype), picked)
+    if return_stats:
+        g_counts = lax.psum(counts, axis_name)      # ep-group expert rows
+        st = routing_stats_ragged(g_counts, k, tile_rows)
+        # actual receiver-side alignment padding, summed over the group
+        pad_local = (_round_up(recv_counts, tile_rows).astype(jnp.float32)
+                     .sum() - recv_counts.astype(jnp.float32).sum())
+        st["moe_padded_rows"] = lax.psum(pad_local, axis_name)
+        wire_local = (counts.sum()
+                      - jnp.take(counts_mat, me, axis=0).sum())
+        st["moe_a2a_wire_rows"] = lax.psum(
+            wire_local.astype(jnp.float32), axis_name)
+        st["moe_a2a_buffer_rows"] = lax.psum(
+            jnp.asarray((n - 1) * chunk_rows, jnp.float32), axis_name)
         return out, aux, st
     return out, aux
 

@@ -1,0 +1,378 @@
+"""Skewed-router fuzz suite for the ragged all-to-all MoE dispatch (PR 10).
+
+Pins the ep>1 semantics of ``moe_ragged_dispatch_a2a`` under adversarial
+routing: all-to-one, zipf-tilted, empty experts, and one-token shards must
+all combine BITWISE-equal to the serial ragged reference, with ZERO drops
+(capacity-free dispatch — the per-hop buffer is sized for the worst case,
+so skew cannot overflow it). The capacity-mode overflow contrast at low cf
+is pinned too, so the dropless claim is falsifiable.
+
+All bitwise comparisons are jitted-vs-jitted: eager-vs-jit XLA fusion
+alone shifts the last ulp, which is not what these tests measure.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu import observability as obs
+from paddle_tpu.parallel.moe import (moe_ragged_dispatch_a2a,
+                                     moe_ragged_dispatch_combine,
+                                     moe_shard_map_dispatch,
+                                     zero_routing_stats)
+
+from jax.experimental.shard_map import shard_map
+
+E, K, D, I, TILE = 8, 2, 16, 32, 8
+
+
+def _weights(rng):
+    w1 = jnp.asarray(rng.randn(E, D, I), jnp.float32)
+    w2 = jnp.asarray(rng.randn(E, I, D), jnp.float32)
+    return w1, w2
+
+
+def _skewed_logits(rng, T, skew):
+    logits = rng.randn(T, E).astype(np.float32)
+    if skew == "uniform":
+        pass
+    elif skew == "zipf":
+        # heavy-tailed expert popularity: expert e gets bias ~ -3*ln(e+1)
+        logits = logits - 3.0 * np.log(np.arange(E) + 1.0)[None, :]
+    elif skew == "all_to_one":
+        # every token's top-1 is expert 0 (the worst a2a hot-spot)
+        logits[:, 0] += 20.0
+    elif skew == "empty_experts":
+        # the upper half of the expert table never wins top-k
+        logits[:, E // 2:] -= 30.0
+    else:  # pragma: no cover
+        raise ValueError(skew)
+    return jnp.asarray(logits)
+
+
+def _run_island(x, logits, w1, w2, n, impl, overlap, with_stats=True):
+    mesh = Mesh(np.array(jax.devices("cpu")[:n]), ("ep",))
+
+    def island(xs, ls, w1s, w2s):
+        return moe_ragged_dispatch_a2a(
+            xs, ls, w1s, w2s, E, axis_name="ep", k=K, tile_rows=TILE,
+            a2a_impl=impl, overlap=overlap, return_stats=with_stats)
+
+    stats_spec = jax.tree_util.tree_map(
+        lambda _: P(), zero_routing_stats("ragged_a2a", E))
+    out_specs = ((P("ep"), P(), stats_spec) if with_stats
+                 else (P("ep"), P()))
+    f = shard_map(island, mesh=mesh,
+                  in_specs=(P("ep"), P("ep"), P("ep"), P("ep")),
+                  out_specs=out_specs, check_rep=False)
+    return jax.jit(f)(x, logits, w1, w2)
+
+
+def _serial_ref(x, logits, w1, w2):
+    return jax.jit(lambda a, b: moe_ragged_dispatch_combine(
+        a, b, w1, w2, E, k=K, tile_rows=TILE))(x, logits)
+
+
+@pytest.mark.parametrize("skew", ["uniform", "zipf", "all_to_one",
+                                  "empty_experts"])
+@pytest.mark.parametrize("n", [2, 4])
+def test_skewed_routing_matches_serial_bitwise(skew, n):
+    rng = np.random.RandomState(hash((skew, n)) % (2 ** 31))
+    T = 24 * n  # per-shard T=24, divisible by nothing tile-ish on purpose
+    w1, w2 = _weights(rng)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = _skewed_logits(rng, T, skew)
+    ref_out, _ = _serial_ref(x, logits, w1, w2)
+    out, aux, st = _run_island(x, logits, w1, w2, n, "ring", False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    # capacity-free: the ragged path NEVER drops, whatever the skew
+    assert float(st["moe_dropped_tokens"]) == 0.0
+    assert float(st["moe_routed_tokens"]) == float(T * K)
+
+
+def test_one_token_shards_match_serial_bitwise():
+    """Degenerate shards (one token each) still round-trip the ring."""
+    rng = np.random.RandomState(7)
+    n = 4
+    w1, w2 = _weights(rng)
+    x = jnp.asarray(rng.randn(n, D), jnp.float32)  # T_local = 1
+    logits = jnp.asarray(rng.randn(n, E), jnp.float32)
+    ref_out, _ = _serial_ref(x, logits, w1, w2)
+    out, _, st = _run_island(x, logits, w1, w2, n, "ring", False)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_out))
+    assert float(st["moe_dropped_tokens"]) == 0.0
+
+
+@pytest.mark.parametrize("impl,overlap", [("ring", True), ("dense", False)])
+def test_transport_variants_bitwise_equal(impl, overlap):
+    """ring/dense x overlap/blocking are schedules over the SAME bytes:
+    combine must be bitwise-equal across all of them."""
+    rng = np.random.RandomState(11)
+    n, T = 2, 48
+    w1, w2 = _weights(rng)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = _skewed_logits(rng, T, "zipf")
+    base, _, _ = _run_island(x, logits, w1, w2, n, "ring", False)
+    out, _, _ = _run_island(x, logits, w1, w2, n, impl, overlap)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(base))
+
+
+def test_gradients_bitwise_across_transports():
+    rng = np.random.RandomState(13)
+    n, T = 2, 32
+    w1, w2 = _weights(rng)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = _skewed_logits(rng, T, "all_to_one")
+    mesh = Mesh(np.array(jax.devices("cpu")[:n]), ("ep",))
+
+    def loss(params, impl, overlap):
+        x_, w1_, w2_ = params
+
+        def island(xs, ls, w1s, w2s):
+            out, aux = moe_ragged_dispatch_a2a(
+                xs, ls, w1s, w2s, E, axis_name="ep", k=K, tile_rows=TILE,
+                a2a_impl=impl, overlap=overlap)
+            return out, aux
+
+        out, aux = shard_map(island, mesh=mesh,
+                             in_specs=(P("ep"), P("ep"), P("ep"), P("ep")),
+                             out_specs=(P("ep"), P()),
+                             check_rep=False)(x_, logits, w1_, w2_)
+        return (out ** 2).sum() + aux
+
+    grads = {}
+    for impl, ov in [("ring", False), ("ring", True), ("dense", False)]:
+        grads[(impl, ov)] = jax.jit(
+            jax.grad(lambda p, i=impl, o=ov: loss(p, i, o)))((x, w1, w2))
+    base = grads[("ring", False)]
+    for key, g in grads.items():
+        for ga, gb in zip(base, g):
+            np.testing.assert_array_equal(np.asarray(ga), np.asarray(gb),
+                                          err_msg=str(key))
+
+
+def test_overlap_counter_and_wire_accounting():
+    """With overlap on, every non-final hop is counted as overlapped; wire
+    rows (actual bytes moved) stay below the worst-case buffer rows."""
+    rng = np.random.RandomState(17)
+    n, T = 4, 64
+    w1, w2 = _weights(rng)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = _skewed_logits(rng, T, "zipf")
+    obs.reset_counters()
+    try:
+        out, _, st = _run_island(x, logits, w1, w2, n, "ring", True)
+        out.block_until_ready()
+        c = obs.counters()
+    finally:
+        obs.reset_counters()
+    # counters are trace-time: n-1 hops per direction recorded once
+    assert c.get("moe.a2a.hops_total", 0) > 0
+    assert c.get("moe.a2a.hops_overlapped", 0) == c["moe.a2a.hops_total"]
+    assert c.get("moe.ragged_a2a.hop.calls", 0) > 0
+    assert c.get("moe.ragged_a2a.counts.bytes", 0) > 0
+    wire = float(st["moe_a2a_wire_rows"])
+    buf = float(st["moe_a2a_buffer_rows"])
+    assert 0.0 <= wire < buf
+
+
+def test_capacity_mode_overflow_contrast():
+    """The pre-PR capacity dispatch DROPS under the same all-to-one skew
+    the ragged a2a path survives — the documented overflow semantics.
+    strict_capacity pins drops at the unrounded reference capacity (the
+    128-rounded buffers would otherwise mask the overflow at test sizes).
+    """
+    rng = np.random.RandomState(19)
+    T = 32
+    w1, w2 = _weights(rng)
+    x = jnp.asarray(rng.randn(T, D), jnp.float32)
+    logits = _skewed_logits(rng, T, "all_to_one")
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("ep",))
+
+    def island(xs, ls, w1s, w2s):
+        out, aux, st = moe_shard_map_dispatch(
+            xs, ls, lambda w, t: jax.nn.gelu(t @ w[0]) @ w[1],
+            (w1s, w2s), E, axis_name="ep", k=K, capacity_factor=1.0,
+            strict_capacity=True, return_stats=True)
+        return out, aux, st
+
+    stats_spec = jax.tree_util.tree_map(
+        lambda _: P(), zero_routing_stats("capacity", E))
+    _, _, st = shard_map(island, mesh=mesh,
+                         in_specs=(P("ep"), P("ep"), P("ep"), P("ep")),
+                         out_specs=(P("ep"), P(), stats_spec),
+                         check_rep=False)(x, logits, w1, w2)
+    assert float(st["moe_dropped_tokens"]) > 0.0
+
+
+def test_ragged_alltoall_single_roundtrip():
+    """distributed.ragged_alltoall_single: uneven splits round-trip and the
+    receive counts are the transpose of the send counts."""
+    from paddle_tpu.distributed import ragged_alltoall_single
+
+    n = 2
+    mesh = Mesh(np.array(jax.devices("cpu")[:n]), ("ep",))
+    peer_rows = 8
+    # rank r sends rows [r*2+dest] to dest (uneven on purpose)
+    send_counts = jnp.asarray([[1, 3], [2, 0]], jnp.int32)  # [n, n]
+    rows = jnp.arange(n * peer_rows * 4, dtype=jnp.float32).reshape(
+        n * peer_rows, 4)
+
+    from paddle_tpu.distributed.communication.ragged import ragged_all_to_all
+
+    def island(r, c):
+        out, rc = ragged_all_to_all(r, c.reshape(-1), "ep", peer_rows,
+                                    impl="ring")
+        return out, rc
+
+    out, rc = shard_map(island, mesh=mesh,
+                        in_specs=(P("ep"), P("ep")),
+                        out_specs=(P("ep"), P("ep")),
+                        check_rep=False)(rows, send_counts)
+    rc = np.asarray(rc).reshape(n, n)
+    np.testing.assert_array_equal(rc, np.asarray(send_counts).T)
+    # sender contract: rows sorted dest-major (rows[:counts[0]] -> dest 0,
+    # next counts[1] -> dest 1, ...); receiver layout: source-major chunks
+    # of peer_rows each, live rows first within each chunk
+    out = np.asarray(out).reshape(n, n, peer_rows, 4)  # [rank, src, ...]
+    src_rows = np.asarray(rows).reshape(n, peer_rows, 4)
+    # rank0 <- rank0: its own first send_counts[0,0]=1 rows
+    np.testing.assert_array_equal(out[0, 0, :1], src_rows[0, :1])
+    # rank0 <- rank1: rank1's rows destined to 0 (first 2 of its shard)
+    np.testing.assert_array_equal(out[0, 1, :2], src_rows[1, :2])
+    # rank1 <- rank0: rank0's rows destined to 1 (rows 1..3 of its shard)
+    np.testing.assert_array_equal(out[1, 0, :3], src_rows[0, 1:4])
+
+
+def test_active_only_moments_bitwise():
+    """llama._adamw_update(masks=): masked rows keep params AND moments
+    bitwise-frozen; unmasked rows are bitwise-identical to the full
+    update (lazy/sparse-Adam semantics)."""
+    from paddle_tpu.models.llama import _adamw_init, _adamw_update
+
+    rng = np.random.RandomState(23)
+    params = {"w": jnp.asarray(rng.randn(4, 3, 5), jnp.float32),
+              "b": jnp.asarray(rng.randn(5), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.randn(4, 3, 5), jnp.float32),
+             "b": jnp.asarray(rng.randn(5), jnp.float32)}
+    state = _adamw_init(params)
+    mask = jnp.asarray([True, False, True, False])
+    masks = {"w": mask, "b": None}
+
+    full_p, full_s = jax.jit(lambda p, g, s: _adamw_update(
+        p, g, s, 1e-3))(params, grads, state)
+    mask_p, mask_s = jax.jit(lambda p, g, s: _adamw_update(
+        p, g, s, 1e-3, masks=masks))(params, grads, state)
+
+    # unmasked leaf and active rows: bitwise vs the full update
+    np.testing.assert_array_equal(np.asarray(mask_p["b"]),
+                                  np.asarray(full_p["b"]))
+    keep = np.asarray(mask)
+    np.testing.assert_array_equal(np.asarray(mask_p["w"])[keep],
+                                  np.asarray(full_p["w"])[keep])
+    # frozen rows: bitwise vs the ORIGINAL param and moments
+    np.testing.assert_array_equal(np.asarray(mask_p["w"])[~keep],
+                                  np.asarray(params["w"])[~keep])
+    for key in ("m", "v"):
+        np.testing.assert_array_equal(np.asarray(mask_s[key]["w"])[~keep],
+                                      np.asarray(state[key]["w"])[~keep])
+        np.testing.assert_array_equal(np.asarray(mask_s[key]["w"])[keep],
+                                      np.asarray(full_s[key]["w"])[keep])
+        np.testing.assert_array_equal(np.asarray(mask_s[key]["b"]),
+                                      np.asarray(full_s[key]["b"]))
+    # the shared step count still advances globally (lazy-Adam semantics)
+    assert float(mask_s["t"]) == float(full_s["t"]) == 1.0
+
+
+@pytest.mark.parametrize("multi_precision", [True, False])
+def test_optimizer_row_mask_class_api(multi_precision):
+    """Adam.set_param_row_mask freezes masked rows' param + accumulators
+    bitwise while unmasked rows match a maskless twin optimizer."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+
+    rng = np.random.RandomState(29)
+    w0 = rng.randn(6, 4).astype(np.float32)
+    g0 = rng.randn(6, 4).astype(np.float32)
+
+    def make():
+        m = nn.Linear(6, 4, bias_attr=False)
+        m.weight.set_value(w0)
+        if multi_precision:
+            m.bfloat16()
+        o = popt.Adam(learning_rate=0.1, parameters=m.parameters(),
+                      multi_precision=multi_precision)
+        return m, o
+
+    m_a, opt_a = make()
+    m_b, opt_b = make()
+    init = np.asarray(m_b.weight._data, np.float32).copy()
+    mask = np.array([True, True, False, False, True, False])
+    opt_b.set_param_row_mask(m_b.weight, mask)
+    for m in (m_a, m_b):
+        m.weight.grad = paddle.to_tensor(
+            g0.astype(np.asarray(m.weight._data).dtype))
+    opt_a.step()
+    opt_b.step()
+    a = np.asarray(m_a.weight._data, np.float32)
+    b = np.asarray(m_b.weight._data, np.float32)
+    np.testing.assert_array_equal(b[mask], a[mask])
+    np.testing.assert_array_equal(b[~mask], init[~mask])
+    # accumulators: frozen rows bitwise-unchanged from init (zeros)
+    st_b = opt_b._accumulators[m_b.weight.name]
+    for name, v in st_b.items():
+        if hasattr(v, "shape") and v.shape == (6, 4):
+            assert np.all(np.asarray(v, np.float32)[~mask] == 0.0), name
+    # clearing the mask un-freezes the next step
+    opt_b.set_param_row_mask(m_b.weight, None)
+    m_b.weight.grad = paddle.to_tensor(
+        g0.astype(np.asarray(m_b.weight._data).dtype))
+    opt_b.step()
+    b2 = np.asarray(m_b.weight._data, np.float32)
+    assert not np.array_equal(b2[~mask], init[~mask])
+
+
+def test_ernie_fine_tiny_ragged_a2a_step():
+    """ernie_moe_fine_tiny (fine-grained preset + shared expert) trains one
+    ep2 x dp2 ragged_a2a step: finite loss, zero drops, wire < buffer."""
+    from paddle_tpu.models import ernie_moe as em
+
+    cfg = em.ernie_moe_fine_tiny()
+    assert cfg.dispatch_mode == "ragged_a2a"
+    assert cfg.num_shared_experts == 1
+    rng = np.random.RandomState(31)
+    ids = rng.randint(0, cfg.vocab_size, size=(4, 16)).astype(np.int32)
+    step, params, opt = em.build_train_step(
+        cfg, ep_degree=2, dp_degree=2, seed=0, with_stats=True,
+        dispatch_mode="ragged_a2a", active_only_moments=True)
+    p, o, loss, stats = step(params, opt, ids, np.roll(ids, -1, 1))
+    assert np.isfinite(float(loss))
+    assert float(stats["moe_dropped_tokens"]) == 0.0
+    assert 0.0 <= float(stats["moe_a2a_wire_rows"]) \
+        < float(stats["moe_a2a_buffer_rows"])
+    moe = p["layers"]["moe"] if "moe" in p["layers"] else p["layers"]
+    assert "s_w1" in moe  # shared expert rode along
+
+
+@pytest.mark.slow  # jit-compiles four ep2xdp2 train steps
+def test_ernie_fine_tiny_a2a_matches_ragged_lm_loss():
+    """First-step lm_loss parity (identical params) between the ragged_a2a
+    island and the pre-PR ragged island — reduction-order noise only."""
+    from paddle_tpu.models import ernie_moe as em
+
+    cfg = em.ernie_moe_fine_tiny()
+    rng = np.random.RandomState(37)
+    ids = rng.randint(0, cfg.vocab_size, size=(4, 16)).astype(np.int32)
+    lm = {}
+    for mode in ("ragged_a2a", "ragged"):
+        step, params, opt = em.build_train_step(
+            cfg, ep_degree=2, dp_degree=2, seed=0, with_stats=True,
+            dispatch_mode=mode)
+        _, _, _, stats = step(params, opt, ids, np.roll(ids, -1, 1))
+        lm[mode] = float(stats["lm_loss"])
+    assert abs(lm["ragged_a2a"] - lm["ragged"]) < 1e-5, lm
