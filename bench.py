@@ -923,8 +923,9 @@ def _static_analysis_record():
     (a weak-scalar or host-sync regression shows up next to the MFU it
     distorted)."""
     try:
-        from paddle_tpu.analysis import run as run_analysis
+        from paddle_tpu.analysis import apply_baseline, run as run_analysis
         report = run_analysis()
+        stale = apply_baseline(report)
     except Exception as exc:  # the record is telemetry, never a gate
         return {"error": f"{type(exc).__name__}: {exc}"}
     return {
@@ -932,6 +933,11 @@ def _static_analysis_record():
         "total_active": len(report.active),
         "total_suppressed": len(report.suppressed),
         "total_allowlisted": len(report.allowlisted),
+        # PR-11 ratchet posture: findings the baseline absorbs (debt
+        # still to burn down) and entries whose finding is gone (stale
+        # — the ratchet demands their deletion)
+        "total_baselined": len(report.baselined),
+        "baseline_stale": len(stale),
     }
 
 

@@ -22,7 +22,7 @@ def main():
     from paddle_tpu.observability.metrics import StepMetrics
     from paddle_tpu.ops import _common
 
-    _common.set_interpret(True)   # paged pallas kernels off-TPU
+    _common.set_interpret(True)  # noqa: PTA007 -- process-lifetime: script entry point, paged pallas kernels off-TPU
 
     config = llama_tiny(vocab=96, hidden=64, layers=1, heads=4, kv_heads=2,
                         seq=256)

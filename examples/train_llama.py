@@ -30,9 +30,9 @@ def main():
                               microbatches=2 if args.pp > 1 else 1)
     if parallel.total > 1:
         from paddle_tpu.ops import _common
-        _common.set_interpret(True)   # virtual CPU devices
+        _common.set_interpret(True)  # noqa: PTA007 -- process-lifetime: script entry point on virtual CPU devices
         cpus = jax.devices("cpu")
-        jax.config.update("jax_default_device", cpus[0])
+        jax.config.update("jax_default_device", cpus[0])  # noqa: PTA007 -- process-lifetime device pin for the script run
         mesh = make_mesh(parallel, devices=cpus[:parallel.total])
     else:
         mesh = None

@@ -29,16 +29,31 @@ this module (whole-file grants, each with a reason).
 
 CLI::
 
-    python -m paddle_tpu.analysis [--strict] [--rule PTA001] [--json] [paths]
+    python -m paddle_tpu.analysis [--strict] [--rule PTA001] [--json]
+                                  [--baseline write|check] [paths]
 
-``--strict`` exits non-zero when any active (unsuppressed, unallowlisted)
-finding remains — the tier-1 gate (tests/test_static_analysis.py) and the
-multichip-dryrun preamble both run in this mode.
+``--strict`` exits non-zero when any active (unsuppressed, unallowlisted,
+unbaselined) finding remains — the tier-1 gate
+(tests/test_static_analysis.py) and the multichip-dryrun preamble both run
+in this mode.
+
+The **baseline ratchet** (``--baseline write|check``, PR 11) lets a new
+strict rule land immediately with existing debt frozen: ``write``
+snapshots every active finding's *fingerprint* (rule + path + normalized
+source line — line-number shifts don't invalidate it) into
+``baseline.json`` next to this module; ``check`` marks findings matching
+the snapshot as ``baselined`` (not active, so --strict passes) and FAILS
+on (a) any new finding — not in the snapshot — and (b) any stale snapshot
+entry whose finding no longer exists, which forces a re-``write`` and
+makes the frozen count monotonically decrease. Deleting a baseline entry
+whose finding still exists turns that finding active again: the ratchet
+only moves one way.
 """
 from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import json
 import os
 import re
@@ -47,12 +62,23 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from . import _astutil
 
 __all__ = ["Finding", "Module", "Rule", "Report", "run", "all_rules",
-           "register", "REPO_ROOT", "DEFAULT_ALLOWLIST"]
+           "register", "REPO_ROOT", "DEFAULT_ALLOWLIST", "DEFAULT_BASELINE",
+           "load_baseline", "write_baseline", "apply_baseline",
+           "DEFAULT_SCAN_PATHS"]
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "allowlist.json")
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+# The default sweep covers everything the rules guard: the package, plus
+# the test suite / entry points / benches that PTA007 (global-state leak)
+# polices. Relative to the repo root; missing entries are skipped so the
+# analyzer still runs on a partial checkout.
+DEFAULT_SCAN_PATHS = ("paddle_tpu", "tests", "examples", "benchmarks",
+                      "bench.py", "__graft_entry__.py")
 
 # `# noqa: PTA001 -- reason` (multiple codes comma-separated). The reason
 # is MANDATORY; a reasonless suppression trades the finding for a PTA000.
@@ -68,8 +94,9 @@ class Finding:
     line: int
     col: int
     message: str
-    status: str = "active"     # active | suppressed | allowlisted
-    reason: str = ""           # the suppression/allowlist reason
+    status: str = "active"     # active | suppressed | allowlisted | baselined
+    reason: str = ""           # the suppression/allowlist/baseline reason
+    fingerprint: str = ""      # stable id for the baseline ratchet
 
     def format(self) -> str:
         tag = "" if self.status == "active" else f" [{self.status}]"
@@ -87,7 +114,11 @@ class Module:
         self.source = source
         self.rel = rel.replace(os.sep, "/")
         self.path = path
-        self.tree = _astutil.link_parents(ast.parse(source, filename=rel))
+        self.tree = ast.parse(source, filename=rel)
+        # One walk serves every rule: parent links plus cached node/call
+        # lists (9 rules re-walking 300+ files dominated scan time).
+        self.nodes = _astutil.link_and_collect(self.tree)
+        self.calls = [n for n in self.nodes if isinstance(n, ast.Call)]
         self.noqa: Dict[int, Tuple[Tuple[str, ...], str]] = {}
         for lineno, line in enumerate(source.splitlines(), start=1):
             m = _NOQA_RE.search(line)
@@ -171,12 +202,17 @@ class Report:
     def allowlisted(self) -> List[Finding]:
         return [f for f in self.findings if f.status == "allowlisted"]
 
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "baselined"]
+
     def counts(self) -> Dict[str, Dict[str, int]]:
-        out = {code: {"active": 0, "suppressed": 0, "allowlisted": 0}
-               for code in self.rules}
+        def zero():
+            return {"active": 0, "suppressed": 0, "allowlisted": 0,
+                    "baselined": 0}
+        out = {code: zero() for code in self.rules}
         for f in self.findings:
-            out.setdefault(f.rule, {"active": 0, "suppressed": 0,
-                                    "allowlisted": 0})[f.status] += 1
+            out.setdefault(f.rule, zero())[f.status] += 1
         return out
 
     def to_json(self) -> dict:
@@ -188,6 +224,7 @@ class Report:
             "total_active": len(self.active),
             "total_suppressed": len(self.suppressed),
             "total_allowlisted": len(self.allowlisted),
+            "total_baselined": len(self.baselined),
             "findings": [f.to_dict() for f in self.findings],
         }
 
@@ -202,11 +239,13 @@ class Report:
             title = self.titles.get(code, "")
             lines.append(f"{code} {title}: active={c['active']} "
                          f"suppressed={c['suppressed']} "
-                         f"allowlisted={c['allowlisted']}")
+                         f"allowlisted={c['allowlisted']} "
+                         f"baselined={c['baselined']}")
         lines.append(f"static-analysis: {len(self.rules)} rules, "
                      f"{len(self.active)} active, "
                      f"{len(self.suppressed)} suppressed, "
-                     f"{len(self.allowlisted)} allowlisted")
+                     f"{len(self.allowlisted)} allowlisted, "
+                     f"{len(self.baselined)} baselined")
         return "\n".join(lines)
 
 
@@ -261,7 +300,8 @@ def run(paths: Optional[List[str]] = None,
     root = os.path.abspath(root or REPO_ROOT)
     default_scan = paths is None
     if default_scan:
-        paths = [os.path.join(root, "paddle_tpu")]
+        paths = [os.path.join(root, p) for p in DEFAULT_SCAN_PATHS
+                 if os.path.exists(os.path.join(root, p))]
     if with_floors is None:
         with_floors = default_scan
 
@@ -325,4 +365,94 @@ def run(paths: Optional[List[str]] = None,
     if meta:
         titles["PTA000"] = "reasonless suppression"
     report_rules = codes + (["PTA000"] if meta else [])
-    return Report(out + meta, report_rules, titles)
+    report = Report(out + meta, report_rules, titles)
+    _attach_fingerprints(report, {m.rel: m for m in modules})
+    return report
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet (PR 11)
+# ---------------------------------------------------------------------------
+
+def _norm_line(source_line: str) -> str:
+    return " ".join(source_line.split())
+
+
+def _attach_fingerprints(report: Report,
+                         modules_by_rel: Dict[str, "Module"]) -> None:
+    """Stable per-finding ids: sha1 of rule|path|normalized source line|k
+    where k disambiguates repeated identical lines in one file (ordered
+    by line number, so an unrelated edit above a finding cannot shift its
+    fingerprint the way a raw line number would)."""
+    groups: Dict[Tuple[str, str, str], List[Finding]] = {}
+    for f in report.findings:
+        mod = modules_by_rel.get(f.path)
+        if mod is None:
+            text = ""
+        else:
+            lines = mod.source.splitlines()
+            text = _norm_line(lines[f.line - 1]) if \
+                0 < f.line <= len(lines) else ""
+        groups.setdefault((f.rule, f.path, text), []).append(f)
+    for (rule, path, text), fs in groups.items():
+        for k, f in enumerate(sorted(fs, key=lambda f: (f.line, f.col))):
+            raw = f"{rule}|{path}|{text}|{k}"
+            f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    """{fingerprint: entry} from baseline.json (empty when absent)."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    out = {}
+    for code, entries in (data.get("rules") or {}).items():
+        for entry in entries:
+            out[entry["fingerprint"]] = dict(entry, rule=code)
+    return out
+
+
+def write_baseline(report: Report, path: Optional[str] = None) -> dict:
+    """Snapshot the report's active findings as the new frozen debt."""
+    path = path or DEFAULT_BASELINE
+    rules: Dict[str, List[dict]] = {}
+    for f in sorted(report.active, key=lambda f: (f.rule, f.path, f.line)):
+        rules.setdefault(f.rule, []).append({
+            "fingerprint": f.fingerprint,
+            "path": f.path,
+            "line": f.line,
+            "message": f.message,
+        })
+    data = {
+        "_comment": ("frozen pre-existing findings (--baseline write); "
+                     "CI fails on NEW findings and on stale entries, so "
+                     "this list only ever shrinks"),
+        "count": sum(len(v) for v in rules.values()),
+        "rules": rules,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+def apply_baseline(report: Report,
+                   baseline: Optional[Dict[str, dict]] = None,
+                   path: Optional[str] = None) -> List[dict]:
+    """Mark active findings matching the baseline as ``baselined``
+    (in place) and return the STALE baseline entries — fingerprints whose
+    finding no longer exists. Callers fail the ratchet check when either
+    ``report.active`` (new findings) or the returned stale list is
+    non-empty."""
+    if baseline is None:
+        baseline = load_baseline(path)
+    matched = set()
+    for f in report.findings:
+        if f.status == "active" and f.fingerprint in baseline:
+            f.status = "baselined"
+            f.reason = "frozen in baseline.json (pre-existing debt)"
+            matched.add(f.fingerprint)
+    return [entry for fp, entry in sorted(baseline.items())
+            if fp not in matched]

@@ -1,9 +1,11 @@
 """CLI for ``paddle_tpu.analysis``.
 
-    python -m paddle_tpu.analysis [--strict] [--rule PTA001] [--json] [paths]
+    python -m paddle_tpu.analysis [--strict] [--rule PTA001] [--json]
+                                  [--baseline write|check] [paths]
 
 Exit status: 0 when no active findings (or not --strict); 1 when --strict
-and active findings remain; 2 on usage errors.
+and active findings remain, or when --baseline check finds new findings /
+stale baseline entries; 2 on usage errors.
 """
 from __future__ import annotations
 
@@ -11,7 +13,8 @@ import argparse
 import json
 import sys
 
-from . import DEFAULT_ALLOWLIST, all_rules, run
+from . import (DEFAULT_ALLOWLIST, DEFAULT_BASELINE, all_rules,
+               apply_baseline, run, write_baseline)
 
 
 def main(argv=None) -> int:
@@ -38,6 +41,14 @@ def main(argv=None) -> int:
     parser.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
                         help="allowlist JSON path (default: the in-package "
                              "allowlist.json)")
+    parser.add_argument("--baseline", choices=("write", "check"),
+                        help="ratchet: 'write' snapshots active findings "
+                             "into baseline.json; 'check' passes pre-frozen "
+                             "findings but fails on new findings and on "
+                             "stale (already-fixed) baseline entries")
+    parser.add_argument("--baseline-file", default=DEFAULT_BASELINE,
+                        help="baseline JSON path (default: the in-package "
+                             "baseline.json)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -55,13 +66,36 @@ def main(argv=None) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
+    stale = []
+    if args.baseline == "write":
+        data = write_baseline(report, path=args.baseline_file)
+        print(f"baseline: wrote {data['count']} finding(s) to "
+              f"{args.baseline_file}")
+        apply_baseline(report, path=args.baseline_file)
+    elif args.baseline == "check":
+        stale = apply_baseline(report, path=args.baseline_file)
+
     if args.json:
         print(json.dumps(report.to_json(), indent=2, sort_keys=True))
     else:
         print(report.render_text())
+
+    rc = 0
+    if args.baseline == "check":
+        if report.active:
+            print(f"baseline check: {len(report.active)} NEW finding(s) "
+                  f"not in the frozen baseline", file=sys.stderr)
+            rc = 1
+        if stale:
+            for entry in stale:
+                print(f"baseline check: stale entry "
+                      f"{entry['rule']} {entry['path']}:{entry['line']} — "
+                      f"finding fixed; re-run --baseline write to shrink "
+                      f"the snapshot", file=sys.stderr)
+            rc = 1
     if args.strict and report.active:
-        return 1
-    return 0
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

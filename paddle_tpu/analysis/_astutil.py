@@ -7,17 +7,33 @@ repo's native runtime) is importable.
 from __future__ import annotations
 
 import ast
-from typing import Dict, Iterator, Optional, Set
+from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 PARENT_ATTR = "_pta_parent"
 
 
 def link_parents(tree: ast.AST) -> ast.AST:
     """Attach a ``_pta_parent`` attribute to every node."""
-    for node in ast.walk(tree):
+    link_and_collect(tree)
+    return tree
+
+
+def link_and_collect(tree: ast.AST) -> List[ast.AST]:
+    """Attach parent links and return every node, in one BFS walk.
+
+    Same visit order as ``ast.walk``.  ``Module`` caches the result so
+    rules iterate ``module.nodes``/``module.calls`` instead of
+    re-walking the full tree once per rule."""
+    from collections import deque
+    nodes: List[ast.AST] = []
+    todo = deque([tree])
+    while todo:
+        node = todo.popleft()
+        nodes.append(node)
         for child in ast.iter_child_nodes(node):
             setattr(child, PARENT_ATTR, node)
-    return tree
+            todo.append(child)
+    return nodes
 
 
 def parent(node: ast.AST) -> Optional[ast.AST]:
@@ -136,16 +152,28 @@ def envs_aliases(tree: ast.AST) -> Set[str]:
 class ConstEnv:
     """Best-effort constant folder over one function's (and the module's)
     straight-line ``name = <literal expr>`` assignments. Supports ints
-    through +,-,*,//,**, min/max and tuple unpacking — enough to resolve
+    through +,-,*,//,%,**, min/max and tuple unpacking — enough to resolve
     the literal BlockSpec shapes the VMEM rule prices. Anything else
-    resolves to None ("unknown"), never a wrong number."""
+    resolves to None ("unknown"), never a wrong number.
 
-    def __init__(self, module_tree: ast.AST, func: Optional[ast.AST] = None):
+    ``bindings`` pre-seeds names with caller-side expressions (the
+    dataflow layer binds helper parameters to call-site arguments so a
+    rule can see through one level of helper calls); bindings win over
+    same-named assignments collected from the trees."""
+
+    def __init__(self, module_tree: ast.AST, func: Optional[ast.AST] = None,
+                 bindings: Optional[Dict[str, ast.AST]] = None):
         self._env: Dict[str, ast.AST] = {}
         self._collect(module_tree, toplevel_only=True)
         if func is not None:
             self._collect(func, toplevel_only=False)
+        if bindings:
+            self._env.update(bindings)
         self._resolving: Set[str] = set()
+
+    def lookup(self, name: str) -> Optional[ast.AST]:
+        """The AST node ``name`` was last straight-line-assigned to."""
+        return self._env.get(name)
 
     def _collect(self, tree, toplevel_only):
         nodes = tree.body if toplevel_only else ast.walk(tree)
@@ -189,6 +217,8 @@ class ConstEnv:
                     return lhs * rhs
                 if isinstance(node.op, ast.FloorDiv):
                     return lhs // rhs
+                if isinstance(node.op, ast.Mod):
+                    return lhs % rhs
                 if isinstance(node.op, ast.Pow):
                     return lhs ** rhs
             except (ZeroDivisionError, OverflowError):
@@ -201,3 +231,279 @@ class ConstEnv:
                 return None
             return (min if node.func.id == "min" else max)(vals)
         return None
+
+    def resolve_str(self, node: ast.AST) -> Optional[str]:
+        """String value of the expression (literal or through one or more
+        straight-line assignments / bindings), or None when unknown."""
+        s = str_const(node)
+        if s is not None:
+            return s
+        if isinstance(node, ast.Name):
+            if node.id in self._resolving or node.id not in self._env:
+                return None
+            self._resolving.add(node.id)
+            try:
+                return self.resolve_str(self._env[node.id])
+            finally:
+                self._resolving.discard(node.id)
+        return None
+
+    def resolve_node(self, node: ast.AST, depth: int = 4) -> ast.AST:
+        """Chase Name -> assigned-node chains, returning the deepest
+        non-Name node reachable (or the original node)."""
+        while depth > 0 and isinstance(node, ast.Name) \
+                and node.id in self._env:
+            nxt = self._env[node.id]
+            if nxt is node:
+                break
+            node = nxt
+            depth -= 1
+        return node
+
+
+# ---------------------------------------------------------------------------
+# dataflow layer (PR 11): per-module call-graph resolution, parameter
+# binding, symbolic affine arithmetic, dtype propagation and the
+# with/try-finally scope model. Everything stays AST-only — helpers are
+# resolved by PARSING, never importing, the modules involved.
+# ---------------------------------------------------------------------------
+
+#: dtype-constructor suffixes recognized by :func:`resolve_dtype_name`
+DTYPE_NAMES = frozenset({
+    "float64", "float32", "float16", "bfloat16",
+    "int64", "int32", "int16", "int8",
+    "uint64", "uint32", "uint8", "bool_", "bool",
+})
+
+
+def resolve_dtype_name(node: ast.AST,
+                       env: Optional["ConstEnv"] = None) -> Optional[str]:
+    """'float32' for ``jnp.float32`` / ``np.float32`` / ``'float32'`` /
+    a Name straight-line-assigned to one of those; None when unknown.
+    This is the assignment-chain dtype propagation the Pallas grid
+    auditor uses to type accumulation scratch."""
+    if env is not None:
+        node = env.resolve_node(node)
+    lit = str_const(node)
+    if lit is not None:
+        return lit if lit in DTYPE_NAMES else None
+    name = dotted_name(node)
+    if name is not None:
+        tail = name.rsplit(".", 1)[-1]
+        if tail in DTYPE_NAMES:
+            return tail
+    return None
+
+
+class FunctionIndex:
+    """Module-level ``def``s by name (the intra-module half of call-graph
+    resolution). Nested defs and methods are deliberately out: the helper
+    conventions this repo lints (_mask_*, _fit_*, island bodies) are all
+    module-level functions."""
+
+    def __init__(self, module_tree: ast.AST):
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for node in ast.iter_child_nodes(module_tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+
+    def get(self, name: Optional[str]) -> Optional[ast.FunctionDef]:
+        if name is None:
+            return None
+        return self.functions.get(name)
+
+
+def bind_call_args(func: ast.FunctionDef,
+                   call: ast.Call) -> Dict[str, ast.AST]:
+    """{param name: caller-side AST node} for one call of a resolved
+    local function — positional args, keywords and defaults, skipping
+    */** (best-effort; a partial binding is still useful)."""
+    params = [a.arg for a in func.args.args]
+    binding: Dict[str, ast.AST] = {}
+    defaults = func.args.defaults
+    if defaults:
+        for name, default in zip(params[-len(defaults):], defaults):
+            binding[name] = default
+    for kwarg, default in zip(func.args.kwonlyargs, func.args.kw_defaults):
+        if default is not None:
+            binding[kwarg.arg] = default
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        if i < len(params):
+            binding[params[i]] = arg
+    kwonly = {a.arg for a in func.args.kwonlyargs}
+    for kw in call.keywords:
+        if kw.arg is not None and (kw.arg in params or kw.arg in kwonly):
+            binding[kw.arg] = kw.value
+    return binding
+
+
+def resolve_local_call(call: ast.Call, index: FunctionIndex,
+                       env: Optional[ConstEnv] = None
+                       ) -> Optional[Tuple[ast.FunctionDef,
+                                           Dict[str, ast.AST]]]:
+    """(FunctionDef, param binding) when ``call`` resolves to a module-
+    level function — directly (``helper(...)``), through a straight-line
+    alias, or through ``functools.partial(helper, ...)`` (the shard_map
+    island-body idiom, where the partial's args pre-bind parameters)."""
+    fn = call.func
+    if env is not None and isinstance(fn, ast.Name):
+        resolved = env.resolve_node(fn)
+        if isinstance(resolved, ast.Lambda):
+            return None
+        if isinstance(resolved, ast.Call):
+            # name assigned to a partial(...) — unwrap below
+            return _resolve_partial(resolved, index, call)
+    target = index.get(fn.id if isinstance(fn, ast.Name) else None)
+    if target is not None:
+        return target, bind_call_args(target, call)
+    return None
+
+
+def _resolve_partial(partial_call: ast.Call, index: FunctionIndex,
+                     outer_call: Optional[ast.Call]):
+    if call_ident(partial_call) != "partial" or not partial_call.args:
+        return None
+    inner = partial_call.args[0]
+    target = index.get(inner.id if isinstance(inner, ast.Name) else None)
+    if target is None:
+        return None
+    params = [a.arg for a in target.args.args]
+    # defaults + the partial's keyword args only: the partial's
+    # positionals are shifted by one (args[0] is the callee) and are
+    # bound explicitly below
+    binding = bind_call_args(target, ast.Call(
+        func=partial_call.func, args=[], keywords=partial_call.keywords))
+    # partial's leading positionals bind the leading params
+    for i, arg in enumerate(partial_call.args[1:]):
+        if i < len(params):
+            binding[params[i]] = arg
+    n_bound_pos = len(partial_call.args) - 1
+    if outer_call is not None:
+        for i, arg in enumerate(outer_call.args):
+            j = n_bound_pos + i
+            if j < len(params) and params[j] not in binding:
+                binding[params[j]] = arg
+        kwonly = {a.arg for a in target.args.kwonlyargs}
+        for kw in outer_call.keywords:
+            if kw.arg is not None and (kw.arg in params or kw.arg in kwonly):
+                binding[kw.arg] = kw.value
+    return target, binding
+
+
+def resolve_callable(node: ast.AST, index: FunctionIndex,
+                     env: Optional[ConstEnv] = None
+                     ) -> Optional[Tuple[ast.AST, Dict[str, ast.AST]]]:
+    """Resolve a callable-position expression (a shard_map body, an
+    index_map) to (Lambda | FunctionDef, binding). Handles a direct
+    lambda, a module-level def name, a straight-line alias to either,
+    and ``functools.partial(def, ...)``."""
+    if env is not None:
+        node = env.resolve_node(node)
+    if isinstance(node, ast.Lambda):
+        return node, {}
+    if isinstance(node, ast.Name):
+        target = index.get(node.id)
+        if target is not None:
+            return target, {}
+        return None
+    if isinstance(node, ast.Call):
+        resolved = _resolve_partial(node, index, None)
+        if resolved is not None:
+            return resolved
+    return None
+
+
+def affine_of(node: ast.AST, env: Optional[ConstEnv] = None
+              ) -> Optional[Tuple[Optional[str], int]]:
+    """(symbol, offset) for expressions of the shape ``sym + c`` /
+    ``sym - c`` / plain constants (symbol None). The symbol is the
+    canonical ``ast.dump`` of the non-constant part after chasing
+    straight-line assignments — enough symbolic arithmetic to compare a
+    comprehension's range bound against a mesh-axis size without knowing
+    either number."""
+    if env is not None:
+        node = env.resolve_node(node)
+    val, ok = number_of(node)
+    if ok and isinstance(val, int):
+        return None, val
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add,
+                                                            ast.Sub)):
+        lhs = affine_of(node.left, env)
+        rhs = affine_of(node.right, env)
+        if lhs is None or rhs is None:
+            return None
+        sign = 1 if isinstance(node.op, ast.Add) else -1
+        if rhs[0] is None:
+            return lhs[0], lhs[1] + sign * rhs[1]
+        if lhs[0] is None and sign == 1:
+            return rhs[0], lhs[1] + rhs[1]
+        return None
+    return ast.dump(node), 0
+
+
+def contains_name(node: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+# --- with / try-finally scope model ----------------------------------------
+
+def enclosing_tries(node: ast.AST) -> List[ast.Try]:
+    """Innermost-first Try statements whose *protected region* (body or
+    orelse — NOT the finalbody or handlers) contains ``node``."""
+    out = []
+    cur, prev = parent(node), node
+    while cur is not None:
+        if isinstance(cur, ast.Try):
+            region = list(cur.body) + list(cur.orelse)
+            if any(prev is stmt or _contains(stmt, prev)
+                   for stmt in region):
+                out.append(cur)
+        prev, cur = cur, parent(cur)
+    return out
+
+
+def _contains(tree: ast.AST, node: ast.AST) -> bool:
+    return any(n is node for n in ast.walk(tree))
+
+
+def enclosing_withs(node: ast.AST) -> List[ast.With]:
+    """Innermost-first With statements whose body contains ``node``."""
+    out = []
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            out.append(cur)
+        cur = parent(cur)
+    return out
+
+
+def decorator_names(func: ast.AST) -> Set[str]:
+    """Last-segment names of a function's decorators:
+    ``@contextlib.contextmanager`` -> {'contextmanager'};
+    ``@pytest.fixture(scope=...)`` -> {'fixture'}."""
+    out = set()
+    for dec in getattr(func, "decorator_list", ()):
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        name = dotted_name(dec)
+        if name:
+            out.add(name.rsplit(".", 1)[-1])
+    return out
+
+
+def statements_after_yield(func: ast.AST) -> List[ast.stmt]:
+    """Top-to-bottom statements of ``func`` that appear strictly after
+    its first ``yield`` (generator-fixture teardown code). Statements in
+    the same Try as the yield count when they are in the finalbody."""
+    yields = [n for n in ast.walk(func) if isinstance(n, ast.Yield)]
+    if not yields:
+        return []
+    first = min(yields, key=lambda n: n.lineno)
+    out = []
+    for node in ast.walk(func):
+        if isinstance(node, ast.stmt) and node.lineno > first.lineno:
+            out.append(node)
+    return out

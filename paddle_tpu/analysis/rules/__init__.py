@@ -5,3 +5,6 @@ from . import pta003_cost_estimate  # noqa: F401
 from . import pta004_comm_span  # noqa: F401
 from . import pta005_env_knobs  # noqa: F401
 from . import pta006_host_sync  # noqa: F401
+from . import pta007_global_state  # noqa: F401
+from . import pta008_collectives  # noqa: F401
+from . import pta009_pallas_grid  # noqa: F401

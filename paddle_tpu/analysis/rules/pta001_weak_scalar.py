@@ -28,8 +28,9 @@ from __future__ import annotations
 import ast
 
 from .. import Rule, register
-from .._astutil import (call_ident, call_root, is_bare_number, iter_calls,
-                        keyword, number_of, parent)
+from .._astutil import (FunctionIndex, call_ident, call_root, is_bare_number,
+                        iter_calls, keyword, number_of, parent,
+                        resolve_local_call)
 
 # dtype constructors that make a literal strongly typed
 _CASTERS = frozenset({
@@ -51,6 +52,21 @@ def _wrap_hint(value):
     return f"np.int32({value!r})"
 
 
+def _params_at_where_sinks(func):
+    """Parameter names of ``func`` that appear as a where()/select()
+    branch argument in its body — a literal bound to one of these at a
+    call site is the same weak-scalar bug, one hop removed (the v1
+    engine's known false-negative class)."""
+    names = set()
+    for call in iter_calls(func):
+        if call_ident(call) not in _WHERE_LIKE:
+            continue
+        for arg in call.args[1:3]:
+            if isinstance(arg, ast.Name):
+                names.add(arg.id)
+    return names
+
+
 @register
 class WeakScalarRule(Rule):
     code = "PTA001"
@@ -63,8 +79,31 @@ class WeakScalarRule(Rule):
 
     def check_module(self, module):
         flagged = set()
-        for call in iter_calls(module.tree):
+        index = FunctionIndex(module.tree)
+        sink_params = {}  # helper name -> params reaching a where sink
+        for call in module.calls:
             ident = call_ident(call)
+            # interprocedural hop: a bare literal bound to a local
+            # helper's parameter that lands in a where()/select() branch
+            resolved = resolve_local_call(call, index)
+            if resolved is not None:
+                helper, binding = resolved
+                if helper.name not in sink_params:
+                    sink_params[helper.name] = _params_at_where_sinks(helper)
+                for pname in sink_params[helper.name]:
+                    arg = binding.get(pname)
+                    if arg is None or id(arg) in flagged:
+                        continue
+                    val, ok = number_of(arg)
+                    if ok and arg in call.args + [
+                            kw.value for kw in call.keywords]:
+                        flagged.add(id(arg))
+                        yield self.finding(
+                            module, arg,
+                            f"weak {type(val).__name__} literal {val!r} "
+                            f"bound to {helper.name}(...{pname}...) which "
+                            f"uses it as a where()/select() branch; wrap "
+                            f"it ({_wrap_hint(val)}) at the call site")
             if ident in _WHERE_LIKE:
                 for arg in call.args[1:3]:
                     val, ok = number_of(arg)
@@ -98,7 +137,7 @@ class WeakScalarRule(Rule):
                         f"f64/i64 under x64")
         # big float constants anywhere else (scalar-arg class): literal
         # mask values must ride wrapped in a dtype constructor
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             # a Constant under a unary +/- is visited via its UnaryOp
             if isinstance(node, ast.Constant) and \
                     isinstance(parent(node), ast.UnaryOp):

@@ -21,8 +21,9 @@ from __future__ import annotations
 import ast
 
 from .. import Rule, register
-from .._astutil import (ConstEnv, call_ident, dotted_name,
-                        enclosing_function, iter_calls, keyword)
+from .._astutil import (ConstEnv, FunctionIndex, call_ident, dotted_name,
+                        enclosing_function, iter_calls, keyword,
+                        resolve_local_call)
 
 # conservative ceiling: the largest fitted budget in tree is the dense
 # flash backward's 52 MB scratch+window set; anything statically priced
@@ -93,52 +94,25 @@ class VmemBudgetRule(Rule):
     budget = BUDGET_BYTES
 
     def check_module(self, module):
-        for call in iter_calls(module.tree):
+        index = FunctionIndex(module.tree)
+        for call in module.calls:
             if call_ident(call) != "pallas_call":
                 continue
             func = enclosing_function(call)
             env = ConstEnv(module.tree, func)
             fitted = _fitter_derived_names(func)
-
-            windows = []      # (prod, itemsize, double_buffered)
-            unresolved = False
-            fitter_routed = False
-            for key in ("in_specs", "out_specs"):
-                kw = keyword(call, key)
-                if kw is None:
-                    continue
-                for spec in iter_calls(kw.value):
-                    ident = call_ident(spec)
-                    if ident == "BlockSpec" and spec.args and \
-                            isinstance(spec.args[0], (ast.Tuple, ast.List)):
-                        prod, state = self._price(spec.args[0], env, fitted)
-                        if state == "fitted":
-                            fitter_routed = True
-                        elif state == "unknown":
-                            unresolved = True
-                        else:
-                            windows.append(prod * DEFAULT_ITEMSIZE * 2)
-            kw = keyword(call, "scratch_shapes")
-            if kw is not None:
-                for spec in iter_calls(kw.value):
-                    if call_ident(spec) not in ("VMEM", "SMEM"):
-                        continue
-                    if not spec.args or not isinstance(
-                            spec.args[0], (ast.Tuple, ast.List)):
-                        continue
-                    prod, state = self._price(spec.args[0], env, fitted)
-                    if state == "fitted":
-                        fitter_routed = True
-                    elif state == "unknown":
-                        unresolved = True
-                    else:
-                        windows.append(prod * _scratch_itemsize(spec))
-
+            total, unresolved, fitter_routed = self._price_site(
+                call, env, fitted)
             if fitter_routed:
                 continue  # the fitter owns the budget for this site
             if unresolved:
-                continue  # caller-threaded blocks: cannot price statically
-            total = sum(windows)
+                # caller-threaded blocks: re-price per intra-module call
+                # site with the caller's arguments bound to the helper's
+                # parameters (the dataflow hop v1 could not make)
+                if func is not None and index.get(func.name) is func:
+                    yield from self._reprice_at_callers(
+                        module, call, func, fitted, index)
+                continue
             if total > self.budget:
                 yield self.finding(
                     module, call,
@@ -146,6 +120,72 @@ class VmemBudgetRule(Rule):
                     f"{total / 2**20:.0f} MiB (double-buffered in/out "
                     f"specs + scratch) > {self.budget / 2**20:.0f} MiB "
                     f"budget; shrink blocks or route sizing through a "
+                    f"registered fitter (_fit_*)")
+
+    def _price_site(self, call, env, fitted):
+        """(total_bytes, unresolved, fitter_routed) for one pallas_call."""
+        windows = []
+        unresolved = False
+        fitter_routed = False
+        for key in ("in_specs", "out_specs"):
+            kw = keyword(call, key)
+            if kw is None:
+                continue
+            for spec in iter_calls(kw.value):
+                ident = call_ident(spec)
+                if ident == "BlockSpec" and spec.args and \
+                        isinstance(spec.args[0], (ast.Tuple, ast.List)):
+                    prod, state = self._price(spec.args[0], env, fitted)
+                    if state == "fitted":
+                        fitter_routed = True
+                    elif state == "unknown":
+                        unresolved = True
+                    else:
+                        windows.append(prod * DEFAULT_ITEMSIZE * 2)
+        kw = keyword(call, "scratch_shapes")
+        if kw is not None:
+            for spec in iter_calls(kw.value):
+                if call_ident(spec) not in ("VMEM", "SMEM"):
+                    continue
+                if not spec.args or not isinstance(
+                        spec.args[0], (ast.Tuple, ast.List)):
+                    continue
+                prod, state = self._price(spec.args[0], env, fitted)
+                if state == "fitted":
+                    fitter_routed = True
+                elif state == "unknown":
+                    unresolved = True
+                else:
+                    windows.append(prod * _scratch_itemsize(spec))
+        return sum(windows), unresolved, fitter_routed
+
+    def _reprice_at_callers(self, module, pallas_call, helper, fitted,
+                            index):
+        """Re-price a caller-threaded pallas_call at each intra-module
+        call site of its enclosing helper, with the site's constant-
+        resolvable arguments bound to the helper's parameters."""
+        for site in module.calls:
+            resolved = resolve_local_call(site, index)
+            if resolved is None or resolved[0] is not helper:
+                continue
+            caller_env = ConstEnv(module.tree, enclosing_function(site))
+            bindings = {}
+            for pname, arg in resolved[1].items():
+                val = caller_env.resolve(arg)
+                if isinstance(val, (int, float)):
+                    bindings[pname] = ast.Constant(value=val)
+            env = ConstEnv(module.tree, helper, bindings=bindings)
+            total, unresolved, fitter_routed = self._price_site(
+                pallas_call, env, fitted)
+            if fitter_routed or unresolved:
+                continue
+            if total > self.budget:
+                yield self.finding(
+                    module, site,
+                    f"call binds {helper.name}() block params so its "
+                    f"pallas_call windows price at {total / 2**20:.0f} "
+                    f"MiB > {self.budget / 2**20:.0f} MiB budget; shrink "
+                    f"the blocks passed here or route sizing through a "
                     f"registered fitter (_fit_*)")
 
     @staticmethod

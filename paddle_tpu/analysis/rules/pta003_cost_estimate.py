@@ -13,7 +13,7 @@ rule fails loudly instead of silently matching nothing.
 from __future__ import annotations
 
 from .. import Finding, Rule, register
-from .._astutil import call_ident, iter_calls, keyword
+from .._astutil import call_ident, keyword
 
 # flash fwd/bwd (resident, streaming, fused flat, split pair), varlen
 # fwd/bwd (streaming + stacked + fused + split), decode slabs, rms_norm,
@@ -37,7 +37,7 @@ class CostEstimateRule(Rule):
         self.sites_seen = 0
 
     def check_module(self, module):
-        for call in iter_calls(module.tree):
+        for call in module.calls:
             if call_ident(call) != "pallas_call":
                 continue
             self.sites_seen += 1

@@ -8,7 +8,7 @@ that test is now a thin shim over this rule.
 from __future__ import annotations
 
 from .. import Finding, Rule, register
-from .._astutil import call_ident, iter_calls, keyword
+from .._astutil import call_ident, keyword
 
 
 @register
@@ -28,7 +28,7 @@ class CommSpanRule(Rule):
     def check_module(self, module):
         # only call sites count; the def site in observability/trace.py
         # never appears as a Call node
-        for call in iter_calls(module.tree):
+        for call in module.calls:
             if call_ident(call) != "comm_span":
                 continue
             self.sites_seen += 1

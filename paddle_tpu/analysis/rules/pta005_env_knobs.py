@@ -80,7 +80,7 @@ class EnvKnobRule(Rule):
 
     def check_module(self, module):
         # (a) raw environ reads of PADDLE_TPU_* keys
-        for call in iter_calls(module.tree):
+        for call in module.calls:
             if not _environ_read(call) or not call.args:
                 continue
             key = str_const(call.args[0])
@@ -90,7 +90,7 @@ class EnvKnobRule(Rule):
                     f"raw environ read of {key}; route it through "
                     f"paddle_tpu.envs.get({key!r}) (validated getter "
                     f"registry)")
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             if isinstance(node, ast.Subscript) and \
                     isinstance(node.ctx, ast.Load):
                 target = dotted_name(node.value) or ""
@@ -103,7 +103,7 @@ class EnvKnobRule(Rule):
                             f"through paddle_tpu.envs.get({key!r})")
         # (b) undocumented knobs: exact PADDLE_TPU_* literals that name a
         # knob missing from the envs.py registry
-        for node in ast.walk(module.tree):
+        for node in module.nodes:
             lit = str_const(node)
             if lit is None or not _KNOB_RE.match(lit):
                 continue

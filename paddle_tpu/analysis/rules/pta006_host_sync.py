@@ -54,7 +54,7 @@ class HostSyncRule(Rule):
 
     def check_module(self, module):
         np_names = numpy_aliases(module.tree) | {"np"}
-        for call in iter_calls(module.tree):
+        for call in module.calls:
             ident = call_ident(call)
             fn = call.func
             if isinstance(fn, ast.Attribute) and not call.args \

@@ -1,0 +1,267 @@
+"""PTA007: process-global state mutated without a restoring scope.
+
+The bug class: the package carries real process-global knobs —
+``ops._common._FORCE_INTERPRET`` (via ``set_interpret``), ``os.environ``
+(the PADDLE_TPU_* / XLA overlap knobs), ``jax.config``, and the
+collective-matmul plan cache. A test or dryrun that mutates one and does
+not restore it poisons every later test in the same pytest process: the
+PR-10 ``_serve_dryrun`` leak (``finally: set_interpret(False)`` —
+restoring a hard-coded value instead of the saved previous one) broke
+~20 order-dependent tier-1 tests before it was found by hand.
+
+The rule flags every mutator call that is not *protected*:
+
+  * inside a ``try`` whose ``finally`` restores the same state domain
+    (same env key / jax.config name; any ``set_interpret`` for the
+    interpret override; a paired ``clear_plan_cache`` for the plan
+    cache);
+  * inside a ``@contextlib.contextmanager`` or generator
+    ``@pytest.fixture`` whose post-``yield`` teardown restores it;
+  * itself in teardown position (a ``finally`` body or after the
+    fixture's ``yield``) — it IS the restore.
+
+Teardown restores of the interpret override must restore a SAVED value:
+``set_interpret(False)`` / ``set_interpret(True)`` with a literal in
+teardown position is flagged as the exact PR-10 shape (it clobbers any
+outer override). Module-scope mutations are flagged under ``tests/``
+only — a module-level mutation in a test file leaks across the whole
+session — while entry scripts set process-lifetime config by design.
+
+Fix with the ``ops/_common.interpret_mode(value)`` contextmanager (saves
+and restores the previous override), or save/restore explicitly in a
+``finally``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional, Tuple
+
+from .. import Rule, register
+from .._astutil import (ConstEnv, call_ident, decorator_names, dotted_name,
+                        enclosing_function, parent, _contains)
+
+# jax.config.update call paths (conftest uses `jax.config.update`,
+# package code may alias `from jax import config`)
+_CONFIG_ROOTS = ("jax.config.update", "config.update")
+
+
+def _is_environ(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name is not None and (name == "environ"
+                                 or name.endswith(".environ"))
+
+
+def _key_sym(node: ast.AST, env: Optional[ConstEnv]) -> str:
+    """Canonical symbol for an env key / config name: its resolved string
+    value when statically known, else the ast.dump of the expression (so
+    ``os.environ[var] = x`` ... ``del os.environ[var]`` still pair up)."""
+    if env is not None:
+        s = env.resolve_str(node)
+        if s is not None:
+            return "str:" + s
+    else:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return "str:" + node.value
+    return "dump:" + ast.dump(node)
+
+
+def _mutation_of(node: ast.AST,
+                 env: Optional[ConstEnv]) -> Optional[Tuple[str, str, str]]:
+    """(domain, key, description) when ``node`` mutates process-global
+    state; None otherwise. Domains: interpret | env | jaxconfig |
+    plan_cache."""
+    if isinstance(node, ast.Call):
+        ident = call_ident(node)
+        if ident == "set_interpret":
+            return "interpret", "", "set_interpret(...)"
+        if ident == "clear_plan_cache":
+            return "plan_cache", "", "clear_plan_cache()"
+        if ident in ("pop", "setdefault") and isinstance(
+                node.func, ast.Attribute) and _is_environ(node.func.value) \
+                and node.args:
+            key = _key_sym(node.args[0], env)
+            return "env", key, f"os.environ.{ident}(...)"
+        name = dotted_name(node.func)
+        if name in _CONFIG_ROOTS and node.args:
+            key = _key_sym(node.args[0], env)
+            return "jaxconfig", key, "jax.config.update(...)"
+        return None
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript) and _is_environ(tgt.value):
+                return ("env", _key_sym(tgt.slice, env),
+                        "os.environ[...] write")
+        return None
+    if isinstance(node, ast.Delete):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript) and _is_environ(tgt.value):
+                return ("env", _key_sym(tgt.slice, env),
+                        "del os.environ[...]")
+    return None
+
+
+def _restores(stmt: ast.stmt, domain: str, key: str,
+              env: Optional[ConstEnv]) -> bool:
+    """Does this (teardown-position) statement restore the domain/key?
+    Any same-domain mutation counts as the restore — teardown writes are
+    by construction putting the state back."""
+    for node in ast.walk(stmt):
+        m = _mutation_of(node, env)
+        if m is not None and m[0] == domain and (
+                domain not in ("env", "jaxconfig") or m[1] == key):
+            return True
+    return False
+
+
+def _first_yield_line(func) -> Optional[int]:
+    yields = [n for n in ast.walk(func)
+              if isinstance(n, (ast.Yield, ast.YieldFrom))]
+    if not yields:
+        return None
+    return min(n.lineno for n in yields)
+
+
+def _teardown_statements(func):
+    """Post-yield statements of a generator contextmanager/fixture."""
+    first = _first_yield_line(func)
+    if first is None:
+        return []
+    return [n for n in ast.walk(func)
+            if isinstance(n, ast.stmt) and n.lineno > first]
+
+
+def _following_try_restores(node, domain, key, env):
+    """The canonical idiom puts the mutation IMMEDIATELY BEFORE the try::
+
+        os.environ[k] = v        # possibly under an `if`
+        try:
+            ...
+        finally:
+            del os.environ[k]
+
+    Accept it: walking out from the mutation, a later sibling Try at ANY
+    statement level (up to the enclosing function) whose finalbody
+    restores the domain/key protects the mutation."""
+    cur = node
+    while cur is not None:
+        p = parent(cur)
+        if p is None or isinstance(cur, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+            return False
+        if isinstance(cur, ast.stmt):
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(p, field, None)
+                if not body or cur not in body:
+                    continue
+                for later in body[body.index(cur) + 1:]:
+                    if isinstance(later, ast.Try) and any(
+                            _restores(s, domain, key, env)
+                            for s in later.finalbody):
+                        return True
+        cur = p
+    return False
+
+
+def _enclosing_tries_with_region(node):
+    """[(Try, in_finalbody)] innermost-first for every Try on the parent
+    chain, recording whether ``node`` sits in its protected region
+    (body/orelse) or its finalbody."""
+    out = []
+    cur, prev = parent(node), node
+    while cur is not None:
+        if isinstance(cur, ast.Try):
+            in_final = any(s is prev or _contains(s, prev)
+                           for s in cur.finalbody)
+            in_region = any(s is prev or _contains(s, prev)
+                            for s in list(cur.body) + list(cur.orelse))
+            if in_final or in_region:
+                out.append((cur, in_final))
+        prev, cur = cur, parent(cur)
+    return out
+
+
+_SCOPED_DECORATORS = ("contextmanager", "asynccontextmanager", "fixture")
+
+
+@register
+class GlobalStateLeakRule(Rule):
+    code = "PTA007"
+    title = "global-state-leak"
+    rationale = ("process-global mutations (set_interpret, os.environ, "
+                 "jax.config, plan cache) without a restoring try/finally "
+                 "or contextmanager poison later tests in the same "
+                 "process (the PR-10 _serve_dryrun leak class)")
+    scope = ("paddle_tpu/", "tests/", "examples/", "benchmarks/",
+             "bench.py", "__graft_entry__.py")
+    exclude = ("tests/analysis_fixtures/", "paddle_tpu/ops/_common.py",
+               "paddle_tpu/analysis/")
+
+    def check_module(self, module):
+        envs = {}  # per-function ConstEnv cache
+        for node in module.nodes:
+            if not isinstance(node, (ast.Call, ast.Assign, ast.Delete)):
+                continue
+            if _mutation_of(node, None) is None:
+                continue  # env only refines the key, never mutator-ness
+            func = enclosing_function(node)
+            env = envs.get(id(func))
+            if env is None:
+                env = envs[id(func)] = ConstEnv(module.tree, func)
+            m = _mutation_of(node, env)
+            if m is None:
+                continue
+            yield from self._check_mutation(module, node, func, env, m)
+
+    def _check_mutation(self, module, node, func, env, m):
+        domain, key, desc = m
+
+        if func is None:
+            # module scope: only test modules leak across the session
+            if module.rel.startswith("tests/"):
+                yield self.finding(
+                    module, node,
+                    f"module-scope {desc} in a test module mutates "
+                    f"process-global state for every later test; move it "
+                    f"into a fixture that restores it")
+            return
+
+        tries = _enclosing_tries_with_region(node)
+        decs = decorator_names(func) & set(_SCOPED_DECORATORS)
+        first_yield = _first_yield_line(func) if decs else None
+        in_teardown = any(in_final for _, in_final in tries) or (
+            first_yield is not None and node.lineno > first_yield)
+
+        if in_teardown:
+            # the PR-10 shape: teardown restoring a hard-coded override
+            if domain == "interpret" and isinstance(node, ast.Call) and \
+                    node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, bool):
+                yield self.finding(
+                    module, node,
+                    f"teardown hard-codes set_interpret("
+                    f"{node.args[0].value}) — restoring a literal instead "
+                    f"of the saved previous value clobbers any outer "
+                    f"override (the PR-10 _serve_dryrun leak); use "
+                    f"`with _common.interpret_mode(...)` or restore the "
+                    f"saved value")
+            return  # otherwise: it IS the restore
+
+        for t, in_final in tries:
+            if in_final:
+                continue
+            if any(_restores(s, domain, key, env) for s in t.finalbody):
+                return  # protected by this try/finally
+        if _following_try_restores(node, domain, key, env):
+            return  # set-then-try/finally-restore idiom
+
+        if first_yield is not None and node.lineno <= first_yield:
+            if any(_restores(s, domain, key, env)
+                   for s in _teardown_statements(func)):
+                return  # contextmanager/fixture with post-yield restore
+
+        yield self.finding(
+            module, node,
+            f"{desc} mutates process-global state with no restoring "
+            f"try/finally or contextmanager in sight; wrap it (e.g. "
+            f"`with _common.interpret_mode(...)`) or restore the saved "
+            f"previous value in a finally")

@@ -1,0 +1,351 @@
+"""PTA008: SPMD collective / mesh consistency inside shard_map islands.
+
+The bug class: the hand-written shard_map islands (collective_matmul
+rings, moe a2a dispatch, the ragged ep ring, ulysses, ring attention,
+pipeline) thread axis names and ring permutations as plain Python values.
+A wrong axis name, a permutation that is not injective on the axis, or
+``axis_index`` arithmetic modded by a *different* axis's size all trace
+fine on one host and only explode (or silently mis-route) in the
+multichip dryrun.
+
+Three checks, all AST-only over the dataflow layer:
+
+  * **axis membership** — at a ``shard_map(...)`` site whose mesh axis
+    names are statically resolvable, every ``psum``/``ppermute``/
+    ``all_to_all``/``axis_index``/... axis name used by the (resolved)
+    body — one helper level deep, through ``functools.partial`` — must
+    be one of the mesh axes;
+  * **permutation audit** — a statically-known ``ppermute`` perm must be
+    injective and in-range: literal pair lists need distinct sources and
+    distinct destinations; comprehension perms
+    ``[(i, f(i)) for i in range(B)]`` are checked symbolically —
+    ``(i + h) % m`` must mod by the same symbol as the range bound
+    (``m == B``), and un-modded ``i + d`` needs ``B <= axis - d`` (the
+    pipeline's partial shift ``range(S - 1)`` with ``i + 1`` is valid;
+    ``range(S)`` with ``i + 1`` overflows the last source);
+  * **axis arithmetic** — ``(... axis_index(a) ...) % axis_size(b)``
+    with ``a != b`` mixes two axes' coordinate systems.
+
+Sites whose mesh/specs/perms are not statically resolvable are skipped,
+never guessed. ``finalize`` enforces a coverage floor: each of the six
+island families (collective_matmul, moe, ragged, ulysses, ring,
+pipeline) must contribute at least one audited collective site, so the
+rule cannot silently rot as modules move.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from .. import Rule, register
+from .._astutil import (ConstEnv, FunctionIndex, affine_of, call_ident,
+                        enclosing_function, iter_calls, keyword,
+                        resolve_callable, resolve_local_call)
+
+# collectives taking an axis name, with the positional index it rides at
+_AXIS_ARG_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "ppermute": 1, "all_to_all": 1, "all_gather": 1,
+    "axis_index": 0, "axis_size": 0, "_axis_size": 0,
+}
+
+# the six island families the coverage floor requires (substring of rel)
+_FAMILIES = ("collective_matmul", "moe", "ragged", "ulysses", "ring",
+             "pipeline")
+
+
+def _axis_arg(call: ast.Call) -> Optional[ast.AST]:
+    ident = call_ident(call)
+    pos = _AXIS_ARG_POS.get(ident)
+    if pos is None:
+        return None
+    kw = keyword(call, "axis_name")
+    if kw is not None:
+        return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _axis_sym(node: ast.AST, env: ConstEnv) -> str:
+    s = env.resolve_str(node)
+    if s is not None:
+        return "str:" + s
+    return "dump:" + ast.dump(env.resolve_node(node))
+
+
+def _perm_arg(call: ast.Call) -> Optional[ast.AST]:
+    kw = keyword(call, "perm")
+    if kw is not None:
+        return kw.value
+    if len(call.args) > 2:
+        return call.args[2]
+    return None
+
+
+def _mesh_axes(mesh_node: ast.AST, env: ConstEnv) -> Optional[Set[str]]:
+    """Statically-known axis-name set of a Mesh(...) expression, chased
+    through straight-line assignments; None when unresolvable."""
+    mesh = env.resolve_node(mesh_node)
+    if not (isinstance(mesh, ast.Call) and call_ident(mesh) == "Mesh"):
+        return None
+    names = keyword(mesh, "axis_names")
+    ax = names.value if names is not None else (
+        mesh.args[1] if len(mesh.args) > 1 else None)
+    if ax is None:
+        return None
+    ax = env.resolve_node(ax)
+    if not isinstance(ax, (ast.Tuple, ast.List)):
+        return None
+    out = set()
+    for elt in ax.elts:
+        s = env.resolve_str(elt)
+        if s is None:
+            return None
+        out.add(s)
+    return out
+
+
+@register
+class CollectiveMeshRule(Rule):
+    code = "PTA008"
+    title = "collective-mesh"
+    rationale = ("wrong axis names, non-injective ppermute perms and "
+                 "axis_index arithmetic modded by the wrong axis trace "
+                 "fine single-host and only explode in the multichip "
+                 "dryrun")
+    scope = ("paddle_tpu/parallel/", "paddle_tpu/distributed/",
+             "paddle_tpu/models/")
+
+    def __init__(self, root):
+        super().__init__(root)
+        self._audited_rels: Set[str] = set()
+
+    def check_module(self, module):
+        index = FunctionIndex(module.tree)
+        audited = False
+        for call in module.calls:
+            ident = call_ident(call)
+            if ident == "shard_map":
+                yield from self._check_island(module, call, index)
+                audited = True
+            elif ident == "ppermute":
+                func = enclosing_function(call)
+                env = ConstEnv(module.tree, func)
+                yield from self._check_perm(module, call, env)
+                audited = True
+            elif ident in _AXIS_ARG_POS:
+                audited = True
+        yield from self._check_axis_arithmetic(module)
+        if audited:
+            self._audited_rels.add(module.rel)
+
+    # --- axis membership at shard_map sites --------------------------------
+
+    def _check_island(self, module, call, index):
+        func = enclosing_function(call)
+        env = ConstEnv(module.tree, func)
+        mesh_kw = keyword(call, "mesh")
+        mesh_node = mesh_kw.value if mesh_kw is not None else (
+            call.args[1] if len(call.args) > 1 else None)
+        if mesh_node is None:
+            return
+        axes = _mesh_axes(mesh_node, env)
+        if axes is None:
+            return  # mesh threaded from a caller: cannot audit statically
+        if not call.args:
+            return
+        resolved = resolve_callable(call.args[0], index, env)
+        if resolved is None:
+            return
+        body, binding = resolved
+        yield from self._check_body_axes(module, body, binding, axes,
+                                         index, depth=2)
+
+    def _check_body_axes(self, module, body, binding, axes, index, depth):
+        env = ConstEnv(module.tree, body if not isinstance(
+            body, ast.Lambda) else None, bindings=binding)
+        for call in iter_calls(body):
+            ident = call_ident(call)
+            if ident in _AXIS_ARG_POS:
+                arg = _axis_arg(call)
+                if arg is None:
+                    continue
+                name = env.resolve_str(arg)
+                if name is not None and name not in axes:
+                    yield self.finding(
+                        module, call,
+                        f"{ident}() over axis {name!r} inside a shard_map "
+                        f"island whose mesh axes are "
+                        f"{sorted(axes)}; the collective would fail (or "
+                        f"bind an outer mesh) at run time")
+            elif depth > 1:
+                resolved = resolve_local_call(call, index, env)
+                if resolved is not None:
+                    helper, hbinding = resolved
+                    yield from self._check_body_axes(
+                        module, helper, hbinding, axes, index, depth - 1)
+
+    # --- ppermute permutation audit -----------------------------------------
+
+    def _check_perm(self, module, call, env):
+        perm = _perm_arg(call)
+        if perm is None:
+            return
+        perm = env.resolve_node(perm)
+        if isinstance(perm, (ast.List, ast.Tuple)):
+            yield from self._check_literal_perm(module, call, perm, env)
+        elif isinstance(perm, ast.ListComp):
+            yield from self._check_comp_perm(module, call, perm, env)
+        # anything else (caller-threaded perm): skip, never guess
+
+    def _check_literal_perm(self, module, call, perm, env):
+        srcs, dsts = [], []
+        for elt in perm.elts:
+            if not (isinstance(elt, (ast.Tuple, ast.List))
+                    and len(elt.elts) == 2):
+                return
+            s = env.resolve(elt.elts[0])
+            d = env.resolve(elt.elts[1])
+            if s is None or d is None:
+                return
+            srcs.append(s)
+            dsts.append(d)
+        if len(set(srcs)) != len(srcs):
+            yield self.finding(
+                module, call,
+                f"ppermute perm has duplicate sources {sorted(srcs)}: a "
+                f"device cannot send twice in one permute")
+        if len(set(dsts)) != len(dsts):
+            yield self.finding(
+                module, call,
+                f"ppermute perm has duplicate destinations {sorted(dsts)}: "
+                f"two devices write the same receive buffer")
+        bad = [v for v in srcs + dsts if v < 0]
+        if bad:
+            yield self.finding(
+                module, call,
+                f"ppermute perm contains negative device ids {bad}")
+
+    def _check_comp_perm(self, module, call, perm, env):
+        if len(perm.generators) != 1:
+            return
+        gen = perm.generators[0]
+        if not isinstance(gen.target, ast.Name) or gen.ifs:
+            return
+        var = gen.target.id
+        rng = env.resolve_node(gen.iter)
+        if not (isinstance(rng, ast.Call) and call_ident(rng) == "range"
+                and len(rng.args) == 1):
+            return
+        bound = affine_of(rng.args[0], env)
+        elt = perm.elt
+        if not (isinstance(elt, (ast.Tuple, ast.List))
+                and len(elt.elts) == 2):
+            return
+        src, dst = elt.elts
+        if not (isinstance(src, ast.Name) and src.id == var):
+            return  # only the (i, f(i)) shape is audited
+        dst = env.resolve_node(dst)
+        if isinstance(dst, ast.BinOp) and isinstance(dst.op, ast.Mod):
+            mod = affine_of(dst.right, env)
+            if bound is not None and mod is not None and bound != mod:
+                yield self.finding(
+                    module, call,
+                    f"ppermute perm ranges over "
+                    f"{ast.unparse(rng.args[0])} but mods destinations by "
+                    f"{ast.unparse(dst.right)} — a different axis size "
+                    f"makes the perm non-injective (or wraps onto the "
+                    f"wrong ring)")
+            return
+        shift = affine_of(dst, env)
+        if shift is None or bound is None:
+            return
+        sym, d = shift
+        # dst must still be an affine function of the loop var
+        if sym is None or var not in {n.id for n in ast.walk(dst)
+                                      if isinstance(n, ast.Name)}:
+            return
+        b_sym, b_off = bound
+        if b_sym is None:
+            return  # constant bound: literal-perm territory
+        # i in [0, B-1], dst = i + d un-modded: max dst = B - 1 + d must
+        # stay below the axis size; with B = sym + b_off that needs
+        # b_off <= -d (range(n - d) with shift d), else the top sources
+        # send out of range.
+        if d > 0 and b_off > -d:
+            yield self.finding(
+                module, call,
+                f"un-modded ppermute shift (i + {d}) over "
+                f"range({ast.unparse(rng.args[0])}): the last "
+                f"{d + b_off} source(s) send past the end of the axis; "
+                f"mod by the axis size or shorten the range to "
+                f"range(<axis> - {d})")
+        if d < 0 and b_off >= 0:
+            yield self.finding(
+                module, call,
+                f"un-modded negative ppermute shift (i - {-d}): source 0 "
+                f"sends to a negative device id; mod by the axis size")
+
+    # --- axis_index arithmetic mod the wrong axis ---------------------------
+
+    def _check_axis_arithmetic(self, module):
+        envs: Dict[int, ConstEnv] = {}
+        for node in module.nodes:
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mod)):
+                continue
+            func = enclosing_function(node)
+            env = envs.get(id(func))
+            if env is None:
+                env = envs[id(func)] = ConstEnv(module.tree, func)
+            idx_axis = self._axis_of_call(node.left, env, "axis_index")
+            if idx_axis is None:
+                continue
+            size_axis = self._axis_of_call(node.right, env,
+                                           "axis_size", "_axis_size")
+            if size_axis is None:
+                continue
+            if idx_axis != size_axis:
+                yield Rule.finding(
+                    self, module, node,
+                    f"axis_index over one axis is modded by the size of "
+                    f"a DIFFERENT axis ({idx_axis.split(':', 1)[1]!r} vs "
+                    f"{size_axis.split(':', 1)[1]!r}); the coordinate "
+                    f"wraps onto the wrong ring")
+
+    @staticmethod
+    def _axis_of_call(node, env, *idents):
+        """Axis symbol of the single axis_index/axis_size call reachable
+        in ``node`` (directly or through one straight-line assignment);
+        None when absent or ambiguous."""
+        node = env.resolve_node(node)
+        hits = []
+        for call in iter_calls(node):
+            if call_ident(call) in idents:
+                arg = _axis_arg(call)
+                if arg is not None:
+                    hits.append(_axis_sym(arg, env))
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                r = env.resolve_node(n)
+                if r is not n:
+                    for call in iter_calls(r):
+                        if call_ident(call) in idents:
+                            arg = _axis_arg(call)
+                            if arg is not None:
+                                hits.append(_axis_sym(arg, env))
+        hits = sorted(set(hits))
+        return hits[0] if len(hits) == 1 else None
+
+    # --- coverage floor -----------------------------------------------------
+
+    def finalize(self):
+        from .. import Finding
+        for fam in sorted(_FAMILIES):
+            if not any(fam in rel for rel in self._audited_rels):
+                yield Finding(
+                    self.code, "paddle_tpu/parallel/", 0, 0,
+                    f"coverage floor: no audited collective site found "
+                    f"for the {fam!r} island family — did the module "
+                    f"move?")

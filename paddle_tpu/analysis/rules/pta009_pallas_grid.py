@@ -1,0 +1,218 @@
+"""PTA009: Pallas grid / BlockSpec / scratch audit.
+
+The bug class: a ``pallas_call`` whose ``index_map`` arity disagrees
+with the grid rank, whose block shape does not divide the (unguarded)
+operand shape, or whose accumulation scratch is bf16 traces fine in
+interpret mode and only fails — or silently loses precision — when
+Mosaic lowers it on hardware. With ~20 ``pallas_call`` sites across six
+kernel files, eyeballing each edit stopped scaling around PR 7.
+
+Checks per site (constant-folded through the dataflow layer; anything
+unresolvable is skipped, never guessed):
+
+  * **index_map arity** — every ``BlockSpec`` index_map must take
+    ``grid_rank`` arguments, plus ``num_scalar_prefetch`` when the site
+    rides a ``PrefetchScalarGridSpec`` (the scalar refs are appended to
+    the index_map signature);
+  * **divisibility** — when both an ``out_shape`` dim and the matching
+    ``out_specs`` block dim are statically known, the block must divide
+    the dim (a non-dividing tail needs an explicit guard/fitter, not
+    silence);
+  * **scratch dtype** — ``pltpu.VMEM`` scratch declared bf16/f16 is
+    flagged: accumulators must be f32 (the kernels here all accumulate
+    in f32 and cast on the way out; a half-precision accumulator loses
+    the summation tail exactly when S gets long).
+
+``finalize`` enforces a coverage floor: at least ``MIN_SITES`` audited
+``pallas_call`` sites across ops/ — if kernels move out from under the
+rule's scope, the floor trips instead of the audit silently shrinking.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .. import Rule, register
+from .._astutil import (ConstEnv, FunctionIndex, call_ident,
+                        enclosing_function, iter_calls, keyword,
+                        resolve_callable, resolve_dtype_name)
+
+# every ops/ kernel file carries multiple sites; the floor trips when the
+# audit sees meaningfully fewer than the ~20 sites in tree today
+MIN_SITES = 18
+
+_HALF_DTYPES = ("bfloat16", "float16")
+
+
+def _grid_parts(call: ast.Call, env: ConstEnv):
+    """(grid_node, n_prefetch, spec_containers) for a pallas_call: the
+    grid expression, the scalar-prefetch count, and the calls whose
+    in_specs/out_specs hold this site's BlockSpecs (the pallas_call
+    itself and/or its grid_spec)."""
+    containers = [call]
+    grid_node = None
+    n_prefetch = 0
+    kw = keyword(call, "grid")
+    if kw is not None:
+        grid_node = kw.value
+    gs = keyword(call, "grid_spec")
+    if gs is not None and isinstance(gs.value, ast.Call):
+        containers.append(gs.value)
+        gkw = keyword(gs.value, "grid")
+        if gkw is not None:
+            grid_node = gkw.value
+        pkw = keyword(gs.value, "num_scalar_prefetch")
+        if pkw is not None:
+            n_prefetch = env.resolve(pkw.value) or 0
+    return grid_node, n_prefetch, containers
+
+
+def _grid_rank(grid_node: Optional[ast.AST],
+               env: ConstEnv) -> Optional[int]:
+    if grid_node is None:
+        return None
+    node = env.resolve_node(grid_node)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return len(node.elts)
+    if env.resolve(node) is not None:
+        return 1  # scalar grid
+    return None
+
+
+def _index_map_arity(spec: ast.Call, index: FunctionIndex,
+                     env: ConstEnv) -> Optional[int]:
+    node = keyword(spec, "index_map")
+    node = node.value if node is not None else (
+        spec.args[1] if len(spec.args) > 1 else None)
+    if node is None:
+        return None
+    resolved = resolve_callable(node, index, env)
+    if resolved is None:
+        return None
+    fn, _ = resolved
+    args = fn.args
+    return len(args.args)
+
+
+@register
+class PallasGridRule(Rule):
+    code = "PTA009"
+    title = "pallas-grid"
+    rationale = ("index_map arity / block divisibility / scratch dtype "
+                 "mistakes trace fine in interpret mode and only fail "
+                 "(or lose precision) when Mosaic lowers on hardware")
+    scope = ("paddle_tpu/ops/", "paddle_tpu/parallel/")
+
+    def __init__(self, root):
+        super().__init__(root)
+        self._sites = 0
+
+    def check_module(self, module):
+        index = FunctionIndex(module.tree)
+        for call in module.calls:
+            if call_ident(call) != "pallas_call":
+                continue
+            self._sites += 1
+            func = enclosing_function(call)
+            env = ConstEnv(module.tree, func)
+            grid_node, n_prefetch, containers = _grid_parts(call, env)
+            rank = _grid_rank(grid_node, env)
+
+            for container in containers:
+                for key in ("in_specs", "out_specs"):
+                    kw = keyword(container, key)
+                    if kw is None:
+                        continue
+                    for spec in iter_calls(kw.value):
+                        if call_ident(spec) != "BlockSpec":
+                            continue
+                        yield from self._check_spec(
+                            module, spec, rank, n_prefetch, index, env)
+            yield from self._check_divisibility(module, call, containers,
+                                                env)
+            yield from self._check_scratch(module, call, env)
+
+    def _check_spec(self, module, spec, rank, n_prefetch, index, env):
+        if rank is None:
+            return
+        arity = _index_map_arity(spec, index, env)
+        if arity is None:
+            return
+        want = rank + n_prefetch
+        if arity != want:
+            yield self.finding(
+                module, spec,
+                f"BlockSpec index_map takes {arity} argument(s) but the "
+                f"grid has rank {rank}"
+                + (f" plus {n_prefetch} scalar-prefetch ref(s)"
+                   if n_prefetch else "")
+                + f" — expected {want}; Mosaic rejects (or worse, "
+                  f"misindexes) the mismatch on hardware")
+
+    def _check_divisibility(self, module, call, containers, env):
+        """Block dims must divide the out_shape dims when both resolve."""
+        shape_kw = keyword(call, "out_shape")
+        if shape_kw is None:
+            return
+        shapes = [c for c in iter_calls(shape_kw.value)
+                  if call_ident(c) == "ShapeDtypeStruct"]
+        if len(shapes) != 1 or not shapes[0].args:
+            return  # multi-output or non-literal: skip
+        dims_node = env.resolve_node(shapes[0].args[0])
+        if not isinstance(dims_node, (ast.Tuple, ast.List)):
+            return
+        dims = [env.resolve(e) for e in dims_node.elts]
+        for container in containers:
+            kw = keyword(container, "out_specs")
+            if kw is None:
+                continue
+            specs = [c for c in iter_calls(kw.value)
+                     if call_ident(c) == "BlockSpec"]
+            if len(specs) != 1 or not specs[0].args:
+                continue
+            block_node = env.resolve_node(specs[0].args[0])
+            if not isinstance(block_node, (ast.Tuple, ast.List)):
+                continue
+            blocks = [env.resolve(e) for e in block_node.elts]
+            if len(blocks) != len(dims):
+                continue  # rank change via index_map: out of audit reach
+            for axis, (dim, blk) in enumerate(zip(dims, blocks)):
+                if dim is None or blk is None or not blk:
+                    continue
+                if int(dim) % int(blk):
+                    yield self.finding(
+                        module, specs[0],
+                        f"out block dim {int(blk)} does not divide "
+                        f"out_shape dim {int(dim)} (axis {axis}); the "
+                        f"tail tile reads/writes out of bounds unless "
+                        f"explicitly guarded — pad the shape or route "
+                        f"sizing through a fitter")
+
+    def _check_scratch(self, module, call, env):
+        kw = keyword(call, "scratch_shapes")
+        if kw is None:
+            gs = keyword(call, "grid_spec")
+            if gs is not None and isinstance(gs.value, ast.Call):
+                kw = keyword(gs.value, "scratch_shapes")
+        if kw is None:
+            return
+        for spec in iter_calls(kw.value):
+            if call_ident(spec) != "VMEM" or len(spec.args) < 2:
+                continue
+            dtype = resolve_dtype_name(spec.args[1], env)
+            if dtype in _HALF_DTYPES:
+                yield self.finding(
+                    module, spec,
+                    f"VMEM scratch declared {dtype}: accumulation "
+                    f"scratch must be f32 (accumulate in f32, cast on "
+                    f"the way out) — a half-precision accumulator drops "
+                    f"the summation tail at long S")
+
+    def finalize(self):
+        from .. import Finding
+        if self._sites < MIN_SITES:
+            yield Finding(
+                self.code, "paddle_tpu/ops/", 0, 0,
+                f"coverage floor: only {self._sites} pallas_call site(s) "
+                f"audited (< {MIN_SITES}) — did kernels move out of the "
+                f"rule's scope?")

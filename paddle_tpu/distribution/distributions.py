@@ -622,7 +622,13 @@ class Multinomial(Distribution):
         for i in range(k - 1):
             pi = jnp.broadcast_to(pn[..., i], full)
             cond = jnp.clip(pi / jnp.clip(tail, 1e-12), 0.0, 1.0)
-            ci = jax.random.binomial(self._key(), remaining, cond, shape=full)
+            # f64 args: jax's binomial internals clamp with weak float
+            # literals (f64 under the package-global x64), so f32 args trip
+            # lax.clamp's same-dtype check
+            ci = jax.random.binomial(self._key(),
+                                     remaining.astype(jnp.float64),
+                                     cond.astype(jnp.float64),
+                                     shape=full).astype(jnp.float32)
             counts.append(ci)
             remaining = remaining - ci
             tail = tail - pi
@@ -666,9 +672,10 @@ class Binomial(Distribution):
         full = self._extended_shape(shape)
         n = unwrap(self.total_count)
         p = unwrap(self.probs_param)
-        data = jax.random.binomial(key, jnp.broadcast_to(n, full),
-                                   jnp.broadcast_to(p, full), shape=full)
-        return Tensor._from_data(data)
+        data = jax.random.binomial(
+            key, jnp.broadcast_to(n, full).astype(jnp.float64),
+            jnp.broadcast_to(p, full).astype(jnp.float64), shape=full)
+        return Tensor._from_data(data.astype(jnp.float32))
 
     def log_prob(self, value):
         def f(n, p, v):

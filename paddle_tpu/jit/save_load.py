@@ -17,6 +17,7 @@ import os
 from typing import List, Optional, Sequence
 
 import jax
+import jax.export  # jax>=0.4.34 no longer re-exports it as a jax attribute
 import jax.numpy as jnp
 import numpy as np
 

@@ -37,10 +37,46 @@ def cost_estimate(flops, transcendentals=0, bytes_accessed=0):
                            bytes_accessed=max(int(bytes_accessed), 0))
 
 
-def interpret_mode() -> bool:
-    """Pallas kernels must run interpreted off-TPU. The axon TPU plugin stays
-    the default backend even when work is pinned to host CPU devices (tests,
-    dryruns), so honor jax_default_device first."""
+class _InterpretOverride:
+    """Context manager that forces interpret mode for one block and
+    restores the PREVIOUS override (not a hard-coded value) on exit —
+    the restore discipline PTA007 enforces. Reentrant-safe: nesting
+    saves/restores like a stack."""
+
+    def __init__(self, value):
+        self._value = value
+        self._prev = None
+
+    def __enter__(self):
+        global _FORCE_INTERPRET
+        self._prev = _FORCE_INTERPRET
+        _FORCE_INTERPRET = self._value
+        return self._value
+
+    def __exit__(self, *exc):
+        global _FORCE_INTERPRET
+        _FORCE_INTERPRET = self._prev
+        return False
+
+
+_UNSET = object()
+
+
+def interpret_mode(value=_UNSET):
+    """Dual-purpose.
+
+    ``interpret_mode()`` (no args) — predicate: Pallas kernels must run
+    interpreted off-TPU. The axon TPU plugin stays the default backend
+    even when work is pinned to host CPU devices (tests, dryruns), so
+    honor jax_default_device first.
+
+    ``with interpret_mode(True):`` — scoped override of the predicate
+    that saves and restores the previous override, replacing bare
+    ``set_interpret(True)`` / ``set_interpret(False)`` pairs (the PR-10
+    leak class: teardown that hard-codes ``False`` clobbers any outer
+    override and poisons later tests in the same process)."""
+    if value is not _UNSET:
+        return _InterpretOverride(value)
     if _FORCE_INTERPRET is not None:
         return _FORCE_INTERPRET
     dd = jax.config.jax_default_device

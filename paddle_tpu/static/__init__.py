@@ -12,6 +12,7 @@ import json
 import os
 
 import jax
+import jax.export  # jax>=0.4.34 no longer re-exports it as a jax attribute
 import jax.numpy as jnp
 import numpy as np
 

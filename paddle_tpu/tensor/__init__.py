@@ -362,8 +362,11 @@ def binomial(count, prob, name=None):
     import jax.numpy as jnp
 
     def f(c, p):
-        # f64 counts: float32 would silently round trial counts > 2^24
+        # f64 counts: float32 would silently round trial counts > 2^24.
+        # f64 prob too: jax's binomial tail path clamps with weak float
+        # literals, which are f64 under the package-global x64 and must
+        # match the prob dtype.
         return _jax.random.binomial(_fill_key(0), c.astype(jnp.float64),
-                                    p.astype(jnp.float32)).astype(jnp.int64)
+                                    p.astype(jnp.float64)).astype(jnp.int64)
 
     return _run_op("binomial", f, (count, prob), {})

@@ -1,0 +1,27 @@
+"""PTA001 interprocedural fixture: the false-negative class the v1
+single-sink engine provably missed. The bare ``0.0`` never touches a
+``where()`` in this scope — it is bound to ``_mask_scores``' ``fill``
+parameter, which lands in the where() branch one call away. v1 saw only
+the helper body (clean: ``fill`` is a Name, not a literal) and the call
+site (clean: no sink, and 0.0 is far below the big-float net); the
+dataflow layer binds the two."""
+import jax.numpy as jnp
+
+
+def _mask_scores(s, mask, fill):
+    return jnp.where(mask, s, fill)
+
+
+def zero_dead_rows(s, mask):
+    # v1-invisible: small literal, sink one call away
+    return _mask_scores(s, mask, 0.0)
+
+
+def mask_logits(s, mask):
+    # kw binding reaches the same sink
+    return _mask_scores(s, mask, fill=-1e30)
+
+
+def attend_wrapped(s, mask):
+    # strongly-typed at the call site: NOT flagged
+    return _mask_scores(s, mask, jnp.float32(-1e30))

@@ -1,0 +1,88 @@
+"""PTA007 positive fixture.
+
+``_serve_dryrun`` below reproduces, byte for byte, the PR-10 leak this
+rule was built from: the ``finally`` restores a HARD-CODED
+``set_interpret(False)`` instead of the saved previous value, clobbering
+any outer interpret override and poisoning ~20 order-dependent tier-1
+tests that planned on tracing Pallas kernels on CPU afterwards.
+
+The other functions are the satellite leak shapes: bare mutations with
+no restoring try/finally, a fixture that mutates before ``yield`` but
+never restores after it, and a module-scope mutation in a test module.
+"""
+import os
+
+import numpy as np
+
+import jax
+
+from paddle_tpu.ops import _common
+
+os.environ["PADDLE_TPU_FIXTURE_LEAK"] = "1"  # module scope, leaks all session
+
+
+def _serve_dryrun():
+    """Continuous-batching serving engine driven end to end on the host
+    (pallas interpret): paged KV pool, chunked prefill interleaved with
+    bucketed decode batches, deterministic arrival trace. Proves the
+    serving hot path — paged_attend_update + block-table scheduling —
+    compiles and runs in the dryrun environment."""
+    import traceback
+
+    from paddle_tpu.ops import _common
+    try:
+        from paddle_tpu.inference import (InferenceEngine, Request,
+                                          ServeConfig)
+        from paddle_tpu.models.llama import init_llama_params, llama_tiny
+        _common.set_interpret(True)
+        try:
+            cfg = llama_tiny(vocab=96, hidden=64, layers=1, heads=4,
+                             kv_heads=2, seq=256)
+            params = init_llama_params(cfg, seed=3)
+            serve = ServeConfig(block_size=128, num_blocks=8, max_batch=2,
+                                prefill_chunk=32, max_seq_len=256)
+            eng = InferenceEngine(params, cfg, serve)
+            rng = np.random.RandomState(0)
+            reqs = [Request(rng.randint(1, 96, size=n).tolist(),
+                            max_new_tokens=4, arrival=float(i))
+                    for i, n in enumerate((7, 40, 130))]
+            st = eng.run(reqs, deterministic=True)
+            assert st["requests"] == 3, st
+            assert eng.pool.used_blocks == 0, "block leak"
+            print(f"serve_dryrun: requests={st['requests']} "
+                  f"tokens={st['generated_tokens']} "
+                  f"iterations={st['iterations']} "
+                  f"compiled_shapes={len(st['compiles'])} "
+                  f"preemptions={st['preemptions']} leak_free=True OK")
+        finally:
+            _common.set_interpret(False)
+    except Exception:
+        traceback.print_exc()
+        print("serve_dryrun: FAILED (see traceback above)")
+
+
+def test_bare_interpret_toggle():
+    _common.set_interpret(True)  # never restored
+    assert _common.interpret_mode()
+
+
+def test_env_knob_leak():
+    os.environ["PADDLE_TPU_MOE_OVERLAP"] = "1"  # never deleted
+    os.environ.pop("PADDLE_TPU_MIN_NBYTES", None)  # never put back
+
+
+def test_config_leak():
+    jax.config.update("jax_numpy_rank_promotion", "warn")  # never restored
+
+
+def _fixture_without_teardown():
+    # shaped like a pytest fixture body: mutate, yield, never restore
+    import pytest
+
+    @pytest.fixture()
+    def _interp():
+        _common.set_interpret(True)
+        yield
+        print("forgot to restore")
+
+    return _interp

@@ -1,0 +1,71 @@
+"""PTA007 negative fixture: every process-global mutation here rides a
+restoring scope — the ``interpret_mode`` contextmanager, the
+set-then-try/finally idiom (including under an ``if``), a saved-value
+restore in teardown position, and generator fixtures/contextmanagers
+that put the state back after ``yield``."""
+import contextlib
+import os
+
+import jax
+
+import pytest
+
+from paddle_tpu.ops import _common
+
+
+def test_with_contextmanager():
+    with _common.interpret_mode(True):
+        assert _common.interpret_mode()
+
+
+def test_saved_value_restore():
+    prev = _common._FORCE_INTERPRET
+    _common.set_interpret(True)
+    try:
+        assert _common.interpret_mode()
+    finally:
+        _common.set_interpret(prev)  # restores the SAVED value, not a literal
+
+
+def test_env_set_then_try(overlap=True):
+    if overlap:
+        os.environ["PADDLE_TPU_MOE_OVERLAP"] = "1"
+    try:
+        assert os.environ.get("PADDLE_TPU_MOE_OVERLAP")
+    finally:
+        del os.environ["PADDLE_TPU_MOE_OVERLAP"]
+
+
+def test_env_pop_then_restore():
+    prev = os.environ.pop("PADDLE_TPU_MIN_NBYTES", None)
+    try:
+        assert "PADDLE_TPU_MIN_NBYTES" not in os.environ
+    finally:
+        if prev is not None:
+            os.environ["PADDLE_TPU_MIN_NBYTES"] = prev
+
+
+def test_config_try_finally():
+    prev = jax.config.jax_numpy_rank_promotion
+    jax.config.update("jax_numpy_rank_promotion", "warn")
+    try:
+        pass
+    finally:
+        jax.config.update("jax_numpy_rank_promotion", prev)
+
+
+@contextlib.contextmanager
+def scoped_interpret(value):
+    prev = _common._FORCE_INTERPRET
+    _common.set_interpret(value)
+    try:
+        yield
+    finally:
+        _common.set_interpret(prev)
+
+
+@pytest.fixture()
+def _env_knob():
+    os.environ["PADDLE_TPU_RAGGED_A2A"] = "1"
+    yield
+    os.environ.pop("PADDLE_TPU_RAGGED_A2A", None)

@@ -1,0 +1,57 @@
+"""PTA008 positive fixture: one of each collective/mesh inconsistency.
+
+Each shape traces fine on one host and only explodes (or silently
+mis-routes) in the multichip dryrun — exactly why the rule audits them
+statically."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _island_body(x):
+    # "tp" is not an axis of the island's ("dp", "mp") mesh
+    return jax.lax.psum(x, "tp")
+
+
+def _helper_one_hop(x):
+    # reached through one helper level from the island body
+    return x * jax.lax.axis_index("ep")
+
+
+def _outer_body(x):
+    return _helper_one_hop(x) + 1
+
+
+def build(devices):
+    mesh = Mesh(devices, ("dp", "mp"))
+    f = shard_map(_island_body, mesh, in_specs=P("dp"), out_specs=P("dp"))
+    g = shard_map(functools.partial(_outer_body), mesh,
+                  in_specs=P("dp"), out_specs=P("dp"))
+    return f, g
+
+
+def duplicate_destination(x):
+    # device 0 and device 1 both write receive buffer 1
+    return jax.lax.ppermute(x, "dp", [(0, 1), (1, 1)])
+
+
+def wrong_mod_axis_perm(x):
+    n = 8
+    m = 4
+    # ranges over n=8 devices but wraps destinations mod m=4
+    return jax.lax.ppermute(x, "dp", [(i, (i + 1) % m) for i in range(n)])
+
+
+def unmodded_overflow(x, axis_name):
+    n = jax.lax.psum(1, axis_name)
+    # range(n) with i+1 un-modded: the last source sends past the ring
+    return jax.lax.ppermute(x, axis_name,
+                            [(i, i + 1) for i in range(n)])
+
+
+def mixed_axis_coordinates():
+    # dp coordinate wrapped onto the mp ring
+    return jax.lax.axis_index("dp") % jax.lax.axis_size("mp")

@@ -1,0 +1,51 @@
+"""PTA008 negative fixture: the in-tree island idioms the rule must NOT
+flag — correct axis names one helper deep, the ring rotation modded by
+its own axis size, the pipeline's partial shift over ``range(S - 1)``,
+and same-axis coordinate arithmetic."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _shard_sum(x):
+    return jax.lax.psum(x, "dp")
+
+
+def _with_coord(x):
+    return x + jax.lax.axis_index("mp")
+
+
+def _body(x):
+    return _with_coord(_shard_sum(x))
+
+
+def build(devices):
+    mesh = Mesh(devices, ("dp", "mp"))
+    return shard_map(functools.partial(_body), mesh,
+                     in_specs=P("dp"), out_specs=P("dp"))
+
+
+def ring_rotate(x, axis_name):
+    n = jax.lax.psum(1, axis_name)
+    # the canonical ring: wraps mod the SAME symbol the range runs over
+    return jax.lax.ppermute(x, axis_name,
+                            [(i, (i + 1) % n) for i in range(n)])
+
+
+def pipeline_shift(x, axis_name):
+    s = jax.lax.psum(1, axis_name)
+    # partial shift: range(S - 1) keeps the last source silent, so the
+    # un-modded i + 1 never leaves the axis
+    return jax.lax.ppermute(x, axis_name,
+                            [(i, i + 1) for i in range(s - 1)])
+
+
+def literal_rotation(x):
+    return jax.lax.ppermute(x, "dp", [(0, 1), (1, 2), (2, 0)])
+
+
+def same_axis_coordinates():
+    return (jax.lax.axis_index("dp") + 1) % jax.lax.axis_size("dp")

@@ -1,0 +1,54 @@
+"""PTA009 positive fixture: one of each Pallas grid/BlockSpec/scratch
+mistake. All of them trace clean in interpret mode; Mosaic rejects (or
+silently mis-computes) them on hardware."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def arity_mismatch(x):
+    m, n = x.shape
+    bm, bn = 128, 128
+    return pl.pallas_call(
+        lambda ref, o: None,
+        grid=(m // bm, n // bn),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i: (i, 0))],  # 1 arg, rank 2
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((256, 256), jnp.float32),
+    )(x)
+
+
+def prefetch_arity_mismatch(x, starts):
+    return pl.pallas_call(
+        lambda s_ref, ref, o: None,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(8,),
+            # index_map must take the grid index PLUS the prefetch ref
+            in_specs=[pl.BlockSpec((128,), lambda i: (i,))],
+            out_specs=pl.BlockSpec((128,), lambda i, s: (i,)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((1024,), jnp.float32),
+    )(starts, x)
+
+
+def non_dividing_block(x):
+    return pl.pallas_call(
+        lambda ref, o: None,
+        grid=(4, 1),
+        in_specs=[pl.BlockSpec((32, 128), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((32, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((100, 128), jnp.float32),  # 100 % 32
+    )(x)
+
+
+def half_precision_accumulator(x):
+    return pl.pallas_call(
+        lambda ref, o, acc: None,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((128, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((1024, 128), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((128, 128), jnp.bfloat16)],  # must be f32
+    )(x)

@@ -1,0 +1,50 @@
+"""PTA009 negative fixture: well-formed Pallas sites — index_map arity
+matches grid rank (plus scalar-prefetch refs), blocks divide the output
+shape, and accumulation scratch is f32 (reached through an assignment
+chain, exercising the dtype propagation)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def matmul_site(x):
+    m, n = 256, 256
+    bm, bn = 128, 128
+    acc_dtype = jnp.float32
+    return pl.pallas_call(
+        lambda ref, o, acc: None,
+        grid=(m // bm, n // bn),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((bm, bn), acc_dtype)],
+    )(x)
+
+
+def _prefetch_index_map(i, starts):
+    return (starts[i],)
+
+
+def prefetch_site(x, starts):
+    return pl.pallas_call(
+        lambda s_ref, ref, o: None,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((128,), _prefetch_index_map)],
+            out_specs=pl.BlockSpec((128,), lambda i, s: (i,)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((1024,), jnp.float32),
+    )(starts, x)
+
+
+def caller_threaded_blocks(x, bm, bn):
+    # unresolvable block dims are skipped, never guessed
+    return pl.pallas_call(
+        lambda ref, o: None,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((512, 512), jnp.float32),
+    )(x)

@@ -8,7 +8,7 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()  # noqa: PTA007 -- session-lifetime: device count must precede backend creation
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -17,11 +17,11 @@ import pytest  # noqa: E402
 # The image's axon TPU plugin registers itself regardless of JAX_PLATFORMS;
 # pin eager dispatch and tensor placement to the 8 virtual CPU devices so
 # tests are deterministic, fp32-exact, and can build 8-way meshes.
-jax.config.update("jax_default_device", jax.devices("cpu")[0])
+jax.config.update("jax_default_device", jax.devices("cpu")[0])  # noqa: PTA007 -- session-lifetime device pin for every test
 
 # Persistent compile cache: repeat suite runs skip XLA compilation entirely.
-jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")  # noqa: PTA007 -- session-lifetime cache config
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)  # noqa: PTA007 -- session-lifetime cache config
 
 
 def pytest_configure(config):

@@ -11,8 +11,8 @@ import json
 import os
 import sys
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=4")
+os.environ.setdefault(  # noqa: PTA007 -- process-lifetime: worker subprocess startup config
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=4")
 
 import jax  # noqa: E402
 

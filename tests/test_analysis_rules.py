@@ -24,12 +24,14 @@ def _run_fixture(name, code, **kw):
 
 # (rule, expected minimum active findings in the positive fixture)
 POSITIVES = [("PTA001", 3), ("PTA002", 1), ("PTA003", 1),
-             ("PTA004", 1), ("PTA005", 3), ("PTA006", 5)]
+             ("PTA004", 1), ("PTA005", 3), ("PTA006", 5),
+             ("PTA007", 7), ("PTA008", 6), ("PTA009", 4)]
 
 
-def test_all_six_rules_registered():
+def test_all_nine_rules_registered():
     assert sorted(all_rules()) == ["PTA001", "PTA002", "PTA003",
-                                   "PTA004", "PTA005", "PTA006"]
+                                   "PTA004", "PTA005", "PTA006",
+                                   "PTA007", "PTA008", "PTA009"]
 
 
 @pytest.mark.parametrize("code,min_hits", POSITIVES)
@@ -127,6 +129,167 @@ def test_json_record_shape():
     assert rec["rules"]["PTA001"]["active"] == len(rep.active)
     assert all({"rule", "path", "line", "col", "message", "status",
                 "reason"} <= set(f) for f in rec["findings"])
+
+
+# -- PR-11 regression locks --------------------------------------------------
+
+def test_pta007_flags_the_serve_dryrun_leak():
+    """The exact PR-10 bug shape — ``finally: _common.set_interpret(False)``
+    in ``_serve_dryrun`` — must be caught at its line, while the paired
+    ``set_interpret(True)`` before the try stays protected."""
+    rep = _run_fixture("pta007_bad.py", "PTA007")
+    src = open(os.path.join(FIXTURES, "pta007_bad.py")).read()
+    lines = src.splitlines()
+    leak_line = next(i for i, l in enumerate(lines, 1)
+                     if l.strip() == "_common.set_interpret(False)")
+    setup_line = next(i for i, l in enumerate(lines, 1)
+                      if l.strip() == "_common.set_interpret(True)")
+    hit_lines = {f.line for f in rep.active}
+    assert leak_line in hit_lines, \
+        f"PR-10 leak at line {leak_line} not flagged ({hit_lines})"
+    assert any("teardown hard-codes set_interpret(False)" in f.message
+               for f in rep.active if f.line == leak_line)
+    assert setup_line not in hit_lines, \
+        "the protected set-then-try mutation must not be flagged"
+
+
+def test_pta001_through_helper_regression():
+    """The v1-invisible shape: a bare 0.0 bound to a helper parameter
+    that lands in the helper's where() branch. The finding must sit at
+    the CALL SITE, not inside the (clean) helper body."""
+    rep = _run_fixture("pta001_helper.py", "PTA001")
+    src = open(os.path.join(FIXTURES, "pta001_helper.py")).read()
+    lines = src.splitlines()
+    call_line = next(i for i, l in enumerate(lines, 1)
+                     if "_mask_scores(s, mask, 0.0)" in l)
+    helper_line = next(i for i, l in enumerate(lines, 1)
+                       if "jnp.where(mask, s, fill)" in l)
+    hit_lines = {f.line for f in rep.active}
+    assert call_line in hit_lines
+    assert helper_line not in hit_lines
+    assert any("bound to _mask_scores" in f.message for f in rep.active)
+    # the wrapped call site stays clean
+    wrapped = next(i for i, l in enumerate(lines, 1)
+                   if "jnp.float32(-1e30)" in l)
+    assert wrapped not in hit_lines
+
+
+# -- dataflow layer unit tests ----------------------------------------------
+
+def test_constenv_bindings_win_and_fold():
+    import ast as _ast
+    from paddle_tpu.analysis._astutil import ConstEnv
+    tree = _ast.parse("b = 4\n\ndef f(n):\n    m = n * b\n")
+    func = tree.body[1]
+    env = ConstEnv(tree, func,
+                   bindings={"n": _ast.Constant(value=8)})
+    assert env.resolve(_ast.parse("m", mode="eval").body) == 32
+
+
+def test_resolve_local_call_through_partial():
+    import ast as _ast
+    from paddle_tpu.analysis._astutil import (FunctionIndex, link_parents,
+                                              resolve_local_call)
+    tree = link_parents(_ast.parse(
+        "import functools\n"
+        "def body(axis, x):\n    return x\n"
+        "g = functools.partial(body, 'dp')\n"
+        "def use(y):\n    return g(y)\n"))
+    index = FunctionIndex(tree)
+    env_tree = tree
+    from paddle_tpu.analysis._astutil import ConstEnv
+    call = [n for n in _ast.walk(tree) if isinstance(n, _ast.Call)
+            and getattr(n.func, "id", None) == "g"][0]
+    target, binding = resolve_local_call(call, index,
+                                         ConstEnv(env_tree))
+    assert target.name == "body"
+    assert binding["axis"].value == "dp"       # pre-bound by the partial
+    assert binding["x"] is call.args[0]        # outer call fills the rest
+
+
+def test_affine_of_symbolic_offsets():
+    import ast as _ast
+    from paddle_tpu.analysis._astutil import ConstEnv, affine_of
+    tree = _ast.parse("n = get()\nm = n - 1\nk = n\n")
+    env = ConstEnv(tree)
+    a_m = affine_of(_ast.parse("m", mode="eval").body, env)
+    a_k = affine_of(_ast.parse("k", mode="eval").body, env)
+    a_n1 = affine_of(_ast.parse("n - 1", mode="eval").body, env)
+    assert a_m == a_n1 and a_m != a_k
+    assert a_k[1] == 0 and a_m[1] == -1 and a_m[0] == a_k[0]
+
+
+def test_resolve_dtype_name_through_assignment():
+    import ast as _ast
+    from paddle_tpu.analysis._astutil import ConstEnv, resolve_dtype_name
+    tree = _ast.parse("acc = jnp.float32\nother = 'bfloat16'\n")
+    env = ConstEnv(tree)
+    assert resolve_dtype_name(
+        _ast.parse("acc", mode="eval").body, env) == "float32"
+    assert resolve_dtype_name(
+        _ast.parse("other", mode="eval").body, env) == "bfloat16"
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+def test_baseline_round_trip_and_ratchet(tmp_path):
+    from paddle_tpu.analysis import (apply_baseline, load_baseline,
+                                     write_baseline)
+    bl = tmp_path / "baseline.json"
+    rep = _run_fixture("pta001_bad.py", "PTA001")
+    assert rep.active and all(f.fingerprint for f in rep.active)
+    write_baseline(rep, path=str(bl))
+    # a fresh run against the written baseline: everything baselined
+    rep2 = _run_fixture("pta001_bad.py", "PTA001")
+    stale = apply_baseline(rep2, path=str(bl))
+    assert not rep2.active and not stale
+    assert len(rep2.baselined) == len(rep.active)
+    # ratchet: deleting an entry whose finding still exists resurfaces it
+    data = load_baseline(str(bl))
+    victim = sorted(data)[0]
+    import json as _json
+    raw = _json.loads(bl.read_text())
+    for entries in raw["rules"].values():
+        entries[:] = [e for e in entries if e["fingerprint"] != victim]
+    bl.write_text(_json.dumps(raw))
+    rep3 = _run_fixture("pta001_bad.py", "PTA001")
+    stale = apply_baseline(rep3, path=str(bl))
+    assert any(f.fingerprint == victim for f in rep3.active), \
+        "deleting a baseline entry must resurface its still-live finding"
+    assert not stale
+
+
+def test_baseline_stale_entry_fails_check(tmp_path):
+    import json as _json
+    from paddle_tpu.analysis import apply_baseline
+    bl = tmp_path / "baseline.json"
+    bl.write_text(_json.dumps({"rules": {"PTA001": [
+        {"fingerprint": "deadbeefdeadbeef",
+         "path": "tests/analysis_fixtures/pta001_bad.py", "line": 1,
+         "message": "gone"}]}}))
+    rep = _run_fixture("pta001_bad.py", "PTA001")
+    stale = apply_baseline(rep, path=str(bl))
+    assert [e["fingerprint"] for e in stale] == ["deadbeefdeadbeef"], \
+        "a baseline entry with no live finding must be reported stale"
+
+
+def test_fingerprints_survive_line_shifts(tmp_path):
+    """Fingerprints key on rule + path + normalized source line (+ dup
+    index), NOT the line number — inserting lines above must not churn
+    the baseline."""
+    from paddle_tpu.analysis import run
+    src = open(os.path.join(FIXTURES, "pta001_bad.py")).read()
+    a = tmp_path / "v1"
+    b = tmp_path / "v2"
+    a.mkdir(), b.mkdir()
+    (a / "mod.py").write_text(src)
+    (b / "mod.py").write_text("# shifted\n# shifted\n\n" + src)
+    fp = lambda d: sorted(
+        f.fingerprint for f in run(paths=[str(d / "mod.py")],
+                                   rules=["PTA001"], root=str(d),
+                                   respect_scope=False,
+                                   with_floors=False).active)
+    assert fp(a) == fp(b)
 
 
 if __name__ == "__main__":

@@ -19,11 +19,12 @@ def test_two_process_mesh_loss_matches_serial(tmp_path):
     out = tmp_path / "out.json"
     env = dict(os.environ)
     # CPU-only children: the axon TPU plugin registers one PHYSICAL chip,
-    # which two processes cannot share; dropping its sys.path entry keeps
-    # the children on the virtual-CPU backend.
+    # which two processes cannot share. Pin the backend explicitly —
+    # with JAX_PLATFORMS unset, both children probe libtpu and task 0
+    # hangs tunneling to the chip until the subprocess timeout.
     env["PYTHONPATH"] = "/root/repo"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env.pop("JAX_PLATFORMS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
            "--nproc_per_node=2", "--job_id=mh",
            f"--log_dir={tmp_path / 'logs'}",
@@ -35,6 +36,11 @@ def test_two_process_mesh_loss_matches_serial(tmp_path):
     if logdir.exists():
         for f in sorted(logdir.iterdir()):
             logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+    if p.returncode != 0 and \
+            "Multiprocess computations aren't implemented" in (
+                p.stdout + p.stderr + logs):
+        pytest.skip("this jax build's CPU backend has no cross-process "
+                    "computations; needs a real multi-host (or gloo) env")
     assert p.returncode == 0, f"launch failed\n{p.stdout}\n{p.stderr}\n{logs}"
     assert out.exists(), f"no output written\n{p.stdout}\n{logs}"
     got = json.loads(out.read_text())

@@ -365,15 +365,19 @@ def test_env_parsing_rejects_junk(var, fn, bad):
 
 
 def test_env_parsing_defaults():
-    os.environ.pop(cm.ENV_MIN_CHUNK, None)
-    os.environ.pop(cm.ENV_CHUNKS, None)
-    assert cm.min_chunk() == 64
-    assert cm.overlap_chunks() is None
-    os.environ[cm.ENV_MIN_CHUNK] = " 32 "
+    prev_min = os.environ.pop(cm.ENV_MIN_CHUNK, None)
+    prev_chunks = os.environ.pop(cm.ENV_CHUNKS, None)
     try:
+        assert cm.min_chunk() == 64
+        assert cm.overlap_chunks() is None
+        os.environ[cm.ENV_MIN_CHUNK] = " 32 "
         assert cm.min_chunk() == 32
     finally:
-        del os.environ[cm.ENV_MIN_CHUNK]
+        os.environ.pop(cm.ENV_MIN_CHUNK, None)
+        if prev_min is not None:
+            os.environ[cm.ENV_MIN_CHUNK] = prev_min
+        if prev_chunks is not None:
+            os.environ[cm.ENV_CHUNKS] = prev_chunks
 
 
 @needs_devices
@@ -385,7 +389,7 @@ def test_plans_are_memoized():
     mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("mp",))
     os.environ[cm.ENV_MIN_CHUNK] = "16"
     try:
-        cm.clear_plan_cache()
+        cm.clear_plan_cache()  # noqa: PTA007 -- deliberate cold cache: the test must observe a fresh plan build; later tests replan lazily
         obs.reset_counters()
         p1 = cm.plan_column_parallel((64, 32), (32, 64), mesh)
         p2 = cm.plan_column_parallel((64, 32), (32, 64), mesh)
@@ -430,7 +434,7 @@ def test_fused_ffn_parity(mp):
     os.environ[cm.ENV_OVERLAP] = "1"
     os.environ[cm.ENV_MIN_CHUNK] = "8"
     try:
-        cm.clear_plan_cache()
+        cm.clear_plan_cache()  # noqa: PTA007 -- deliberate cold cache: the test must observe a fresh plan build; later tests replan lazily
         plan = cm.plan_fused_ffn((t, k), (k, inter), (inter, k), mesh,
                                  n_cols=2, activation=cm.swiglu,
                                  batch_axis=None)
@@ -476,7 +480,7 @@ def test_vocab_embed_ring_exact():
     os.environ[cm.ENV_OVERLAP] = "1"
     os.environ[cm.ENV_MIN_CHUNK] = "8"
     try:
-        cm.clear_plan_cache()
+        cm.clear_plan_cache()  # noqa: PTA007 -- deliberate cold cache: the test must observe a fresh plan build; later tests replan lazily
         plan = cm.plan_vocab_parallel_embedding((B, S), (V, H), mesh,
                                                 batch_axis=None)
         assert plan is not None
@@ -505,7 +509,7 @@ def test_parallel_ce_ring_parity():
     os.environ[cm.ENV_OVERLAP] = "1"
     os.environ[cm.ENV_MIN_CHUNK] = "8"
     try:
-        cm.clear_plan_cache()
+        cm.clear_plan_cache()  # noqa: PTA007 -- deliberate cold cache: the test must observe a fresh plan build; later tests replan lazily
         plan = cm.plan_parallel_cross_entropy((B, S, V), mesh,
                                               batch_axis=None)
         assert plan is not None
@@ -674,20 +678,19 @@ def test_pp_overlap_via_llama_config():
     from paddle_tpu.models.llama import (ParallelConfig, build_train_step,
                                          llama_tiny, make_mesh)
     from paddle_tpu.ops import _common
-    _common.set_interpret(True)
     losses = {}
-    for ovl in (False, True):
-        parallel = ParallelConfig(dp=1, pp=2, microbatches=4,
-                                  use_flash=False, overlap_p2p=ovl)
-        config = llama_tiny(vocab=64, hidden=32, layers=4, heads=4,
-                            kv_heads=4, inter=64, seq=32)
-        mesh = make_mesh(parallel, devices=jax.devices("cpu")[:2])
-        step, params, opt = build_train_step(config, parallel, mesh=mesh,
-                                             lr=1e-3)
-        rng = np.random.RandomState(0)
-        ids = rng.randint(0, 64, (4, 32)).astype(np.int32)
-        labels = np.roll(ids, -1, 1).astype(np.int32)
-        _, _, loss = step(params, opt, ids, labels)
-        losses[ovl] = float(jax.device_get(loss))
-    _common.set_interpret(None)
+    with _common.interpret_mode(True):
+        for ovl in (False, True):
+            parallel = ParallelConfig(dp=1, pp=2, microbatches=4,
+                                      use_flash=False, overlap_p2p=ovl)
+            config = llama_tiny(vocab=64, hidden=32, layers=4, heads=4,
+                                kv_heads=4, inter=64, seq=32)
+            mesh = make_mesh(parallel, devices=jax.devices("cpu")[:2])
+            step, params, opt = build_train_step(config, parallel, mesh=mesh,
+                                                 lr=1e-3)
+            rng = np.random.RandomState(0)
+            ids = rng.randint(0, 64, (4, 32)).astype(np.int32)
+            labels = np.roll(ids, -1, 1).astype(np.int32)
+            _, _, loss = step(params, opt, ids, labels)
+            losses[ovl] = float(jax.device_get(loss))
     assert losses[True] == losses[False]

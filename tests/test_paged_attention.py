@@ -38,9 +38,8 @@ KVD = NH * HD
 
 @pytest.fixture(autouse=True)
 def _interpret():
-    _common.set_interpret(True)
-    yield
-    _common.set_interpret(False)
+    with _common.interpret_mode(True):
+        yield
 
 
 @pytest.fixture(scope="module")

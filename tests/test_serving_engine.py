@@ -29,9 +29,8 @@ from paddle_tpu.ops import _common
 
 @pytest.fixture(autouse=True)
 def _interpret():
-    _common.set_interpret(True)
-    yield
-    _common.set_interpret(False)
+    with _common.interpret_mode(True):
+        yield
 
 
 @pytest.fixture(scope="module")
@@ -43,9 +42,9 @@ def model():
 
 def _greedy_ref(model, prompt, n_new):
     cfg, params = model
-    _common.set_interpret(True)
-    out = greedy_generate(params, jnp.asarray([prompt], jnp.int32), cfg,
-                          n_new)
+    with _common.interpret_mode(True):
+        out = greedy_generate(params, jnp.asarray([prompt], jnp.int32), cfg,
+                              n_new)
     return np.asarray(out)[0].tolist()
 
 
@@ -54,7 +53,6 @@ def basic_run(model):
     """Two mixed-length prompts (one multi-chunk, multi-block) through
     the engine twice on the same deterministic trace."""
     cfg, params = model
-    _common.set_interpret(True)
     rng = np.random.RandomState(0)
     prompts = [rng.randint(1, 96, size=n).tolist() for n in (7, 130)]
     serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
@@ -67,8 +65,9 @@ def basic_run(model):
         stats = eng.run(reqs, deterministic=True)
         return eng, stats
 
-    eng, stats = one()
-    eng2, _ = one()
+    with _common.interpret_mode(True):
+        eng, stats = one()
+        eng2, _ = one()
     return {"prompts": prompts, "eng": eng, "stats": stats, "eng2": eng2}
 
 
@@ -104,7 +103,6 @@ def evict_run(model):
     each crosses its block boundary mid-decode: 4 usable blocks, three
     120-token prompts growing past 128 cached tokens."""
     cfg, params = model
-    _common.set_interpret(True)
     rng = np.random.RandomState(1)
     prompts = [rng.randint(1, 96, size=120).tolist() for _ in range(3)]
     serve = ServeConfig(block_size=128, num_blocks=5, max_batch=3,
@@ -112,7 +110,8 @@ def evict_run(model):
     eng = InferenceEngine(params, cfg, serve, record_events=True)
     reqs = [Request(p, max_new_tokens=16, arrival=float(i))
             for i, p in enumerate(prompts)]
-    stats = eng.run(reqs, deterministic=True)
+    with _common.interpret_mode(True):
+        stats = eng.run(reqs, deterministic=True)
     return {"prompts": prompts, "eng": eng, "stats": stats}
 
 

@@ -44,6 +44,25 @@ def test_cli_strict_exits_zero_and_emits_json(capsys):
     assert set(rec["rules"]) >= set(all_rules())
 
 
+def test_cli_strict_baseline_check_is_the_ci_gate(capsys):
+    """The exact invocation CI and the multichip-dryrun preamble run:
+    new findings AND stale baseline entries both fail it."""
+    rc = cli_main(["--strict", "--baseline", "check"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_checked_in_baseline_has_no_stale_entries(repo_report):
+    from paddle_tpu.analysis import apply_baseline
+    stale = apply_baseline(repo_report)
+    assert not stale, f"stale baseline entries: {stale}"
+    # the ratchet only ever shrinks: the checked-in baseline is empty
+    # today, so every new finding fails CI immediately
+    from paddle_tpu.analysis import DEFAULT_BASELINE, load_baseline
+    assert os.path.exists(DEFAULT_BASELINE)
+    assert load_baseline() == {}
+
+
 def test_cli_strict_fails_on_a_dirty_fixture(capsys):
     fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "analysis_fixtures", "pta001_bad.py")
