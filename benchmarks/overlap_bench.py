@@ -294,9 +294,11 @@ def bench_telemetry(cpus, dp=8, width=256, depth=4, batch=64, cap_mb=0.25,
 
 
 def bench_overhead(cpus, dp=8, width=256, depth=4, batch=64, cap_mb=0.25):
-    """Telemetry-on vs telemetry-off step time on the CPU mesh — the
-    acceptance bound is <2% overhead (the collector is interval timing +
-    an in-memory record append; nothing touches the device)."""
+    """Telemetry-on vs telemetry-off step time on the CPU mesh, plus the
+    serve-side twin: request tracing + SLO histograms + flight recorder on
+    vs off in the engine dryrun — the acceptance bound is <2% overhead on
+    both (the collectors are interval timing + in-memory appends; nothing
+    touches the device, and tokens must be bit-identical)."""
     import tempfile
 
     import paddle_tpu as paddle
@@ -333,7 +335,107 @@ def bench_overhead(cpus, dp=8, width=256, depth=4, batch=64, cap_mb=0.25):
             step.telemetry.close()
             obs.set_active(None)
     res["overhead_pct"] = (res["on"] / res["off"] - 1.0) * 100.0
+    res.update(bench_serve_overhead())
     return res
+
+
+class _TimedProxy:
+    """Attribute proxy that wall-times every method call on the target.
+
+    The timing clamp itself (two ``perf_counter`` reads + an attribute
+    hop per call) is billed to the target, so the attributed total is an
+    UPPER bound on what the unwrapped instrumentation costs."""
+
+    def __init__(self, target, counter):
+        self._target = target
+        self._counter = counter  # single-element list, shared across proxies
+
+    def __getattr__(self, name):
+        attr = getattr(self._target, name)
+        if not callable(attr):
+            return attr
+        counter = self._counter
+
+        def timed(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                return attr(*args, **kwargs)
+            finally:
+                counter[0] += time.perf_counter() - t0
+        # cache the bound wrapper so repeat calls skip __getattr__ —
+        # the clamp should time the instrumentation, not itself
+        object.__setattr__(self, name, timed)
+        return timed
+
+
+def bench_serve_overhead(reps=3):
+    """Request-tracing + histogram + flight-recorder overhead in the serve
+    dryrun. Two measurements:
+
+    - **attributed** (the <2% gate): wall time spent inside observability
+      calls during a traced run, clamped per call via ``_TimedProxy``
+      (conservative — the clamp bills its own cost to the layers), as a
+      share of the run's wall. Stable to well under a percent even on a
+      noisy 1-vCPU host because it sums µs-scale intervals instead of
+      differencing two ~100ms walls.
+    - **A/B tokens/s** (reported for reference): traced vs untraced runs
+      of the same deterministic arrival trace. Identical schedules, so
+      generated tokens must match bit for bit; on a shared host the
+      ratio itself carries several percent of scheduler noise.
+    """
+    from paddle_tpu.inference import InferenceEngine, Request, ServeConfig
+    from paddle_tpu.models.llama import init_llama_params, llama_tiny
+    from paddle_tpu.ops import _common
+
+    # two layers, hidden 128: still a toy, but the per-iteration device
+    # work is no longer degenerate next to the fixed ~25us of host
+    # instrumentation (the serve dryrun's 1-layer hidden-64 config exists
+    # to make the FUNCTIONAL checks fast, not to proxy a real step time)
+    cfg = llama_tiny(vocab=96, hidden=128, layers=2, heads=4, kv_heads=2,
+                     seq=256)
+    params = init_llama_params(cfg, seed=3)
+    serve = ServeConfig(block_size=128, num_blocks=17, max_batch=4,
+                        prefill_chunk=32, max_seq_len=256)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 96, size=n).tolist()
+               for n in (7, 40, 130, 25, 60, 90)]
+
+    def one(on, attribute=False):
+        eng = InferenceEngine(params, cfg, serve, trace_requests=on,
+                              flight_recorder=on)
+        counter = [0.0]
+        if attribute:
+            eng.tracer = _TimedProxy(eng.tracer, counter)
+            eng.recorder = _TimedProxy(eng.recorder, counter)
+            eng.slo = {k: _TimedProxy(h, counter)
+                       for k, h in eng.slo.items()}
+        reqs = [Request(p, max_new_tokens=48, arrival=float(i))
+                for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        st = eng.run(reqs, deterministic=True)
+        wall = time.perf_counter() - t0
+        toks = {s.req.request_id: list(s.generated) for s in eng.finished}
+        return st["generated_tokens"] / wall, toks, counter[0] / wall
+
+    prev = _common._FORCE_INTERPRET
+    _common.set_interpret(True)
+    try:
+        one(False)  # compile + warm outside the timed reps
+        attributed, offs, ons = [], [], []
+        toks_off = toks_on = None
+        for _ in range(reps):
+            tps, toks_off, _ = one(False)
+            offs.append(tps)
+            tps, toks_on, _ = one(True)
+            ons.append(tps)
+            _, _, share = one(True, attribute=True)
+            attributed.append(share)
+    finally:
+        _common.set_interpret(prev)
+    return dict(serve_off_tps=max(offs), serve_on_tps=max(ons),
+                serve_overhead_pct=max(attributed) * 100.0,
+                serve_ab_overhead_pct=(max(offs) / max(ons) - 1.0) * 100.0,
+                serve_tokens_identical=toks_on == toks_off)
 
 
 def run(cpus=None, prefix="overlap_bench"):
@@ -372,6 +474,12 @@ def run(cpus=None, prefix="overlap_bench"):
     print(f"{prefix}({N_DEV}): telemetry overhead: on "
           f"{ovh['on']:.2f}ms vs off {ovh['off']:.2f}ms = "
           f"{ovh['overhead_pct']:+.2f}% (<2%: {verdict2})")
+    v_tr = "OK" if ovh["serve_overhead_pct"] < 2.0 else "OVER"
+    print(f"{prefix}({N_DEV}): serve tracing overhead: traced "
+          f"{ovh['serve_on_tps']:.1f} tok/s vs untraced "
+          f"{ovh['serve_off_tps']:.1f} tok/s = "
+          f"{ovh['serve_overhead_pct']:+.2f}% (<2%: {v_tr}), tokens "
+          f"identical: {ovh['serve_tokens_identical']}")
     for mp, sweep in chunk.items():
         parts = []
         for nc, rec in sweep["sweep"].items():

@@ -6,9 +6,15 @@ with bucketed decode batches (one compiled step family, recompiles
 bounded and counted), and preempts-by-eviction if the block pool runs
 dry. Tiny model on CPU (pallas interpret); the same engine drives the
 flagship config on TPU (see bench.py serve_continuous).
+
+The run also demos the observability stack: request-lifecycle tracing
+(exported as a Chrome/Perfetto trace plus JSONL spans), the streaming
+SLO histograms behind a Prometheus text snapshot, and the failure
+flight recorder (clean shutdown here, so nothing is dumped).
 """
 import os
 import sys
+import tempfile
 
 import numpy as np
 
@@ -30,7 +36,8 @@ def main():
     serve = ServeConfig(block_size=128, num_blocks=17, max_batch=4,
                         prefill_chunk=64, max_seq_len=256)
     metrics = StepMetrics(name="serve", n_devices=1)
-    engine = InferenceEngine(params, config, serve, telemetry=metrics)
+    engine = InferenceEngine(params, config, serve, telemetry=metrics,
+                             trace_requests=True, flight_recorder=True)
 
     rng = np.random.RandomState(0)
     arrivals = np.cumsum(rng.exponential(1.0 / 8.0, size=6))  # Poisson 8/s
@@ -56,6 +63,24 @@ def main():
     for seq in sorted(engine.finished, key=lambda s: s.req.request_id):
         print(f"request {seq.req.request_id}: prompt {seq.n_prompt} tokens"
               f" -> continuation: {seq.generated}")
+
+    # observability exports: open the chrome trace in Perfetto
+    # (ui.perfetto.dev) — one row per engine phase, one row per request
+    out = tempfile.mkdtemp(prefix="paddle_tpu_serve_")
+    trace = engine.tracer.export_chrome(os.path.join(out, "serve_trace.json"))
+    spans = engine.tracer.export_jsonl(os.path.join(out, "serve_spans.jsonl"))
+    print(f"request trace: {engine.tracer.span_count()} spans -> {trace} "
+          f"(Perfetto) and {spans} (JSONL)")
+    print(f"streaming SLO estimates (fixed-memory histograms): "
+          f"ttft p50 {stats['ttft_stream_p50_s']:.3f} s, "
+          f"tpot p50 {stats['tpot_stream_p50_s']:.3f} s")
+    prom = engine.render_prometheus()
+    print(f"prometheus snapshot: {len(prom.splitlines())} lines, e.g.")
+    for line in prom.splitlines():
+        if line.startswith("# TYPE paddle_tpu_serve_ttft"):
+            print(f"  {line}")
+    print(f"flight recorder: ring {len(engine.recorder.ring)} records, "
+          f"dumped: {engine.recorder.dumped or 'nothing (clean run)'}")
 
 
 if __name__ == "__main__":

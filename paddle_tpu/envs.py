@@ -306,6 +306,39 @@ _register(
     parse=_strict_bool("PADDLE_TPU_MOE_A2A_OVERLAP"))
 
 _register(
+    "PADDLE_TPU_TRACE_REQUESTS", "bool", False,
+    doc="Request-lifecycle tracing in the serving engine (PR 12): per-"
+        "request span trees (queue wait, prefill chunks, decode "
+        "iterations, evictions) exportable as JSONL and Chrome trace "
+        "JSON. Measurement-only: tokens are bit-identical on/off. An "
+        "explicit trace_requests= argument to InferenceEngine wins.",
+    parse=_strict_bool("PADDLE_TPU_TRACE_REQUESTS"))
+
+_register(
+    "PADDLE_TPU_FLIGHT_RECORDER", "bool", False,
+    doc="Failure flight recorder (PR 12): keep a bounded ring of the "
+        "last N iteration/step records in the engine and TrainStep, "
+        "dumped to PADDLE_TPU_TELEMETRY_DIR on exception, eviction "
+        "storm, or step-time spike. An explicit flight_recorder= "
+        "argument wins over the env.",
+    parse=_strict_bool("PADDLE_TPU_FLIGHT_RECORDER"))
+
+_register(
+    "PADDLE_TPU_FLIGHT_RECORDER_SIZE", "int", 256,
+    doc="Ring capacity (records) of the failure flight recorder (PR 12). "
+        "Positive integer; also bounds the step-time window the spike "
+        "detector computes its median/MAD over.",
+    parse=_positive_int("PADDLE_TPU_FLIGHT_RECORDER_SIZE", 256))
+
+_register(
+    "PADDLE_TPU_SPIKE_MAD", "float", 8.0,
+    doc="Step-time spike threshold for the flight recorder (PR 12), in "
+        "robust sigmas: a step further than this many MAD-derived "
+        "standard deviations (MAD x 1.4826) from the window median "
+        "triggers a dump. Positive number.",
+    parse=_positive_float("PADDLE_TPU_SPIKE_MAD", 8.0))
+
+_register(
     "PADDLE_TPU_SEP_STRATEGY", "enum", "ring",
     doc="Context-parallel attention strategy for the llama sep axis "
         "(PR 7): 'ring' (PR-1 ring attention) or 'ulysses' (head-sharded "

@@ -40,11 +40,19 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax.numpy as jnp
 
+from .. import envs
 from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
                             _jitted_paged_prefill, init_paged_kv_pool)
+from ..observability import histogram as _hist
+from ..observability.flight_recorder import (FlightRecorder,
+                                             flight_recorder_enabled)
+from ..observability.histogram import LogHistogram
 from ..observability.metrics import StepMetrics
+from ..observability.request_trace import RequestTracer
 from ..observability.trace import comm_span, record_counter
 from .kv_cache import BlockPool, pad_table
+
+ENV_TRACE_REQUESTS = "PADDLE_TPU_TRACE_REQUESTS"
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
@@ -134,7 +142,9 @@ class InferenceEngine:
     def __init__(self, params: Dict[str, Any], config: LlamaConfig,
                  serve: Optional[ServeConfig] = None,
                  telemetry: Optional[StepMetrics] = None,
-                 record_events: bool = False):
+                 record_events: bool = False,
+                 trace_requests: Optional[bool] = None,
+                 flight_recorder: Optional[bool] = None):
         self.params = params
         self.config = config
         self.serve = serve or ServeConfig()
@@ -143,6 +153,22 @@ class InferenceEngine:
             config, self.serve.num_blocks, self.serve.block_size)
         self.metrics = telemetry
         self.record_events = record_events
+        # request-lifecycle tracing is measurement-only: spans are recorded
+        # from timestamps the scheduler already crosses, never consulted by
+        # it, so tokens are bit-identical with tracing on or off
+        if trace_requests is None:
+            trace_requests = envs.get(ENV_TRACE_REQUESTS)
+        self.tracer: Optional[RequestTracer] = \
+            RequestTracer() if trace_requests else None
+        self.recorder: Optional[FlightRecorder] = (
+            FlightRecorder(source="engine")
+            if flight_recorder_enabled(flight_recorder) else None)
+        # streaming SLO histograms, always on (one list increment per
+        # token); values are in the ENGINE clock — seconds in wall mode,
+        # iterations in deterministic mode — matching stats()
+        self.slo: Dict[str, LogHistogram] = {
+            "ttft": LogHistogram(), "tpot": LogHistogram(),
+            "queue_wait": LogHistogram()}
         self.events: List[Tuple] = []
         self.waiting: List[_Seq] = []
         self.active: List[_Seq] = []      # PREFILL + RUNNING, FCFS order
@@ -202,6 +228,11 @@ class InferenceEngine:
         self.preemptions += 1
         record_counter("serve.preempt")
         self._event("evict", victim.req.request_id)
+        if self.tracer is not None:
+            self.tracer.evict(victim.req.request_id, time.perf_counter(),
+                              victim.n_preempted)
+        if self.recorder is not None:
+            self.recorder.note_eviction(self.iteration)
         return True
 
     def _mark_compiled(self, kind: str, key, t_call: float):
@@ -210,6 +241,8 @@ class InferenceEngine:
             record_counter(f"serve.compile.{kind}")
             if self.metrics is not None:
                 self.metrics.record_compile(compile_s=t_call)
+            if self.recorder is not None:
+                self.recorder.record_compile(f"{kind}_{key}", t_call)
 
     # -- public API ---------------------------------------------------------
 
@@ -231,6 +264,8 @@ class InferenceEngine:
         seq.order = next(self._seqno)
         self.waiting.append(seq)
         self._event("submit", req.request_id)
+        if self.tracer is not None:
+            self.tracer.submit(req.request_id, time.perf_counter())
 
     def step(self) -> List[_Seq]:
         """One scheduler iteration: admit, one prefill chunk, one decode
@@ -246,20 +281,35 @@ class InferenceEngine:
         t_dec = time.perf_counter()
         for seq in done:
             self._event("finish", seq.req.request_id, len(seq.generated))
-        if self.metrics is not None:
-            n_run = sum(1 for s in self.active if s.state == RUNNING)
-            self.metrics.step(
+        if self.tracer is not None:
+            self.tracer.phase("admit", t_iter, t_adm, self.iteration)
+            if ran_prefill:
+                self.tracer.phase("prefill", t_adm, t_pre, self.iteration)
+            self.tracer.phase("decode", t_pre, t_dec, self.iteration)
+        if self.metrics is not None or self.recorder is not None:
+            n_run = n_pre = 0
+            for s in self.active:
+                if s.state == RUNNING:
+                    n_run += 1
+                elif s.state == PREFILL:
+                    n_pre += 1
+            fields = dict(
                 step_time_s=t_dec - t_iter,
                 tokens=self._last_tokens,
                 queue_depth=len(self.waiting),
                 n_running=n_run,
-                n_prefill=sum(1 for s in self.active
-                              if s.state == PREFILL),
+                n_prefill=n_pre,
                 batch_occupancy=n_run / self.serve.max_batch,
                 pool_utilization=self.pool.utilization,
                 prefill_ms=(t_pre - t_adm) * 1e3 if ran_prefill else 0.0,
                 decode_ms=(t_dec - t_pre) * 1e3,
             )
+            if self.metrics is not None:
+                self.metrics.step(**fields)
+            if self.recorder is not None:
+                self.recorder.record(
+                    {"iteration": self.iteration, **fields})
+                self.recorder.check_step_time(t_dec - t_iter)
         return done
 
     def idle(self) -> bool:
@@ -279,6 +329,14 @@ class InferenceEngine:
             self.active.append(seq)
             record_counter("serve.admit")
             self._event("admit", seq.req.request_id)
+            if not seq.generated:
+                # first admission: queue wait from submit to here (a
+                # readmitted sequence's renewed wait shows in its trace
+                # requeue span, not the SLO histogram)
+                self.slo["queue_wait"].record(self._clock - seq.arrival)
+            if self.tracer is not None:
+                self.tracer.admit(seq.req.request_id, time.perf_counter(),
+                                  seq.n_preempted)
 
     def _prefill_chunk(self) -> bool:
         seq = next((s for s in self.active if s.state == PREFILL), None)
@@ -305,7 +363,12 @@ class InferenceEngine:
                 jnp.asarray(table), np.int32(seq.n_cached),
                 jnp.asarray(ids), np.int32(n_live))
             logits = np.asarray(logits)  # noqa: PTA006 -- deliberate sync so prefill phase timing is honest
-        self._mark_compiled(*key, time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._mark_compiled(*key, t1 - t0)
+        if self.tracer is not None:
+            self.tracer.prefill_chunk(
+                seq.req.request_id, t0, t1, int(n_live),
+                recompute=bool(seq.generated))
         seq.n_cached += n_live
         if seq.n_cached == seq.prefill_target:
             if not seq.generated:
@@ -315,6 +378,7 @@ class InferenceEngine:
                 seq.first_token_t = self._now()
                 seq.token_times.append(seq.first_token_t)
                 self._last_tokens += 1
+                self.slo["ttft"].record(seq.first_token_t - seq.arrival)
             seq.state = RUNNING
         return True
 
@@ -355,7 +419,11 @@ class InferenceEngine:
                 jnp.asarray(tables), jnp.asarray(positions),
                 jnp.asarray(toks))
             next_tok = np.asarray(logits).argmax(-1)  # noqa: PTA006 -- step boundary: sampled tokens must reach the scheduler
-        self._mark_compiled(*key, time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        self._mark_compiled(*key, t1 - t0)
+        if self.tracer is not None:
+            self.tracer.decode([s.req.request_id for s in rows], t0, t1,
+                               self.iteration)
         self._last_tokens += len(rows)
         done = []
         now = self._now()
@@ -364,6 +432,9 @@ class InferenceEngine:
             seq.tokens.append(int(next_tok[i]))
             if seq.first_token_t is None:
                 seq.first_token_t = now
+                self.slo["ttft"].record(now - seq.arrival)
+            elif seq.token_times:
+                self.slo["tpot"].record(now - seq.token_times[-1])
             seq.token_times.append(now)
             if seq.done():
                 seq.state = FINISHED
@@ -372,6 +443,9 @@ class InferenceEngine:
                 self.finished.append(seq)
                 record_counter("serve.finish")
                 done.append(seq)
+                if self.tracer is not None:
+                    self.tracer.finish(seq.req.request_id, t1,
+                                       len(seq.generated))
         return done
 
     # -- driving loops ------------------------------------------------------
@@ -392,29 +466,45 @@ class InferenceEngine:
         (scheduling never consults wall time)."""
         pending = sorted(requests, key=lambda r: r.arrival)
         t0 = time.perf_counter()
-        while pending or not self.idle():
-            if self.iteration >= max_iterations:
-                raise RuntimeError("engine exceeded max_iterations")
-            self._clock = (float(self.iteration) if deterministic
-                           else time.perf_counter() - t0)
-            while pending and pending[0].arrival <= self._clock:
-                self.submit(pending.pop(0))
-            if self.idle() and pending:
-                if deterministic:
-                    self.iteration += 1
-                else:
-                    time.sleep(min(
-                        pending[0].arrival - self._clock, 0.01))
-                continue
-            self.step()
-            if not deterministic:
-                self._clock = time.perf_counter() - t0
+        try:
+            while pending or not self.idle():
+                if self.iteration >= max_iterations:
+                    raise RuntimeError("engine exceeded max_iterations")
+                self._clock = (float(self.iteration) if deterministic
+                               else time.perf_counter() - t0)
+                while pending and pending[0].arrival <= self._clock:
+                    self.submit(pending.pop(0))
+                if self.idle() and pending:
+                    if deterministic:
+                        self.iteration += 1
+                    else:
+                        time.sleep(min(
+                            pending[0].arrival - self._clock, 0.01))
+                    continue
+                self.step()
+                if not deterministic:
+                    self._clock = time.perf_counter() - t0
+        except BaseException:
+            # crash post-mortem: dump the last N iteration records before
+            # the exception leaves the engine (no-op without a recorder
+            # or a telemetry dir)
+            if self.recorder is not None:
+                self.recorder.dump("exception")
+            raise
         return self.stats()
 
     def stats(self) -> Dict[str, Any]:
         """Throughput/latency aggregates over finished requests (times
         in the engine clock: seconds in wall mode, iterations in
-        deterministic mode)."""
+        deterministic mode).
+
+        Requests that never produced a first token — still queued, mid-
+        prefill, or evicted at shutdown — are counted in ``unfinished``
+        rather than silently dropped, so the TTFT percentiles are
+        explicitly conditioned on completion instead of optimistically
+        biased. The ``*_stream_*`` entries are the live log-bucketed
+        histogram estimates next to the exact percentiles (they must
+        agree within one bucket)."""
         seqs = self.finished
         gen = sum(len(s.generated) for s in seqs)
         ttfts = [s.first_token_t - s.arrival for s in seqs
@@ -426,8 +516,11 @@ class InferenceEngine:
                     default=0.0)
                 - min((s.arrival for s in seqs), default=0.0))
         pct = (lambda a, q: float(np.percentile(a, q)) if a else None)
+        unfinished = (len(self.waiting) + len(self.active)
+                      + sum(1 for s in seqs if s.first_token_t is None))
         return {
             "requests": len(seqs),
+            "unfinished": unfinished,
             "generated_tokens": gen,
             "elapsed_s": span,
             "tokens_per_sec": gen / span if span > 0 else None,
@@ -435,9 +528,40 @@ class InferenceEngine:
             "ttft_p99_s": pct(ttfts, 99),
             "tpot_p50_s": pct(gaps, 50),
             "tpot_p99_s": pct(gaps, 99),
+            "ttft_stream_p50_s": self.slo["ttft"].percentile(50),
+            "ttft_stream_p99_s": self.slo["ttft"].percentile(99),
+            "tpot_stream_p50_s": self.slo["tpot"].percentile(50),
+            "tpot_stream_p99_s": self.slo["tpot"].percentile(99),
             "preemptions": self.preemptions,
             "iterations": self.iteration,
             "compiles": {f"{k}_{v}": round(t, 3)
                          for (k, v), t in sorted(self._compiled.items())},
             "pool_blocks": self.serve.num_blocks - 1,
         }
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """Live metric snapshot, any time mid-run: the streaming SLO
+        histograms plus scheduler gauges. Feed it to
+        :func:`paddle_tpu.observability.render_prometheus` (or call
+        :meth:`render_prometheus`) for text exposition."""
+        return {
+            "ttft_seconds": self.slo["ttft"],
+            "tpot_seconds": self.slo["tpot"],
+            "queue_wait_seconds": self.slo["queue_wait"],
+            "queue_depth": len(self.waiting),
+            "running": sum(1 for s in self.active if s.state == RUNNING),
+            "prefilling": sum(1 for s in self.active
+                              if s.state == PREFILL),
+            "batch_capacity": self.serve.max_batch,
+            "pool_utilization": self.pool.utilization,
+            "iterations": self.iteration,
+            "preemptions": self.preemptions,
+            "finished_requests": len(self.finished),
+            "generated_tokens": sum(len(s.generated)
+                                    for s in self.finished),
+        }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of :meth:`metrics_snapshot`."""
+        return _hist.render_prometheus(self.metrics_snapshot(),
+                                       prefix="paddle_tpu_serve")

@@ -45,7 +45,8 @@ class TrainStep:
                  param_bucket_mb: Optional[float] = None,
                  telemetry: Optional[bool] = None,
                  telemetry_dir: Optional[str] = None,
-                 tokens_per_step: Optional[int] = None):
+                 tokens_per_step: Optional[int] = None,
+                 flight_recorder: Optional[bool] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -280,6 +281,15 @@ class TrainStep:
         self.telemetry = None
         self._flops_stale = True
         self._seen_cache_size = 0
+        # failure flight recorder (observability.FlightRecorder): rings the
+        # last N dispatch records host-side and dumps them to
+        # PADDLE_TPU_TELEMETRY_DIR when a step raises or its wall time
+        # spikes. Independent of the telemetry switch so post-mortems don't
+        # depend on having had telemetry on.
+        self.recorder = (
+            observability.FlightRecorder(source="train_step")
+            if observability.flight_recorder_enabled(flight_recorder)
+            else None)
         if observability.telemetry_enabled(telemetry):
             self.telemetry = observability.StepMetrics(
                 name="train_step", tokens_per_step=tokens_per_step,
@@ -430,21 +440,45 @@ class TrainStep:
             # program's cost analysis — trace-time work, nothing per step
             self._capture_cost(train_params, frozen, batch, sub, lr)
             captured = True
-        t0 = time.perf_counter() if m is not None else 0.0
-        new_p, new_s, new_b, loss = self._compiled(
-            train_params, self.opt_states, self.buffers, frozen, batch, sub, lr)
-        if m is not None:
+        rec = self.recorder
+        t0 = time.perf_counter() if (m is not None or rec is not None) else 0.0
+        try:
+            new_p, new_s, new_b, loss = self._compiled(
+                train_params, self.opt_states, self.buffers, frozen, batch,
+                sub, lr)
+        except BaseException:
+            # crash post-mortem: flush the last N dispatch records before
+            # the exception propagates (no-op without a telemetry dir)
+            if rec is not None:
+                rec.dump("exception")
+            raise
+        if m is not None or rec is not None:
             dt = time.perf_counter() - t0
-            if self._note_compile():
-                # this dispatch paid trace+compile: account it as compile
-                # time, not a step sample. A recompile marks FLOPs stale
-                # (the program changed) — unless they were captured for
-                # exactly this program a few lines up.
-                if captured:
-                    self._flops_stale = False
-                m.record_compile(compile_s=dt, flops=m.flops_per_step)
-            else:
-                m.step(tokens=self._batch_tokens(batch), dispatch_ms=dt * 1e3)
+            is_compile = (self._note_compile() if m is not None
+                          else self._step_count == 0)
+            if m is not None:
+                if is_compile:
+                    # this dispatch paid trace+compile: account it as compile
+                    # time, not a step sample. A recompile marks FLOPs stale
+                    # (the program changed) — unless they were captured for
+                    # exactly this program a few lines up.
+                    if captured:
+                        self._flops_stale = False
+                    m.record_compile(compile_s=dt, flops=m.flops_per_step)
+                else:
+                    m.step(tokens=self._batch_tokens(batch),
+                           dispatch_ms=dt * 1e3)
+            if rec is not None:
+                if is_compile:
+                    rec.record_compile("train_step", dt)
+                else:
+                    # dispatch wall time (async): in steady state with
+                    # donation it tracks device step time; a spike means a
+                    # recompile, host stall, or device-queue backup
+                    rec.record({"iteration": self._step_count + 1,
+                                "dispatch_ms": dt * 1e3,
+                                "tokens": self._batch_tokens(batch)})
+                    rec.check_step_time(dt)
         self.params.update(new_p)
         self.opt_states = new_s
         self.buffers = new_b
@@ -488,7 +522,8 @@ class TrainStep:
         """Tokens per step for throughput: [B, S] integer inputs count B*S
         (sequence ids), anything else counts batch rows. Override with the
         ``tokens_per_step`` ctor arg."""
-        if self.telemetry.tokens_per_step is not None:
+        if self.telemetry is not None \
+                and self.telemetry.tokens_per_step is not None:
             return self.telemetry.tokens_per_step
         try:
             x = batch["inputs"][0]

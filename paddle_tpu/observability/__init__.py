@@ -1,19 +1,35 @@
-"""Observability: step telemetry + comm/compute trace attribution.
+"""Observability: tracing, streaming SLO metrics, and a flight recorder.
 
 Grown from the profiler stub in the spirit of XLA's xplane/TensorBoard
-pipeline: ``StepMetrics`` collects wall step time, compile time, tokens/sec,
-device memory and MFU with zero host syncs on the hot path; ``comm_span``
-names every overlap site (TP ring hops, grad-sync buckets, 1F1B p2p,
-shard_map islands) in the HLO metadata so device profiles attribute comm vs
-compute; counters tally the static structure (hop counts, bucket bytes,
-overlap on/off); exporters stream JSONL / TensorBoard scalars / rank-tagged
-logs. Switched by ``PADDLE_TPU_TELEMETRY`` (+ ``PADDLE_TPU_TELEMETRY_DIR``
-for the step log).
+pipeline, in three layers (PR 2 + PR 12):
+
+1. **Trace attribution** — ``comm_span`` names every overlap site in the
+   HLO metadata, counters tally static structure, and ``RequestTracer``
+   gives every serving request a span tree (queue wait, prefill chunks,
+   decode iterations, evictions) exported as JSONL / Chrome trace JSON
+   (``write_chrome_trace``, shared with the profiler) for Perfetto.
+2. **Streaming metrics** — ``StepMetrics`` collects wall step time,
+   compile time, tokens/sec, device memory and MFU with zero host syncs
+   on the hot path; ``LogHistogram`` keeps fixed-memory TTFT/TPOT/
+   queue-wait/step-time distributions with live percentiles, rendered by
+   ``render_prometheus`` for scraping.
+3. **Failure flight recorder** — ``FlightRecorder`` rings the last N
+   iteration/step records and dumps them to ``PADDLE_TPU_TELEMETRY_DIR``
+   on exception, eviction storm, or MAD step-time spike.
+
+Switched by ``PADDLE_TPU_TELEMETRY`` / ``PADDLE_TPU_TRACE_REQUESTS`` /
+``PADDLE_TPU_FLIGHT_RECORDER`` (+ ``PADDLE_TPU_TELEMETRY_DIR`` for file
+output).
 """
 from .exporters import (JsonlWriter, TensorBoardWriter, get_logger,  # noqa: F401
-                        load_jsonl, log_event, process_rank)
+                        load_jsonl, log_event, process_rank,
+                        write_chrome_trace)
+from .flight_recorder import (FlightRecorder, flight_recorder_enabled,  # noqa: F401
+                              load_dump)
+from .histogram import LogHistogram, render_prometheus  # noqa: F401
 from .metrics import (PEAK_FLOPS_TABLE, StepMetrics, active,  # noqa: F401
                       peak_flops_per_device, set_active)
+from .request_trace import RequestTracer  # noqa: F401
 from .trace import (ENV_TELEMETRY, ENV_TELEMETRY_DIR, comm_span,  # noqa: F401
                     counters, overlap_flags, record_counter, reset_counters,
                     set_counter, telemetry_dir, telemetry_enabled)

@@ -60,6 +60,21 @@ class JsonlWriter:
             pass
 
 
+def write_chrome_trace(path: str, events) -> str:
+    """Write a Chrome trace-event JSON file (``{"traceEvents": [...]}``).
+
+    The single writer behind both the profiler's host-span export and the
+    serving engine's request traces, so every trace the repo emits opens
+    in Perfetto / chrome://tracing the same way. ``events`` is an iterable
+    of trace-event dicts (``ph`` "X"/"i"/"M" etc., µs timebase).
+    """
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": list(events)}, f, default=_jsonable)
+    return path
+
+
 def load_jsonl(path: str):
     """Read a JSONL step log back into a list of dicts."""
     out = []

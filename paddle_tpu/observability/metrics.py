@@ -19,6 +19,7 @@ import jax
 
 from .. import envs
 from . import trace as _trace
+from .histogram import LogHistogram
 
 ENV_PEAK_FLOPS = "PADDLE_TPU_PEAK_FLOPS"
 
@@ -90,6 +91,10 @@ class StepMetrics:
         self.recompiles = 0  # compiles beyond the first
         self.steps = 0
         self.records: collections.deque = collections.deque(maxlen=window)
+        # full-run step-time distribution at fixed memory (the bounded
+        # records window only covers the last `window` steps); seconds,
+        # 10 µs .. 10 ks span
+        self.step_time_hist = LogHistogram(lo=1e-5, hi=1e4)
         self._last_t: Optional[float] = None
         self._exporters: List = []
 
@@ -152,6 +157,8 @@ class StepMetrics:
             step_time_s = now - self._last_t
         self._last_t = now
         self.steps += 1
+        if step_time_s is not None:
+            self.step_time_hist.record(step_time_s)
         tokens = tokens if tokens is not None else self.tokens_per_step
         rec: Dict = {
             "name": self.name,
@@ -196,6 +203,12 @@ class StepMetrics:
             "tokens_per_sec_best": max(toks) if toks else None,
             "mfu_best": self.mfu(best / 1e3) if best else None,
         }
+        # streaming (full-run, fixed-memory) step-time distribution —
+        # the window stats above forget everything past `window` steps
+        if self.step_time_hist.count:
+            for q in (50, 90, 99):
+                p = self.step_time_hist.percentile(q)
+                out[f"step_time_ms_p{q}"] = p * 1e3 if p is not None else None
         out.update(self.device_memory())
         try:
             out["overlap"] = _trace.overlap_flags()

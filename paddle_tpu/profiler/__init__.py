@@ -219,8 +219,10 @@ class Profiler:
                     events.append({"name": name, "ph": "X", "ts": t0 / 1000.0,
                                    "dur": (t1 - t0) / 1000.0, "pid": 0,
                                    "tid": tid, "cat": "host"})
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events}, f)
+        # shared writer with the serving engine's request traces, so every
+        # chrome-trace file the repo emits has the same envelope
+        from ..observability.exporters import write_chrome_trace
+        write_chrome_trace(path, events)
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):

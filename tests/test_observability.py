@@ -272,3 +272,181 @@ def test_telemetry_off_by_default(tmp_path, monkeypatch):
     opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
     step = TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt)
     assert step.telemetry is None
+
+
+# -- LogHistogram streaming percentiles (PR-12) -------------------------------
+
+_BUCKET = 10.0 ** (1.0 / 16.0)  # default bucket width factor
+
+
+def _nearest_rank(xs, q):
+    import math
+    s = sorted(xs)
+    return s[max(0, math.ceil(q / 100.0 * len(s)) - 1)]
+
+
+def _adversarial(dist):
+    rng = np.random.RandomState(7)
+    if dist == "lognormal":
+        return np.exp(rng.randn(5000)).tolist()
+    if dist == "bimodal":
+        # two modes five decades apart: percentile walks must not smear
+        # mass across the empty decades between them
+        return (list(rng.uniform(8e-4, 1.2e-3, size=600))
+                + list(rng.uniform(4e2, 6e2, size=400)))
+    if dist == "heavy":
+        return np.clip((rng.pareto(1.2, size=3000) + 1.0) * 0.01,
+                       None, 9e3).tolist()
+    assert dist == "constant"
+    return [0.25] * 100
+
+
+@pytest.mark.parametrize("dist", ["lognormal", "bimodal", "heavy",
+                                  "constant"])
+def test_histogram_percentiles_within_one_bucket(dist):
+    xs = _adversarial(dist)
+    h = obs.LogHistogram()
+    for v in xs:
+        h.record(float(v))
+    for q in (50, 90, 99):
+        exact = _nearest_rank(xs, q)
+        est = h.percentile(q)
+        assert exact / _BUCKET <= est <= exact * _BUCKET, (dist, q, exact,
+                                                          est)
+
+
+def test_histogram_out_of_range_reports_exact_extremes():
+    h = obs.LogHistogram(lo=1e-2, hi=1e2)
+    for v in (0.0, -3.0, 1e-5):          # all below lo (incl. non-positive)
+        h.record(v)
+    assert h.percentile(50) == -3.0      # underflow bucket -> exact min
+    h2 = obs.LogHistogram(lo=1e-2, hi=1e2)
+    h2.record(0.5)
+    h2.record(5e6)                       # overflow
+    assert h2.percentile(99) == 5e6      # overflow bucket -> exact max
+    # p0 stays within one bucket of the exact floor (clamped to >= min)
+    assert 0.5 <= h2.percentile(0) <= 0.5 * _BUCKET
+
+
+def test_histogram_merge_matches_concat():
+    rng = np.random.RandomState(3)
+    a, b = rng.lognormal(size=200), rng.lognormal(size=300)
+    ha, hb, hc = obs.LogHistogram(), obs.LogHistogram(), obs.LogHistogram()
+    for v in a:
+        ha.record(v)
+    for v in b:
+        hb.record(v)
+    for v in list(a) + list(b):
+        hc.record(v)
+    ha.merge(hb)
+    assert ha.counts == hc.counts
+    assert ha.count == hc.count == 500
+    assert ha.min == hc.min and ha.max == hc.max
+    np.testing.assert_allclose(ha.sum, hc.sum)
+    with pytest.raises(ValueError):
+        ha.merge(obs.LogHistogram(bins_per_decade=8))
+
+
+def test_histogram_empty_and_validation():
+    h = obs.LogHistogram()
+    assert h.percentile(50) is None
+    assert h.snapshot()["mean"] is None
+    h.record(1.0)
+    with pytest.raises(ValueError):
+        h.percentile(101)
+    with pytest.raises(ValueError):
+        obs.LogHistogram(lo=0.0)
+    with pytest.raises(ValueError):
+        obs.LogHistogram(lo=2.0, hi=1.0)
+    with pytest.raises(ValueError):
+        obs.LogHistogram(bins_per_decade=0)
+
+
+def test_render_prometheus_exposition():
+    h = obs.LogHistogram()
+    for v in (0.01, 0.02, 0.02, 1.5, 900.0):
+        h.record(v)
+    text = obs.render_prometheus(
+        {"lat_seconds": h, "depth": 3, "skipped": None}, prefix="t")
+    lines = text.splitlines()
+    assert "# TYPE t_lat_seconds histogram" in lines
+    assert "# TYPE t_depth gauge" in lines
+    assert "t_depth 3.0" in lines
+    assert not any("skipped" in ln for ln in lines)
+    # cumulative bucket counts are nondecreasing and end at the total
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+            if ln.startswith('t_lat_seconds_bucket')]
+    assert cums == sorted(cums)
+    assert cums[-1] == 5                      # the +Inf bucket
+    assert 't_lat_seconds_bucket{le="+Inf"} 5' in lines
+    assert "t_lat_seconds_count 5" in lines
+    [s] = [ln for ln in lines if ln.startswith("t_lat_seconds_sum ")]
+    np.testing.assert_allclose(float(s.split()[1]), h.sum)
+    with pytest.raises(TypeError):
+        obs.render_prometheus({"bad": "a string"})
+
+
+def test_step_metrics_step_time_histogram():
+    m = obs.StepMetrics(name="t", n_devices=1)
+    for ms in (10.0, 11.0, 12.0, 100.0):
+        m.step(step_time_s=ms / 1e3)
+    s = m.summary()
+    assert s["step_time_ms_p50"] == pytest.approx(
+        _nearest_rank([10.0, 11.0, 12.0, 100.0], 50), rel=_BUCKET - 1.0)
+    assert s["step_time_ms_p99"] == pytest.approx(100.0, rel=_BUCKET - 1.0)
+
+
+# -- flight recorder (PR-12) --------------------------------------------------
+
+def test_flight_recorder_ring_bound_and_dump_roundtrip(tmp_path):
+    rec = obs.FlightRecorder(source="t", size=8, out_dir=str(tmp_path))
+    for i in range(1, 21):
+        rec.record({"iteration": i, "tokens": i * 2})
+    assert len(rec.ring) == 8
+    path = rec.dump("exception")
+    assert path is not None and os.path.exists(path)
+    payload = obs.load_dump(path)
+    assert payload["source"] == "t" and payload["reason"] == "exception"
+    assert payload["n_records"] == 8
+    assert [r["iteration"] for r in payload["records"]] == list(range(13, 21))
+    # one dump per reason unless forced
+    assert rec.dump("exception") is None
+    assert rec.dump("exception", force=True) is not None
+    assert len(rec.dumped) == 2
+
+
+def test_flight_recorder_spike_fires_and_dumps(tmp_path):
+    from paddle_tpu.observability.flight_recorder import MIN_SPIKE_SAMPLES
+    rec = obs.FlightRecorder(source="t", out_dir=str(tmp_path))
+    for _ in range(MIN_SPIKE_SAMPLES + 4):
+        assert rec.check_step_time(0.01) is None
+    path = rec.check_step_time(0.5)
+    assert path is not None
+    assert obs.load_dump(path)["anomalies"][0]["kind"] == "step_time_spike"
+
+
+def test_flight_recorder_eviction_storm(tmp_path):
+    rec = obs.FlightRecorder(source="t", out_dir=str(tmp_path))
+    paths = [rec.note_eviction(i) for i in range(1, 41)]
+    fired = [p for p in paths if p]
+    assert len(fired) == 1                    # once, not once per iteration
+    assert obs.load_dump(fired[0])["anomalies"][0]["kind"] == "eviction_storm"
+
+
+def test_flight_recorder_dump_without_dir_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_TELEMETRY_DIR", raising=False)
+    rec = obs.FlightRecorder(source="t")
+    rec.record({"iteration": 1})
+    assert rec.dump("exception") is None
+    assert rec.dumped == []
+
+
+def test_flight_recorder_env_gate(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_FLIGHT_RECORDER", raising=False)
+    assert not obs.flight_recorder_enabled()
+    assert obs.flight_recorder_enabled(True)
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER", "1")
+    assert obs.flight_recorder_enabled()
+    assert not obs.flight_recorder_enabled(False)
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_RECORDER_SIZE", "4")
+    assert obs.FlightRecorder(source="t").size == 4

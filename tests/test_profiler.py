@@ -191,3 +191,63 @@ def test_summary_includes_telemetry_section():
         assert "StepMetrics[sumtest]" in prof.summary()
     finally:
         obs.set_active(None)
+
+
+# -- chrome trace-event schema (shared writer, PR-12) -------------------------
+
+def _assert_chrome_schema(path):
+    """Minimal Chrome trace-event-format contract: a JSON object with a
+    ``traceEvents`` list whose events all carry name/ph/pid, duration
+    events numeric ts/dur, and instants a valid scope."""
+    data = json.load(open(path))
+    assert isinstance(data, dict) and isinstance(data["traceEvents"], list)
+    assert data["traceEvents"], "empty trace"
+    for e in data["traceEvents"]:
+        assert isinstance(e["name"], str) and e["name"]
+        assert e["ph"] in ("X", "i", "M", "B", "E")
+        assert isinstance(e["pid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        elif e["ph"] == "i":
+            assert e.get("s", "t") in ("t", "p", "g")
+        elif e["ph"] == "M":
+            assert e["name"] in ("process_name", "thread_name")
+            assert isinstance(e["args"]["name"], str)
+    return data
+
+
+def test_profiler_export_matches_chrome_schema(tmp_path):
+    prof = Profiler(timer_only=True)
+    prof.start()
+    with RecordEvent("alpha"):
+        with RecordEvent("beta"):
+            pass
+    prof.stop()
+    path = str(tmp_path / "prof_schema.json")
+    prof.export(path)
+    _assert_chrome_schema(path)
+
+
+def test_request_tracer_export_matches_chrome_schema(tmp_path):
+    # the tracer goes through the same write_chrome_trace writer as the
+    # profiler, so both exports must satisfy the same schema
+    from paddle_tpu.observability.request_trace import RequestTracer
+    tr = RequestTracer()
+    tr.submit(0, 0.0)
+    tr.admit(0, 0.5)
+    tr.prefill_chunk(0, 0.5, 0.8, n_tokens=32, recompute=False)
+    tr.phase("prefill", 0.5, 0.8, iteration=0)
+    tr.decode([0], 1.0, 1.1, iteration=1)
+    tr.evict(0, 1.2, n_preempted=1)
+    tr.admit(0, 1.5, n_preempted=1)
+    tr.prefill_chunk(0, 1.5, 1.9, n_tokens=33, recompute=True)
+    tr.decode([0], 2.0, 2.1, iteration=4)
+    tr.finish(0, 2.1, n_generated=2)
+    path = tr.export_chrome(str(tmp_path / "req_schema.json"))
+    data = _assert_chrome_schema(path)
+    phs = {e["ph"] for e in data["traceEvents"]}
+    assert {"M", "X", "i"} <= phs
+    rows = {e["args"]["name"] for e in data["traceEvents"]
+            if e["name"] == "thread_name"}
+    assert "request 0" in rows and "engine/prefill" in rows
