@@ -961,6 +961,156 @@ def bench_serve_continuous(dev, config, on_tpu):
     return out
 
 
+def bench_preempt_resume(dev, config, on_tpu):
+    """PR-13 robustness rung: what preemption tolerance costs.
+
+    * save_overlap_overhead_pct — wall time of n train steps with the
+      CheckpointManager's interval-paced ASYNC saves riding along
+      (device->host snapshot inline, file write overlapping subsequent
+      steps) vs the same n steps bare; blocking_save_overhead_pct rides
+      along to show what the overlap buys back;
+    * resume_to_parity_ms — CheckpointManager.restore into a fresh
+      state plus the first post-restore step, whose loss must match the
+      uninterrupted run at that step bitwise (same compiled step);
+    * swap_drain_ms — InferenceEngine.swap_weights drain latency at a
+      mid-serve iteration boundary (identical weights, token streams
+      checked bit-identical against an unswapped run).
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+    from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+    from paddle_tpu.inference import InferenceEngine, Request, ServeConfig
+    from paddle_tpu.models.llama import (ParallelConfig, build_train_step,
+                                         init_llama_params)
+
+    import jax.numpy as jnp
+
+    parallel = ParallelConfig(remat=True, use_flash=on_tpu)
+    step, params, opt = build_train_step(config, parallel, lr=1e-4)
+    batch, seq = (4, 2048) if on_tpu else (2, 128)
+    rng = np.random.RandomState(13)
+    ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, 1).astype(np.int32)
+
+    # the jitted step DONATES its param/opt buffers, so every run that
+    # branches from shared state must branch from a fresh device copy
+    def copy_tree(t):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.copy(a) if isinstance(a, jax.Array) else a, t)
+
+    p, o = copy_tree(params), copy_tree(opt)
+    for _ in range(2):  # compile + warm outside every timed window
+        p, o, loss = step(p, o, ids, labels)
+    jax.device_get(loss)
+    n = 6 if on_tpu else 4
+    interval = n // 2  # two saves per measured run
+
+    root = tempfile.mkdtemp(prefix="paddle_tpu_bench_ckpt_")
+    try:
+        # orbax cold-start (imports, type-handler registration, asyncio
+        # setup) lands on the process's first save/restore — pay it here,
+        # outside every timed window
+        warm = CheckpointManager(os.path.join(root, "warm"), keep=1)
+        warm.save({"params": p, "opt": o, "step": 0}, 0, block=True)
+        warm.restore({"params": copy_tree(p), "opt": copy_tree(o),
+                      "step": 0})
+
+        pp, oo = copy_tree(p), copy_tree(o)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            pp, oo, loss = step(pp, oo, ids, labels)
+        jax.device_get(loss)
+        t_plain = time.perf_counter() - t0
+
+        mgr = CheckpointManager(os.path.join(root, "async"), keep=2,
+                                interval=interval)
+        pp, oo = copy_tree(p), copy_tree(o)
+        t0 = time.perf_counter()
+        for i in range(1, n + 1):
+            pp, oo, loss = step(pp, oo, ids, labels)
+            mgr.on_step(i, lambda: {"params": pp, "opt": oo, "step": i})
+        jax.device_get(loss)
+        t_async = time.perf_counter() - t0
+        errs = mgr.wait()  # drain the tail write OUTSIDE the window:
+        assert not errs, errs  # overlapping it is the feature measured
+
+        mgr_b = CheckpointManager(os.path.join(root, "block"), keep=2)
+        pb, ob = copy_tree(p), copy_tree(o)
+        t0 = time.perf_counter()
+        for i in range(1, n + 1):
+            pb, ob, loss = step(pb, ob, ids, labels)
+            if i % interval == 0:
+                mgr_b.save({"params": pb, "opt": ob, "step": i}, i,
+                           block=True)
+        jax.device_get(loss)
+        t_block = time.perf_counter() - t0
+
+        # resume-to-parity: the uninterrupted run's next-step loss is the
+        # target; restore the newest checkpoint (written at step n, state
+        # == pp/oo) into a fresh template and replay that step
+        _, _, l_ref = step(pp, oo, ids, labels)
+        l_ref = float(jax.device_get(l_ref))
+        tmpl = {"params": copy_tree(params), "opt": copy_tree(opt),
+                "step": 0}
+        t0 = time.perf_counter()
+        restored_step = mgr.restore(tmpl)
+        _, _, l_res = step(tmpl["params"], tmpl["opt"], ids, labels)
+        l_res = float(jax.device_get(l_res))
+        resume_ms = (time.perf_counter() - t0) * 1e3
+
+        # mid-serve weight-swap drain latency, identical-weights parity
+        if on_tpu:
+            serve = ServeConfig(block_size=128, num_blocks=65, max_batch=4,
+                                prefill_chunk=256, max_seq_len=1024)
+            plens, max_new = (64, 384), 16
+        else:
+            serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                                prefill_chunk=64, max_seq_len=256)
+            plens, max_new = (8, 130), 6
+        sparams = init_llama_params(config, seed=0)
+        copy = lambda t: jax.tree_util.tree_map(lambda a: a, t)
+
+        def mk_reqs():
+            r = np.random.RandomState(3)
+            return [Request(r.randint(1, config.vocab_size,
+                                      size=int(nn)).tolist(),
+                            max_new_tokens=max_new, arrival=float(i))
+                    for i, nn in enumerate(plens)]
+
+        ref_eng = InferenceEngine(copy(sparams), config, serve)
+        ref_eng.run(mk_reqs(), deterministic=True)
+        eng = InferenceEngine(copy(sparams), config, serve)
+        eng.swap_weights(copy(sparams), at_iteration=3)
+        st = eng.run(mk_reqs(), deterministic=True)
+        toks = lambda e: {s.req.request_id: s.tokens for s in e.finished}
+
+        out = {
+            "train_steps_timed": n,
+            "saves_per_run": n // interval,
+            "step_time_plain_ms": round(t_plain / n * 1e3, 2),
+            "save_overlap_overhead_pct":
+                round((t_async / t_plain - 1) * 100, 2),
+            "blocking_save_overhead_pct":
+                round((t_block / t_plain - 1) * 100, 2),
+            "resume_to_parity_ms": round(resume_ms, 1),
+            "resume_step": restored_step,
+            "resume_loss_bitwise": l_res == l_ref,
+            "swap_drain_ms": round(eng.last_swap["swap_ms"], 2),
+            "swap_tokens_identical": toks(eng) == toks(ref_eng),
+            "swap_unfinished": st["unfinished"],
+        }
+        if not on_tpu:
+            out["note"] = ("tiny config on CPU — overhead ratios are "
+                           "functional-rung numbers; the flagship costs "
+                           "land with the TPU bench round")
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _static_analysis_record():
     """Per-rule finding counts from paddle_tpu.analysis — the bench
     record carries the lint posture of the tree the numbers came from
@@ -1096,6 +1246,10 @@ def main():
     # continuous-batching serving engine (paged KV cache) under a
     # Poisson arrival trace — runs on both backends
     detail["serve_continuous"] = bench_serve_continuous(dev, config, on_tpu)
+
+    # preemption-tolerant training (PR 13): checkpoint-overlap cost,
+    # resume-to-parity, live weight-swap drain — runs on both backends
+    detail["preempt_resume"] = bench_preempt_resume(dev, config, on_tpu)
 
     if on_tpu:
         detail["step_ledger_flagship"] = bench_step_ledger(

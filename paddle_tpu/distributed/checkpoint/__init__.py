@@ -5,5 +5,7 @@ Sharded, metadata-carrying save/load with reshard-on-load, built on orbax
 mesh/shardings — the TPU-native equivalent of the reference's per-rank shard
 files + reshard logic.
 """
-from .save_load import (AsyncSaveHandle, load_sharding_meta, load_state_dict,
-                        save_state_dict, wait_all_async_saves)
+from .manager import CheckpointManager, Preempted
+from .save_load import (AsyncSaveHandle, load_manifest, load_sharding_meta,
+                        load_state_dict, save_state_dict,
+                        wait_all_async_saves)

@@ -18,12 +18,30 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Any, Dict, Optional
+import warnings
+import zlib
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
 from ...tensor.tensor import Tensor
+from ...testing import faults
+
+# The named stages of the write/publish protocol, in order. The crash
+# matrix (tests/test_checkpoint_manager.py) kills a save at every one of
+# these and asserts CheckpointManager.latest() still resolves a complete
+# checkpoint. "after_publish" is past the commit rename: a crash there
+# loses nothing but the manager's COMMIT marker (see manager.COMMIT_POINTS
+# for the marker-side points).
+CKPT_WRITE_POINTS = (
+    "ckpt.write.begin",          # before leftover cleanup / any I/O
+    "ckpt.write.after_arrays",   # array shards written into the tmp dir
+    "ckpt.write.after_meta",     # sharding_meta.json written
+    "ckpt.write.after_manifest", # manifest.json (checksums) written
+    "ckpt.write.before_publish", # one instant before the commit rename
+    "ckpt.write.after_publish",  # tmp renamed to its final name
+)
 
 
 def _leaf_sharding_meta(v):
@@ -49,9 +67,12 @@ def _to_arrays(state_dict):
     # shardings, and load_state_dict re-shards onto each target tensor's
     # layout (single-controller: the host sees every shard anyway). Nested
     # pytrees (optimizer states etc.) pass through with Tensor/array leaves
-    # converted in place.
+    # converted in place. copy=True is load-bearing: np.asarray of a CPU
+    # jax.Array can alias the XLA buffer, and a donating jitted step reuses
+    # that buffer — an aliased "snapshot" mutates under the async writer
     return jax.tree_util.tree_map(
-        lambda v: np.asarray(v._data if isinstance(v, Tensor) else v),
+        lambda v: np.array(v._data if isinstance(v, Tensor) else v,
+                           copy=True),
         state_dict, is_leaf=lambda v: isinstance(v, Tensor))
 
 
@@ -71,6 +92,19 @@ def _sharding_tree(state_dict):
         is_leaf=lambda v: isinstance(v, Tensor))
 
 
+def leaf_checksums(arrays) -> list:
+    """Per-leaf CRC32s over the host snapshot, in tree_leaves order.
+    Each entry folds shape+dtype into the checksum so a truncated or
+    re-typed shard can't collide with its original."""
+    out = []
+    for leaf in jax.tree_util.tree_leaves(arrays):
+        a = np.asarray(leaf)
+        crc = zlib.crc32(repr((a.shape, str(a.dtype))).encode())
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+        out.append(int(crc))
+    return out
+
+
 class AsyncSaveHandle:
     """Future-like handle for a background checkpoint write."""
 
@@ -78,10 +112,20 @@ class AsyncSaveHandle:
         self._thread = thread
         self._error: Optional[BaseException] = None
 
+    def started(self) -> bool:
+        return self._thread.ident is not None
+
     def done(self) -> bool:
-        return not self._thread.is_alive()
+        # an unstarted thread is not alive, but its write hasn't happened
+        # either — "done" must mean "the write finished", or a manager
+        # would GC/commit over a save that never ran
+        return self._thread.ident is not None and not self._thread.is_alive()
 
     def wait(self, timeout: Optional[float] = None):
+        if self._thread.ident is None:
+            raise RuntimeError(
+                "checkpoint write thread was never started; the save that "
+                "created this handle failed before launching its writer")
         self._thread.join(timeout)
         if self._thread.is_alive():
             raise TimeoutError("checkpoint write still in progress")
@@ -101,36 +145,59 @@ def wait_all_async_saves():
         h.wait()
 
 
-def _write_checkpoint(path: str, arrays, meta):
+def _write_checkpoint(path: str, arrays, meta, manifest=None):
     import shutil
 
     import orbax.checkpoint as ocp
     tmp, old = path + ".tmp", path + ".old"
+    faults.inject("ckpt.write.begin", dir=path)
     for leftover in (tmp, old):  # residue of an earlier crashed save
         if os.path.exists(leftover):
             shutil.rmtree(leftover)
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(tmp, arrays, force=True)
+    faults.inject("ckpt.write.after_arrays", dir=tmp)
     with open(os.path.join(tmp, "sharding_meta.json"), "w") as f:
         json.dump(meta, f)
+    faults.inject("ckpt.write.after_meta", dir=tmp)
+    if manifest is not None:
+        manifest = dict(manifest)
+        sums = leaf_checksums(arrays)
+        manifest["leaf_checksums"] = sums
+        manifest["n_leaves"] = len(sums)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        faults.inject("ckpt.write.after_manifest", dir=tmp)
     # crash-safe publish: the previous complete checkpoint is moved aside
     # (rename, not delete) before the new one is renamed in, so a kill at
     # any instant leaves either `path` or `path + ".old"` complete —
     # load_state_dict falls back to ".old" if `path` is missing.
+    faults.inject("ckpt.write.before_publish", dir=tmp)
     if os.path.exists(path):
         os.replace(path, old)
     os.replace(tmp, path)
+    faults.inject("ckpt.write.after_publish", dir=path)
     if os.path.exists(old):
         shutil.rmtree(old)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    async_save: bool = False):
+                    async_save: bool = False,
+                    manifest: Optional[Dict[str, Any]] = None,
+                    on_complete: Optional[Callable[[], None]] = None):
     """Save `state_dict` to `path`. With async_save=True the device->host
     snapshot happens now (cheap) and the write runs in a background thread;
     returns an AsyncSaveHandle. A second save to the same path waits for
-    the first (ordering is preserved per-path)."""
+    the first (ordering is preserved per-path).
+
+    `manifest` (extra fields, e.g. the step number) opts into writing a
+    ``manifest.json`` with per-leaf checksums inside the checkpoint before
+    publish. `on_complete` runs in the writer thread after a successful
+    publish and before the handle resolves — CheckpointManager writes its
+    COMMIT marker there, so "handle done without error" implies "marker
+    down". An on_complete failure surfaces on wait() like a write failure.
+    """
     arrays = _to_arrays(state_dict)  # snapshot: values at call time
     # per-leaf meta, aligned with the flatten order of `arrays`' leaves
     # (same structure, every leaf mapped — None kept for unsharded leaves)
@@ -147,7 +214,9 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
 
     def run():
         try:
-            _write_checkpoint(path, arrays, meta)
+            _write_checkpoint(path, arrays, meta, manifest=manifest)
+            if on_complete is not None:
+                on_complete()
         except BaseException as e:  # surfaced on wait()
             handle_box["h"]._error = e
         finally:
@@ -206,23 +275,53 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     ckptr = ocp.PyTreeCheckpointer()
     restored = ckptr.restore(path)
     if isinstance(restored, dict):
-        restored.pop("sharding_meta.json", None)
+        # json sidecars written inside the checkpoint dir come back as
+        # tree entries; they are not state
+        for sidecar in ("sharding_meta.json", "manifest.json",
+                        "COMMIT.json"):
+            restored.pop(sidecar, None)
 
-    def fill(target, saved):
+    def reshard(data, sharding, leaf_path):
+        try:
+            return jax.device_put(data, sharding)
+        except Exception as e:
+            # a failed device_put leaves the leaf host-resident/replicated:
+            # correct values, silently slow (every step re-shards it). Warn
+            # once per leaf so an elastic resume onto an incompatible
+            # sharding is diagnosable.
+            if leaf_path not in _reshard_warned:
+                _reshard_warned.add(leaf_path)
+                warnings.warn(
+                    f"checkpoint leaf {leaf_path!r}: device_put onto "
+                    f"{sharding} failed ({type(e).__name__}: {e}); keeping "
+                    "the host copy un-resharded", RuntimeWarning)
+            return data
+
+    def fill(target, saved, leaf_path=""):
         """Recursively fill Tensor leaves in place; returns the new value for
-        non-Tensor leaves so nested optimizer-state dicts restore too."""
+        non-Tensor leaves so nested optimizer-state dicts restore too. Raw
+        jax.Array leaves (TrainStep state dicts, functional train states)
+        are replaced by the saved values resharded onto the leaf's current
+        sharding — the elastic-resume path for non-Tensor trees."""
         if isinstance(target, Tensor):
-            data = jax.numpy.asarray(np.asarray(saved), dtype=target._data.dtype)
-            try:
-                data = jax.device_put(data, target._data.sharding)
-            except Exception:
-                pass
-            target._data = data
+            data = _from_host(saved, target._data.dtype)
+            target._data = reshard(data, target._data.sharding, leaf_path)
             return target
+        if isinstance(target, jax.Array):
+            data = _from_host(saved, target.dtype)
+            if not getattr(target, "_committed", True):
+                # an UNCOMMITTED target (e.g. a functional optimizer's
+                # scalar step counter, never device_put by its builder)
+                # must stay uncommitted: committing it to the default
+                # device makes jit refuse to co-place it with mesh-
+                # sharded params on elastic resume
+                return data
+            return reshard(data, target.sharding, leaf_path)
         if isinstance(target, dict) and isinstance(saved, dict):
             for k in target:
                 if k in saved:
-                    target[k] = fill(target[k], saved[k])
+                    target[k] = fill(target[k], saved[k],
+                                     f"{leaf_path}.{k}" if leaf_path else str(k))
             for k in saved:
                 # structure the target hasn't materialized yet (e.g. an
                 # optimizer's lazily-created moment dicts before step 1)
@@ -235,7 +334,8 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                 raise ValueError(
                     f"checkpoint sequence length mismatch: target has "
                     f"{len(target)} entries, saved has {len(saved)}")
-            out = [fill(t, s) for t, s in zip(target, saved)]
+            out = [fill(t, s, f"{leaf_path}[{i}]")
+                   for i, (t, s) in enumerate(zip(target, saved))]
             if hasattr(target, "_fields"):
                 # namedtuples take positional fields, not an iterable
                 return type(target)(*out)
@@ -246,6 +346,20 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     return state_dict
 
 
+# leaf paths already warned about (once per process, not per load: an
+# elastic resume loads the same tree repeatedly in retry loops)
+_reshard_warned: set = set()
+
+
+def _from_host(saved, dtype=None):
+    """Host (orbax-restored) value -> device array that OWNS its buffer.
+    jnp.array, NOT jnp.asarray: asarray of a 64-byte-aligned numpy array
+    (orbax buffers, by allocation luck) is ZERO-COPY — jax borrows the
+    numpy buffer, and a donating train step then writes into / frees
+    memory jax doesn't own (flaky nan losses and heap corruption)."""
+    return jax.numpy.array(np.asarray(saved), dtype=dtype)
+
+
 def _adopt(saved):
     """Convert restored host values to Tensor-leaved structures."""
     if isinstance(saved, dict):
@@ -253,8 +367,22 @@ def _adopt(saved):
     if isinstance(saved, (list, tuple)):
         return type(saved)(_adopt(v) for v in saved)
     if isinstance(saved, np.ndarray):
-        return Tensor._from_data(jax.numpy.asarray(saved))
+        return Tensor._from_data(_from_host(saved))
     return saved
+
+
+def load_manifest(path: str):
+    """The checksum manifest written at save time (None when absent or
+    unparseable — an unparseable manifest marks the checkpoint incomplete,
+    it is never an error here)."""
+    p = os.path.join(os.path.abspath(path), "manifest.json")
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def load_sharding_meta(path: str):

@@ -339,6 +339,36 @@ _register(
     parse=_positive_float("PADDLE_TPU_SPIKE_MAD", 8.0))
 
 _register(
+    "PADDLE_TPU_CKPT_KEEP", "int", 3,
+    doc="Rolling-checkpoint retention for CheckpointManager (PR 13): the "
+        "keep-N garbage collector deletes complete step dirs beyond the "
+        "newest N. Positive integer; an explicit keep= argument wins.",
+    parse=_positive_int("PADDLE_TPU_CKPT_KEEP", 3))
+
+_register(
+    "PADDLE_TPU_CKPT_INTERVAL", "int", None,
+    doc="Steps between CheckpointManager.on_step async saves (PR 13); "
+        "''/'auto'/unset disables interval pacing (explicit save() calls "
+        "only). An explicit interval= argument wins.",
+    parse=_positive_int("PADDLE_TPU_CKPT_INTERVAL", None, allow_auto=True))
+
+_register(
+    "PADDLE_TPU_PREEMPT_GRACE", "float", 30.0,
+    doc="Seconds a preemption shutdown (PR 13) waits for the in-flight "
+        "async checkpoint write before abandoning it and taking the "
+        "final sync save. Positive number.",
+    parse=_positive_float("PADDLE_TPU_PREEMPT_GRACE", 30.0))
+
+_register(
+    "PADDLE_TPU_FAULTS", "bool", False,
+    doc="Gate for the deterministic fault-injection harness "
+        "(paddle_tpu.testing.faults, PR 13): arming an injection point "
+        "raises unless this is set, so production code can never run "
+        "with live fault hooks. The hooks themselves cost one flag "
+        "check when disarmed.",
+    parse=_strict_bool("PADDLE_TPU_FAULTS"))
+
+_register(
     "PADDLE_TPU_SEP_STRATEGY", "enum", "ring",
     doc="Context-parallel attention strategy for the llama sep axis "
         "(PR 7): 'ring' (PR-1 ring attention) or 'ulysses' (head-sharded "

@@ -34,13 +34,19 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import os
+import signal
+import threading
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .. import envs
+from ..testing import faults
 from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
                             _jitted_paged_prefill, init_paged_kv_pool)
 from ..observability import histogram as _hist
@@ -181,6 +187,14 @@ class InferenceEngine:
         self._frozen = _freeze_config(config)
         self._compiled: Dict[Tuple, float] = {}
         self._clock = 0.0
+        # preemption + live weight push (PR 13)
+        self._preempt = threading.Event()
+        self._was_preempted = False
+        self._signum: Optional[int] = None
+        self._prev_handler: Any = None
+        self._pending_swap: Optional[Tuple[Any, int]] = None
+        self.swaps = 0
+        self.last_swap: Optional[Dict[str, Any]] = None
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -270,9 +284,21 @@ class InferenceEngine:
     def step(self) -> List[_Seq]:
         """One scheduler iteration: admit, one prefill chunk, one decode
         batch. Returns sequences that finished this iteration."""
+        # the gap between step() calls is the engine's safe boundary: the
+        # previous decode already synced its tokens to the host, nothing
+        # is in flight — scheduled weight swaps land exactly here
+        if self._pending_swap is not None \
+                and self.iteration + 1 >= self._pending_swap[1]:
+            source, _ = self._pending_swap
+            self._pending_swap = None
+            self._apply_swap(source)
         self.iteration += 1
         self._last_tokens = 0
         t_iter = time.perf_counter()
+        if faults.fires("serve.preempt_storm"):
+            # injected pool-pressure fault: forcibly evict the youngest
+            # running sequence, as if a burst had stolen its blocks
+            self._evict_one()
         self._admit()
         t_adm = time.perf_counter()
         ran_prefill = self._prefill_chunk()
@@ -448,6 +474,165 @@ class InferenceEngine:
                                        len(seq.generated))
         return done
 
+    # -- preemption + live weight push (PR 13) ------------------------------
+
+    def request_preemption(self) -> None:
+        """Signal a graceful stop: run() exits at the next iteration
+        boundary with queued/active requests intact (thread/signal safe)."""
+        self._preempt.set()
+
+    def clear_preemption(self) -> None:
+        """Re-arm a preempted engine: run() continues from intact queue/
+        active state (deterministic replay resumes bit-identically)."""
+        self._preempt.clear()
+
+    def install_preemption_handler(self, signum: int = signal.SIGTERM) -> None:
+        """SIGTERM -> request_preemption(); the loop itself never runs
+        device code from the handler."""
+        try:
+            self._prev_handler = signal.signal(
+                signum, lambda s, f: self._preempt.set())
+            self._signum = signum
+        except ValueError:
+            warnings.warn(
+                "cannot install a signal handler off the main thread; "
+                "use request_preemption()", RuntimeWarning)
+
+    def uninstall_preemption_handler(self) -> None:
+        if self._signum is not None:
+            signal.signal(self._signum, self._prev_handler or signal.SIG_DFL)
+            self._signum = None
+            self._prev_handler = None
+
+    def swap_weights(self, source, at_iteration: Optional[int] = None
+                     ) -> Dict[str, Any]:
+        """Live weight push: replace the model weights without restarting
+        the engine or dropping a request.
+
+        `source` is a checkpoint directory (a ``save_state_dict`` dir or a
+        CheckpointManager root, whose newest complete checkpoint is used)
+        or an in-memory param pytree. The new tree must match the current
+        one exactly — same structure, shapes, dtypes (same compiled step
+        family, so no recompile). Each leaf is placed onto the CURRENT
+        leaf's sharding and rebound in place, one leaf at a time (peak
+        extra memory = one weight); the KV pools, block tables and all
+        scheduler state are untouched.
+
+        With ``at_iteration`` the swap is deferred to that iteration's
+        boundary — the safe drain point: the previous decode has synced
+        its sampled tokens, nothing is in flight. Called without it, the
+        swap applies immediately (between run() calls, or before serving
+        starts). With identical weights the post-swap token stream is
+        bit-identical; in-flight sequences keep their KV prefix either
+        way (their earlier tokens reflect the old weights — the standard
+        live-update contract)."""
+        if at_iteration is not None and at_iteration > self.iteration:
+            self._pending_swap = (source, int(at_iteration))
+            self._event("swap_scheduled", int(at_iteration))
+            return {"scheduled_at": int(at_iteration)}
+        return self._apply_swap(source)
+
+    def _resolve_swap_source(self, source):
+        if not isinstance(source, str):
+            return source, None
+        path = os.path.abspath(source)
+        from ..distributed.checkpoint import save_load as sl
+        from ..distributed.checkpoint.manager import (CheckpointManager,
+                                                      _STEP_RE)
+        try:
+            entries = os.listdir(path)
+        except OSError:
+            entries = []
+        if any(_STEP_RE.match(n) for n in entries):
+            # a manager root: serve from its newest complete checkpoint
+            resolved = CheckpointManager(path).latest_path()
+            if resolved is None:
+                raise FileNotFoundError(
+                    f"swap_weights: no complete checkpoint under {path!r}")
+            path = resolved
+        with sl._pending_lock:
+            prev = sl._pending.get(path)
+        if prev is not None:
+            prev.wait()  # an in-flight async save to this very dir
+        import orbax.checkpoint as ocp
+        restored = ocp.PyTreeCheckpointer().restore(path)
+        if isinstance(restored, dict):
+            for sidecar in ("sharding_meta.json", "manifest.json",
+                            "COMMIT.json"):
+                restored.pop(sidecar, None)
+            # a TrainStep/manager checkpoint nests weights under "params"
+            if "params" in restored and "params" not in self.params:
+                restored = restored["params"]
+        return restored, path
+
+    def _apply_swap(self, source) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        new_tree, path = self._resolve_swap_source(source)
+        n_leaves = [0]
+
+        def swap_fill(target, saved, leaf_path):
+            if isinstance(target, dict):
+                if not isinstance(saved, dict) or set(target) != set(saved):
+                    raise ValueError(
+                        f"swap_weights: param tree mismatch at "
+                        f"{leaf_path or '<root>'!r}: engine has "
+                        f"{sorted(target) if isinstance(target, dict) else type(target)}, "
+                        f"source has "
+                        f"{sorted(saved) if isinstance(saved, dict) else type(saved)}")
+                for k in target:
+                    target[k] = swap_fill(
+                        target[k], saved[k],
+                        f"{leaf_path}.{k}" if leaf_path else str(k))
+                return target
+            if isinstance(target, (list, tuple)):
+                if not isinstance(saved, (list, tuple)) \
+                        or len(target) != len(saved):
+                    raise ValueError(
+                        f"swap_weights: param tree mismatch at "
+                        f"{leaf_path!r}")
+                out = [swap_fill(t, s, f"{leaf_path}[{i}]")
+                       for i, (t, s) in enumerate(zip(target, saved))]
+                return type(target)(out)
+            shape = tuple(np.shape(saved))
+            if tuple(target.shape) != shape:
+                raise ValueError(
+                    f"swap_weights: shape mismatch at {leaf_path!r}: "
+                    f"engine {tuple(target.shape)}, source {shape}")
+            # place onto the CURRENT leaf's sharding/dtype: the compiled
+            # decode/prefill steps see identical avals, so no recompile;
+            # the old buffer frees as soon as this rebind drops it
+            arr = jnp.asarray(np.asarray(saved), dtype=target.dtype)  # noqa: PTA006 -- swap boundary is a drain point by contract; source is host-resident
+            sh = getattr(target, "sharding", None)
+            if sh is not None:
+                arr = jax.device_put(arr, sh)
+            n_leaves[0] += 1
+            return arr
+
+        drained_running = sum(1 for s in self.active if s.state == RUNNING)
+        drained_prefill = sum(1 for s in self.active if s.state == PREFILL)
+        if isinstance(self.params, dict):
+            swap_fill(self.params, new_tree, "")
+        else:
+            self.params = swap_fill(self.params, new_tree, "")
+        self.swaps += 1
+        record_counter("serve.swap")
+        stats = {
+            "iteration": self.iteration,
+            "swap_ms": (time.perf_counter() - t0) * 1e3,
+            "n_leaves": n_leaves[0],
+            "in_flight_running": drained_running,
+            "in_flight_prefill": drained_prefill,
+            "source": path,
+        }
+        self.last_swap = stats
+        self._event("swap", n_leaves[0])
+        if self.recorder is not None:
+            self.recorder.record({"iteration": self.iteration,
+                                  "event": "swap", **{
+                                      k: v for k, v in stats.items()
+                                      if k != "iteration"}})
+        return stats
+
     # -- driving loops ------------------------------------------------------
 
     def _now(self) -> float:
@@ -468,6 +653,17 @@ class InferenceEngine:
         t0 = time.perf_counter()
         try:
             while pending or not self.idle():
+                if self._preempt.is_set() or faults.fires("serve.preempt"):
+                    # graceful preemption: stop at the iteration boundary
+                    # (nothing in flight), dump the post-mortem ring and
+                    # return — queued/active work stays intact for a
+                    # successor engine to re-drive
+                    self._was_preempted = True
+                    record_counter("serve.preempted")
+                    self._event("preempt_stop")
+                    if self.recorder is not None:
+                        self.recorder.dump("preemption")
+                    break
                 if self.iteration >= max_iterations:
                     raise RuntimeError("engine exceeded max_iterations")
                 self._clock = (float(self.iteration) if deterministic
@@ -533,6 +729,8 @@ class InferenceEngine:
             "tpot_stream_p50_s": self.slo["tpot"].percentile(50),
             "tpot_stream_p99_s": self.slo["tpot"].percentile(99),
             "preemptions": self.preemptions,
+            "preempted": self._was_preempted,
+            "weight_swaps": self.swaps,
             "iterations": self.iteration,
             "compiles": {f"{k}_{v}": round(t, 3)
                          for (k, v), t in sorted(self._compiled.items())},

@@ -46,7 +46,16 @@ class TrainStep:
                  telemetry: Optional[bool] = None,
                  telemetry_dir: Optional[str] = None,
                  tokens_per_step: Optional[int] = None,
-                 flight_recorder: Optional[bool] = None):
+                 flight_recorder: Optional[bool] = None,
+                 checkpoint=None):
+        # rolling-checkpoint + preemption orchestration (PR 13): a
+        # CheckpointManager instance or a root directory string. on_step
+        # fires after every completed step; interval pacing and the
+        # SIGTERM path live in the manager.
+        if isinstance(checkpoint, str):
+            from ..distributed.checkpoint.manager import CheckpointManager
+            checkpoint = CheckpointManager(checkpoint)
+        self.checkpoint = checkpoint
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -483,7 +492,44 @@ class TrainStep:
         self.opt_states = new_s
         self.buffers = new_b
         self._step_count += 1
+        if self.checkpoint is not None:
+            # interval-paced async save (overlaps the next steps) and the
+            # preemption hook: a pending SIGTERM raises Preempted here,
+            # after the final sync save and flight-recorder dump
+            self.checkpoint.on_step(self._step_count, self.state_dict,
+                                    recorder=self.recorder)
         return Tensor._from_data(loss)
+
+    def state_dict(self):
+        """Checkpointable state: params, optimizer states, buffers and the
+        step counter, as raw (possibly sharded) jax arrays. Restoring via
+        CheckpointManager.restore reshards each leaf onto whatever
+        sharding THIS TrainStep placed it with — the elastic-resume path
+        when the mesh shape changed between save and restore."""
+        return {"params": dict(self.params),
+                "opt_states": self.opt_states,
+                "buffers": dict(self.buffers),
+                "step": self._step_count}
+
+    def load_state_dict(self, state):
+        """Adopt a (restored) state dict produced by :meth:`state_dict`."""
+        self.params.update(state["params"])
+        self.opt_states = state["opt_states"]
+        self.buffers.update(state["buffers"])
+        self._step_count = int(np.asarray(state["step"]))  # noqa: PTA006 -- restore boundary, once per resume: the step counter must become a host int
+
+    def restore(self, checkpoint=None, step: Optional[int] = None) -> int:
+        """Restore from `checkpoint` (defaults to the ctor's manager):
+        fills a fresh state_dict() — current shardings as reshard targets —
+        and adopts it. Returns the restored step number."""
+        mgr = checkpoint if checkpoint is not None else self.checkpoint
+        if mgr is None:
+            raise ValueError("no CheckpointManager: pass checkpoint= to "
+                             "restore() or the TrainStep constructor")
+        state = self.state_dict()
+        restored = mgr.restore(state, step=step)
+        self.load_state_dict(state)
+        return restored
 
     def _capture_cost(self, train_params, frozen, batch, sub, lr):
         """FLOPs-per-step from the lowered program's cost analysis (client-

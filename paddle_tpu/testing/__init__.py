@@ -1,0 +1,10 @@
+"""Test-support machinery importable from library code.
+
+Only :mod:`paddle_tpu.testing.faults` lives here today: deterministic
+fault injection for the robustness suite (checkpoint crash matrix,
+serving preemption storms). Library call sites stay O(one dict probe)
+when nothing is armed, so shipping the hooks costs nothing.
+"""
+from . import faults
+
+__all__ = ["faults"]

@@ -1,0 +1,437 @@
+"""CheckpointManager: crash matrix, rolling GC, elastic resume, preemption.
+
+The acceptance contract pinned here (ISSUE 13):
+
+  * crash matrix — a save killed at EVERY named point of the write/
+    publish/commit protocol leaves ``latest()`` resolving a complete,
+    checksum-valid checkpoint, and training resumed from it reproduces
+    the uninterrupted run's losses BITWISE;
+  * corruption (bitrot after commit) degrades to the next-older
+    checkpoint with a warning, never to a corrupted resume;
+  * keep-N GC only ever reaps complete checkpoints — never a dir whose
+    async write is still in flight, never another manager's work;
+  * elastic resume — a checkpoint saved under one mesh shape restores
+    onto a different one, resharding every leaf onto the new layout;
+  * SIGTERM — the in-flight write finishes, one final sync save lands,
+    the flight-recorder ring is dumped, and Preempted unwinds the loop.
+
+Tiny model on CPU; fault injection via paddle_tpu.testing.faults (env-
+gated, seeded, replayable — no real kills or wall-clock needed).
+"""
+import os
+import signal
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.checkpoint import save_load as sl
+from paddle_tpu.distributed.checkpoint.manager import (CRASH_POINTS, MARKER,
+                                                       CheckpointManager,
+                                                       Preempted)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.testing import faults
+from paddle_tpu.utils import unique_name
+
+
+@pytest.fixture
+def faults_on(monkeypatch):
+    monkeypatch.setenv(faults.ENV_FAULTS, "1")
+    yield
+    faults.disarm()
+
+
+def _loss(out, label):
+    return paddle.mean((out - label) ** 2)
+
+
+def _make_step(checkpoint=None, **kw):
+    # unique_name.guard(): a fresh process after a preemption restarts the
+    # auto-name counters — param/accumulator keys must match the save
+    with unique_name.guard():
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+        opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+        return TrainStep(model, _loss, opt, checkpoint=checkpoint, **kw)
+
+
+def _batches(n=5):
+    rng = np.random.RandomState(7)
+    return [(paddle.to_tensor(rng.randn(8, 8).astype(np.float32)),
+             paddle.to_tensor(rng.randn(8, 4).astype(np.float32)))
+            for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def ref_losses():
+    """The uninterrupted run every resumed run must match bitwise."""
+    step = _make_step()
+    return [float(step(x, labels=y)) for x, y in _batches()]
+
+
+# -- the crash matrix --------------------------------------------------------
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crash_matrix_resume_is_bitwise(point, tmp_path, ref_losses,
+                                        faults_on):
+    """Kill the step-3 save at `point`: latest() must still resolve a
+    complete checkpoint and the resumed losses must equal the
+    uninterrupted run's exactly."""
+    batches = _batches()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=8, interval=1)
+    step = _make_step(checkpoint=mgr)
+    losses = []
+    for x, y in batches[:2]:
+        losses.append(float(step(x, labels=y)))
+    assert mgr.wait() == [] and mgr.latest() == 2
+    with faults.scope(point, "raise") as plan:
+        losses.append(float(step(batches[2][0], labels=batches[2][1])))
+        errs = mgr.wait()
+    assert plan.fired == 1, f"{point} was never reached"
+    # the injected crash surfaced as that save's error, not a training
+    # failure — the loss stream is untouched
+    assert len(errs) == 1 and isinstance(errs[0][1], faults.FaultError)
+    assert losses == ref_losses[:3]
+    # past the marker the save IS complete; anywhere earlier it never
+    # produced one and latest() falls back to step 2
+    expect = 3 if point == "ckpt.commit.after_marker" else 2
+    assert mgr.latest() == expect
+    assert mgr.verify_step(expect)
+
+    # "restart": a fresh process discovers the root from disk alone
+    step2 = _make_step()
+    start = step2.restore(checkpoint=CheckpointManager(str(tmp_path / "ck")))
+    assert start == expect
+    resumed = [float(step2(x, labels=y)) for x, y in batches[start:]]
+    assert resumed == ref_losses[start:]
+
+
+def test_corrupted_checkpoint_falls_back_older(tmp_path, ref_losses):
+    """Bitrot in a committed checkpoint: restore detects the checksum
+    mismatch, warns, and resumes from the next-older step — bitwise."""
+    batches = _batches()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=8, interval=1)
+    step = _make_step(checkpoint=mgr)
+    for x, y in batches[:3]:
+        step(x, labels=y)
+    assert mgr.wait() == [] and mgr.latest() == 3
+    faults.corrupt_array_file({"dir": mgr.step_dir(3)})
+    assert not mgr.verify_step(3) and mgr.verify_step(2)
+    step2 = _make_step()
+    with pytest.warns(RuntimeWarning, match="checksum"):
+        start = step2.restore(
+            checkpoint=CheckpointManager(str(tmp_path / "ck")))
+    assert start == 2
+    resumed = [float(step2(x, labels=y)) for x, y in batches[2:]]
+    assert resumed == ref_losses[2:]
+
+
+# -- rolling window / completeness ------------------------------------------
+
+def test_rolling_keep_n_gc(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save({"w": paddle.to_tensor(np.full(8, float(s), np.float32)),
+                  "step": paddle.to_tensor(s)}, s, block=True)
+    assert mgr.steps() == [3, 4]
+    assert not os.path.isdir(mgr.step_dir(1))
+    assert not os.path.isdir(mgr.step_dir(2))
+
+
+def test_latest_skips_incomplete_dirs(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=4)
+    mgr.save({"w": paddle.to_tensor(np.arange(4, dtype=np.float32)),
+              "step": paddle.to_tensor(1)}, 1, block=True)
+    # a killed save's residue: dir without a marker ...
+    os.makedirs(os.path.join(mgr.root, "step_00000002"))
+    # ... and one whose marker is torn mid-write
+    d3 = os.path.join(mgr.root, "step_00000003")
+    os.makedirs(d3)
+    with open(os.path.join(d3, MARKER), "w") as f:
+        f.write("{not json")
+    assert mgr.latest() == 1
+    tgt = {"w": paddle.zeros([4]), "step": paddle.to_tensor(0)}
+    assert mgr.restore(tgt) == 1
+    np.testing.assert_array_equal(tgt["w"].numpy(),
+                                  np.arange(4, dtype=np.float32))
+
+
+def test_on_step_interval_pacing(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=8, interval=2)
+    state_fn = lambda: {"w": paddle.to_tensor(np.ones(4, np.float32))}
+    saved = [mgr.on_step(s, state_fn) is not None for s in range(1, 6)]
+    assert mgr.wait() == []
+    assert saved == [False, True, False, True, False]
+    assert mgr.steps() == [2, 4]
+
+
+def test_restore_on_empty_root_names_the_reason(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with pytest.raises(FileNotFoundError, match="empty"):
+        mgr.restore({"w": paddle.zeros([2])})
+
+
+# -- concurrency edges -------------------------------------------------------
+
+def test_wait_on_unstarted_handle():
+    """A handle whose writer thread never launched must not report done
+    (GC/commit would run over a save that never happened) and must raise
+    a clear error instead of hanging on join()."""
+    h = sl.AsyncSaveHandle(threading.Thread(target=lambda: None))
+    assert not h.started() and not h.done()
+    with pytest.raises(RuntimeError, match="never started"):
+        h.wait()
+
+
+def test_failed_handle_error_is_sticky(tmp_path, faults_on):
+    sd = {"w": paddle.ones([2])}
+    path = str(tmp_path / "ck")
+    with faults.scope("ckpt.write.begin", "raise"):
+        h = sl.save_state_dict(sd, path, async_save=True)
+        with pytest.raises(faults.FaultError):
+            h.wait()
+    assert h.started() and h.done()
+    with pytest.raises(faults.FaultError):
+        h.wait()  # every waiter sees the failure, not just the first
+    # the dead save deregistered itself: the path is reusable
+    sl.save_state_dict(sd, path)
+    tgt = {"w": paddle.zeros([2])}
+    sl.load_state_dict(tgt, path)
+    np.testing.assert_array_equal(tgt["w"].numpy(), 1.0)
+
+
+def test_two_managers_one_directory(tmp_path):
+    root = str(tmp_path / "shared")
+    m1 = CheckpointManager(root, keep=2)
+    m2 = CheckpointManager(root, keep=2)
+    st = {"w": paddle.to_tensor(np.ones(4, np.float32))}
+    m1.save(st, 1)
+    m2.save(st, 2)
+    assert m1.wait() == [] and m2.wait() == []
+    assert m1.steps() == m2.steps() == [1, 2]
+    # either manager may roll the shared window; completeness, not
+    # ownership, decides what is reapable
+    m2.keep = 1
+    m2.gc()
+    assert m1.steps() == [2]
+    # re-saving a published step (same or different manager) replaces it
+    m1.save(st, 3, block=True)
+    m2.save(st, 3, block=True)
+    assert m1.latest() == 3 and m1.verify_step(3)
+
+
+def test_gc_never_reaps_in_flight_write(tmp_path, faults_on):
+    """keep-N sweeps racing an in-flight async write: the half-written
+    dir is invisible to steps() and untouched by gc()."""
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=1)
+    st = {"w": paddle.to_tensor(np.zeros(2048, np.float32))}
+    mgr.save(st, 1, block=True)
+    assert mgr.steps() == [1]
+    with faults.scope("ckpt.write.after_arrays", "delay", delay_s=0.4):
+        mgr.save(st, 2)  # writer parked mid-protocol for 0.4s
+        for _ in range(3):
+            mgr.gc()  # racing sweeps during the window
+        assert mgr.steps() == [1]  # in-flight dir is not a checkpoint yet
+        assert mgr.wait() == []
+    # once complete, the window rolls: 2 in, 1 out
+    assert mgr.steps() == [2]
+    assert mgr.verify_step(2)
+
+
+# -- preemption --------------------------------------------------------------
+
+def test_sigterm_final_save_dump_and_bitwise_resume(tmp_path, monkeypatch,
+                                                    ref_losses):
+    """SIGTERM mid-run: the pending async save lands, one final sync save
+    commits the current step, the flight-recorder ring is dumped, and the
+    resumed run matches the uninterrupted losses bitwise."""
+    from paddle_tpu.observability import load_dump
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path / "tele"))
+    batches = _batches()
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=4, interval=2,
+                            grace=30.0)
+    step = _make_step(checkpoint=mgr, flight_recorder=True)
+    mgr.install_preemption_handler()
+    try:
+        for x, y in batches[:2]:
+            step(x, labels=y)
+        os.kill(os.getpid(), signal.SIGTERM)
+        with pytest.raises(Preempted) as ei:
+            step(batches[2][0], labels=batches[2][1])
+    finally:
+        mgr.uninstall_preemption_handler()
+    assert ei.value.step == 3
+    assert ei.value.checkpoint == mgr.step_dir(3)
+    assert mgr.latest() == 3 and mgr.verify_step(3)
+    assert step.recorder is not None and len(step.recorder.dumped) == 1
+    payload = load_dump(step.recorder.dumped[0])
+    assert payload["reason"] == "preemption"
+    assert payload["source"] == "train_step"
+
+    step2 = _make_step()
+    assert step2.restore(
+        checkpoint=CheckpointManager(str(tmp_path / "ck"))) == 3
+    resumed = [float(step2(x, labels=y)) for x, y in batches[3:]]
+    assert resumed == ref_losses[3:]
+
+
+# -- elastic resume ----------------------------------------------------------
+
+def test_elastic_resume_across_mesh_shapes(tmp_path):
+    """Save under mesh (dp2, sharding4), resume under (dp4, sharding2):
+    every param/opt-state leaf reshards onto the new layout and the
+    continued losses track the uninterrupted run (dp reduction order
+    changes, so parity is numerical, not bitwise — the bitwise claim
+    belongs to same-shape resume, pinned by the crash matrix)."""
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    devs = np.array(jax.devices("cpu")[:8])
+
+    def build(shape):
+        with unique_name.guard():
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(8, 16), nn.GELU(),
+                                  nn.Linear(16, 4))
+            opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+            model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+            mesh = Mesh(devs.reshape(shape), ("dp", "sharding"))
+            return TrainStep(model, _loss, opt, mesh=mesh,
+                             batch_spec=P("dp"))
+
+    batches = _batches()
+    ref = build((2, 4))
+    ref_l = [float(ref(x, labels=y)) for x, y in batches]
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=4)
+    a = build((2, 4))
+    for x, y in batches[:2]:
+        a(x, labels=y)
+    mgr.save(a.state_dict(), 2, block=True)
+
+    b = build((4, 2))  # the survivor topology
+    assert b.restore(checkpoint=mgr) == 2
+    # the restored leaves live on b's OWN mesh — actually resharded, not
+    # host-parked replicas of the old layout
+    def on_sharding_axis(spec):
+        for ax in spec:
+            axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+            if "sharding" in [a for a in axes if a]:
+                return True
+        return False
+
+    sharded = [k for k in b.trainable_keys
+               if on_sharding_axis(b.params[k].sharding.spec)]
+    assert sharded, "no parameter is sharded — the reshard proved nothing"
+    for k in sharded:
+        assert b.params[k].sharding.mesh.devices.shape == (4, 2)
+    resumed = [float(b(x, labels=y)) for x, y in batches[2:]]
+    np.testing.assert_allclose(resumed, ref_l[2:], rtol=1e-5)
+
+
+# -- save_load satellites ----------------------------------------------------
+
+def test_leaf_checksums_fold_shape_and_dtype():
+    a = {"w": np.zeros((2, 4), np.float32)}
+    assert sl.leaf_checksums(a) == sl.leaf_checksums(
+        {"w": np.zeros((2, 4), np.float32)})
+    # same bytes, different shape/dtype: must not collide
+    assert sl.leaf_checksums(a) != sl.leaf_checksums(
+        {"w": np.zeros((4, 2), np.float32)})
+    assert sl.leaf_checksums(a) != sl.leaf_checksums(
+        {"w": np.zeros((2, 4), np.int32)})
+
+
+class _DevicePutBoom:
+    """sl-namespace jax shim: everything passes through except device_put."""
+
+    def __getattr__(self, name):
+        return getattr(jax, name)
+
+    def device_put(self, *a, **k):
+        raise ValueError("injected device_put failure")
+
+
+def test_reshard_failure_warns_once_with_leaf_path(tmp_path, monkeypatch):
+    """fill() must not swallow a failed reshard silently: one warning per
+    leaf path, naming the leaf and the target sharding; the values still
+    load (host-resident). The target leaf is COMMITTED (device_put by its
+    builder) — uncommitted leaves skip resharding entirely (next test)."""
+    src = {"a": {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}}
+    path = str(tmp_path / "ck")
+    sl.save_state_dict(src, path)
+    monkeypatch.setattr(sl, "jax", _DevicePutBoom())
+    sl._reshard_warned.clear()
+
+    def committed_zeros():
+        return jax.device_put(jnp.zeros((2, 2)), jax.devices("cpu")[0])
+
+    tgt = {"a": {"w": committed_zeros()}}
+    with pytest.warns(RuntimeWarning, match=r"a\.w.*device_put"):
+        sl.load_state_dict(tgt, path)
+    np.testing.assert_array_equal(np.asarray(tgt["a"]["w"]), 1.0)
+    # warned once per process, not per load (elastic retry loops)
+    tgt2 = {"a": {"w": committed_zeros()}}
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        sl.load_state_dict(tgt2, path)
+    assert not [w for w in rec if "device_put" in str(w.message)]
+
+
+def test_restore_keeps_uncommitted_leaves_uncommitted(tmp_path):
+    """A functional train state can carry UNCOMMITTED leaves (e.g. the
+    AdamW scalar step counter, never device_put by its builder). Restore
+    must not commit them to the default device: a committed scalar makes
+    jit refuse to co-place it with mesh-sharded params on elastic
+    resume (seen live in the dp4xmp2 -> dp2xmp4 dryrun rung)."""
+    t = jnp.zeros(()) + 1.0                    # uncommitted scalar
+    c = jax.device_put(jnp.zeros(()) + 2.0,    # committed scalar
+                       jax.devices("cpu")[0])
+    path = str(tmp_path / "ck")
+    sl.save_state_dict({"t": t, "c": c}, path)
+    tmpl = {"t": jnp.zeros(()),
+            "c": jax.device_put(jnp.zeros(()), jax.devices("cpu")[0])}
+    sl.load_state_dict(tmpl, path)
+    assert float(tmpl["t"]) == 1.0 and float(tmpl["c"]) == 2.0
+    assert not tmpl["t"]._committed
+    assert tmpl["c"]._committed
+
+
+def test_save_snapshot_does_not_alias_device_buffers():
+    """The async writer serializes from the host snapshot while training
+    continues. np.asarray of a CPU jax.Array can alias the XLA buffer, and
+    a donating jitted step reuses that buffer — an aliased snapshot would
+    mutate under the writer (seen live: warm-compile-cache runs restored a
+    checkpoint whose every leaf held later-step values). Pin that the
+    snapshot owns its memory."""
+    a = jnp.arange(8.0)
+    snap = jax.tree_util.tree_leaves(sl._to_arrays({"a": a}))[0]
+    assert not np.shares_memory(snap, np.asarray(a))
+
+
+def test_restore_conversion_does_not_borrow_host_buffers():
+    """Mirror image of the save-side pin: jnp.asarray of a 64-byte-aligned
+    numpy array (orbax restore buffers, by allocation luck) is ZERO-COPY,
+    so a donating train step would write into / free memory jax doesn't
+    own (seen live: flaky nan losses on the 2nd post-restore step and
+    'double free or corruption' aborts). The restore conversion must
+    always produce a device array that owns its buffer."""
+    raw = np.zeros(1024 + 16, dtype=np.float32)
+    off = (-raw.ctypes.data) % 64 // 4
+    aligned = raw[off:off + 1024]
+    assert aligned.ctypes.data % 64 == 0
+    # the precondition that makes copying load-bearing: plain asarray of
+    # this source IS zero-copy on the CPU backend
+    assert np.shares_memory(np.asarray(jnp.asarray(aligned)), aligned)
+    out = sl._from_host(aligned, np.float32)
+    assert not np.shares_memory(np.asarray(out), aligned)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -92,6 +92,18 @@ def test_stage3_params_are_sharded():
     assert found
 
 
+def _param_2d_shapes(step):
+    """Full 2D parameter shapes (and transposes — XLA is free to carry
+    either orientation through the backward)."""
+    shapes = set()
+    for k in step.trainable_keys:
+        shp = tuple(int(s) for s in step.param_objs[k]._data.shape)
+        if len(shp) == 2:
+            shapes.add(shp)
+            shapes.add(shp[::-1])
+    return shapes
+
+
 def test_stage3_params_allgathered_in_hlo():
     """Stage 3 (p_g_os), observable in the compiled HLO: parameters are
     STORED shard-sized ([HIDDEN/4, ...] between steps) and the program
@@ -107,20 +119,65 @@ def test_stage3_params_allgathered_in_hlo():
         return TrainStep(model, _loss_fn, opt, mesh=_mesh(),
                          batch_spec=P(("dp", "sharding")))
 
-    def param_allgathers(hlo):
-        # stage-3 signature: a stored param SHARD is all-gathered and the
-        # gathered value feeds a dot (the forward/backward matmuls) — the
-        # per-layer gather-before-use. Stage 2 stores params full, so its
-        # dots consume %param inputs directly (its update-side gathers of
-        # new param shards don't feed dots).
-        return [ln for ln in hlo.splitlines()
-                if re.search(r"dot\([^)]*%all-gather", ln)]
+    def computation_bodies(hlo):
+        """Map each HLO computation name to its body text (fusions pull
+        dots out of the straight-line program, so consumer checks must
+        look through ``calls=``)."""
+        bodies, cur = {}, None
+        for ln in hlo.splitlines():
+            m = re.match(r"\s*(?:ENTRY\s+)?%?([\w.-]+)\s*\(.*->.*\{", ln)
+            if m:
+                cur = m.group(1)
+                bodies[cur] = []
+            elif ln.strip() == "}":
+                cur = None
+            elif cur is not None:
+                bodies[cur].append(ln)
+        return {k: "\n".join(v) for k, v in bodies.items()}
+
+    def param_allgathers(hlo, param_shapes):
+        # stage-3 signature: an all-gather PRODUCING a full param-shaped
+        # value whose result feeds a dot (the forward/backward matmuls) —
+        # the per-layer gather-before-use. Semantic on two counts: the
+        # shape filter keeps batch/activation gathers out (the partitioner
+        # is free to all-gather dp-sharded activations into dots — that is
+        # data movement, not ZeRO-3), and the dot linkage keeps stage 2's
+        # update-side gathers of NEW param shards out (those feed the
+        # output tuple, not a matmul). The dot may sit behind a fusion —
+        # follow its calls= into the fused computation.
+        gathered = set()
+        for ln in hlo.splitlines():
+            m = re.match(r"\s*%?([\w.-]+)\s*=\s*f32\[(\d+),(\d+)\]\S*\s+"
+                         r"all-gather\(", ln)
+            if m and (int(m.group(2)), int(m.group(3))) in param_shapes:
+                gathered.add(m.group(1))
+        bodies = computation_bodies(hlo)
+        hits = []
+
+        def uses(ln, name):
+            # operand use of %name (boundary: %all-gather must not match
+            # %all-gather.4), excluding the defining line itself
+            pat = rf"%{re.escape(name)}(?![\w.])"
+            return (re.search(pat, ln)
+                    and not re.match(rf"\s*{pat}\s*=", ln))
+
+        for ln in hlo.splitlines():
+            if not any(uses(ln, name) for name in gathered):
+                continue
+            if "dot(" in ln:
+                hits.append(ln)
+                continue
+            m = re.search(r"calls=%([\w.-]+)", ln)
+            if m and "dot(" in bodies.get(m.group(1), ""):
+                hits.append(ln)
+        return hits
 
     x, y = _batch()
     step3 = build("p_g_os")
     hlo3 = step3.compiled_hlo(x, labels=y)
     step2 = build("os_g")
     hlo2 = step2.compiled_hlo(x, labels=y)
+    param_shapes = _param_2d_shapes(step3)
 
     # stored param arrays are shard-sized under stage 3: the [16, HIDDEN]
     # weight's addressable shard is [16, HIDDEN/4] (largest dim sharded)
@@ -137,9 +194,9 @@ def test_stage3_params_allgathered_in_hlo():
                    if ax)
     assert shard_sized > 0
 
-    assert param_allgathers(hlo3), \
+    assert param_allgathers(hlo3, param_shapes), \
         "stage 3 must all-gather param shards before use"
-    assert not param_allgathers(hlo2), \
+    assert not param_allgathers(hlo2, param_shapes), \
         "stage 2 must not all-gather params (they are stored full)"
 
 
@@ -202,40 +259,72 @@ def test_save_group_sharded_model(tmp_path):
 
 
 def test_stage2_grads_reduce_scattered_vs_stage1():
-    """The stage-1 vs stage-2 distinction, observable in the compiled HLO:
-    stage 1 all-reduces FULL-shape grads once over the whole mesh; stage 2
-    constrains grads onto the 'sharding' axis, so the partitioner reduces
-    shard-sized grad pieces over the sharding groups (reduce-scatter
-    traffic — each rank only materializes its grad shard)."""
+    """The stage-1 vs stage-2 distinction, observable in the compiled HLO —
+    asserted on SEMANTICS (what is reduced, over which replica groups),
+    not on which exact shapes the partitioner's current schedule happens
+    to materialize:
+
+    - stage 1 keeps grads replicated: some full-param-shaped 2D grad is
+      summed in ONE collective spanning the whole mesh (all 8 devices);
+    - stage 2 constrains grads onto the 'sharding' axis: NO 2D grad is
+      reduced whole-mesh; instead shard-sized 2D grad pieces (one param
+      dim divided by the sharding degree) are reduced over group-local
+      replica groups — the reduce-scatter traffic pattern where each rank
+      only materializes its grad shard."""
     import re
 
-    def hlo_for(level):
+    def build(level):
         model, opt = _make_model_and_opt()
         model, opt, _ = group_sharded_parallel(model, opt, level)
         # sharding subdivides data parallelism (reference ZeRO): batch is
         # split over dp AND sharding ranks
-        step = TrainStep(model, _loss_fn, opt, mesh=_mesh(),
+        return TrainStep(model, _loss_fn, opt, mesh=_mesh(),
                          batch_spec=P(("dp", "sharding")))
-        x, y = _batch()
-        return step.compiled_hlo(x, labels=y)
 
-    def shard_shape_collectives(hlo):
-        # Linear(16, HIDDEN) weight grad is [HIDDEN,16]; its 4-way shard is
-        # [HIDDEN/4,16]. Count collectives on shard-sized operands.
-        return [ln for ln in hlo.splitlines()
-                if re.search(r"all-reduce\(|reduce-scatter\(", ln)
-                and f"f32[{HIDDEN // 4},16]" in ln]
+    def reduces_2d(hlo):
+        """(shape, group_size) for every all-reduce/reduce-scatter whose
+        line carries a 2D f32 operand. Handles both replica_groups
+        encodings: the iota form [n_groups,size]<=... and the literal
+        {{0,1},{2,3},...} form."""
+        out = []
+        for ln in hlo.splitlines():
+            if not re.search(r"(all-reduce|reduce-scatter)\(", ln):
+                continue
+            shapes = [(int(a), int(b))
+                      for a, b in re.findall(r"f32\[(\d+),(\d+)\]", ln)]
+            if not shapes:
+                continue
+            m = re.search(r"replica_groups=\[(\d+),(\d+)\]", ln)
+            if m:
+                group_size = int(m.group(2))
+            else:
+                groups = re.findall(r"\{([\d,]+)\}", ln)
+                group_size = (max(len(g.split(",")) for g in groups)
+                              if groups else 0)
+            for shp in set(shapes):
+                out.append((shp, group_size))
+        return out
 
-    hlo1, hlo2 = hlo_for("os"), hlo_for("os_g")
-    assert not shard_shape_collectives(hlo1), \
-        "stage 1 must not reduce shard-sized grads"
-    assert shard_shape_collectives(hlo2), \
-        "stage 2 must reduce shard-sized grad pieces (reduce-scatter)"
-    # stage 1 still all-reduces the full-shape grad somewhere
-    full = [ln for ln in hlo1.splitlines()
-            if re.search(r"all-reduce\(|reduce-scatter\(", ln)
-            and f"f32[{HIDDEN},16]" in ln]
-    assert full, "stage 1 should all-reduce full-shape grads"
+    x, y = _batch()
+    step1, step2 = build("os"), build("os_g")
+    hlo1, hlo2 = (step1.compiled_hlo(x, labels=y),
+                  step2.compiled_hlo(x, labels=y))
+    mesh_size = 8
+    degree = 4  # sharding axis size in _mesh()
+    full = _param_2d_shapes(step1)
+    shard = {(a // degree, b) for a, b in full if a % degree == 0} \
+        | {(a, b // degree) for a, b in full if b % degree == 0}
+
+    r1, r2 = reduces_2d(hlo1), reduces_2d(hlo2)
+    assert any(shp in full and gs == mesh_size for shp, gs in r1), \
+        f"stage 1 must reduce a full-shape 2D grad over the whole mesh " \
+        f"(saw {r1})"
+    assert not any(gs == mesh_size for shp, gs in r2), \
+        f"stage 2 must not reduce any 2D grad over the whole mesh " \
+        f"(saw {r2})"
+    assert any(shp in shard and 1 < gs < mesh_size for shp, gs in r2), \
+        f"stage 2 must reduce shard-sized 2D grad pieces over group-" \
+        f"local replica groups (saw {r2})"
 
 
 def test_shard_spec_divisibility():
