@@ -383,6 +383,8 @@ def bench_serve_overhead(reps=3):
       generated tokens must match bit for bit; on a shared host the
       ratio itself carries several percent of scheduler noise.
     """
+    import tempfile
+
     from paddle_tpu.inference import InferenceEngine, Request, ServeConfig
     from paddle_tpu.models.llama import init_llama_params, llama_tiny
     from paddle_tpu.ops import _common
@@ -401,14 +403,21 @@ def bench_serve_overhead(reps=3):
                for n in (7, 40, 130, 25, 60, 90)]
 
     def one(on, attribute=False):
-        eng = InferenceEngine(params, cfg, serve, trace_requests=on,
-                              flight_recorder=on)
+        # "on" also enables the PR-14 robustness layers (append-only
+        # journal + admission control) so the attributed share covers
+        # the FULL instrumented surface, not just observability
+        jdir = tempfile.mkdtemp() if on else None
+        eng = InferenceEngine(
+            params, cfg, serve, trace_requests=on, flight_recorder=on,
+            journal=(os.path.join(jdir, "engine.jsonl") if on else None))
         counter = [0.0]
         if attribute:
             eng.tracer = _TimedProxy(eng.tracer, counter)
             eng.recorder = _TimedProxy(eng.recorder, counter)
             eng.slo = {k: _TimedProxy(h, counter)
                        for k, h in eng.slo.items()}
+            eng._journal = _TimedProxy(eng._journal, counter)
+            eng.admission = _TimedProxy(eng.admission, counter)
         reqs = [Request(p, max_new_tokens=48, arrival=float(i))
                 for i, p in enumerate(prompts)]
         t0 = time.perf_counter()

@@ -369,6 +369,64 @@ _register(
     parse=_strict_bool("PADDLE_TPU_FAULTS"))
 
 _register(
+    "PADDLE_TPU_SERVE_MAX_QUEUE", "int", None,
+    doc="Bounded waiting-queue depth for the serving engine's admission "
+        "control (PR 14): submit() rejects with cause 'queue_full' once "
+        "this many requests wait. ''/'auto'/unset means 4 x max_batch; "
+        "ServeConfig(max_queue=) wins.",
+    parse=_positive_int("PADDLE_TPU_SERVE_MAX_QUEUE", None,
+                        allow_auto=True))
+
+_register(
+    "PADDLE_TPU_SERVE_RATE", "float", None,
+    doc="Token-bucket admission rate for the serving engine (PR 14), in "
+        "requests per engine-clock unit (seconds in wall mode, "
+        "iterations in deterministic replay). Unset/empty disables rate "
+        "limiting; ServeConfig(rate_limit=) wins.",
+    parse=_positive_float("PADDLE_TPU_SERVE_RATE", None))
+
+_register(
+    "PADDLE_TPU_SERVE_BURST", "int", None,
+    doc="Token-bucket burst capacity for serve admission rate limiting "
+        "(PR 14). ''/'auto'/unset means max(2, max_batch); "
+        "ServeConfig(burst=) wins.",
+    parse=_positive_int("PADDLE_TPU_SERVE_BURST", None, allow_auto=True))
+
+_register(
+    "PADDLE_TPU_SERVE_OVERCOMMIT", "float", 4.0,
+    doc="Free-block-aware admission estimate (PR 14): submit() rejects "
+        "with cause 'overcommit' when the worst-case block demand of "
+        "everything queued+active plus the new request exceeds this "
+        "factor times the usable pool. Positive number; "
+        "ServeConfig(overcommit=) wins.",
+    parse=_positive_float("PADDLE_TPU_SERVE_OVERCOMMIT", 4.0))
+
+_register(
+    "PADDLE_TPU_SERVE_NAN_CHECK", "bool", True,
+    doc="Per-row non-finite logit screen in the serving engine (PR 14): "
+        "a request whose prefill/decode logits contain NaN/Inf is "
+        "quarantined (failed with cause, blocks released) while the "
+        "rest of the batch keeps serving. Default ON; "
+        "ServeConfig(nan_check=) wins.",
+    parse=_truthy(("1", "true", "yes", "on"), unset="1"))
+
+_register(
+    "PADDLE_TPU_SERVE_JOURNAL", "str", None,
+    doc="Path of the serving engine's crash-recoverable request/token "
+        "journal (PR 14): append-only JSONL of accepted requests and "
+        "emitted tokens; a fresh engine's recover() re-drives to bit-"
+        "identical streams. Unset/empty disables journaling; "
+        "InferenceEngine(journal=) wins.",
+    parse=lambda value: value or None)
+
+_register(
+    "PADDLE_TPU_SERVE_JOURNAL_FSYNC", "bool", False,
+    doc="fsync the serve journal once per engine iteration (PR 14) for "
+        "power-failure durability; default flushes to the OS only "
+        "(process-crash durability).",
+    parse=_strict_bool("PADDLE_TPU_SERVE_JOURNAL_FSYNC"))
+
+_register(
     "PADDLE_TPU_SEP_STRATEGY", "enum", "ring",
     doc="Context-parallel attention strategy for the llama sep axis "
         "(PR 7): 'ring' (PR-1 ring attention) or 'ulysses' (head-sharded "

@@ -253,8 +253,11 @@ __all__ = ["Config", "Predictor", "PredictorPool", "create_predictor",
 
 
 # --- continuous-batching serving engine (paged KV cache) -------------------
-from .kv_cache import BlockPool, pad_table  # noqa: E402
-from .engine import (InferenceEngine, Request, ServeConfig)  # noqa: E402
+from .kv_cache import BlockPool, BlockPoolError, pad_table  # noqa: E402
+from .engine import (Admission, AdmissionController, InferenceEngine,  # noqa: E402
+                     PoisonError, Request, ServeConfig)
+from .journal import EngineJournal, read_journal  # noqa: E402
 
-__all__ += ["BlockPool", "pad_table", "InferenceEngine", "Request",
-            "ServeConfig"]
+__all__ += ["BlockPool", "BlockPoolError", "pad_table", "InferenceEngine",
+            "Request", "ServeConfig", "Admission", "AdmissionController",
+            "PoisonError", "EngineJournal", "read_journal"]

@@ -29,6 +29,32 @@ Scheduling per ``step()`` iteration:
 Telemetry: queue depth, batch occupancy, block-pool utilization and
 prefill-vs-decode time share per iteration through StepMetrics, with
 comm_span/counter markers on every scheduling event.
+
+Overload + fault contract (PR 14):
+
+  - ``submit()`` returns an :class:`Admission` decision instead of
+    queueing unboundedly: a bounded waiting queue, a token-bucket rate
+    limit and a free-block-aware overcommit estimate each produce a
+    deterministic ``rejected`` outcome with a cause.
+  - Requests carry optional TTFT/total deadlines and a priority; the
+    scheduler sheds queued requests whose deadline has already passed
+    (engine-clock arithmetic only, so shedding replays bit-identically)
+    and evicts lowest-priority-first under pool pressure, shrinking a
+    prefill chunk's live span (same compiled shape) before evicting.
+  - A request whose prefill raises, or whose prefill/decode logits go
+    non-finite, is QUARANTINED: blocks released, marked failed with a
+    cause, the decode batch re-driven without it — one poisoned request
+    never takes down the engine.
+  - With a journal path, every accepted request and emitted token is
+    appended to a crash-recoverable JSONL journal (inference/journal.py);
+    a fresh engine's :meth:`InferenceEngine.recover` re-drives to
+    bit-identical token streams. ``faults.py`` points ``serve.admit.*``/
+    ``serve.prefill.*``/``serve.decode.*``/``serve.swap.*`` let the
+    crash-matrix test kill the engine at every stage.
+
+Every request the engine ever saw ends in exactly one terminal state —
+finished, rejected, shed, or failed — with a cause (:meth:`outcomes`);
+nothing is silently dropped.
 """
 from __future__ import annotations
 
@@ -56,23 +82,113 @@ from ..observability.histogram import LogHistogram
 from ..observability.metrics import StepMetrics
 from ..observability.request_trace import RequestTracer
 from ..observability.trace import comm_span, record_counter
+from .journal import EngineJournal, read_journal
 from .kv_cache import BlockPool, pad_table
 
 ENV_TRACE_REQUESTS = "PADDLE_TPU_TRACE_REQUESTS"
+ENV_SERVE_MAX_QUEUE = "PADDLE_TPU_SERVE_MAX_QUEUE"
+ENV_SERVE_RATE = "PADDLE_TPU_SERVE_RATE"
+ENV_SERVE_BURST = "PADDLE_TPU_SERVE_BURST"
+ENV_SERVE_OVERCOMMIT = "PADDLE_TPU_SERVE_OVERCOMMIT"
+ENV_SERVE_NAN_CHECK = "PADDLE_TPU_SERVE_NAN_CHECK"
+ENV_SERVE_JOURNAL = "PADDLE_TPU_SERVE_JOURNAL"
+ENV_SERVE_JOURNAL_FSYNC = "PADDLE_TPU_SERVE_JOURNAL_FSYNC"
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
+SHED, FAILED = "shed", "failed"
+
+
+class PoisonError(RuntimeError):
+    """A poisoned per-request computation, attributable to ``rid``.
+    Raised by the engine's own non-finite logit screens, and usable from
+    a fault-injection corrupt callable to simulate a request whose
+    device computation raises (``PoisonError(ctx['rids'][0])``)."""
+
+    def __init__(self, rid: int, cause: str = "poisoned request"):
+        super().__init__(f"request {rid}: {cause}")
+        self.rid = rid
+        self.cause = cause
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request. ``arrival`` is seconds from engine start
-    (wall mode) or the iteration index (deterministic replay mode)."""
+    (wall mode) or the iteration index (deterministic replay mode).
+    ``ttft_deadline``/``deadline`` are engine-clock spans from arrival
+    (first token / full completion); a queued request past its deadline
+    is shed. Higher ``priority`` survives eviction longer."""
     prompt: Sequence[int]
     max_new_tokens: int = 16
     request_id: Optional[int] = None
     eos_id: Optional[int] = None
     arrival: float = 0.0
+    priority: int = 0
+    ttft_deadline: Optional[float] = None
+    deadline: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Admission:
+    """The ``submit()`` outcome: accepted into the bounded queue, or
+    rejected with a deterministic cause (``queue_full`` | ``overcommit``
+    | ``rate_limit``)."""
+    accepted: bool
+    request_id: int
+    cause: Optional[str] = None
+
+
+class _TokenBucket:
+    """``rate`` admissions per engine-clock unit, capacity ``burst``.
+    Refill arithmetic uses the ENGINE clock (iteration index in
+    deterministic replay), never wall time, so admission decisions
+    replay bit-identically."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._level = float(burst)
+        self._at = 0.0
+
+    def take(self, now: float) -> bool:
+        if now > self._at:
+            self._level = min(self.burst,
+                              self._level + (now - self._at) * self.rate)
+            self._at = now
+        if self._level < 1.0:
+            return False
+        self._level -= 1.0
+        return True
+
+
+class AdmissionController:
+    """Explicit admit/reject decision at ``submit()``.
+
+    Three independent valves, checked in order (first hit wins):
+    ``queue_full`` (bounded waiting queue), ``overcommit`` (the worst-
+    case block demand of everything queued+active plus this request
+    exceeds ``overcommit`` x the usable pool — a free-block-aware
+    estimate, since admitted work is never silently dropped) and
+    ``rate_limit`` (token bucket; checked last so rejected-anyway
+    requests do not drain the bucket)."""
+
+    def __init__(self, max_queue: int, rate: Optional[float],
+                 burst: float, overcommit: float):
+        self.max_queue = int(max_queue)
+        self.overcommit = float(overcommit)
+        self.bucket = _TokenBucket(rate, burst) if rate else None
+
+    def decide(self, queue_len: int, demand_blocks: int,
+               worst_blocks: int, usable_blocks: int,
+               now: float) -> Optional[str]:
+        """None to accept, else the rejection cause."""
+        if queue_len >= self.max_queue:
+            return "queue_full"
+        if demand_blocks + worst_blocks > self.overcommit * usable_blocks:
+            return "overcommit"
+        if self.bucket is not None and not self.bucket.take(now):
+            return "rate_limit"
+        return None
 
 
 @dataclasses.dataclass
@@ -83,6 +199,13 @@ class ServeConfig:
     prefill_chunk: int = 64
     max_seq_len: int = 1024       # bounds the block-table width
     decode_buckets: Optional[Tuple[int, ...]] = None
+    # overload valves (PR 14); None defers to the PADDLE_TPU_SERVE_*
+    # knob, which in turn falls back to the documented default
+    max_queue: Optional[int] = None       # default 4 x max_batch
+    rate_limit: Optional[float] = None    # admissions/clock-unit; 0=off
+    burst: Optional[int] = None           # default max(2, max_batch)
+    overcommit: Optional[float] = None    # default 4.0 x usable blocks
+    nan_check: Optional[bool] = None      # default True
 
     def __post_init__(self):
         if self.decode_buckets is None:
@@ -117,6 +240,8 @@ class _Seq:
         self.first_token_t: Optional[float] = None
         self.token_times: List[float] = []
         self.n_preempted = 0
+        self.fail_cause: Optional[str] = None   # shed/quarantine cause
+        self.recovered = False                  # rebuilt from a journal
 
     @property
     def generated(self) -> List[int]:
@@ -150,7 +275,8 @@ class InferenceEngine:
                  telemetry: Optional[StepMetrics] = None,
                  record_events: bool = False,
                  trace_requests: Optional[bool] = None,
-                 flight_recorder: Optional[bool] = None):
+                 flight_recorder: Optional[bool] = None,
+                 journal: Optional[str] = None):
         self.params = params
         self.config = config
         self.serve = serve or ServeConfig()
@@ -179,9 +305,38 @@ class InferenceEngine:
         self.waiting: List[_Seq] = []
         self.active: List[_Seq] = []      # PREFILL + RUNNING, FCFS order
         self.finished: List[_Seq] = []
+        self.rejected: List[Tuple[Request, str]] = []
+        self.shed: List[_Seq] = []
+        self.failed: List[_Seq] = []
         self.iteration = 0
         self.preemptions = 0
         self._last_tokens = 0
+        self._redrives = 0
+        self._recovered = 0
+        self._jtoks: List[Tuple[int, int]] = []  # this iteration's tokens
+        # admission valves: explicit ServeConfig fields win, then the
+        # PADDLE_TPU_SERVE_* knobs, then the documented defaults
+        sv = self.serve
+        max_queue = (sv.max_queue if sv.max_queue is not None
+                     else envs.get(ENV_SERVE_MAX_QUEUE) or 4 * sv.max_batch)
+        rate = (sv.rate_limit if sv.rate_limit is not None
+                else envs.get(ENV_SERVE_RATE))
+        burst = (sv.burst if sv.burst is not None
+                 else envs.get(ENV_SERVE_BURST) or max(2, sv.max_batch))
+        overcommit = (sv.overcommit if sv.overcommit is not None
+                      else envs.get(ENV_SERVE_OVERCOMMIT))
+        self.admission = AdmissionController(max_queue, rate, burst,
+                                             overcommit)
+        self._nan_check = (sv.nan_check if sv.nan_check is not None
+                           else envs.get(ENV_SERVE_NAN_CHECK))
+        # crash-recoverable request/token journal (inference/journal.py)
+        self.journal_path = (journal if journal is not None
+                             else envs.get(ENV_SERVE_JOURNAL)) or None
+        self._journal: Optional[EngineJournal] = None
+        if self.journal_path:
+            self._journal = EngineJournal(
+                self.journal_path,
+                fsync=envs.get(ENV_SERVE_JOURNAL_FSYNC))
         self._rid = itertools.count()
         self._seqno = itertools.count()
         self._frozen = _freeze_config(config)
@@ -222,17 +377,19 @@ class InferenceEngine:
             seq.blocks = []
 
     def _evict_one(self, protect: Optional[_Seq] = None) -> bool:
-        """Preempt the YOUNGEST running sequence: free its blocks and
-        push it to the FRONT of the waiting queue for recompute-style
-        readmission (its generated tokens are kept; the KV prefix is
-        re-prefilled)."""
+        """Preempt the lowest-priority, then YOUNGEST running sequence:
+        free its blocks and push it to the FRONT of the waiting queue for
+        recompute-style readmission (its generated tokens are kept; the
+        KV prefix is re-prefilled)."""
         victims = [s for s in self.active
                    if s.state == RUNNING and s is not protect]
         if not victims:
             return False
-        # ties on arrival (e.g. a burst submitted at the same instant)
-        # break toward the latest-submitted sequence, deterministically
-        victim = max(victims, key=lambda s: (s.arrival, s.order))
+        # lowest priority goes first; within a priority, ties on arrival
+        # (e.g. a burst submitted at the same instant) break toward the
+        # latest-submitted sequence, deterministically
+        victim = max(victims,
+                     key=lambda s: (-s.req.priority, s.arrival, s.order))
         self.active.remove(victim)
         self._release(victim)
         victim.state = WAITING
@@ -249,6 +406,86 @@ class InferenceEngine:
             self.recorder.note_eviction(self.iteration)
         return True
 
+    def _finish_seq(self, seq: _Seq, t: float):
+        seq.state = FINISHED
+        if seq in self.active:
+            self.active.remove(seq)
+        self._release(seq)
+        self.finished.append(seq)
+        record_counter("serve.finish")
+        if self.tracer is not None:
+            self.tracer.finish(seq.req.request_id, t, len(seq.generated))
+
+    def _shed_seq(self, seq: _Seq, cause: str):
+        """Terminal shed of a QUEUED sequence (deadline already missed)."""
+        self._release(seq)
+        seq.state = SHED
+        seq.fail_cause = cause
+        self.shed.append(seq)
+        record_counter("serve.shed")
+        self._event("shed", seq.req.request_id, cause)
+        if self.tracer is not None:
+            self.tracer.shed(seq.req.request_id, time.perf_counter(),
+                             cause)
+        if self.recorder is not None:
+            self.recorder.record({"iteration": self.iteration,
+                                  "event": "shed",
+                                  "rid": seq.req.request_id,
+                                  "cause": cause})
+        if self._journal is not None:
+            self._journal.shed(seq.req.request_id, cause)
+
+    def _shed_expired(self):
+        """Deadline-based load shedding over the waiting queue: a queued
+        request past its TTFT or total deadline can no longer meet it —
+        shed it now instead of burning pool blocks on a dead request.
+        Pure engine-clock arithmetic, so replays shed identically."""
+        if not self.waiting:
+            return
+        kept = []
+        for seq in self.waiting:
+            r, waited = seq.req, self._clock - seq.arrival
+            if r.deadline is not None and waited > r.deadline:
+                self._shed_seq(seq, "deadline")
+            elif (r.ttft_deadline is not None and not seq.generated
+                    and waited > r.ttft_deadline):
+                self._shed_seq(seq, "ttft_deadline")
+            else:
+                kept.append(seq)
+        self.waiting = kept
+
+    def _quarantine(self, seq: _Seq, cause: str):
+        """Poisoned request: release its blocks, mark it failed with the
+        cause, keep serving everyone else."""
+        if seq in self.active:
+            self.active.remove(seq)
+        self._release(seq)
+        seq.state = FAILED
+        seq.fail_cause = cause
+        self.failed.append(seq)
+        record_counter("serve.quarantine")
+        self._event("quarantine", seq.req.request_id, cause)
+        if self.tracer is not None:
+            self.tracer.quarantine(seq.req.request_id,
+                                   time.perf_counter(), cause)
+        if self.recorder is not None:
+            self.recorder.record({"iteration": self.iteration,
+                                  "event": "quarantine",
+                                  "rid": seq.req.request_id,
+                                  "cause": cause})
+        if self._journal is not None:
+            self._journal.failed(seq.req.request_id, cause)
+
+    def _pools_alive(self) -> bool:
+        """False when an exception killed a kernel AFTER its donated
+        k/v pool buffers were invalidated — unrecoverable in-process
+        (the journal recovery path owns that failure mode)."""
+        for pool in (self.k_pool, self.v_pool):
+            deleted = getattr(pool, "is_deleted", None)
+            if deleted is not None and deleted():
+                return False
+        return True
+
     def _mark_compiled(self, kind: str, key, t_call: float):
         if (kind, key) not in self._compiled:
             self._compiled[(kind, key)] = t_call
@@ -260,7 +497,17 @@ class InferenceEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def submit(self, req: Request):
+    def _demand_blocks(self) -> int:
+        """Worst-case block demand of everything queued + active."""
+        return sum(
+            self.pool.blocks_for(len(s.req.prompt) + s.req.max_new_tokens)
+            for s in itertools.chain(self.waiting, self.active))
+
+    def submit(self, req: Request) -> Admission:
+        """Admit ``req`` into the bounded queue or reject it with a
+        deterministic cause. Malformed requests (can never be served at
+        any load) still raise ValueError; overload is an Admission
+        outcome, not an exception."""
         if req.request_id is None:
             req.request_id = next(self._rid)
         worst = len(req.prompt) + req.max_new_tokens
@@ -274,12 +521,38 @@ class InferenceEngine:
                 f"({worst} tokens > {self.serve.num_blocks - 1} blocks)")
         if not len(req.prompt):
             raise ValueError(f"request {req.request_id}: empty prompt")
+        faults.inject("serve.admit.before", rid=req.request_id)
+        cause = self.admission.decide(
+            queue_len=len(self.waiting),
+            demand_blocks=self._demand_blocks(),
+            worst_blocks=self.pool.blocks_for(worst),
+            usable_blocks=self.serve.num_blocks - 1,
+            now=self._clock)
+        if cause is not None:
+            self.rejected.append((req, cause))
+            record_counter("serve.reject")
+            self._event("reject", req.request_id, cause)
+            if self.tracer is not None:
+                self.tracer.reject(req.request_id, time.perf_counter(),
+                                   cause)
+            if self.recorder is not None:
+                self.recorder.record({"iteration": self.iteration,
+                                      "event": "reject",
+                                      "rid": req.request_id,
+                                      "cause": cause})
+            if self._journal is not None:
+                self._journal.reject(req.request_id, cause)
+            return Admission(False, req.request_id, cause)
         seq = _Seq(req, self._clock)
         seq.order = next(self._seqno)
         self.waiting.append(seq)
         self._event("submit", req.request_id)
         if self.tracer is not None:
             self.tracer.submit(req.request_id, time.perf_counter())
+        if self._journal is not None:
+            self._journal.submit(req)
+        faults.inject("serve.admit.after", rid=req.request_id)
+        return Admission(True, req.request_id)
 
     def step(self) -> List[_Seq]:
         """One scheduler iteration: admit, one prefill chunk, one decode
@@ -294,19 +567,29 @@ class InferenceEngine:
             self._apply_swap(source)
         self.iteration += 1
         self._last_tokens = 0
+        self._jtoks = []
         t_iter = time.perf_counter()
         if faults.fires("serve.preempt_storm"):
             # injected pool-pressure fault: forcibly evict the youngest
             # running sequence, as if a burst had stolen its blocks
             self._evict_one()
+        self._shed_expired()
         self._admit()
         t_adm = time.perf_counter()
-        ran_prefill = self._prefill_chunk()
+        done: List[_Seq] = []
+        ran_prefill = self._prefill_chunk(done)
         t_pre = time.perf_counter()
-        done = self._decode_batch()
+        done += self._decode_batch()
         t_dec = time.perf_counter()
         for seq in done:
             self._event("finish", seq.req.request_id, len(seq.generated))
+        if self._journal is not None:
+            # one tokens record per iteration; finish marks AFTER it so
+            # a torn tail can lose a finish mark but never a finished
+            # request's tokens (recover() re-derives the mark)
+            self._journal.tokens(self.iteration, self._jtoks)
+            for seq in done:
+                self._journal.finish(seq.req.request_id)
         if self.tracer is not None:
             self.tracer.phase("admit", t_iter, t_adm, self.iteration)
             if ran_prefill:
@@ -364,12 +647,23 @@ class InferenceEngine:
                 self.tracer.admit(seq.req.request_id, time.perf_counter(),
                                   seq.n_preempted)
 
-    def _prefill_chunk(self) -> bool:
+    def _prefill_chunk(self, done_out: Optional[List[_Seq]] = None) -> bool:
         seq = next((s for s in self.active if s.state == PREFILL), None)
         if seq is None:
             return False
+        rid = seq.req.request_id
+        faults.inject("serve.prefill.before", rid=rid)
         c = self.serve.prefill_chunk
         n_live = min(c, seq.prefill_target - seq.n_cached)
+        # graceful degradation: under pool pressure, shrink this chunk's
+        # LIVE span to the headroom the pool still has (n_live is data,
+        # not shape — same compiled step) before resorting to eviction
+        headroom = ((len(seq.blocks) + self.pool.free_blocks)
+                    * self.pool.block_size - seq.n_cached)
+        if 1 <= headroom < n_live:
+            n_live = headroom
+            record_counter("serve.prefill_shrink")
+            self._event("prefill_shrink", rid, n_live)
         if not self._alloc_for(seq, seq.n_cached + n_live):
             # pool dry mid-prompt: steal from the youngest decoder; if
             # there is none, stall — decode progress will free blocks
@@ -382,18 +676,32 @@ class InferenceEngine:
         fn = _jitted_paged_prefill(self._frozen)
         key = ("prefill", c)
         t0 = time.perf_counter()
-        with comm_span("serve.prefill",
-                       nbytes=int(n_live) * 4):
-            logits, self.k_pool, self.v_pool = fn(
-                self.params, self.k_pool, self.v_pool,
-                jnp.asarray(table), np.int32(seq.n_cached),
-                jnp.asarray(ids), np.int32(n_live))
-            logits = np.asarray(logits)  # noqa: PTA006 -- deliberate sync so prefill phase timing is honest
+        try:
+            faults.inject("serve.prefill.poison", rid=rid)
+            with comm_span("serve.prefill",
+                           nbytes=int(n_live) * 4):
+                logits, self.k_pool, self.v_pool = fn(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.asarray(table), np.int32(seq.n_cached),
+                    jnp.asarray(ids), np.int32(n_live))
+                logits = np.asarray(logits)  # noqa: PTA006 -- deliberate sync so prefill phase timing is honest
+            faults.inject("serve.prefill.logits", rid=rid, logits=logits)
+            if self._nan_check and not bool(np.isfinite(logits).all()):
+                raise PoisonError(rid, "non-finite prefill logits")
+        except Exception as e:  # noqa: BLE001 -- quarantine boundary
+            if not self._pools_alive():
+                raise  # donated pools died mid-kernel: journal recovery
+            # a prefill chunk touches exactly one request, so ANY
+            # failure here is attributable: quarantine it, keep serving
+            cause = (e.cause if isinstance(e, PoisonError)
+                     else f"prefill: {e!r}")
+            self._quarantine(seq, cause)
+            return True
         t1 = time.perf_counter()
         self._mark_compiled(*key, t1 - t0)
         if self.tracer is not None:
             self.tracer.prefill_chunk(
-                seq.req.request_id, t0, t1, int(n_live),
+                rid, t0, t1, int(n_live),
                 recompute=bool(seq.generated))
         seq.n_cached += n_live
         if seq.n_cached == seq.prefill_target:
@@ -404,8 +712,18 @@ class InferenceEngine:
                 seq.first_token_t = self._now()
                 seq.token_times.append(seq.first_token_t)
                 self._last_tokens += 1
+                self._jtoks.append((rid, seq.tokens[-1]))
                 self.slo["ttft"].record(seq.first_token_t - seq.arrival)
-            seq.state = RUNNING
+            if seq.done():
+                # eos/max_new on the very first token: finish here so
+                # "done() implies finished" holds at every iteration
+                # boundary (recover() relies on the invariant)
+                self._finish_seq(seq, time.perf_counter())
+                if done_out is not None:
+                    done_out.append(seq)
+            else:
+                seq.state = RUNNING
+        faults.inject("serve.prefill.after", rid=rid)
         return True
 
     def _decode_batch(self) -> List[_Seq]:
@@ -427,35 +745,76 @@ class InferenceEngine:
         rows = [s for s in ready if s.state == RUNNING]
         if not rows:
             return []
-        bucket = next(b for b in self.serve.decode_buckets
-                      if b >= len(rows))
-        toks = np.zeros((bucket,), np.int32)
-        positions = np.zeros((bucket,), np.int32)
-        tables = np.zeros((bucket, self.serve.max_nb), np.int32)
-        for i, seq in enumerate(rows):
-            toks[i] = seq.tokens[-1]
-            positions[i] = seq.n_cached
-            tables[i] = pad_table(seq.blocks, self.serve.max_nb)
+        faults.inject("serve.decode.before",
+                      rids=[s.req.request_id for s in rows])
         fn = _jitted_paged_decode(self._frozen)
-        key = ("decode", bucket)
-        t0 = time.perf_counter()
-        with comm_span("serve.decode", nbytes=bucket * 4):
-            logits, self.k_pool, self.v_pool = fn(
-                self.params, self.k_pool, self.v_pool,
-                jnp.asarray(tables), jnp.asarray(positions),
-                jnp.asarray(toks))
-            next_tok = np.asarray(logits).argmax(-1)  # noqa: PTA006 -- step boundary: sampled tokens must reach the scheduler
+        logits = None
+        # re-drive loop: a PoisonError attributable to one row drops that
+        # row (quarantined) and re-runs the batch without it; rows are
+        # independent (disjoint blocks, per-row tables), so survivors'
+        # tokens are bit-identical to a batch that never held the poison
+        while rows:
+            rids = [s.req.request_id for s in rows]
+            bucket = next(b for b in self.serve.decode_buckets
+                          if b >= len(rows))
+            toks = np.zeros((bucket,), np.int32)
+            positions = np.zeros((bucket,), np.int32)
+            tables = np.zeros((bucket, self.serve.max_nb), np.int32)
+            for i, seq in enumerate(rows):
+                toks[i] = seq.tokens[-1]
+                positions[i] = seq.n_cached
+                tables[i] = pad_table(seq.blocks, self.serve.max_nb)
+            key = ("decode", bucket)
+            t0 = time.perf_counter()
+            try:
+                faults.inject("serve.decode.poison", rids=rids)
+                with comm_span("serve.decode", nbytes=bucket * 4):
+                    logits, self.k_pool, self.v_pool = fn(
+                        self.params, self.k_pool, self.v_pool,
+                        jnp.asarray(tables), jnp.asarray(positions),
+                        jnp.asarray(toks))
+                    logits = np.asarray(logits)  # noqa: PTA006 -- step boundary: sampled tokens must reach the scheduler
+                faults.inject("serve.decode.logits", rids=rids,
+                              logits=logits)
+            except PoisonError as e:
+                if not self._pools_alive():
+                    raise  # donated pools died mid-kernel: journal path
+                bad = next((s for s in rows
+                            if s.req.request_id == e.rid), None)
+                if bad is None:
+                    raise  # not attributable to this batch
+                self._quarantine(bad, e.cause)
+                rows = [s for s in rows if s is not bad]
+                self._redrives += 1
+                record_counter("serve.decode_redrive")
+                continue
+            break
+        if not rows:
+            return []
         t1 = time.perf_counter()
         self._mark_compiled(*key, t1 - t0)
+        next_tok = logits.argmax(-1)
+        live = list(enumerate(rows))
+        if self._nan_check:
+            # per-row screen: quarantine rows whose logits went
+            # non-finite; the survivors' already-computed argmax stands
+            # (rows are independent)
+            finite = np.isfinite(
+                logits[:len(rows)].reshape(len(rows), -1)).all(axis=1)
+            if not bool(finite.all()):
+                for i, seq in [p for p in live if not finite[p[0]]]:
+                    self._quarantine(seq, "non-finite decode logits")
+                live = [p for p in live if finite[p[0]]]
         if self.tracer is not None:
-            self.tracer.decode([s.req.request_id for s in rows], t0, t1,
-                               self.iteration)
-        self._last_tokens += len(rows)
+            self.tracer.decode([s.req.request_id for _, s in live],
+                               t0, t1, self.iteration)
+        self._last_tokens += len(live)
         done = []
         now = self._now()
-        for i, seq in enumerate(rows):
+        for i, seq in live:
             seq.n_cached += 1
             seq.tokens.append(int(next_tok[i]))
+            self._jtoks.append((seq.req.request_id, seq.tokens[-1]))
             if seq.first_token_t is None:
                 seq.first_token_t = now
                 self.slo["ttft"].record(now - seq.arrival)
@@ -463,15 +822,10 @@ class InferenceEngine:
                 self.slo["tpot"].record(now - seq.token_times[-1])
             seq.token_times.append(now)
             if seq.done():
-                seq.state = FINISHED
-                self.active.remove(seq)
-                self._release(seq)
-                self.finished.append(seq)
-                record_counter("serve.finish")
+                self._finish_seq(seq, t1)
                 done.append(seq)
-                if self.tracer is not None:
-                    self.tracer.finish(seq.req.request_id, t1,
-                                       len(seq.generated))
+        faults.inject("serve.decode.after",
+                      rids=[s.req.request_id for _, s in live])
         return done
 
     # -- preemption + live weight push (PR 13) ------------------------------
@@ -566,6 +920,7 @@ class InferenceEngine:
         return restored, path
 
     def _apply_swap(self, source) -> Dict[str, Any]:
+        faults.inject("serve.swap.before", iteration=self.iteration)
         t0 = time.perf_counter()
         new_tree, path = self._resolve_swap_source(source)
         n_leaves = [0]
@@ -631,6 +986,9 @@ class InferenceEngine:
                                   "event": "swap", **{
                                       k: v for k, v in stats.items()
                                       if k != "iteration"}})
+        if self._journal is not None:
+            self._journal.swap(self.iteration, path)
+        faults.inject("serve.swap.after", iteration=self.iteration)
         return stats
 
     # -- driving loops ------------------------------------------------------
@@ -681,13 +1039,112 @@ class InferenceEngine:
                 if not deterministic:
                     self._clock = time.perf_counter() - t0
         except BaseException:
+            # a crashed run must leave a LEAK-FREE pool: demote every
+            # live sequence to the front of the waiting queue (eviction-
+            # style, order preserved) with its blocks released, so a
+            # successor engine — or recover() — inherits clean state
+            while self.active:
+                seq = self.active.pop()
+                self._release(seq)
+                seq.state = WAITING
+                seq.n_cached = 0
+                self.waiting.insert(0, seq)
             # crash post-mortem: dump the last N iteration records before
             # the exception leaves the engine (no-op without a recorder
             # or a telemetry dir)
             if self.recorder is not None:
                 self.recorder.dump("exception")
             raise
+        if self._journal is not None:
+            # clean exit: drain the buffered tokens/finish marks so the
+            # on-disk journal of an idle engine is always complete
+            self._journal.flush()
         return self.stats()
+
+    def recover(self, journal_path: Optional[str] = None
+                ) -> Dict[str, Any]:
+        """Rebuild scheduler state from an engine journal after a crash.
+
+        The journal holds every accepted request and every token the
+        dead engine emitted. Greedy decoding is deterministic in
+        (prompt + generated history), so re-queueing each unfinished
+        request with its journaled tokens and re-driving it through the
+        ordinary preempted-sequence path (re-prefill the cached
+        context, resume decoding) reproduces the remaining stream
+        bit-identically — tokens emitted after the journal's last flush
+        are simply re-derived. Call on a FRESH engine, or on one whose
+        ``run()`` raised (its demoted sequences are discarded in favor
+        of the journal's authoritative record); then ``run([])`` drives
+        the recovered requests to completion. The journal is reopened
+        for append, so the recovered engine keeps journaling."""
+        path = journal_path or self.journal_path
+        if not path:
+            raise ValueError(
+                "recover() needs a journal: pass journal_path= or build "
+                "the engine with journal=/PADDLE_TPU_SERVE_JOURNAL")
+        st = read_journal(path)
+        for seq in itertools.chain(self.active, self.waiting):
+            self._release(seq)
+        self.active, self.waiting = [], []
+        if self.pool.used_blocks:
+            raise RuntimeError(
+                f"recover(): pool leaked {self.pool.used_blocks} blocks")
+        terminal = st.terminal_rids()
+        n_replayed = n_prefinished = 0
+        for rid in st.unfinished_rids():
+            rec = st.requests[rid]
+            req = Request(
+                prompt=rec["prompt"],
+                max_new_tokens=rec["max_new_tokens"],
+                request_id=rid, eos_id=rec.get("eos_id"),
+                arrival=float(rec.get("arrival", 0.0)),
+                priority=int(rec.get("priority", 0)),
+                ttft_deadline=rec.get("ttft_deadline"),
+                deadline=rec.get("deadline"))
+            seq = _Seq(req, self._clock)
+            seq.order = next(self._seqno)
+            seq.tokens.extend(st.tokens.get(rid, ()))
+            seq.recovered = True
+            if seq.generated:
+                # its first token predates this engine: keep the SLO
+                # histograms honest by not re-measuring TTFT
+                seq.first_token_t = seq.arrival
+            if seq.done():
+                # crashed after its last token but before its finish
+                # mark was journaled: already complete, no re-drive
+                seq.state = FINISHED
+                self.finished.append(seq)
+                n_prefinished += 1
+            else:
+                self.waiting.append(seq)
+                n_replayed += 1
+        self._recovered = n_replayed + n_prefinished
+        known = list(st.requests) + list(st.rejected)
+        if known:
+            self._rid = itertools.count(max(known) + 1)
+        if self._journal is None:
+            self._journal = EngineJournal(
+                path, fsync=envs.get(ENV_SERVE_JOURNAL_FSYNC),
+                resume=True)
+            self.journal_path = path
+        else:
+            # in-place recovery after run() raised: the writer may hold
+            # token pairs from before the crash — they predate the read
+            # above, and draining them now would duplicate streams
+            self._journal.discard_pending()
+        self._journal.recovered(self._recovered, st.torn_lines)
+        for seq in self.finished[len(self.finished) - n_prefinished:]:
+            self._journal.finish(seq.req.request_id)
+        record_counter("serve.recover")
+        self._event("recover", self._recovered)
+        return {
+            "recovered": self._recovered,
+            "replayed": n_replayed,
+            "already_finished": n_prefinished,
+            "terminal_in_journal": len(terminal),
+            "torn_lines": st.torn_lines,
+            "journal_swaps": st.swaps,
+        }
 
     def stats(self) -> Dict[str, Any]:
         """Throughput/latency aggregates over finished requests (times
@@ -735,7 +1192,32 @@ class InferenceEngine:
             "compiles": {f"{k}_{v}": round(t, 3)
                          for (k, v), t in sorted(self._compiled.items())},
             "pool_blocks": self.serve.num_blocks - 1,
+            "rejected": len(self.rejected),
+            "shed": len(self.shed),
+            "failed": len(self.failed),
+            "decode_redrives": self._redrives,
+            "recovered": self._recovered,
+            "outcomes": self.outcomes(),
         }
+
+    def outcomes(self) -> Dict[int, Tuple[str, Optional[str]]]:
+        """Disposition of EVERY request this engine has seen:
+        ``rid -> (state, cause)``. The overload contract — nothing is
+        silently dropped — means each submitted request appears here in
+        exactly one state (terminal: finished/rejected/shed/failed with
+        a cause; live requests report their current scheduler state)."""
+        out: Dict[int, Tuple[str, Optional[str]]] = {}
+        for req, cause in self.rejected:
+            out[req.request_id] = ("rejected", cause)
+        for seq in self.finished:
+            out[seq.req.request_id] = (FINISHED, None)
+        for seq in self.shed:
+            out[seq.req.request_id] = (SHED, seq.fail_cause)
+        for seq in self.failed:
+            out[seq.req.request_id] = (FAILED, seq.fail_cause)
+        for seq in itertools.chain(self.waiting, self.active):
+            out[seq.req.request_id] = (seq.state, None)
+        return out
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Live metric snapshot, any time mid-run: the streaming SLO
@@ -755,6 +1237,10 @@ class InferenceEngine:
             "iterations": self.iteration,
             "preemptions": self.preemptions,
             "finished_requests": len(self.finished),
+            "rejected_requests": len(self.rejected),
+            "shed_requests": len(self.shed),
+            "failed_requests": len(self.failed),
+            "decode_redrives": self._redrives,
             "generated_tokens": sum(len(s.generated)
                                     for s in self.finished),
         }

@@ -21,12 +21,21 @@ from typing import List, Optional
 import numpy as np
 
 
+class BlockPoolError(ValueError):
+    """A caller violated the pool's ownership contract: double free,
+    out-of-range id, or the reserved null block. Subclasses ValueError
+    so pre-existing ``except ValueError`` callers keep working."""
+
+
 class BlockPool:
     """Free-list allocator over ``num_blocks`` fixed-size blocks.
 
-    O(1) alloc/free via a LIFO free list; all-or-nothing allocation so
-    a failed admission never leaks partial sets. Block 0 is reserved
-    (the null block) and never handed out."""
+    O(1) alloc/free via a LIFO free list (with a set mirror for O(1)
+    double-free detection); all-or-nothing allocation so a failed
+    admission never leaks partial sets. Block 0 is reserved (the null
+    block) and never handed out; ``free()`` validates every id —
+    including duplicates WITHIN one call — before mutating anything, so
+    a rejected free leaves the pool untouched."""
 
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 2:
@@ -41,6 +50,7 @@ class BlockPool:
         self.block_size = block_size
         # LIFO keeps recently-freed (cache-warm) blocks in circulation
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._free_set = set(self._free)
 
     @property
     def free_blocks(self) -> int:
@@ -65,15 +75,22 @@ class BlockPool:
             return None
         got = self._free[-n:] if n else []
         del self._free[len(self._free) - n:]
+        self._free_set.difference_update(got)
         return got
 
     def free(self, blocks: List[int]) -> None:
+        seen = set()
         for b in blocks:
+            if b == 0:
+                raise BlockPoolError(
+                    "free of the reserved null block 0")
             if not 1 <= b < self.num_blocks:
-                raise ValueError(f"free of out-of-range block {b}")
-            if b in self._free:
-                raise ValueError(f"double free of block {b}")
+                raise BlockPoolError(f"free of out-of-range block {b}")
+            if b in self._free_set or b in seen:
+                raise BlockPoolError(f"double free of block {b}")
+            seen.add(b)
         self._free.extend(blocks)
+        self._free_set.update(blocks)
 
     def blocks_for(self, n_tokens: int) -> int:
         """Blocks a sequence of ``n_tokens`` occupies."""

@@ -105,6 +105,24 @@ class RequestTracer:
                    {"n_generated": n_generated})
         self._chunk_idx.pop(rid, None)
 
+    def reject(self, rid: int, t: float, cause: str) -> None:
+        """Admission rejection: instant marker — the request never made
+        it into the waiting queue, so no queue span opens."""
+        self._span(rid, "reject", "reject", t, t, {"cause": cause})
+
+    def shed(self, rid: int, t: float, cause: str) -> None:
+        """Deadline shed: closes the request's pending queue-wait span
+        with the shed cause (it waited, then the scheduler gave up)."""
+        t0 = self._queue_from.pop(rid, t)
+        self._span(rid, "shed", "shed", t0, t, {"cause": cause})
+        self._chunk_idx.pop(rid, None)
+
+    def quarantine(self, rid: int, t: float, cause: str) -> None:
+        """Poison quarantine: instant failure marker on the request row."""
+        self._queue_from.pop(rid, None)
+        self._span(rid, "quarantine", "quarantine", t, t, {"cause": cause})
+        self._chunk_idx.pop(rid, None)
+
     def phase(self, name: str, t0: float, t1: float, iteration: int) -> None:
         """Engine-phase span (admit/prefill/decode) for one iteration.
         Inlined append — called up to three times per iteration."""

@@ -7,6 +7,26 @@ checkpoint write/publish protocol, the serving engine's scheduling loop.
 Tests *arm* a point with a seeded trigger and an action; everything is
 replayable from the seed — no wall-clock, no real signals needed.
 
+Serving-engine points (PR 14; ctx carries ``rid``/``rids``):
+
+  crash matrix (outside the quarantine boundary — a ``raise`` here
+  kills the engine, exercising journal recovery):
+    ``serve.admit.before`` / ``serve.admit.after``  around the submit
+    decision+journal append; ``serve.prefill.before`` /
+    ``serve.prefill.after`` around one prefill chunk;
+    ``serve.decode.before`` / ``serve.decode.after`` around one decode
+    batch; ``serve.swap.before`` / ``serve.swap.after`` around a live
+    weight swap.
+  poison (inside the quarantine boundary — failures here are
+  attributed to one request, which is quarantined):
+    ``serve.prefill.poison`` (any exception quarantines the prefilling
+    request), ``serve.decode.poison`` (raise
+    ``engine.PoisonError(ctx["rids"][i])`` from a corrupt callable to
+    poison one batch row), and ``serve.prefill.logits`` /
+    ``serve.decode.logits`` (ctx carries the host logits array).
+  control flow: ``serve.preempt`` (graceful stop), ``serve.
+  preempt_storm`` (forced eviction).
+
 Actions
     ``raise``    raise :class:`FaultError` at the point (a crashed save,
                  an OOM, a preempted pod — anything that unwinds).

@@ -1,0 +1,244 @@
+"""Crash-recoverable engine journal (PR 14).
+
+The journal is an append-only record of every accepted request and every
+token the engine emitted. Greedy decode is deterministic in (prompt +
+history), so ``recover()`` on a fresh engine re-queues each unfinished
+request with its journaled tokens and re-derives the rest of the stream
+bit-identically — including tokens lost to a torn tail.
+
+The crash matrix arms a ``raise`` at every ``serve.*`` crash point
+(testing/faults.py), kills the engine mid-run, asserts the pool is
+leak-free (satellite: run()'s exception path releases all live blocks),
+then recovers into a fresh engine and checks every request's final
+stream against an unkilled reference run.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (EngineJournal, InferenceEngine, Request,
+                                  ServeConfig, read_journal)
+from paddle_tpu.models.llama import init_llama_params, llama_tiny
+from paddle_tpu.ops import _common
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "1")
+    with _common.interpret_mode(True):
+        yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(vocab=96, hidden=64, layers=1, heads=4, kv_heads=2,
+                     seq=512)
+    return cfg, init_llama_params(cfg, seed=3)
+
+
+def _requests(n=3, size=24, max_new=6, seed=0):
+    rng = np.random.RandomState(seed)
+    # explicit request_ids keep the client<->journal rid mapping stable
+    # across a crash-and-resubmit cycle
+    return [Request(rng.randint(1, 96, size=size).tolist(),
+                    max_new_tokens=max_new, arrival=float(i),
+                    request_id=i)
+            for i in range(n)]
+
+
+def _engine(model, journal, **kw):
+    cfg, params = model
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        prefill_chunk=32, max_seq_len=256, **kw)
+    return InferenceEngine(params, cfg, serve, record_events=True,
+                           journal=journal)
+
+
+# -- journal file format ------------------------------------------------------
+
+
+def test_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = EngineJournal(path)
+    j.submit(Request([1, 2, 3], max_new_tokens=4, request_id=0,
+                     priority=2, ttft_deadline=5.0))
+    j.submit(Request([4, 5], max_new_tokens=2, request_id=1))
+    j.reject(2, "queue_full")
+    j.tokens(1, [(0, 7), (1, 8)])
+    j.tokens(2, [(0, 9)])
+    j.finish(1)
+    j.shed(3, "deadline")
+    j.failed(4, "non-finite decode logits")
+    j.close()
+    st = read_journal(path)
+    assert list(st.requests) == [0, 1]
+    assert st.requests[0]["priority"] == 2
+    assert st.requests[0]["ttft_deadline"] == 5.0
+    assert st.tokens == {0: [7, 9], 1: [8]}
+    assert st.finished == {1}
+    assert st.rejected == {2: "queue_full"}
+    assert st.shed == {3: "deadline"}
+    assert st.failed == {4: "non-finite decode logits"}
+    assert st.torn_lines == 0
+    assert st.terminal_rids() == {1, 2, 3, 4}
+    assert st.unfinished_rids() == [0]
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = EngineJournal(path)
+    j.submit(Request([1, 2], max_new_tokens=3, request_id=0))
+    j.tokens(1, [(0, 5)])
+    j.close()
+    with open(path, "a") as f:
+        f.write('{"type": "tokens", "iteration": 2, "t')  # torn write
+    st = read_journal(path)
+    assert st.torn_lines == 1
+    assert st.tokens == {0: [5]}        # intact prefix fully parsed
+    assert st.unfinished_rids() == [0]
+
+
+def test_engine_journals_a_clean_run(model, tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    eng = _engine(model, path)
+    stats = eng.run(_requests(2), deterministic=True)
+    assert stats["requests"] == 2
+    st = read_journal(path)
+    assert set(st.requests) == {0, 1}
+    assert st.finished == {0, 1}
+    for seq in eng.finished:
+        assert st.tokens[seq.req.request_id] == seq.generated
+    # a finished journal recovers to an idle engine, not a re-run
+    eng2 = _engine(model, path)
+    rec = eng2.recover()
+    assert rec == {"recovered": 0, "replayed": 0, "already_finished": 0,
+                   "terminal_in_journal": 2, "torn_lines": 0,
+                   "journal_swaps": 0}
+    assert eng2.idle()
+
+
+# -- crash matrix -------------------------------------------------------------
+
+MATRIX = [
+    ("serve.admit.before", 2),   # 2nd submit dies pre-journal
+    ("serve.admit.after", 2),    # 2nd submit dies post-journal
+    ("serve.prefill.before", 2),
+    ("serve.prefill.after", 2),
+    ("serve.decode.before", 3),
+    ("serve.decode.after", 3),
+    ("serve.swap.before", 1),
+    ("serve.swap.after", 1),
+]
+
+
+def _reference_streams(model, tmp_path):
+    """Unkilled run (with the same mid-run weight swap the matrix runs
+    schedule) -> rid -> generated tokens."""
+    cfg, params = model
+    eng = _engine(model, str(tmp_path / "ref.jsonl"))
+    eng.swap_weights(params, at_iteration=4)
+    stats = eng.run(_requests(), deterministic=True)
+    assert stats["requests"] == 3
+    return {s.req.request_id: s.generated for s in eng.finished}
+
+
+@pytest.mark.parametrize("point,nth", MATRIX, ids=[p for p, _ in MATRIX])
+def test_crash_matrix_recovers_bit_identical(model, tmp_path, point, nth):
+    cfg, params = model
+    ref = _reference_streams(model, tmp_path)
+    path = str(tmp_path / "kill.jsonl")
+    reqs = _requests()
+
+    eng = _engine(model, path)
+    eng.swap_weights(params, at_iteration=4)
+    with faults.scope(point, "raise", nth=nth) as plan:
+        with pytest.raises(faults.FaultError):
+            eng.run(reqs, deterministic=True)
+        assert plan.fired == 1
+        # satellite: the crash path released every live block
+        assert eng.pool.used_blocks == 0
+
+        # recover into a FRESH engine over the same journal
+        eng2 = _engine(model, path)
+        rec = eng2.recover()
+        assert rec["torn_lines"] == 0   # every line was flushed whole
+        journaled = ({s.req.request_id for s in eng2.waiting}
+                     | {s.req.request_id for s in eng2.finished})
+        # requests the dead engine never journaled are re-submitted by
+        # the client (explicit rid keeps the mapping stable)
+        resubmit = [Request(r.prompt, max_new_tokens=r.max_new_tokens,
+                            request_id=r.request_id)
+                    for r in reqs if r.request_id not in journaled]
+        eng2.run(resubmit, deterministic=True)
+
+    got = {s.req.request_id: s.generated for s in eng2.finished}
+    assert got == ref, f"streams diverged after crash at {point}"
+    assert eng2.pool.used_blocks == 0
+    st = read_journal(path)
+    assert st.finished == set(ref)
+    assert st.torn_lines == 0
+
+
+def test_recover_on_crashed_engine_in_place(model, tmp_path):
+    """recover() also works on the engine whose run() just raised: its
+    demoted sequences are discarded in favor of the journal's record,
+    and the SAME engine finishes the work bit-identically."""
+    ref = _reference_streams(model, tmp_path)
+    path = str(tmp_path / "kill.jsonl")
+    eng = _engine(model, path)
+    with faults.scope("serve.decode.before", "raise", nth=4):
+        with pytest.raises(faults.FaultError):
+            eng.run(_requests(), deterministic=True)
+    assert eng.pool.used_blocks == 0 and eng.waiting
+    rec = eng.recover()
+    assert rec["recovered"] == rec["replayed"] > 0
+    eng.run([], deterministic=True)
+    assert {s.req.request_id: s.generated for s in eng.finished} == ref
+
+
+def test_recover_without_journal_raises(model):
+    eng = _engine(model, None)
+    with pytest.raises(ValueError):
+        eng.recover()
+
+
+def test_journal_env_knob_enables_journaling(model, tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("PADDLE_TPU_SERVE_JOURNAL", path)
+    eng = _engine(model, None)
+    assert eng.journal_path == path
+    eng.run(_requests(1), deterministic=True)
+    assert read_journal(path).finished == {0}
+
+
+def test_torn_tail_recovery_rederives_lost_tokens(model, tmp_path):
+    """Truncate the journal mid-file (torn final records): recover()
+    counts the torn line and the re-driven stream still matches the
+    reference — lost tokens are re-derived, not lost."""
+    ref = _reference_streams(model, tmp_path)
+    path = str(tmp_path / "torn.jsonl")
+    eng = _engine(model, path)
+    eng.run(_requests(), deterministic=True)
+    with open(path, "rb") as f:
+        raw = f.readlines()
+    # keep a prefix, then tear the next line in half
+    keep, torn = raw[:-4], raw[-4]
+    with open(path, "wb") as f:
+        f.writelines(keep)
+        f.write(torn[:max(1, len(torn) // 2)])
+    eng2 = _engine(model, path)
+    rec = eng2.recover()
+    assert rec["torn_lines"] == 1
+    eng2.run([], deterministic=True)
+    got = {s.req.request_id: s.generated for s in eng2.finished}
+    for rid, toks in got.items():
+        assert toks == ref[rid]
+    assert read_journal(path).torn_lines == 1  # resume never rewrites
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -1,0 +1,315 @@
+"""Overload-hardened serving (PR 14): admission control, deadlines &
+load shedding, graceful degradation.
+
+The degradation contract pinned here (also PARITY.md):
+  * submit() never queues unboundedly — overload is a deterministic
+    Admission outcome (queue_full / overcommit / rate_limit), never an
+    exception and never silent;
+  * shedding is deterministic: replaying an arrival trace sheds the
+    SAME set of requests and the survivors' token streams are
+    bit-identical (and match the greedy reference);
+  * every request the engine saw ends finished/rejected/shed/failed
+    with a cause (outcomes());
+  * a 2x capacity burst leaves a leak-free pool;
+  * eviction is priority-aware; a prefill chunk shrinks its live span
+    (same compiled shape) before the scheduler resorts to eviction.
+
+Tiny model, pallas interpret mode on CPU.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.inference import (Admission, InferenceEngine, Request,
+                                  ServeConfig)
+from paddle_tpu.models.llama import (greedy_generate, init_llama_params,
+                                     llama_tiny)
+from paddle_tpu.ops import _common
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    with _common.interpret_mode(True):
+        yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(vocab=96, hidden=64, layers=1, heads=4, kv_heads=2,
+                     seq=512)
+    return cfg, init_llama_params(cfg, seed=3)
+
+
+def _greedy_ref(model, prompt, n_new):
+    cfg, params = model
+    with _common.interpret_mode(True):
+        out = greedy_generate(params, jnp.asarray([prompt], jnp.int32),
+                              cfg, n_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _prompts(n, size=20, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 96, size=size).tolist() for _ in range(n)]
+
+
+# -- admission valves (host-side, no device work needed) ---------------------
+
+
+def test_bounded_queue_rejects_with_cause(model):
+    cfg, params = model
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        max_seq_len=256, max_queue=2)
+    eng = InferenceEngine(params, cfg, serve)
+    outs = [eng.submit(Request(p, max_new_tokens=4))
+            for p in _prompts(4)]
+    assert [o.accepted for o in outs] == [True, True, False, False]
+    assert all(isinstance(o, Admission) for o in outs)
+    assert [o.cause for o in outs] == [None, None, "queue_full",
+                                       "queue_full"]
+    assert len(eng.waiting) == 2 and len(eng.rejected) == 2
+    # rejected requests carry a terminal outcome — nothing silent
+    assert eng.outcomes()[outs[2].request_id] == ("rejected", "queue_full")
+
+
+def test_overcommit_rejects_on_block_demand(model):
+    cfg, params = model
+    # 3 usable blocks, overcommit 1.0: worst-case demand must stay <= 3
+    serve = ServeConfig(block_size=128, num_blocks=4, max_batch=2,
+                        max_seq_len=384, overcommit=1.0, max_queue=16)
+    eng = InferenceEngine(params, cfg, serve)
+    a = eng.submit(Request([1] * 200, max_new_tokens=4))   # 2 blocks
+    b = eng.submit(Request([1] * 100, max_new_tokens=4))   # 1 block
+    c = eng.submit(Request([1] * 10, max_new_tokens=4))    # 1 over budget
+    assert a.accepted and b.accepted
+    assert not c.accepted and c.cause == "overcommit"
+
+
+def test_rate_limit_token_bucket_on_engine_clock(model):
+    cfg, params = model
+    serve = ServeConfig(block_size=128, num_blocks=16, max_batch=2,
+                        max_seq_len=256, rate_limit=0.5, burst=2,
+                        max_queue=64)
+    eng = InferenceEngine(params, cfg, serve)
+    burst = [eng.submit(Request(p, max_new_tokens=2))
+             for p in _prompts(3, size=8)]
+    assert [o.accepted for o in burst] == [True, True, False]
+    assert burst[2].cause == "rate_limit"
+    # advance the engine clock 2 units -> one refill at rate 0.5
+    eng._clock = 2.0
+    again = [eng.submit(Request(p, max_new_tokens=2))
+             for p in _prompts(2, size=8, seed=1)]
+    assert [o.accepted for o in again] == [True, False]
+
+
+def test_env_knobs_drive_admission(model, monkeypatch):
+    cfg, params = model
+    monkeypatch.setenv("PADDLE_TPU_SERVE_MAX_QUEUE", "1")
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        max_seq_len=256)
+    eng = InferenceEngine(params, cfg, serve)
+    assert eng.admission.max_queue == 1
+    assert eng.submit(Request([1] * 8, max_new_tokens=2)).accepted
+    assert eng.submit(Request([2] * 8,
+                              max_new_tokens=2)).cause == "queue_full"
+    # explicit ServeConfig field wins over the env
+    eng2 = InferenceEngine(params, cfg, ServeConfig(
+        block_size=128, num_blocks=10, max_batch=2, max_seq_len=256,
+        max_queue=7))
+    assert eng2.admission.max_queue == 7
+
+
+def test_malformed_requests_still_raise(model):
+    cfg, params = model
+    serve = ServeConfig(block_size=128, num_blocks=4, max_batch=4,
+                        max_seq_len=256)
+    eng = InferenceEngine(params, cfg, serve)
+    with pytest.raises(ValueError):
+        eng.submit(Request([1] * 300, max_new_tokens=16))
+    with pytest.raises(ValueError):
+        eng.submit(Request([]))
+
+
+# -- deadlines & shedding -----------------------------------------------------
+
+
+def _overload_run(model, seed=0):
+    """A 2x-capacity deterministic burst: a tiny pool + max_batch 1, six
+    requests arriving faster than the engine can serve, tight TTFT
+    deadlines — some must shed."""
+    cfg, params = model
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(1, 96, size=30).tolist() for _ in range(6)]
+    serve = ServeConfig(block_size=128, num_blocks=3, max_batch=1,
+                        prefill_chunk=32, max_seq_len=256, max_queue=8,
+                        overcommit=8.0)
+    eng = InferenceEngine(params, cfg, serve, record_events=True)
+    reqs = [Request(p, max_new_tokens=6, arrival=float(i),
+                    ttft_deadline=10.0, deadline=40.0)
+            for i, p in enumerate(prompts)]
+    stats = eng.run(reqs, deterministic=True)
+    return eng, stats, prompts
+
+
+@pytest.fixture(scope="module")
+def overload_runs(model):
+    with _common.interpret_mode(True):
+        a = _overload_run(model)
+        b = _overload_run(model)
+    return a, b
+
+
+def test_deadline_shedding_fires(overload_runs):
+    (eng, stats, _), _ = overload_runs
+    assert stats["shed"] >= 1, "overload trace must shed"
+    assert stats["requests"] >= 1, "some requests must still finish"
+    for seq in eng.shed:
+        assert seq.fail_cause in ("ttft_deadline", "deadline")
+
+
+def test_shedding_is_deterministic_across_replays(overload_runs):
+    (eng_a, _, _), (eng_b, _, _) = overload_runs
+    shed = lambda e: sorted((s.req.request_id, s.fail_cause)
+                            for s in e.shed)
+    assert shed(eng_a) == shed(eng_b)
+    assert shed(eng_a), "expected a non-empty shed set"
+    toks = lambda e: {s.req.request_id: s.tokens for s in e.finished}
+    assert toks(eng_a) == toks(eng_b)
+    assert eng_a.events == eng_b.events
+
+
+def test_survivors_match_greedy_reference(model, overload_runs):
+    (eng, _, prompts), _ = overload_runs
+    assert eng.finished, "no survivors"
+    for seq in eng.finished:
+        ref = _greedy_ref(model, prompts[seq.req.request_id], 6)
+        assert seq.generated == ref, f"request {seq.req.request_id}"
+
+
+def test_no_leaks_and_no_silent_drops_after_burst(overload_runs):
+    (eng, stats, prompts), _ = overload_runs
+    assert eng.pool.used_blocks == 0
+    outcomes = stats["outcomes"]
+    assert set(outcomes) == set(range(len(prompts)))
+    for rid, (state, cause) in outcomes.items():
+        assert state in ("finished", "shed", "rejected", "failed"), (
+            rid, state)
+        if state != "finished":
+            assert cause, f"request {rid}: terminal state without a cause"
+
+
+def test_shed_events_reach_observability(model):
+    cfg, params = model
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(1, 96, size=30).tolist() for _ in range(4)]
+    serve = ServeConfig(block_size=128, num_blocks=3, max_batch=1,
+                        prefill_chunk=32, max_seq_len=256, max_queue=8,
+                        overcommit=8.0)
+    eng = InferenceEngine(params, cfg, serve, record_events=True,
+                          trace_requests=True, flight_recorder=True)
+    reqs = [Request(p, max_new_tokens=6, arrival=float(i),
+                    ttft_deadline=6.0)
+            for i, p in enumerate(prompts)]
+    with _common.interpret_mode(True):
+        stats = eng.run(reqs, deterministic=True)
+    assert stats["shed"] >= 1
+    shed_rids = {s.req.request_id for s in eng.shed}
+    # tracer: one shed span per shed request, closing its queue wait
+    assert eng.tracer.span_count("shed") == len(shed_rids)
+    # flight recorder: a shed record per event
+    recorded = [r for r in eng.recorder.ring
+                if r.get("event") == "shed"]
+    assert {r["rid"] for r in recorded} == shed_rids
+    # prometheus: the scalar counter renders
+    assert "paddle_tpu_serve_shed_requests" in eng.render_prometheus()
+
+
+# -- graceful degradation under pool pressure --------------------------------
+
+
+def test_eviction_is_priority_aware(model):
+    """Two decoders + forced pressure: the LOW-priority one is evicted
+    even though it is older (pre-PR-14 tie-break was youngest-first)."""
+    cfg, params = model
+    rng = np.random.RandomState(3)
+    serve = ServeConfig(block_size=128, num_blocks=6, max_batch=2,
+                        prefill_chunk=32, max_seq_len=256)
+    eng = InferenceEngine(params, cfg, serve, record_events=True)
+    lo = Request(rng.randint(1, 96, size=8).tolist(), max_new_tokens=8,
+                 priority=0)
+    hi = Request(rng.randint(1, 96, size=8).tolist(), max_new_tokens=8,
+                 priority=5)
+    with _common.interpret_mode(True):
+        assert eng.submit(lo).accepted and eng.submit(hi).accepted
+        while any(s.state != "running" for s in eng.active) \
+                or len(eng.active) < 2:
+            eng.step()
+        assert eng._evict_one()
+    assert eng.waiting and eng.waiting[0].req.request_id == lo.request_id
+    assert all(s.req.request_id == hi.request_id for s in eng.active)
+
+
+def test_prefill_shrinks_before_evicting(model):
+    """Steal most of the pool mid-prefill: the next chunk must shrink its
+    live span to the remaining headroom (same compiled shape, no
+    eviction) and the request must still match the greedy reference."""
+    cfg, params = model
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(1, 96, size=400).tolist()
+    serve = ServeConfig(block_size=128, num_blocks=7, max_batch=1,
+                        prefill_chunk=256, max_seq_len=512)
+    eng = InferenceEngine(params, cfg, serve, record_events=True)
+    with _common.interpret_mode(True):
+        assert eng.submit(Request(prompt, max_new_tokens=4)).accepted
+        eng.step()                       # chunk 1: 256 tokens, 2 blocks
+        assert eng.active[0].n_cached == 256
+        stolen = eng.pool.alloc(3)       # leave exactly 1 free block
+        assert stolen is not None and eng.pool.free_blocks == 1
+        eng.step()                       # chunk 2 shrinks 144 -> 128
+        assert eng.active[0].n_cached == 256 + 128
+        eng.pool.free(stolen)
+        stats = eng.run([], deterministic=True)
+    shrunk = [ev for ev in eng.events if ev[1] == "prefill_shrink"]
+    assert shrunk and shrunk[0][3] == 128
+    assert stats["preemptions"] == 0, "shrink must pre-empt eviction"
+    assert stats["compiles"].keys() <= {"prefill_256", "decode_1"}
+    seq = eng.finished[0]
+    assert seq.generated == _greedy_ref(model, prompt, 4)
+    assert eng.pool.used_blocks == 0
+
+
+# -- BlockPool hardening ------------------------------------------------------
+
+
+def test_block_pool_named_errors():
+    """Corrupting frees fail loudly with BlockPoolError (a ValueError,
+    so pre-PR-14 handlers keep working) and leave the pool UNCHANGED —
+    validation is atomic, no partial free."""
+    from paddle_tpu.inference import BlockPool, BlockPoolError
+    pool = BlockPool(num_blocks=8, block_size=128)
+    blocks = pool.alloc(3)
+    free_before = pool.free_blocks
+
+    with pytest.raises(BlockPoolError, match="null block 0"):
+        pool.free([0])
+    with pytest.raises(BlockPoolError, match="out-of-range"):
+        pool.free([8])
+    with pytest.raises(BlockPoolError, match="out-of-range"):
+        pool.free([-1])
+    pool.free([blocks[0]])
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free([blocks[0]])
+    # duplicates WITHIN one call are a double free too
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free([blocks[1], blocks[1]])
+    # a rejected free touched nothing: the valid id in the bad batch is
+    # still allocated and frees cleanly now
+    assert pool.free_blocks == free_before + 1
+    pool.free(blocks[1:])
+    assert pool.used_blocks == 0
+    assert issubclass(BlockPoolError, ValueError)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

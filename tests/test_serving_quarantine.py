@@ -1,0 +1,226 @@
+"""Poison-request quarantine (PR 14): a request whose prefill/decode
+raises — or produces non-finite logits — is quarantined (blocks
+released, marked failed with a cause) while the engine keeps serving
+everyone else. Decode poison re-drives the surviving batch rows in the
+same iteration.
+
+Injection uses testing/faults.py poison points (INSIDE the engine's
+quarantine try blocks — contrast the crash-matrix points exercised by
+test_engine_journal.py, which kill the engine). Genuine-NaN paths are
+exercised with params surgery: embedding row 95 is set to NaN, so any
+prompt/history containing token 95 poisons its own logits.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (InferenceEngine, PoisonError, Request,
+                                  ServeConfig)
+from paddle_tpu.models.llama import (greedy_generate, init_llama_params,
+                                     llama_tiny)
+from paddle_tpu.ops import _common
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "1")
+    with _common.interpret_mode(True):
+        yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(vocab=96, hidden=64, layers=1, heads=4, kv_heads=2,
+                     seq=512)
+    return cfg, init_llama_params(cfg, seed=3)
+
+
+@pytest.fixture(scope="module")
+def nan_model(model):
+    """Same model with a NaN embedding row for token 95: feeding 95
+    through the network yields non-finite logits — a genuine poison
+    input, not an injected exception."""
+    cfg, params = model
+    import jax
+    params = jax.tree_util.tree_map(lambda x: x, params)  # shallow copy
+
+    def _poison(tree):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                out[k] = _poison(v)
+            elif k == "embed":
+                out[k] = v.at[95].set(jnp.nan)
+            else:
+                out[k] = v
+        return out
+
+    return cfg, _poison(params)
+
+
+def _greedy_ref(model, prompt, n_new):
+    cfg, params = model
+    with _common.interpret_mode(True):
+        out = greedy_generate(params, jnp.asarray([prompt], jnp.int32),
+                              cfg, n_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _serve(model, **kw):
+    cfg, params = model
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        prefill_chunk=32, max_seq_len=256, **kw)
+    return InferenceEngine(params, cfg, serve, record_events=True)
+
+
+def _prompts(n, size=20, seed=0, hi=95):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, hi, size=size).tolist() for _ in range(n)]
+
+
+# -- prefill quarantine -------------------------------------------------------
+
+
+def test_prefill_exception_quarantines_one_request(model):
+    """First request's prefill kernel raises; it is failed with a cause
+    and released, the second request still finishes with reference
+    tokens, and the pool ends clean."""
+    eng = _serve(model)
+    p_bad, p_ok = _prompts(2, size=24)
+    with faults.scope("serve.prefill.poison", "raise", nth=1):
+        stats = eng.run([Request(p_bad, max_new_tokens=4),
+                         Request(p_ok, max_new_tokens=4)],
+                        deterministic=True)
+    assert stats["failed"] == 1 and stats["requests"] == 1
+    bad = eng.failed[0]
+    assert bad.req.request_id == 0 and "prefill" in bad.fail_cause
+    assert bad.blocks == [] and eng.pool.used_blocks == 0
+    ok = eng.finished[0]
+    assert ok.generated == _greedy_ref(model, p_ok, 4)
+    assert stats["outcomes"][0][0] == "failed"
+
+
+def test_prefill_nan_logits_quarantined(nan_model, model):
+    """A prompt containing the NaN-embedded token yields non-finite
+    prefill logits -> quarantined by the nan screen; a clean prompt on
+    the same engine finishes and matches the NaN-free reference (token
+    95 never appears in its prompt or output)."""
+    eng = _serve(nan_model)
+    p_bad = _prompts(1, size=24, seed=1)[0]
+    p_bad[10] = 95                      # the poisoned embedding row
+    p_ok = _prompts(1, size=24, seed=2)[0]
+    stats = eng.run([Request(p_bad, max_new_tokens=4),
+                     Request(p_ok, max_new_tokens=4)],
+                    deterministic=True)
+    assert stats["failed"] == 1
+    assert eng.failed[0].fail_cause == "non-finite prefill logits"
+    assert eng.pool.used_blocks == 0
+    ref = _greedy_ref(nan_model, p_ok, 4)
+    assert eng.finished[0].generated == ref
+    assert 95 not in ref
+
+
+def test_nan_check_can_be_disabled(nan_model):
+    """nan_check=False skips the logits screen: the poisoned request is
+    NOT quarantined (it keeps decoding garbage argmax tokens)."""
+    cfg, params = nan_model
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        prefill_chunk=32, max_seq_len=256,
+                        nan_check=False)
+    eng = InferenceEngine(params, cfg, serve)
+    p_bad = _prompts(1, size=24, seed=1)[0]
+    p_bad[10] = 95
+    stats = eng.run([Request(p_bad, max_new_tokens=2)],
+                    deterministic=True)
+    assert stats["failed"] == 0 and stats["requests"] == 1
+
+
+# -- decode quarantine & re-drive ---------------------------------------------
+
+
+def test_decode_nan_row_quarantined_batchmate_survives(nan_model):
+    """Two decoders; one's first generated token is forced to the NaN
+    embedding row, so its NEXT decode step produces a non-finite logits
+    row. Only that row is quarantined — its batchmate's stream is
+    bit-identical to a solo run."""
+    cfg, params = nan_model
+    p_bad, p_ok = _prompts(2, size=24, seed=3)
+    solo = _serve(nan_model)
+    solo_stats = solo.run([Request(p_ok, max_new_tokens=6)],
+                          deterministic=True)
+    assert solo_stats["requests"] == 1
+    ref = solo.finished[0].tokens
+
+    eng = _serve(nan_model)
+    assert eng.submit(Request(p_bad, max_new_tokens=6)).accepted
+    assert eng.submit(Request(p_ok, max_new_tokens=6)).accepted
+    # drive both through prefill + first decode
+    while len(eng.active) < 2 or not all(s.generated for s in eng.active):
+        eng.step()
+    bad = next(s for s in eng.active if s.req.request_id == 0)
+    bad.tokens[-1] = 95                 # force the poison row into history
+    stats = eng.run([], deterministic=True)
+    assert stats["failed"] == 1
+    assert eng.failed[0].req.request_id == 0
+    assert eng.failed[0].fail_cause == "non-finite decode logits"
+    assert eng.finished[0].tokens == ref
+    assert eng.pool.used_blocks == 0
+
+
+def test_decode_poison_error_redrives_batch(model):
+    """A corrupt-action callable raises PoisonError(rid) from inside the
+    decode batch: the engine quarantines that row and RE-DRIVES the
+    remaining rows in the same iteration — the survivor finishes with
+    reference tokens and stats count the re-drive."""
+    eng = _serve(model)
+    p_bad, p_ok = _prompts(2, size=24, seed=4)
+
+    def boom(ctx):
+        raise PoisonError(ctx["rids"][0], "injected decode poison")
+
+    with faults.scope("serve.decode.poison", "corrupt", nth=2,
+                      corrupt=boom):
+        stats = eng.run([Request(p_bad, max_new_tokens=6),
+                         Request(p_ok, max_new_tokens=6)],
+                        deterministic=True)
+    assert stats["failed"] == 1 and stats["requests"] == 1
+    assert stats["decode_redrives"] >= 1
+    assert eng.failed[0].fail_cause == "injected decode poison"
+    assert eng.finished[0].generated == _greedy_ref(model, p_ok, 6)
+    assert eng.pool.used_blocks == 0
+
+
+def test_decode_generic_exception_still_raises(model):
+    """A NON-poison decode failure (no request attribution) must not be
+    swallowed by quarantine — it propagates, and run()'s crash path
+    releases every live block (satellite: leak-free pool after crash)."""
+    eng = _serve(model)
+    with faults.scope("serve.decode.poison", "raise", nth=2):
+        with pytest.raises(faults.FaultError):
+            eng.run([Request(p, max_new_tokens=6)
+                     for p in _prompts(2, size=24, seed=5)],
+                    deterministic=True)
+    assert eng.pool.used_blocks == 0
+    assert not eng.active and eng.waiting   # crashed work is re-queued
+
+
+def test_quarantine_reaches_observability(model):
+    cfg, params = model
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        prefill_chunk=32, max_seq_len=256)
+    eng = InferenceEngine(params, cfg, serve, record_events=True,
+                          trace_requests=True, flight_recorder=True)
+    p_bad, p_ok = _prompts(2, size=24, seed=6)
+    with faults.scope("serve.prefill.poison", "raise", nth=1):
+        eng.run([Request(p_bad, max_new_tokens=3),
+                 Request(p_ok, max_new_tokens=3)], deterministic=True)
+    assert eng.tracer.span_count("quarantine") == 1
+    assert any(r.get("event") == "quarantine" and r.get("rid") == 0
+               for r in eng.recorder.ring)
+    assert "paddle_tpu_serve_failed_requests 1" in eng.render_prometheus()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
