@@ -8,7 +8,9 @@ Metric is model FLOPs utilization (MFU) for a bf16 Llama training step
 from __future__ import annotations
 
 import json
+import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -846,6 +848,81 @@ def varlen_ceiling_ablation(dev, dense_fwd_ms, dense_bwd_ms):
     return out
 
 
+def bench_fleet_observability(dev, config, on_tpu):
+    """PR 15 rung: FleetMonitor cost and parity. The same training run
+    twice from identical seeds — bare, then with every step feeding a
+    FleetMonitor (interval reporting: site counter deltas, all-device
+    memory, one fleet_health JSONL record each) — gated on (a) bitwise-
+    identical loss sequences (the monitor only ever SEES host floats the
+    loop already had, it cannot perturb the computation) and (b)
+    attributed monitor overhead — time inside FleetMonitor calls via the
+    overlap_bench timing proxy — under 2% of the monitored run's wall."""
+    import jax
+    from benchmarks.overlap_bench import _TimedProxy
+    from paddle_tpu.models.llama import ParallelConfig, build_train_step
+    from paddle_tpu.observability import fleet as fleet_mod
+    from paddle_tpu.observability.fleet import FleetMonitor
+
+    parallel = ParallelConfig(remat=True, use_flash=on_tpu)
+    rng = np.random.RandomState(5)
+    n_steps, batch, seq = (20, 4, 512) if on_tpu else (8, 2, 64)
+    ids = rng.randint(0, config.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+
+    def run(monitor):
+        step, params, opt = build_train_step(config, parallel, lr=1e-4)
+        for _ in range(2):  # compile + settle outside the timed window
+            params, opt, loss = step(params, opt, ids, labels)
+        jax.device_get(loss)
+        losses = []
+        t0 = time.perf_counter()
+        last = t0
+        for _ in range(n_steps):
+            params, opt, loss = step(params, opt, ids, labels)
+            # per-step host sync in BOTH runs so the monitored and bare
+            # loops execute the identical schedule (and the loss is a
+            # host float by the time the monitor sees it)
+            losses.append(float(jax.device_get(loss)))
+            now = time.perf_counter()
+            if monitor is not None:
+                monitor.on_step(now - last, loss=losses[-1])
+            last = now
+        return losses, time.perf_counter() - t0
+
+    losses_off, wall_off = run(None)
+    path = os.path.join(
+        tempfile.mkdtemp(prefix="paddle_tpu_fleet_bench_"),
+        "fleet_health.jsonl")
+    counter = [0.0]
+    mon = FleetMonitor(rank=0, world=1, interval=4, out_path=path)
+    losses_on, wall_on = run(_TimedProxy(mon, counter))
+    n_reports, problems = fleet_mod.check_file(path)
+    overhead_pct = counter[0] / wall_on * 100.0
+    last_report = mon.reports[-1] if mon.reports else {}
+    out = {
+        "steps": n_steps,
+        "reports": n_reports,
+        "monitored_losses_identical": losses_on == losses_off,
+        "fleet_overhead_pct": round(overhead_pct, 3),
+        "fleet_overhead_ab_pct": round((wall_on / wall_off - 1.0) * 100.0,
+                                       2),
+        "health_check_ok": not problems,
+        "step_time_ms_worst": (last_report.get("step_time_ms") or
+                               {}).get("worst"),
+        "hbm_peak_bytes": last_report.get("hbm_peak_bytes"),
+        "anomalies": len(mon.anomalies),
+    }
+    assert out["monitored_losses_identical"], (losses_off, losses_on)
+    assert overhead_pct < 2.0, \
+        f"fleet monitor attributed overhead {overhead_pct:.2f}% >= 2%"
+    assert not problems, problems
+    if not on_tpu:
+        out["note"] = ("tiny config on CPU — functional rung; the "
+                       "overhead gate is attributed (proxy-timed), not "
+                       "the noisy A/B wall delta")
+    return out
+
+
 def bench_serve_continuous(dev, config, on_tpu):
     """Tentpole rung: the continuous-batching serving engine under a
     Poisson arrival trace with mixed prompt lengths. Reports end-to-end
@@ -1386,6 +1463,11 @@ def main():
     # under a 2x burst, admission+journal cost — runs on both backends
     detail["serve_overload"] = bench_serve_overload(dev, config, on_tpu)
 
+    # fleet observability (PR 15): attributed FleetMonitor cost + loss
+    # parity monitored vs bare — runs on both backends
+    detail["fleet_observability"] = bench_fleet_observability(
+        dev, config, on_tpu)
+
     if on_tpu:
         detail["step_ledger_flagship"] = bench_step_ledger(
             dev, config, batch, seq, dt)
@@ -1643,6 +1725,11 @@ def main():
             and so["no_silent_drops"] and so["pool_leak_free"])
         rungs["serve_admission_journal_pct"] = \
             so["admission_journal_overhead_pct"]
+    if "fleet_observability" in detail:
+        fo = detail["fleet_observability"]
+        rungs["fleet_observability_pct"] = fo["fleet_overhead_pct"]
+        rungs["fleet_observability_clean"] = bool(
+            fo["monitored_losses_identical"] and fo["health_check_ok"])
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(float(mfu), 4),

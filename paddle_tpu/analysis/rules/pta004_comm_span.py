@@ -1,14 +1,20 @@
-"""PTA004: every ``comm_span(...)`` call site passes ``nbytes=``.
+"""PTA004: every ``comm_span(...)`` call site passes ``nbytes=`` and a
+static ``site=`` label.
 
 A span with no byte count shows up as a hole in the per-hop/per-bucket
 traffic accounting the benches and the multichip dryrun assert on (the
-PR-3 telemetry contract). Migrated from tests/test_comm_span_lint.py —
-that test is now a thin shim over this rule.
+PR-3 telemetry contract). A span with no ``site=`` is invisible to the
+FleetMonitor's cross-rank straggler attribution (PR 15), and a DYNAMIC
+site label (f-string, variable) would fan one logical collective family
+out into unbounded per-instance keys that never line up across ranks —
+hence the label must be a string literal. Migrated from
+tests/test_comm_span_lint.py — that test is now a thin shim over this
+rule.
 """
 from __future__ import annotations
 
 from .. import Finding, Rule, register
-from .._astutil import call_ident, keyword
+from .._astutil import call_ident, keyword, str_const
 
 
 @register
@@ -17,7 +23,9 @@ class CommSpanRule(Rule):
     title = "comm-span-nbytes"
     rationale = ("comm_span without nbytes= leaves a hole in the per-hop "
                  "traffic attribution the benches and dryrun assert on "
-                 "(PR-3 telemetry contract)")
+                 "(PR-3 telemetry contract); without a static site= label "
+                 "the span is invisible to cross-rank straggler "
+                 "attribution (PR 15)")
     scope = ("paddle_tpu/",)
     exclude = ("paddle_tpu/analysis/",)
 
@@ -37,6 +45,18 @@ class CommSpanRule(Rule):
                     module, call,
                     "comm_span without nbytes=; the span's traffic volume "
                     "is unattributed in the step telemetry")
+            site = keyword(call, "site")
+            if site is None:
+                yield self.finding(
+                    module, call,
+                    "comm_span without site=; the span has no stable "
+                    "straggler-attribution key for cross-rank comparison")
+            elif str_const(site.value) is None:
+                yield self.finding(
+                    module, call,
+                    "comm_span site= must be a static string literal "
+                    "(one shared key per collective family, identical "
+                    "on every rank)")
 
     def finalize(self):
         if self.sites_seen < 1:

@@ -38,7 +38,7 @@ def exchange_counts(counts, axis_name, *, name="ragged_a2a.counts"):
     counts = jnp.asarray(counts)
     n = _axis_size(axis_name)
     nbytes = int(counts.size * counts.dtype.itemsize)
-    with _obs.comm_span(name, nbytes=nbytes):
+    with _obs.comm_span(name, nbytes=nbytes, site="ragged_a2a.counts"):
         if n == 1:
             return counts
         return lax.all_to_all(counts, axis_name, split_axis=0, concat_axis=0,
@@ -54,7 +54,7 @@ def ring_hop(x, axis_name, hop, *, name="ragged_a2a.hop"):
         return x
     perm = [(i, (i + h) % n) for i in range(n)]
     nbytes = int(x.size * x.dtype.itemsize)
-    with _obs.comm_span(name, nbytes=nbytes):
+    with _obs.comm_span(name, nbytes=nbytes, site="ragged_a2a.hop"):
         return lax.ppermute(x, axis_name, perm)
 
 
@@ -101,7 +101,8 @@ def ragged_all_to_all(rows, send_counts, axis_name, peer_rows, *,
         return send.reshape((peer_rows,) + rows.shape[1:]), recv_counts
     if impl == "dense":
         nbytes = int(send.size * send.dtype.itemsize)
-        with _obs.comm_span(f"{name}.dense", nbytes=nbytes):
+        with _obs.comm_span(f"{name}.dense", nbytes=nbytes,
+                            site="ragged_a2a.dense"):
             out = lax.all_to_all(send, axis_name, split_axis=0,
                                  concat_axis=0, tiled=True)
     else:

@@ -149,7 +149,8 @@ def prefetch_param_gathers(params: dict, buckets, shardings: dict):
             chained = jax.lax.optimization_barrier(tuple(vals) + (prev,))
             vals = list(chained[:-1])
         nbytes = sum(v.size * v.dtype.itemsize for v in vals)
-        with _obs.comm_span(f"param_gather.bucket{i:02d}", nbytes=nbytes):
+        with _obs.comm_span(f"param_gather.bucket{i:02d}", nbytes=nbytes,
+                            site="param_gather.bucket"):
             gathered = [
                 jax.lax.with_sharding_constraint(v, shardings[n])
                 for v, n in zip(vals, present)]
@@ -177,7 +178,8 @@ def bucketed_psum(grads: dict, buckets, axis_names):
             continue
         nbytes = sum(grads[n].size * grads[n].dtype.itemsize
                      for n in present)
-        with _obs.comm_span(f"grad_sync.bucket{i:02d}", nbytes=nbytes):
+        with _obs.comm_span(f"grad_sync.bucket{i:02d}", nbytes=nbytes,
+                            site="grad_sync.bucket"):
             reduced = jax.lax.psum(tuple(grads[n] for n in present),
                                    axis_names)
         out.update(zip(present, reduced))

@@ -427,6 +427,39 @@ _register(
     parse=_strict_bool("PADDLE_TPU_SERVE_JOURNAL_FSYNC"))
 
 _register(
+    "PADDLE_TPU_FLEET", "bool", False,
+    doc="Wire a FleetMonitor (PR 15) into jit.TrainStep: per-rank step "
+        "times, per-site comm_span hop stats and all-device memory are "
+        "aggregated across ranks every reporting interval (one small "
+        "host-side allgather, nothing on the step hot path). An explicit "
+        "TrainStep(fleet=) argument wins.",
+    parse=_strict_bool("PADDLE_TPU_FLEET"))
+
+_register(
+    "PADDLE_TPU_FLEET_INTERVAL", "int", 32,
+    doc="Steps between FleetMonitor fleet-health reports (PR 15); each "
+        "report is one host-side allgather + one JSONL record. Positive "
+        "integer; FleetMonitor(interval=) wins.",
+    parse=_positive_int("PADDLE_TPU_FLEET_INTERVAL", 32))
+
+_register(
+    "PADDLE_TPU_FLEET_HBM_WATERMARK", "float", 0.92,
+    doc="HBM high-watermark anomaly threshold for the FleetMonitor "
+        "(PR 15): a device whose peak_bytes_in_use exceeds this fraction "
+        "of its bytes_limit trips an hbm_high_watermark anomaly and a "
+        "flight-recorder dump. Positive number (fraction of the limit); "
+        "FleetMonitor(hbm_watermark=) wins.",
+    parse=_positive_float("PADDLE_TPU_FLEET_HBM_WATERMARK", 0.92))
+
+_register(
+    "PADDLE_TPU_FLEET_DESYNC_STEPS", "int", 4,
+    doc="Allowed rank step-count divergence before the FleetMonitor's "
+        "desync detector (PR 15) raises a rank_desync anomaly (one rank "
+        "stuck recompiling or spinning in host code while the others "
+        "advance). Positive integer; FleetMonitor(desync_steps=) wins.",
+    parse=_positive_int("PADDLE_TPU_FLEET_DESYNC_STEPS", 4))
+
+_register(
     "PADDLE_TPU_SEP_STRATEGY", "enum", "ring",
     doc="Context-parallel attention strategy for the llama sep axis "
         "(PR 7): 'ring' (PR-1 ring attention) or 'ulysses' (head-sharded "

@@ -75,10 +75,10 @@ from .. import envs
 from ..testing import faults
 from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
                             _jitted_paged_prefill, init_paged_kv_pool)
-from ..observability import histogram as _hist
 from ..observability.flight_recorder import (FlightRecorder,
                                              flight_recorder_enabled)
 from ..observability.histogram import LogHistogram
+from ..observability.registry import MetricsRegistry
 from ..observability.metrics import StepMetrics
 from ..observability.request_trace import RequestTracer
 from ..observability.trace import comm_span, record_counter
@@ -314,6 +314,11 @@ class InferenceEngine:
         self._redrives = 0
         self._recovered = 0
         self._jtoks: List[Tuple[int, int]] = []  # this iteration's tokens
+        # unified exposition (PR 15): the SLO histograms register by
+        # reference, scheduler gauges as render-time callbacks; the
+        # registration order IS the metrics_snapshot() key order
+        self.registry = MetricsRegistry(prefix="paddle_tpu_serve")
+        self._register_metrics()
         # admission valves: explicit ServeConfig fields win, then the
         # PADDLE_TPU_SERVE_* knobs, then the documented defaults
         sv = self.serve
@@ -350,6 +355,49 @@ class InferenceEngine:
         self._pending_swap: Optional[Tuple[Any, int]] = None
         self.swaps = 0
         self.last_swap: Optional[Dict[str, Any]] = None
+
+    def _register_metrics(self) -> None:
+        """Register every engine metric into the unified registry: the
+        live SLO histograms by reference (zero double bookkeeping) and
+        the scheduler gauges as callbacks read at render time — all
+        host-side ``len()``s and counters, so scraping never touches the
+        device."""
+        r = self.registry
+        r.summary("ttft_seconds", hist=self.slo["ttft"],
+                  help="time to first token (engine clock)")
+        r.summary("tpot_seconds", hist=self.slo["tpot"],
+                  help="time per output token (engine clock)")
+        r.summary("queue_wait_seconds", hist=self.slo["queue_wait"],
+                  help="submit-to-first-schedule wait (engine clock)")
+        r.gauge("queue_depth", fn=lambda: len(self.waiting),
+                help="requests admitted but not yet scheduled")
+        r.gauge("running", fn=lambda: sum(1 for s in self.active
+                                          if s.state == RUNNING),
+                help="sequences in decode")
+        r.gauge("prefilling", fn=lambda: sum(1 for s in self.active
+                                             if s.state == PREFILL),
+                help="sequences in chunked prefill")
+        r.gauge("batch_capacity", fn=lambda: self.serve.max_batch,
+                help="configured max decode batch")
+        r.gauge("pool_utilization", fn=lambda: self.pool.utilization,
+                help="fraction of KV blocks in use")
+        r.gauge("iterations", fn=lambda: self.iteration,
+                help="scheduler iterations run")
+        r.gauge("preemptions", fn=lambda: self.preemptions,
+                help="sequences evicted for memory pressure")
+        r.gauge("finished_requests", fn=lambda: len(self.finished),
+                help="requests completed")
+        r.gauge("rejected_requests", fn=lambda: len(self.rejected),
+                help="requests refused at admission")
+        r.gauge("shed_requests", fn=lambda: len(self.shed),
+                help="requests shed past their deadline")
+        r.gauge("failed_requests", fn=lambda: len(self.failed),
+                help="requests quarantined or failed")
+        r.gauge("decode_redrives", fn=lambda: self._redrives,
+                help="decode steps re-driven during journal recovery")
+        r.gauge("generated_tokens",
+                fn=lambda: sum(len(s.generated) for s in self.finished),
+                help="tokens generated by finished requests")
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -679,7 +727,8 @@ class InferenceEngine:
         try:
             faults.inject("serve.prefill.poison", rid=rid)
             with comm_span("serve.prefill",
-                           nbytes=int(n_live) * 4):
+                           nbytes=int(n_live) * 4,
+                           site="serve.prefill"):
                 logits, self.k_pool, self.v_pool = fn(
                     self.params, self.k_pool, self.v_pool,
                     jnp.asarray(table), np.int32(seq.n_cached),
@@ -768,7 +817,8 @@ class InferenceEngine:
             t0 = time.perf_counter()
             try:
                 faults.inject("serve.decode.poison", rids=rids)
-                with comm_span("serve.decode", nbytes=bucket * 4):
+                with comm_span("serve.decode", nbytes=bucket * 4,
+                               site="serve.decode"):
                     logits, self.k_pool, self.v_pool = fn(
                         self.params, self.k_pool, self.v_pool,
                         jnp.asarray(tables), jnp.asarray(positions),
@@ -1221,31 +1271,13 @@ class InferenceEngine:
 
     def metrics_snapshot(self) -> Dict[str, Any]:
         """Live metric snapshot, any time mid-run: the streaming SLO
-        histograms plus scheduler gauges. Feed it to
-        :func:`paddle_tpu.observability.render_prometheus` (or call
-        :meth:`render_prometheus`) for text exposition."""
-        return {
-            "ttft_seconds": self.slo["ttft"],
-            "tpot_seconds": self.slo["tpot"],
-            "queue_wait_seconds": self.slo["queue_wait"],
-            "queue_depth": len(self.waiting),
-            "running": sum(1 for s in self.active if s.state == RUNNING),
-            "prefilling": sum(1 for s in self.active
-                              if s.state == PREFILL),
-            "batch_capacity": self.serve.max_batch,
-            "pool_utilization": self.pool.utilization,
-            "iterations": self.iteration,
-            "preemptions": self.preemptions,
-            "finished_requests": len(self.finished),
-            "rejected_requests": len(self.rejected),
-            "shed_requests": len(self.shed),
-            "failed_requests": len(self.failed),
-            "decode_redrives": self._redrives,
-            "generated_tokens": sum(len(s.generated)
-                                    for s in self.finished),
-        }
+        histograms plus scheduler gauges, straight from the unified
+        :class:`~paddle_tpu.observability.MetricsRegistry` (key order is
+        the registration order, unchanged from the pre-PR-15 dict)."""
+        return self.registry.snapshot()
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition of :meth:`metrics_snapshot`."""
-        return _hist.render_prometheus(self.metrics_snapshot(),
-                                       prefix="paddle_tpu_serve")
+        """Prometheus text exposition via the unified registry (sample
+        lines byte-identical to the legacy dict renderer; ``# HELP``/
+        ``# TYPE`` pairs ahead of each family)."""
+        return self.registry.render_prometheus()

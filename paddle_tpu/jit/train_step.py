@@ -47,7 +47,7 @@ class TrainStep:
                  telemetry_dir: Optional[str] = None,
                  tokens_per_step: Optional[int] = None,
                  flight_recorder: Optional[bool] = None,
-                 checkpoint=None):
+                 fleet=None, checkpoint=None):
         # rolling-checkpoint + preemption orchestration (PR 13): a
         # CheckpointManager instance or a root directory string. on_step
         # fires after every completed step; interval pacing and the
@@ -311,6 +311,26 @@ class TrainStep:
             observability.set_active(self.telemetry)
             observability.set_counter(
                 "grad_sync.mode." + sync_mode, 1)
+        # fleet monitor (PR 15): cross-rank step/comm/memory aggregation,
+        # one host-side allgather per reporting interval, nothing on the
+        # step hot path. Accepts a shared FleetMonitor instance (the
+        # multichip dryrun's), True/False, or None -> PADDLE_TPU_FLEET.
+        if isinstance(fleet, observability.FleetMonitor):
+            self.fleet = fleet
+        elif observability.fleet_enabled(fleet if isinstance(fleet, bool)
+                                         else None):
+            logdir = telemetry_dir or observability.telemetry_dir()
+            self.fleet = observability.FleetMonitor(
+                recorder=self.recorder,
+                out_path=(os.path.join(logdir, "fleet_health.jsonl")
+                          if logdir else None))
+        else:
+            self.fleet = None
+        if self.fleet is not None and self.telemetry is not None:
+            try:
+                self.telemetry.register_into(self.fleet.registry)
+            except ValueError:
+                pass  # shared monitor: an earlier TrainStep registered
         if self.grad_buckets is not None:
             sizes = sharding_utils.bucket_bytes(shapes, self.grad_buckets)
             observability.set_counter("grad_sync.n_buckets",
@@ -450,7 +470,9 @@ class TrainStep:
             self._capture_cost(train_params, frozen, batch, sub, lr)
             captured = True
         rec = self.recorder
-        t0 = time.perf_counter() if (m is not None or rec is not None) else 0.0
+        fl = self.fleet
+        timed = m is not None or rec is not None or fl is not None
+        t0 = time.perf_counter() if timed else 0.0
         try:
             new_p, new_s, new_b, loss = self._compiled(
                 train_params, self.opt_states, self.buffers, frozen, batch,
@@ -461,7 +483,7 @@ class TrainStep:
             if rec is not None:
                 rec.dump("exception")
             raise
-        if m is not None or rec is not None:
+        if timed:
             dt = time.perf_counter() - t0
             is_compile = (self._note_compile() if m is not None
                           else self._step_count == 0)
@@ -488,6 +510,10 @@ class TrainStep:
                                 "dispatch_ms": dt * 1e3,
                                 "tokens": self._batch_tokens(batch)})
                     rec.check_step_time(dt)
+            if fl is not None and not is_compile:
+                # host float only — the monitor must never pull a device
+                # value (that would be the sync this path avoids)
+                fl.on_step(dt)
         self.params.update(new_p)
         self.opt_states = new_s
         self.buffers = new_b

@@ -1433,7 +1433,8 @@ def build_train_step(config: LlamaConfig, parallel: ParallelConfig,
                 axis_names=manual,
                 check_vma=False)
             with _obs.comm_span("llama.sep_island",
-                                nbytes=ids.size * ids.dtype.itemsize):
+                                nbytes=ids.size * ids.dtype.itemsize,
+                                site="llama.sep_island"):
                 return smap(p, ids, labels)
         return llama_loss(p, ids, labels, config, parallel, mesh,
                           use_flash=use_flash)
@@ -1587,7 +1588,8 @@ def _build_pp_train_step(config, parallel, mesh, params, pspecs, lr, use_flash):
     def step(p, opt, ids, labels):
         def island(pp_, i, l):
             with _obs.comm_span("llama.pp_island",
-                                nbytes=i.size * i.dtype.itemsize):
+                                nbytes=i.size * i.dtype.itemsize,
+                                site="llama.pp_island"):
                 return smap_loss(pp_, i, l)
         loss, grads = jax.value_and_grad(island)(p, ids, labels)
         new_p, new_opt = _adamw_update(p, grads, opt, lr)

@@ -16,19 +16,30 @@ pipeline, in three layers (PR 2 + PR 12):
 3. **Failure flight recorder** — ``FlightRecorder`` rings the last N
    iteration/step records and dumps them to ``PADDLE_TPU_TELEMETRY_DIR``
    on exception, eviction storm, or MAD step-time spike.
+4. **Fleet view** (PR 15) — ``MetricsRegistry`` is the single Prometheus
+   exposition every surface registers into; ``FleetMonitor`` aggregates
+   per-rank step times, per-``site=`` comm_span hop stats and all-device
+   memory across ranks (one host-side allgather per interval), computes
+   worst/median rank + straggler attribution + desync, and hooks
+   non-finite-loss / grad-norm-spike / HBM-watermark anomalies into the
+   shared flight-recorder ring.
 
 Switched by ``PADDLE_TPU_TELEMETRY`` / ``PADDLE_TPU_TRACE_REQUESTS`` /
-``PADDLE_TPU_FLIGHT_RECORDER`` (+ ``PADDLE_TPU_TELEMETRY_DIR`` for file
-output).
+``PADDLE_TPU_FLIGHT_RECORDER`` / ``PADDLE_TPU_FLEET`` (+
+``PADDLE_TPU_TELEMETRY_DIR`` for file output).
 """
 from .exporters import (JsonlWriter, TensorBoardWriter, get_logger,  # noqa: F401
                         load_jsonl, log_event, process_rank,
                         write_chrome_trace)
+from .fleet import (FleetMonitor, device_memory_all,  # noqa: F401
+                    fleet_enabled)
 from .flight_recorder import (FlightRecorder, flight_recorder_enabled,  # noqa: F401
                               load_dump)
-from .histogram import LogHistogram, render_prometheus  # noqa: F401
+from .histogram import (LogHistogram, histogram_sample_lines,  # noqa: F401
+                        render_prometheus)
 from .metrics import (PEAK_FLOPS_TABLE, StepMetrics, active,  # noqa: F401
                       peak_flops_per_device, set_active)
+from .registry import MetricsRegistry  # noqa: F401
 from .request_trace import RequestTracer  # noqa: F401
 from .trace import (ENV_TELEMETRY, ENV_TELEMETRY_DIR, comm_span,  # noqa: F401
                     counters, overlap_flags, record_counter, reset_counters,

@@ -1,0 +1,546 @@
+"""Fleet-wide training observability: cross-rank aggregation + anomalies.
+
+Everything before PR 15 itemized ONE rank of one host: StepMetrics
+timed the local step, comm_span counters tallied the local program, and
+``memory_stats()`` sampled device 0. ``FleetMonitor`` turns the
+MULTICHIP dryrun's ad-hoc "worst-rank step time" pattern into a layer:
+
+- **per-rank collection** (``on_step``) is a few host-side float appends
+  — the step hot path keeps zero device syncs (the monitor never calls
+  into jax on a step; callers hand it numbers they already have);
+- **one small host-side allgather per reporting interval** shares each
+  rank's step-time stats, per-``site=`` comm_span hop time/bytes deltas,
+  and ALL local devices' ``memory_stats()``; the aggregate computes
+  worst/median rank, per-site straggler attribution (which collective
+  family is slowest on which rank), a desync detector (rank step-count
+  divergence — e.g. one rank stuck recompiling), and the fleet HBM peak;
+- **anomaly hooks** — non-finite loss, grad-norm MAD spike, HBM
+  high-watermark, rank desync — append ``fleet_anomaly`` records to the
+  shared PR-12 FlightRecorder ring and dump it with the offending rank
+  and metric attached;
+- every aggregated report lands in a ``fleet_health`` JSONL record;
+  ``python -m paddle_tpu.observability.fleet --check <jsonl>`` validates
+  schema + no-desync + the monitor-overhead bound (the multichip dryrun
+  tail runs it on its own health log).
+
+Knobs (all through the ``envs`` registry, PTA005): ``PADDLE_TPU_FLEET``
+(wiring switch for ``jit.TrainStep``), ``PADDLE_TPU_FLEET_INTERVAL``
+(steps between reports), ``PADDLE_TPU_FLEET_HBM_WATERMARK`` (fraction
+of a device's byte limit that trips the high-watermark anomaly) and
+``PADDLE_TPU_FLEET_DESYNC_STEPS`` (allowed rank step-count divergence).
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from .. import envs
+from . import trace as _trace
+from .exporters import _jsonable, process_rank
+from .registry import MetricsRegistry
+
+__all__ = ["FleetMonitor", "fleet_enabled", "device_memory_all",
+           "check_file", "main", "REPORT_KIND"]
+
+ENV_FLEET = "PADDLE_TPU_FLEET"
+ENV_FLEET_INTERVAL = "PADDLE_TPU_FLEET_INTERVAL"
+ENV_FLEET_HBM_WATERMARK = "PADDLE_TPU_FLEET_HBM_WATERMARK"
+ENV_FLEET_DESYNC_STEPS = "PADDLE_TPU_FLEET_DESYNC_STEPS"
+
+REPORT_KIND = "fleet_health"
+# every fleet_health record must carry these (the --check schema)
+REQUIRED_KEYS = ("kind", "world", "step", "step_time_ms", "sites",
+                 "top_straggler_site", "hbm_peak_bytes", "desync",
+                 "interval_wall_ms", "monitor_overhead_ms", "anomalies")
+# the grad-norm MAD detector stays quiet below this many samples
+# (median/MAD over warmup jitter flags nothing but noise)
+MIN_GRAD_SAMPLES = 16
+_MAD_SIGMA = 1.4826  # MAD -> sigma under normality
+
+
+def fleet_enabled(explicit: Optional[bool] = None) -> bool:
+    """Fleet-monitor switch: explicit argument wins, else the env knob."""
+    if explicit is not None:
+        return bool(explicit)
+    return envs.get(ENV_FLEET)
+
+
+def device_memory_all() -> List[Dict[str, Any]]:
+    """Host-side PJRT ``memory_stats()`` of EVERY local device (no device
+    sync — PJRT answers from the client). Backends that report nothing
+    (host CPU) yield an empty list, which downstream renders as n/a."""
+    out: List[Dict[str, Any]] = []
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        devices = []
+    for i, dev in enumerate(devices):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out.append({"device": i,
+                    "device_kind": getattr(dev, "device_kind", ""),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit")})
+    return out
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class FleetMonitor:
+    """Cross-rank step/collective/memory aggregation off the hot path.
+
+    Per step the caller hands over numbers it already has on the host
+    (``on_step(step_time_s=...)``; optionally ``loss=``/``grad_norm=``
+    as HOST floats — the monitor never pulls a device value). Every
+    ``interval`` steps the monitor builds its local rank report, runs
+    ONE small host-side allgather, aggregates, updates its registry,
+    appends a ``fleet_health`` JSONL record, and checks the anomaly
+    hooks. All other steps cost two ``perf_counter`` reads and a list
+    append.
+    """
+
+    def __init__(self, rank: Optional[int] = None,
+                 world: Optional[int] = None,
+                 interval: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 recorder=None, out_path: Optional[str] = None,
+                 hbm_watermark: Optional[float] = None,
+                 desync_steps: Optional[int] = None,
+                 spike_mad: Optional[float] = None,
+                 allgather: Optional[Callable[[Dict], List[Dict]]] = None):
+        self.rank = rank if rank is not None else process_rank()
+        self.world = world if world is not None else max(
+            1, jax.process_count())
+        self.interval = int(interval if interval is not None
+                            else envs.get(ENV_FLEET_INTERVAL))
+        self.hbm_watermark = float(
+            hbm_watermark if hbm_watermark is not None
+            else envs.get(ENV_FLEET_HBM_WATERMARK))
+        self.desync_steps = int(desync_steps if desync_steps is not None
+                                else envs.get(ENV_FLEET_DESYNC_STEPS))
+        self.spike_mad = float(spike_mad if spike_mad is not None
+                               else envs.get("PADDLE_TPU_SPIKE_MAD"))
+        self.allgather = allgather if allgather is not None \
+            else self._default_allgather
+        self.recorder = recorder  # shared PR-12 FlightRecorder ring
+        self.registry = registry if registry is not None \
+            else MetricsRegistry(prefix="paddle_tpu_fleet")
+        self.out_path = out_path
+        self.steps_done = 0
+        self.reports: List[Dict] = []
+        self.anomalies: List[Dict] = []
+        self._step_times: List[float] = []     # since last report
+        self._grad_norms: collections.deque = collections.deque(maxlen=128)
+        self._site_base: Dict[str, float] = {}
+        self._overhead_s = 0.0
+        self._overhead_reported = 0.0
+        self._interval_t0 = time.perf_counter()
+        self._anoms_reported = 0
+        self._register_metrics()
+
+    # -- registry wiring -----------------------------------------------------
+
+    def _register_metrics(self) -> None:
+        r = self.registry
+        self._m_reports = r.counter(
+            "reports_total", help="fleet health reports emitted")
+        self._m_hist = r.summary(
+            "local_step_time_seconds", lo=1e-5, hi=1e4,
+            help="this rank's step wall-time distribution")
+        self._m_worst = r.gauge(
+            "step_time_ms_worst",
+            help="worst-rank mean step time over the last interval (ms)")
+        self._m_median = r.gauge(
+            "step_time_ms_median",
+            help="median-rank mean step time over the last interval (ms)")
+        self._m_worst_rank = r.gauge(
+            "worst_rank", help="rank with the slowest mean step time")
+        self._m_desync = r.gauge(
+            "desync_max_ahead",
+            help="max rank step-count divergence at the last report")
+        self._m_hbm = r.gauge(
+            "hbm_peak_bytes",
+            help="fleet-wide peak HBM bytes across all reporting devices")
+        self._m_site_ms = r.family(
+            "site_hop_ms", "gauge", labelnames=("site",),
+            help="worst-rank host ms inside each comm_span site over the "
+                 "last interval")
+        self._m_site_bytes = r.family(
+            "site_hop_bytes", "gauge", labelnames=("site",),
+            help="total bytes attributed to each comm_span site over the "
+                 "last interval")
+
+    # -- per-step collection (hot path: host floats only) --------------------
+
+    def on_step(self, step_time_s: Optional[float] = None,
+                loss: Optional[float] = None,
+                grad_norm: Optional[float] = None) -> Optional[Dict]:
+        """Record one local step; returns the aggregated fleet report on
+        interval boundaries, else None. All arguments must already be
+        host values — passing a device array here would add the very
+        sync this layer is designed to avoid."""
+        t0 = time.perf_counter()
+        self.steps_done += 1
+        if step_time_s is not None:
+            v = float(step_time_s)
+            self._step_times.append(v)
+            self._m_hist.observe(v)
+        if loss is not None:
+            self.observe_loss(loss)
+        if grad_norm is not None:
+            self.observe_grad_norm(grad_norm)
+        report = None
+        if self.interval > 0 and self.steps_done % self.interval == 0:
+            report = self._report(t0)
+        self._overhead_s += time.perf_counter() - t0
+        return report
+
+    # -- anomaly hooks -------------------------------------------------------
+
+    def _anomaly(self, kind: str, **fields) -> Dict:
+        rec = {"kind": kind, "rank": self.rank, "step": self.steps_done}
+        rec.update(fields)
+        self.anomalies.append(rec)
+        if self.recorder is not None:
+            # shared PR-12 ring: the fleet event rides next to the step
+            # records so the dump shows what the rank was doing around it
+            self.recorder.record({"iteration": self.steps_done,
+                                  "event": "fleet_anomaly", **rec})
+            self.recorder.anomalies.append(rec)
+            self.recorder.dump(kind)
+        return rec
+
+    def observe_loss(self, value: float) -> Optional[Dict]:
+        """Non-finite-loss hook over a HOST float the caller already has
+        (a logging loop's ``float(loss)``); never syncs to fetch one."""
+        v = float(value)
+        if not math.isfinite(v):
+            return self._anomaly("nonfinite_loss", metric="loss", value=v)
+        return None
+
+    def observe_grad_norm(self, value: float) -> Optional[Dict]:
+        """Grad-norm MAD spike hook: a norm beyond ``spike_mad`` robust
+        sigmas from the rolling-window median (the loss-scale-blowup /
+        bad-batch signature), plus the non-finite screen."""
+        v = float(value)
+        if not math.isfinite(v):
+            return self._anomaly("nonfinite_loss", metric="grad_norm",
+                                 value=v)
+        prior = list(self._grad_norms)
+        self._grad_norms.append(v)
+        if len(prior) < MIN_GRAD_SAMPLES:
+            return None
+        med = _median(prior)
+        sigma = _MAD_SIGMA * _median([abs(x - med) for x in prior])
+        spike = (abs(v - med) > self.spike_mad * sigma if sigma > 0
+                 else v > med * self.spike_mad)
+        if spike:
+            return self._anomaly("grad_norm_spike", metric="grad_norm",
+                                 value=v, median=med,
+                                 mad_sigma=sigma / _MAD_SIGMA,
+                                 threshold_mads=self.spike_mad)
+        return None
+
+    # -- interval reporting --------------------------------------------------
+
+    def _site_deltas(self) -> Dict[str, Dict[str, float]]:
+        """Per-site comm_span counter deltas since the last report.
+        Counter resets (``reset_counters()``) are detected per key — a
+        value below its base restarts the delta from the raw value."""
+        cur = {k: v for k, v in _trace.counters().items()
+               if k.startswith("site.")}
+        out: Dict[str, Dict[str, float]] = {}
+        for key, val in cur.items():
+            site, _, field = key[len("site."):].rpartition(".")
+            if field not in ("calls", "bytes", "ms") or not site:
+                continue
+            base = self._site_base.get(key, 0.0)
+            delta = val - base if val >= base else val
+            if delta:
+                out.setdefault(site, {})[field] = delta
+        self._site_base = cur
+        return out
+
+    def local_report(self) -> Dict[str, Any]:
+        """This rank's payload for the interval allgather: step-time
+        stats since the last report, per-site comm deltas, and every
+        local device's memory stats."""
+        times = self._step_times
+        stats: Dict[str, Any] = {"count": len(times)}
+        if times:
+            stats["mean"] = sum(times) / len(times) * 1e3
+            stats["max"] = max(times) * 1e3
+        return {"rank": self.rank, "steps_done": self.steps_done,
+                "step_time_ms": stats,
+                "sites": self._site_deltas(),
+                "devices": device_memory_all()}
+
+    @staticmethod
+    def aggregate(rank_reports: List[Dict]) -> Dict[str, Any]:
+        """Fold per-rank payloads into one fleet view: worst/median rank
+        step time, per-site straggler attribution (worst rank + cross-
+        rank spread per comm_span site), fleet HBM peak, and the rank
+        step-count desync. Pure function of the gathered payloads."""
+        reports = [r for r in rank_reports if r]
+        per_rank = [(r["rank"], r["step_time_ms"]["mean"])
+                    for r in reports
+                    if r.get("step_time_ms", {}).get("mean") is not None]
+        step_time: Dict[str, Any] = {"worst": None, "median": None,
+                                     "worst_rank": None}
+        if per_rank:
+            worst_rank, worst = max(per_rank, key=lambda rv: rv[1])
+            step_time = {"worst": worst,
+                         "median": _median([v for _, v in per_rank]),
+                         "worst_rank": worst_rank}
+        sites: Dict[str, Dict[str, Any]] = {}
+        names = sorted({s for r in reports for s in (r.get("sites") or {})})
+        for site in names:
+            entries = [(r["rank"], r["sites"][site]) for r in reports
+                       if site in (r.get("sites") or {})]
+            ms = [(rk, d.get("ms", 0.0)) for rk, d in entries]
+            worst_rank, worst_ms = max(ms, key=lambda rv: rv[1])
+            median_ms = _median([v for _, v in ms])
+            sites[site] = {
+                "worst_rank": worst_rank,
+                "worst_ms": worst_ms,
+                "median_ms": median_ms,
+                "spread_ms": worst_ms - median_ms,
+                "bytes": sum(d.get("bytes", 0.0) for _, d in entries),
+                "calls": sum(d.get("calls", 0.0) for _, d in entries),
+            }
+        top = None
+        if sites:
+            spreads = {s: v["spread_ms"] for s, v in sites.items()}
+            if any(v > 0 for v in spreads.values()):
+                top = max(spreads, key=spreads.get)
+            else:  # single rank (or perfectly even): attribute by cost
+                top = max(sites, key=lambda s: sites[s]["worst_ms"])
+        devices = [{**d, "rank": r["rank"]}
+                   for r in reports for d in (r.get("devices") or [])]
+        peaks = [d["peak_bytes_in_use"] for d in devices
+                 if d.get("peak_bytes_in_use") is not None]
+        steps = {str(r["rank"]): r["steps_done"] for r in reports}
+        max_ahead = (max(steps.values()) - min(steps.values())
+                     if steps else 0)
+        return {
+            "kind": REPORT_KIND,
+            "world": len(reports),
+            "step": max(steps.values()) if steps else 0,
+            "step_time_ms": step_time,
+            "sites": sites,
+            "top_straggler_site": top,
+            "devices": devices,
+            "hbm_peak_bytes": max(peaks) if peaks else None,
+            "desync": {"max_ahead": max_ahead, "steps": steps},
+        }
+
+    def _default_allgather(self, payload: Dict) -> List[Dict]:
+        """One host-side allgather of the (small) JSON payload. Single
+        process returns the local payload; multi-process ships it as a
+        padded uint8 buffer through ``multihost_utils`` — two tiny
+        gathers per interval, nothing on the step itself."""
+        if jax.process_count() <= 1:
+            return [payload]
+        import numpy as np
+        from jax.experimental import multihost_utils
+        raw = json.dumps(payload, default=_jsonable).encode()
+        sizes = multihost_utils.process_allgather(
+            np.asarray(len(raw), np.int32))
+        cap = int(sizes.max())
+        buf = np.zeros(cap, np.uint8)
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+        gathered = multihost_utils.process_allgather(buf)
+        return [json.loads(bytes(gathered[i][:int(sizes[i])]).decode())
+                for i in range(len(sizes))]
+
+    def _report(self, t0: float) -> Dict[str, Any]:
+        gathered = self.allgather(self.local_report())
+        agg = self.aggregate(gathered)
+        # desync + HBM watermark hooks run on the AGGREGATED view, so a
+        # healthy rank still raises the alarm for a stuck/overcommitted one
+        if agg["desync"]["max_ahead"] > self.desync_steps:
+            self._anomaly("rank_desync",
+                          max_ahead=agg["desync"]["max_ahead"],
+                          steps=agg["desync"]["steps"],
+                          allowed=self.desync_steps)
+        for d in agg["devices"]:
+            limit, peak = d.get("bytes_limit"), d.get("peak_bytes_in_use")
+            if limit and peak and peak / limit > self.hbm_watermark:
+                self._anomaly("hbm_high_watermark", metric="hbm_peak",
+                              rank=d["rank"], device=d.get("device"),
+                              fraction=peak / limit,
+                              watermark=self.hbm_watermark,
+                              peak_bytes=peak, limit_bytes=limit)
+        now = time.perf_counter()
+        total_overhead = self._overhead_s + (now - t0)
+        agg["monitor_overhead_ms"] = \
+            (total_overhead - self._overhead_reported) * 1e3
+        self._overhead_reported = total_overhead
+        agg["interval_wall_ms"] = (now - self._interval_t0) * 1e3
+        self._interval_t0 = now
+        agg["anomalies"] = self.anomalies[self._anoms_reported:]
+        self._anoms_reported = len(self.anomalies)
+        self._update_registry(agg)
+        self.reports.append(agg)
+        self._step_times = []
+        if self.out_path:
+            with open(self.out_path, "a") as fh:
+                json.dump(agg, fh, default=_jsonable)
+                fh.write("\n")
+        return agg
+
+    def _update_registry(self, agg: Dict[str, Any]) -> None:
+        self._m_reports.inc()
+        st = agg["step_time_ms"]
+        if st["worst"] is not None:
+            self._m_worst.set(st["worst"])
+            self._m_median.set(st["median"])
+            self._m_worst_rank.set(st["worst_rank"])
+        self._m_desync.set(agg["desync"]["max_ahead"])
+        if agg["hbm_peak_bytes"] is not None:
+            self._m_hbm.set(agg["hbm_peak_bytes"])
+        for site, v in agg["sites"].items():
+            self._m_site_ms.labels(site=site).set(v["worst_ms"])
+            self._m_site_bytes.labels(site=site).set(v["bytes"])
+
+    # -- human view ----------------------------------------------------------
+
+    def health_lines(self, tag: Optional[str] = None) -> List[str]:
+        """The per-rung fleet health report the dryrun prints."""
+        prefix = f"fleet[{tag}]" if tag else "fleet"
+        if not self.reports:
+            return [f"{prefix}: no reports yet"]
+        r = self.reports[-1]
+        st = r["step_time_ms"]
+        if st["worst"] is not None:
+            l1 = (f"{prefix}: world={r['world']} step={r['step']} "
+                  f"worst_rank_step={st['worst']:.2f}ms@rank"
+                  f"{st['worst_rank']} median={st['median']:.2f}ms")
+        else:
+            l1 = (f"{prefix}: world={r['world']} step={r['step']} "
+                  f"step_time=n/a (no timed steps this interval)")
+        top = r["top_straggler_site"]
+        if top is not None:
+            s = r["sites"][top]
+            l2 = (f"{prefix}: straggler site={top} "
+                  f"worst={s['worst_ms']:.2f}ms@rank{s['worst_rank']} "
+                  f"median={s['median_ms']:.2f}ms bytes={s['bytes']:.0f}")
+        else:
+            l2 = (f"{prefix}: straggler site=n/a "
+                  f"(no labeled comm_span traffic this interval)")
+        if r["hbm_peak_bytes"] is not None:
+            hbm = (f"hbm_peak={r['hbm_peak_bytes'] / 2 ** 20:.1f}MiB "
+                   f"over {len(r['devices'])} device(s)")
+        else:
+            hbm = "hbm=n/a (backend reports no memory_stats)"
+        l3 = (f"{prefix}: {hbm} "
+              f"desync_max_ahead={r['desync']['max_ahead']} "
+              f"anomalies={len(r['anomalies'])} "
+              f"overhead={r['monitor_overhead_ms']:.2f}ms"
+              f"/{r['interval_wall_ms']:.0f}ms")
+        return [l1, l2, l3]
+
+
+# -- CLI: validate a dryrun's fleet-health JSONL -----------------------------
+
+def check_file(path: str, max_overhead_pct: float = 2.0,
+               max_desync: Optional[int] = None):
+    """Validate a fleet-health JSONL: every line parses as a
+    ``fleet_health`` record with the full schema, no report exceeds the
+    allowed rank desync, and the attributed monitor overhead stays under
+    ``max_overhead_pct`` of each interval's wall time. Returns
+    ``(n_records, problems)``."""
+    if max_desync is None:
+        max_desync = envs.get(ENV_FLEET_DESYNC_STEPS)
+    n = 0
+    problems: List[str] = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                problems.append(f"line {lineno}: not valid JSON ({e})")
+                continue
+            if rec.get("kind") != REPORT_KIND:
+                problems.append(f"line {lineno}: kind={rec.get('kind')!r}, "
+                                f"expected {REPORT_KIND!r}")
+                continue
+            n += 1
+            missing = [k for k in REQUIRED_KEYS if k not in rec]
+            if missing:
+                problems.append(f"line {lineno}: missing keys {missing}")
+                continue
+            st = rec["step_time_ms"]
+            if not isinstance(st, dict) or not {"worst", "median",
+                                                "worst_rank"} <= set(st):
+                problems.append(f"line {lineno}: malformed step_time_ms "
+                                f"{st!r}")
+            desync = rec["desync"] or {}
+            ahead = desync.get("max_ahead", 0)
+            if ahead > max_desync:
+                problems.append(
+                    f"line {lineno}: rank desync {ahead} steps "
+                    f"(allowed {max_desync}); steps={desync.get('steps')}")
+            wall, over = rec["interval_wall_ms"], rec["monitor_overhead_ms"]
+            if (isinstance(wall, (int, float)) and wall > 0
+                    and isinstance(over, (int, float))):
+                pct = over / wall * 100.0
+                if pct > max_overhead_pct:
+                    problems.append(
+                        f"line {lineno}: monitor overhead {pct:.2f}% of "
+                        f"interval wall (bound {max_overhead_pct}%)")
+    if n == 0:
+        problems.append(f"{path}: no {REPORT_KIND} records found")
+    return n, problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.fleet",
+        description="Validate a fleet-health JSONL (schema + no-desync + "
+                    "monitor-overhead bound).")
+    parser.add_argument("--check", metavar="JSONL", required=True,
+                        help="path of a FleetMonitor out_path JSONL")
+    parser.add_argument("--max-overhead-pct", type=float, default=2.0,
+                        help="allowed monitor overhead as %% of interval "
+                             "wall time (default 2)")
+    parser.add_argument("--max-desync", type=int, default=None,
+                        help="allowed rank step-count divergence "
+                             "(default: PADDLE_TPU_FLEET_DESYNC_STEPS)")
+    args = parser.parse_args(argv)
+    n, problems = check_file(args.check, args.max_overhead_pct,
+                             args.max_desync)
+    if problems:
+        for msg in problems:
+            print(f"fleet_check: {msg}", file=sys.stderr)
+        print(f"fleet_check: {os.path.basename(args.check)} reports={n} "
+              f"FAILED ({len(problems)} problem(s))")
+        return 1
+    print(f"fleet_check: {os.path.basename(args.check)} reports={n} "
+          f"schema_ok=True desync_ok=True overhead_ok=True OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

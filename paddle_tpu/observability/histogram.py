@@ -20,7 +20,7 @@ from __future__ import annotations
 import math
 from typing import Dict, List, Optional, Union
 
-__all__ = ["LogHistogram", "render_prometheus"]
+__all__ = ["LogHistogram", "render_prometheus", "histogram_sample_lines"]
 
 
 class LogHistogram:
@@ -157,6 +157,40 @@ def _prom_num(v: float) -> str:
     return repr(float(v))
 
 
+def histogram_sample_lines(name: str, val: LogHistogram,
+                           labels: str = "") -> List[str]:
+    """The sample lines of one histogram family (no # comment lines).
+
+    ``labels`` is a pre-rendered ``key="value"`` list (empty for an
+    unlabeled family) merged into each ``_bucket`` line ahead of ``le``.
+    This is THE bucket-assembly code path: both the legacy dict renderer
+    below and ``registry.MetricsRegistry`` call it, so the exposition
+    stays byte-identical across the two surfaces.
+    """
+    sep = labels + "," if labels else ""
+    brace = "{" + labels + "}" if labels else ""
+    lines: List[str] = []
+    # emit only the populated bucket range (plus one flanking
+    # bucket each side); the le bounds stay cumulative because the
+    # skipped leading buckets are all empty bar underflow, which
+    # folds into the first emitted bound
+    nz = [i for i in range(1, val.n_bins + 1) if val.counts[i]]
+    cum = val.counts[0]
+    if nz:
+        start = max(1, nz[0] - 1)
+        end = min(val.n_bins, nz[-1] + 1)
+        for i in range(1, end + 1):
+            cum += val.counts[i]
+            if i >= start:
+                lines.append(
+                    f'{name}_bucket{{{sep}le="{_prom_num(val.edge(i))}"}}'
+                    f" {cum}")
+    lines.append(f'{name}_bucket{{{sep}le="+Inf"}} {val.count}')
+    lines.append(f"{name}_sum{brace} {_prom_num(val.sum)}")
+    lines.append(f"{name}_count{brace} {val.count}")
+    return lines
+
+
 def render_prometheus(metrics: Dict[str, Union[LogHistogram, float, int]],
                       prefix: str = "paddle_tpu") -> str:
     """Prometheus text exposition of a metric dict.
@@ -172,24 +206,7 @@ def render_prometheus(metrics: Dict[str, Union[LogHistogram, float, int]],
         name = _prom_name(f"{prefix}_{key}" if prefix else key)
         if isinstance(val, LogHistogram):
             lines.append(f"# TYPE {name} histogram")
-            # emit only the populated bucket range (plus one flanking
-            # bucket each side); the le bounds stay cumulative because the
-            # skipped leading buckets are all empty bar underflow, which
-            # folds into the first emitted bound
-            nz = [i for i in range(1, val.n_bins + 1) if val.counts[i]]
-            cum = val.counts[0]
-            if nz:
-                start = max(1, nz[0] - 1)
-                end = min(val.n_bins, nz[-1] + 1)
-                for i in range(1, end + 1):
-                    cum += val.counts[i]
-                    if i >= start:
-                        lines.append(
-                            f'{name}_bucket{{le="{_prom_num(val.edge(i))}"}}'
-                            f" {cum}")
-            lines.append(f'{name}_bucket{{le="+Inf"}} {val.count}')
-            lines.append(f"{name}_sum {_prom_num(val.sum)}")
-            lines.append(f"{name}_count {val.count}")
+            lines.extend(histogram_sample_lines(name, val))
         elif isinstance(val, (int, float)) and not isinstance(val, bool):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {_prom_num(float(val))}")

@@ -97,6 +97,32 @@ class StepMetrics:
         self.step_time_hist = LogHistogram(lo=1e-5, hi=1e4)
         self._last_t: Optional[float] = None
         self._exporters: List = []
+        self._mem_fams = None  # (in_use, peak) gauge families, per device
+
+    def register_into(self, registry) -> None:
+        """Expose this collector through a :class:`MetricsRegistry`: the
+        full-run step-time histogram (by reference), compile accounting
+        gauges, and per-device memory gauge families keyed ``device=``
+        (refreshed on every :meth:`device_memory` poll, i.e. each step)."""
+        registry.summary("step_time_seconds", hist=self.step_time_hist,
+                         help="training step wall time (steady-state "
+                              "dispatch interval)")
+        registry.gauge("steps", fn=lambda: self.steps,
+                       help="steps recorded this run")
+        registry.gauge("compiles", fn=lambda: self.compiles,
+                       help="program (re)compilations observed")
+        registry.gauge("recompiles", fn=lambda: self.recompiles,
+                       help="compilations beyond the first")
+        registry.gauge("compile_time_seconds",
+                       fn=lambda: self.compile_time_s,
+                       help="cumulative wall time spent compiling")
+        self._mem_fams = (
+            registry.family("device_mem_bytes_in_use", "gauge",
+                            labelnames=("device",),
+                            help="live HBM bytes per local device"),
+            registry.family("device_mem_peak_bytes_in_use", "gauge",
+                            labelnames=("device",),
+                            help="peak HBM bytes per local device"))
 
     # -- wiring -------------------------------------------------------------
 
@@ -129,17 +155,49 @@ class StepMetrics:
         # steady-state interval clock
         self._last_t = None
 
-    def device_memory(self) -> Dict[str, Optional[int]]:
-        """Host-side PJRT memory stats of device 0 (no sync; {} on backends
-        like CPU that report none)."""
+    def device_memory(self) -> Dict:
+        """Host-side PJRT memory stats over ALL ``jax.local_devices()``
+        (no sync; {} on backends like CPU that report none). The scalar
+        roll-ups keep the pre-PR-15 record keys — ``mem_bytes_in_use``
+        is now the SUM across local devices and ``mem_peak_bytes_in_use``
+        the max — while ``mem_per_device`` carries each device's stats
+        (the devices[0]-only sampling hid every non-0 device's headroom).
+        When registered into a MetricsRegistry the per-device values also
+        refresh the ``device=``-labeled gauge families."""
+        per_dev = []
         try:
-            stats = jax.local_devices()[0].memory_stats()
+            devices = jax.local_devices()
         except Exception:
-            stats = None
-        if not stats:
+            devices = []
+        for i, dev in enumerate(devices):
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            per_dev.append({"device": i,
+                            "bytes_in_use": stats.get("bytes_in_use"),
+                            "peak_bytes_in_use":
+                                stats.get("peak_bytes_in_use")})
+        if not per_dev:
             return {}
-        return {"mem_bytes_in_use": stats.get("bytes_in_use"),
-                "mem_peak_bytes_in_use": stats.get("peak_bytes_in_use")}
+        if self._mem_fams is not None:
+            fam_use, fam_peak = self._mem_fams
+            for e in per_dev:
+                if e["bytes_in_use"] is not None:
+                    fam_use.labels(device=str(e["device"])).set(
+                        e["bytes_in_use"])
+                if e["peak_bytes_in_use"] is not None:
+                    fam_peak.labels(device=str(e["device"])).set(
+                        e["peak_bytes_in_use"])
+        in_use = [e["bytes_in_use"] for e in per_dev
+                  if e["bytes_in_use"] is not None]
+        peaks = [e["peak_bytes_in_use"] for e in per_dev
+                 if e["peak_bytes_in_use"] is not None]
+        return {"mem_bytes_in_use": sum(in_use) if in_use else None,
+                "mem_peak_bytes_in_use": max(peaks) if peaks else None,
+                "mem_per_device": per_dev}
 
     def mfu(self, step_time_s: Optional[float]) -> Optional[float]:
         if (not step_time_s or step_time_s <= 0 or not self.flops_per_step

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from typing import Dict, Optional
 
 import jax
@@ -72,23 +73,40 @@ def reset_counters() -> None:
 
 
 @contextlib.contextmanager
-def comm_span(name: str, nbytes: Optional[int] = None):
+def comm_span(name: str, nbytes: Optional[int] = None,
+              site: Optional[str] = None):
     """Attribute a collective site: named HLO scope + host trace annotation +
     ``{name}.calls`` / ``{name}.bytes`` counters. Safe inside jit/shard_map/
-    scan tracing (where it tallies once per trace) and in eager host code."""
+    scan tracing (where it tallies once per trace) and in eager host code.
+
+    ``site=`` is the STABLE straggler-attribution key (PR 15): unlike
+    ``name`` — often per-instance, e.g. ``grad_sync.bucket07`` — the site
+    label is a static string shared by every instance of one collective
+    family, tallied as ``site.<site>.{calls,bytes,ms}`` counters so the
+    FleetMonitor can compare the same site across ranks. The ``.ms``
+    tally is host time inside the span (trace time under jit; wall time
+    at eager sites like the serve prefill/decode dispatch)."""
     record_counter(name + ".calls", 1)
     if nbytes is not None:
         record_counter(name + ".bytes", int(nbytes))
+    if site is not None:
+        record_counter(f"site.{site}.calls", 1)
+        if nbytes is not None:
+            record_counter(f"site.{site}.bytes", int(nbytes))
     ann = None
     try:
         ann = jax.profiler.TraceAnnotation(name)
         ann.__enter__()
     except Exception:
         ann = None
+    t0 = time.perf_counter()
     try:
         with jax.named_scope(name):
             yield
     finally:
+        if site is not None:
+            record_counter(f"site.{site}.ms",
+                           (time.perf_counter() - t0) * 1e3)
         if ann is not None:
             ann.__exit__(None, None, None)
 

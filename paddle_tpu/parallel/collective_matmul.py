@@ -110,13 +110,15 @@ def _ring_hop(buf, axis_name, perm, nchunks, span):
     collective-permute in the HLO, free to be scheduled (and its latency
     hidden) independently of its siblings."""
     if nchunks <= 1:
-        with _obs.comm_span(span, nbytes=buf.size * buf.dtype.itemsize):
+        with _obs.comm_span(span, nbytes=buf.size * buf.dtype.itemsize,
+                            site="tp_ring.hop"):
             return lax.ppermute(buf, axis_name, perm)
     rc = buf.shape[0] // nchunks
     tiles = []
     for j in range(nchunks):
         t = lax.slice_in_dim(buf, j * rc, (j + 1) * rc, axis=0)
-        with _obs.comm_span(span, nbytes=t.size * t.dtype.itemsize):
+        with _obs.comm_span(span, nbytes=t.size * t.dtype.itemsize,
+                            site="tp_ring.hop"):
             tiles.append(lax.ppermute(t, axis_name, perm))
     return jnp.concatenate(tiles, axis=0)
 
@@ -319,14 +321,16 @@ ring_allgather.defvjp(_rg_fwd, _rg_bwd)
 def blocking_allreduce_matmul(x, w, n, axis_name):
     y = x @ w
     with _obs.comm_span("tp_blocking.allreduce",
-                        nbytes=y.size * y.dtype.itemsize):
+                        nbytes=y.size * y.dtype.itemsize,
+                        site="tp_blocking.allreduce"):
         return lax.psum(y, axis_name)
 
 
 def blocking_allgather_matmul(x, w, n, axis_name):
     y = x @ w
     with _obs.comm_span("tp_blocking.allgather",
-                        nbytes=y.size * y.dtype.itemsize):
+                        nbytes=y.size * y.dtype.itemsize,
+                        site="tp_blocking.allgather"):
         return lax.all_gather(y, axis_name, axis=1, tiled=True)
 
 

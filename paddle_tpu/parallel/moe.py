@@ -545,7 +545,8 @@ def moe_slot_dispatch_local(x, gate_logits, expert_fn, expert_params_local,
     w = weight * mine.reshape(T, k)                 # remote pairs -> 0
     partial = jnp.einsum("tk,tkd->td", w.astype(picked.dtype), picked)
     with _obs.comm_span("moe.combine_psum",
-                        nbytes=partial.size * partial.dtype.itemsize):
+                        nbytes=partial.size * partial.dtype.itemsize,
+                        site="moe.combine_psum"):
         out = lax.psum(partial, axis_name)
     if return_stats:
         # routing is computed identically on every ep shard from this dp
@@ -630,7 +631,8 @@ def moe_ragged_dispatch_local(x, gate_logits, w1_local, w2_local,
     w = weight * mine.reshape(T, k)
     partial = jnp.einsum("tk,tkd->td", w.astype(picked.dtype), picked)
     with _obs.comm_span("moe.combine_psum",
-                        nbytes=partial.size * partial.dtype.itemsize):
+                        nbytes=partial.size * partial.dtype.itemsize,
+                        site="moe.combine_psum"):
         out = lax.psum(partial, axis_name)
     if return_stats:
         g_counts = jax.nn.one_hot(e_flat, E, dtype=jnp.int32).sum(axis=0)
@@ -755,7 +757,8 @@ def moe_ragged_dispatch_a2a(x, gate_logits, w1_local, w2_local, num_experts,
         # major on the way out, source-major -> hop-major on the way in.
         dest_major = jnp.roll(send, me, axis=0)
         with _obs.comm_span("moe.ragged_a2a.dense",
-                            nbytes=send.size * send.dtype.itemsize):
+                            nbytes=send.size * send.dtype.itemsize,
+                            site="moe.ragged_a2a"):
             recv_src = lax.all_to_all(dest_major, axis_name, split_axis=0,
                                       concat_axis=0, tiled=True)
         hop_major = jnp.roll(recv_src[::-1], me + 1, axis=0)
@@ -789,7 +792,8 @@ def moe_ragged_dispatch_a2a(x, gate_logits, w1_local, w2_local, num_experts,
         stack_y = jnp.stack(ys)                     # [hop, chunk_rows, D']
         tosrc = jnp.roll(stack_y[::-1], me + 1, axis=0)  # [source, ...]
         with _obs.comm_span("moe.ragged_a2a.dense_ret",
-                            nbytes=stack_y.size * stack_y.dtype.itemsize):
+                            nbytes=stack_y.size * stack_y.dtype.itemsize,
+                            site="moe.ragged_a2a"):
             ret_src = lax.all_to_all(tosrc, axis_name, split_axis=0,
                                      concat_axis=0, tiled=True)
         ret_hop = jnp.roll(ret_src, -me, axis=0)
@@ -841,14 +845,16 @@ def moe_shard_map_dispatch(x, gate_logits, expert_fn, expert_params_local,
     # concatenate along capacity -> each owner holds its experts' slots from
     # EVERY source device: [e_local, n*C, D]
     with _obs.comm_span("moe.all_to_all_dispatch",
-                        nbytes=expert_in.size * expert_in.dtype.itemsize):
+                        nbytes=expert_in.size * expert_in.dtype.itemsize,
+                        site="moe.a2a_dispatch"):
         recv = lax.all_to_all(expert_in, axis_name, split_axis=0,
                               concat_axis=1, tiled=True)
     out_local = jax.vmap(expert_fn)(expert_params_local, recv)
     # inverse exchange: capacity splits back per source, experts concat back
     # to the full [E, C, D'] on each source device
     with _obs.comm_span("moe.all_to_all_combine",
-                        nbytes=out_local.size * out_local.dtype.itemsize):
+                        nbytes=out_local.size * out_local.dtype.itemsize,
+                        site="moe.a2a_combine"):
         expert_out = lax.all_to_all(out_local, axis_name, split_axis=1,
                                     concat_axis=0, tiled=True)
     out = jnp.einsum("tec,ecd->td", combine.astype(expert_out.dtype), expert_out)

@@ -99,7 +99,8 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
                 jnp.where(write, out, outputs[idx]))
             if S > 1:
                 with _obs.comm_span("pp.p2p",
-                                    nbytes=out.size * out.dtype.itemsize):
+                                    nbytes=out.size * out.dtype.itemsize,
+                                    site="pp.p2p"):
                     h_next = lax.ppermute(out, axis_name, perm)
             else:
                 h_next = out
@@ -111,7 +112,8 @@ def pipeline_apply(stage_fn: Callable, num_stages: int, num_microbatches: int,
             # tick's body computes — no data dependence between the two
             with _obs.comm_span(
                     "pp.p2p_async",
-                    nbytes=out_prev.size * out_prev.dtype.itemsize):
+                    nbytes=out_prev.size * out_prev.dtype.itemsize,
+                    site="pp.p2p_async"):
                 h_recv = lax.ppermute(out_prev, axis_name, perm)
             mb = t - 2 * stage
             active = (mb >= 0) & (mb < M)
@@ -201,7 +203,8 @@ def pipeline_apply_interleave(stage_fn: Callable, num_stages: int,
                 perm = [(i_, (i_ + 1) % S) for i_ in range(S)]
                 with _obs.comm_span(
                         "pp.p2p_interleave",
-                        nbytes=out.size * out.dtype.itemsize):
+                        nbytes=out.size * out.dtype.itemsize,
+                        site="pp.p2p_interleave"):
                     h_next = lax.ppermute(out, axis_name, perm)
             else:
                 h_next = out

@@ -67,7 +67,8 @@ def resolve_sep_strategy(value=None) -> str:
 def _a2a_seq_to_heads(x, axis_name, n, span):
     """[B, S/n, h, D] -> [B, S, h/n, D]: keep head slice, gather sequence."""
     b, s_loc, h, d = x.shape
-    with _obs.comm_span(span, nbytes=x.size * x.dtype.itemsize):
+    with _obs.comm_span(span, nbytes=x.size * x.dtype.itemsize,
+                        site="sep_ulysses.a2a"):
         xs = x.reshape(b, s_loc, n, h // n, d)
         xs = jnp.moveaxis(xs, 2, 0)                  # [n, B, S/n, h/n, D]
         xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0,
@@ -80,7 +81,8 @@ def _a2a_heads_to_seq(x, axis_name, n, span):
     """[B, S, h/n, D] -> [B, S/n, h, D]: the exact inverse layout."""
     b, s_full, hl, d = x.shape
     s_loc = s_full // n
-    with _obs.comm_span(span, nbytes=x.size * x.dtype.itemsize):
+    with _obs.comm_span(span, nbytes=x.size * x.dtype.itemsize,
+                        site="sep_ulysses.a2a"):
         xs = x.reshape(b, n, s_loc, hl, d)
         xs = jnp.moveaxis(xs, 1, 0)                  # [n, B, S/n, h/n, D]
         xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=0,

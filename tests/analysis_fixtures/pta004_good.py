@@ -1,7 +1,8 @@
-"""PTA004 negative fixture: the comm_span attributes its traffic."""
+"""PTA004 negative fixture: the comm_span attributes its traffic and
+carries a static straggler-attribution site label."""
 from paddle_tpu.observability.trace import comm_span
 
 
 def hop(x):
-    with comm_span("fixture.hop", nbytes=x.nbytes):
+    with comm_span("fixture.hop", nbytes=x.nbytes, site="fixture.hop"):
         return x

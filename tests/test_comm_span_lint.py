@@ -2,14 +2,22 @@
 moved there): every ``comm_span(...)`` call site in ``paddle_tpu/`` must
 pass ``nbytes=`` so the step-level telemetry always attributes traffic
 volume — a span with no byte count shows up as a hole in the per-hop/
-per-bucket accounting the benches and the multichip dryrun assert on."""
+per-bucket accounting the benches and the multichip dryrun assert on —
+and (PR 15) a static ``site=`` string literal, the stable key the
+FleetMonitor compares across ranks for straggler attribution."""
 import pytest
 
 from paddle_tpu.analysis import Module, run
 from paddle_tpu.analysis.rules.pta004_comm_span import CommSpanRule
 
 
-def test_every_comm_span_passes_nbytes():
+def _check(source):
+    mod = Module.from_source(source,
+                             rel="paddle_tpu/parallel/_synthetic.py")
+    return list(CommSpanRule(root=".").check_module(mod))
+
+
+def test_every_comm_span_passes_nbytes_and_site():
     # with_floors keeps the "at least one call site seen" floor from the
     # pre-migration lint: finalize() fires if the walk matches nothing
     report = run(rules=["PTA004"], with_floors=True)
@@ -17,16 +25,37 @@ def test_every_comm_span_passes_nbytes():
         "\n".join(f.format() for f in report.active)
 
 
-def test_lint_catches_a_missing_nbytes():
-    """The rule itself must flag a bare comm_span call (guard against
-    the AST walk silently matching nothing)."""
-    mod = Module.from_source("with comm_span('x.hop'):\n    pass\n",
-                             rel="paddle_tpu/parallel/_synthetic.py")
-    rule = CommSpanRule(root=".")
-    findings = list(rule.check_module(mod))
-    assert len(findings) == 1
-    assert findings[0].rule == "PTA004"
+def test_lint_catches_a_missing_nbytes_and_site():
+    """A bare comm_span call is doubly deficient: no traffic attribution
+    AND no straggler-attribution key (guard against the AST walk
+    silently matching nothing)."""
+    findings = _check("with comm_span('x.hop'):\n    pass\n")
+    assert len(findings) == 2
+    assert all(f.rule == "PTA004" for f in findings)
     assert "nbytes" in findings[0].message
+    assert "site" in findings[1].message
+
+
+def test_lint_catches_a_missing_site_alone():
+    findings = _check(
+        "with comm_span('x.hop', nbytes=8):\n    pass\n")
+    assert len(findings) == 1
+    assert "site" in findings[0].message
+
+
+def test_lint_rejects_a_dynamic_site_label():
+    """f-strings / variables fan one collective family out into
+    per-instance keys that never line up across ranks."""
+    findings = _check(
+        "with comm_span('x.hop', nbytes=8, site=f'x{i}'):\n    pass\n")
+    assert len(findings) == 1
+    assert "static string literal" in findings[0].message
+
+
+def test_lint_accepts_a_fully_labeled_span():
+    findings = _check(
+        "with comm_span('x.hop', nbytes=8, site='x.hop'):\n    pass\n")
+    assert findings == []
 
 
 if __name__ == "__main__":

@@ -1,0 +1,333 @@
+"""FleetMonitor (PR 15): cross-rank aggregation, anomaly hooks, the
+fleet-health JSONL + CLI validator, and the all-local-devices memory fix.
+
+Multi-rank behaviour is driven through the injected ``allgather=`` hook
+(synthetic per-rank payloads), so every scenario — stragglers, desync,
+HBM watermark — runs single-process on CPU. The FlightRecorder
+integration uses the real PR-12 ring and asserts the dump fires with the
+offending rank and metric in it.
+"""
+import json
+import math
+
+import pytest
+
+import jax
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import fleet as fleet_mod
+from paddle_tpu.observability import trace as _trace
+from paddle_tpu.observability.fleet import (FleetMonitor, check_file,
+                                            device_memory_all, main)
+from paddle_tpu.observability.metrics import StepMetrics
+from paddle_tpu.observability.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    _trace.reset_counters()
+    yield
+    _trace.reset_counters()
+
+
+def _mon(**kw):
+    kw.setdefault("rank", 0)
+    kw.setdefault("world", 1)
+    kw.setdefault("interval", 4)
+    return FleetMonitor(**kw)
+
+
+def _rank_report(rank, mean_ms, steps=8, sites=None, devices=None):
+    return {"rank": rank, "steps_done": steps,
+            "step_time_ms": {"count": 4, "mean": mean_ms,
+                             "max": mean_ms * 1.2},
+            "sites": sites or {}, "devices": devices or []}
+
+
+# -- aggregate(): pure fold over gathered payloads ---------------------------
+
+def test_aggregate_picks_worst_and_median_rank():
+    reports = [_rank_report(r, 100.0 + r) for r in range(8)]
+    reports[5]["step_time_ms"]["mean"] = 250.0  # the straggler
+    agg = FleetMonitor.aggregate(reports)
+    assert agg["kind"] == "fleet_health"
+    assert agg["world"] == 8
+    assert agg["step_time_ms"]["worst"] == 250.0
+    assert agg["step_time_ms"]["worst_rank"] == 5
+    # 8 means: 100,101,102,103,104,106,107,250 -> median = (103+104)/2
+    assert agg["step_time_ms"]["median"] == 103.5
+    assert agg["desync"]["max_ahead"] == 0
+
+
+def test_aggregate_attributes_the_straggler_site():
+    sites_fast = {"tp_ring.hop": {"calls": 16, "bytes": 1 << 20, "ms": 3.0},
+                  "grad_sync.bucket": {"calls": 4, "bytes": 1 << 22,
+                                       "ms": 8.0}}
+    sites_slow = {"tp_ring.hop": {"calls": 16, "bytes": 1 << 20, "ms": 30.0},
+                  "grad_sync.bucket": {"calls": 4, "bytes": 1 << 22,
+                                       "ms": 9.0}}
+    reports = [_rank_report(r, 100.0, sites=sites_fast) for r in range(7)]
+    reports.append(_rank_report(7, 130.0, sites=sites_slow))
+    agg = FleetMonitor.aggregate(reports)
+    # rank 7's ring hop is 10x the fleet median: that's the straggler key
+    assert agg["top_straggler_site"] == "tp_ring.hop"
+    hop = agg["sites"]["tp_ring.hop"]
+    assert hop["worst_rank"] == 7
+    assert hop["worst_ms"] == 30.0
+    assert hop["median_ms"] == 3.0
+    assert hop["spread_ms"] == 27.0
+    assert hop["bytes"] == 8 << 20
+    assert hop["calls"] == 128
+    # even spread falls back to attributing the costliest site
+    even = FleetMonitor.aggregate(
+        [_rank_report(r, 100.0, sites=sites_fast) for r in range(4)])
+    assert even["top_straggler_site"] == "grad_sync.bucket"
+
+
+def test_aggregate_flattens_devices_and_finds_desync():
+    devs_a = [{"device": 0, "bytes_in_use": 100, "peak_bytes_in_use": 900,
+               "bytes_limit": 1000}]
+    devs_b = [{"device": 0, "bytes_in_use": 50, "peak_bytes_in_use": 400,
+               "bytes_limit": 1000},
+              {"device": 1, "bytes_in_use": 60, "peak_bytes_in_use": 990,
+               "bytes_limit": 1000}]
+    agg = FleetMonitor.aggregate([
+        _rank_report(0, 10.0, steps=8, devices=devs_a),
+        _rank_report(1, 11.0, steps=2, devices=devs_b)])
+    assert agg["hbm_peak_bytes"] == 990
+    assert len(agg["devices"]) == 3
+    assert {d["rank"] for d in agg["devices"]} == {0, 1}
+    assert agg["desync"] == {"max_ahead": 6, "steps": {"0": 8, "1": 2}}
+    assert agg["step"] == 8
+
+
+# -- anomaly hooks -----------------------------------------------------------
+
+def test_nonfinite_loss_dumps_the_flight_recorder(tmp_path):
+    rec = obs.FlightRecorder(source="fleet", out_dir=str(tmp_path))
+    mon = _mon(recorder=rec)
+    assert mon.on_step(step_time_s=0.01, loss=1.25) is None
+    assert mon.anomalies == []
+    mon.on_step(step_time_s=0.01, loss=float("nan"))
+    (anom,) = mon.anomalies
+    assert anom["kind"] == "nonfinite_loss"
+    assert anom["metric"] == "loss"
+    assert anom["rank"] == 0 and anom["step"] == 2
+    assert math.isnan(anom["value"])
+    # the shared PR-12 ring got the event AND the dump fired
+    assert rec.anomalies[-1] is anom
+    dumps = list(tmp_path.glob("flightrec-fleet-nonfinite_loss-*.json"))
+    assert len(dumps) == 1
+    payload = obs.load_dump(str(dumps[0]))
+    events = [r for r in payload["records"]
+              if r.get("event") == "fleet_anomaly"]
+    assert events and events[0]["metric"] == "loss"
+
+
+def test_grad_norm_mad_spike():
+    mon = _mon(spike_mad=8.0)
+    # warmup window: noisy-but-sane norms never trip the hook
+    for i in range(fleet_mod.MIN_GRAD_SAMPLES + 4):
+        assert mon.observe_grad_norm(1.0 + 0.01 * (i % 5)) is None
+    anom = mon.observe_grad_norm(50.0)
+    assert anom is not None and anom["kind"] == "grad_norm_spike"
+    assert anom["value"] == 50.0
+    assert anom["threshold_mads"] == 8.0
+    # a non-finite norm is flagged immediately, window or not
+    fresh = _mon()
+    bad = fresh.observe_grad_norm(float("inf"))
+    assert bad["kind"] == "nonfinite_loss" and bad["metric"] == "grad_norm"
+
+
+def test_hbm_watermark_fires_for_a_remote_rank():
+    """The watermark check runs on the AGGREGATED view: a healthy rank
+    raises the alarm for an overcommitted one."""
+    hot = [{"device": 3, "bytes_in_use": 90, "peak_bytes_in_use": 980,
+            "bytes_limit": 1000}]
+
+    def gather(payload):
+        return [payload, _rank_report(1, 12.0, devices=hot)]
+
+    mon = _mon(world=2, interval=2, hbm_watermark=0.92, allgather=gather)
+    mon.on_step(step_time_s=0.01)
+    mon.on_step(step_time_s=0.01)
+    (anom,) = [a for a in mon.anomalies
+               if a["kind"] == "hbm_high_watermark"]
+    assert anom["rank"] == 1 and anom["device"] == 3
+    assert anom["fraction"] == pytest.approx(0.98)
+    assert mon.reports[-1]["hbm_peak_bytes"] == 980
+
+
+def test_rank_desync_detector(tmp_path):
+    rec = obs.FlightRecorder(source="fleet", out_dir=str(tmp_path))
+
+    def gather(payload):
+        stuck = _rank_report(1, 12.0, steps=payload["steps_done"] - 7)
+        return [payload, stuck]
+
+    mon = _mon(world=2, interval=8, desync_steps=4, allgather=gather,
+               recorder=rec)
+    for _ in range(8):
+        mon.on_step(step_time_s=0.01)
+    (anom,) = mon.anomalies
+    assert anom["kind"] == "rank_desync"
+    assert anom["max_ahead"] == 7 and anom["allowed"] == 4
+    assert mon.reports[-1]["desync"]["max_ahead"] == 7
+    assert list(tmp_path.glob("flightrec-fleet-rank_desync-*.json"))
+
+
+# -- per-step collection and site deltas -------------------------------------
+
+def test_site_deltas_and_counter_reset_clamp():
+    mon = _mon()
+    _trace.record_counter("site.tp_ring.hop.calls", 4)
+    _trace.record_counter("site.tp_ring.hop.bytes", 4096)
+    _trace.record_counter("site.tp_ring.hop.ms", 2.5)
+    _trace.record_counter("serve.blocks_alloc", 3)  # not a site key
+    first = mon._site_deltas()
+    assert first == {"tp_ring.hop": {"calls": 4, "bytes": 4096, "ms": 2.5}}
+    # second interval sees only the delta
+    _trace.record_counter("site.tp_ring.hop.calls", 2)
+    assert mon._site_deltas() == {"tp_ring.hop": {"calls": 2}}
+    # a reset_counters() drops values below their base: the delta must
+    # restart from the raw value instead of going negative
+    _trace.reset_counters()
+    _trace.record_counter("site.tp_ring.hop.calls", 1)
+    assert mon._site_deltas() == {"tp_ring.hop": {"calls": 1}}
+
+
+def test_on_step_reports_on_interval_and_accounts_overhead(tmp_path):
+    path = tmp_path / "fleet_health.jsonl"
+    mon = _mon(interval=3, out_path=str(path))
+    assert mon.on_step(step_time_s=0.010) is None
+    assert mon.on_step(step_time_s=0.020) is None
+    rep = mon.on_step(step_time_s=0.015)
+    assert rep is not None and rep["kind"] == "fleet_health"
+    assert rep["step_time_ms"]["worst"] == pytest.approx(15.0)
+    assert rep["step_time_ms"]["worst_rank"] == 0
+    assert rep["world"] == 1
+    assert rep["interval_wall_ms"] > 0
+    assert rep["monitor_overhead_ms"] >= 0
+    # the local window resets between reports
+    for _ in range(2):
+        assert mon.on_step(step_time_s=0.001) is None
+    rep2 = mon.on_step(step_time_s=0.001)
+    assert rep2["step_time_ms"]["worst"] == pytest.approx(1.0)
+    assert [json.loads(l)["step"] for l in
+            path.read_text().splitlines()] == [3, 6]
+    assert "paddle_tpu_fleet_reports_total 2.0" in \
+        mon.registry.render_prometheus()
+
+
+def test_health_lines_render():
+    mon = _mon(interval=2)
+    assert mon.health_lines("warm") == ["fleet[warm]: no reports yet"]
+    _trace.record_counter("site.pp.p2p.ms", 1.5)
+    _trace.record_counter("site.pp.p2p.calls", 2)
+    mon.on_step(step_time_s=0.01)
+    mon.on_step(step_time_s=0.02)
+    l1, l2, l3 = mon.health_lines("warm")
+    assert l1.startswith("fleet[warm]: world=1 step=2 "
+                         "worst_rank_step=15.00ms@rank0")
+    assert "straggler site=pp.p2p" in l2
+    assert "desync_max_ahead=0" in l3 and "overhead=" in l3
+
+
+# -- JSONL validator + CLI ---------------------------------------------------
+
+def _good_record(**over):
+    rec = FleetMonitor.aggregate([_rank_report(0, 10.0)])
+    rec.update({"interval_wall_ms": 1000.0, "monitor_overhead_ms": 2.0,
+                "anomalies": []})
+    rec.update(over)
+    return rec
+
+
+def test_check_file_accepts_a_clean_log(tmp_path, capsys):
+    path = tmp_path / "ok.jsonl"
+    path.write_text(json.dumps(_good_record()) + "\n")
+    n, problems = check_file(str(path))
+    assert (n, problems) == (1, [])
+    assert main(["--check", str(path)]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_check_file_flags_each_failure_mode(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    lines = [
+        "{not json",
+        json.dumps({"kind": "step_trace"}),
+        json.dumps({k: v for k, v in _good_record().items()
+                    if k != "desync"}),
+        json.dumps(_good_record(
+            desync={"max_ahead": 9, "steps": {"0": 17, "1": 8}})),
+        json.dumps(_good_record(monitor_overhead_ms=500.0)),
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    n, problems = check_file(str(path), max_desync=4)
+    assert n == 3  # the two non-fleet_health lines don't count
+    joined = "\n".join(problems)
+    assert "not valid JSON" in joined
+    assert "kind='step_trace'" in joined
+    assert "missing keys ['desync']" in joined
+    assert "rank desync 9 steps" in joined
+    assert "monitor overhead 50.00%" in joined
+    assert main(["--check", str(path)]) == 1
+
+
+def test_check_file_rejects_an_empty_log(tmp_path):
+    path = tmp_path / "empty.jsonl"
+    path.write_text("")
+    n, problems = check_file(str(path))
+    assert n == 0 and "no fleet_health records" in problems[0]
+
+
+# -- device memory: ALL local devices ----------------------------------------
+
+class _FakeDev:
+    def __init__(self, i, stats):
+        self.id = i
+        self.device_kind = "FakeTPU"
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def _fake_devices(monkeypatch):
+    devs = [_FakeDev(0, {"bytes_in_use": 100, "peak_bytes_in_use": 300,
+                         "bytes_limit": 1000}),
+            _FakeDev(1, {"bytes_in_use": 200, "peak_bytes_in_use": 800,
+                         "bytes_limit": 1000})]
+    monkeypatch.setattr(jax, "local_devices", lambda: devs)
+    return devs
+
+
+def test_device_memory_all_covers_every_local_device(monkeypatch):
+    _fake_devices(monkeypatch)
+    out = device_memory_all()
+    assert [d["device"] for d in out] == [0, 1]
+    assert [d["peak_bytes_in_use"] for d in out] == [300, 800]
+
+
+def test_step_metrics_device_memory_sums_and_labels(monkeypatch):
+    """The devices[0]-only bug: the roll-up must SUM in-use bytes and
+    MAX peaks across local devices, and refresh the per-device gauge
+    families."""
+    _fake_devices(monkeypatch)
+    reg = MetricsRegistry(prefix="paddle_tpu_train")
+    m = StepMetrics()
+    m.register_into(reg)
+    mem = m.device_memory()
+    assert mem["mem_bytes_in_use"] == 300
+    assert mem["mem_peak_bytes_in_use"] == 800
+    assert [e["device"] for e in mem["mem_per_device"]] == [0, 1]
+    text = reg.render_prometheus()
+    assert ('paddle_tpu_train_device_mem_bytes_in_use{device="0"} 100.0'
+            in text)
+    assert ('paddle_tpu_train_device_mem_peak_bytes_in_use{device="1"} '
+            '800.0' in text)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
