@@ -1319,6 +1319,191 @@ def bench_serve_overload(dev, config, on_tpu):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_serve_prefix_cache(dev, config, on_tpu):
+    """PR-16 tentpole rung: prefix-cached serving (COW shared KV blocks)
+    under a Poisson trace where 80% of requests share one long system
+    prompt. Reports the cache hit rate, TTFT p50/p99 cache-on vs
+    cache-off on the SAME trace, tokens/s, and the two correctness
+    gates the feature ships under: cached-vs-cold greedy tokens bitwise
+    identical, and a leak-free pool (shared blocks counted once,
+    parked cache blocks excluded)."""
+    from paddle_tpu.inference import InferenceEngine, Request, ServeConfig
+    from paddle_tpu.models.llama import init_llama_params
+
+    rng = np.random.RandomState(16)
+    if on_tpu:
+        serve_kw = dict(block_size=128, num_blocks=257, max_batch=8,
+                        prefill_chunk=256, max_seq_len=2048)
+        n_req, rate, max_new, sys_len = 24, 12.0, 32, 1024
+        tail = (16, 96)
+    else:
+        serve_kw = dict(block_size=128, num_blocks=24, max_batch=2,
+                        prefill_chunk=64, max_seq_len=512)
+        n_req, rate, max_new, sys_len = 10, 4.0, 6, 384
+        tail = (8, 24)
+    params = init_llama_params(config, seed=0)
+    system = rng.randint(1, config.vocab_size, size=sys_len).tolist()
+    prompts = []
+    for i in range(n_req):
+        if rng.rand() < 0.8 or i == 0:   # 80% share the system prompt
+            sfx = rng.randint(1, config.vocab_size,
+                              size=rng.randint(*tail)).tolist()
+            prompts.append(system + sfx)
+        else:
+            prompts.append(rng.randint(
+                1, config.vocab_size,
+                size=rng.randint(sys_len // 4, sys_len // 2)).tolist())
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+
+    def wall_run(prefix_cache):
+        eng = InferenceEngine(
+            params, config, ServeConfig(prefix_cache=prefix_cache,
+                                        **serve_kw))
+        reqs = [Request(list(p), max_new_tokens=max_new, arrival=float(t))
+                for p, t in zip(prompts, arrivals)]
+        t0 = time.perf_counter()
+        stats = eng.run(reqs)
+        return eng, stats, time.perf_counter() - t0
+
+    def det_tokens(prefix_cache):
+        eng = InferenceEngine(
+            params, config, ServeConfig(prefix_cache=prefix_cache,
+                                        **serve_kw))
+        reqs = [Request(list(p), max_new_tokens=max_new, arrival=float(i))
+                for i, p in enumerate(prompts)]
+        eng.run(reqs, deterministic=True)
+        return eng, {s.req.request_id: list(s.generated)
+                     for s in eng.finished}
+
+    det_tokens(False)            # warm the jit caches outside timing
+    eng_off, st_off, wall_off = wall_run(False)
+    eng_on, st_on, wall_on = wall_run(True)
+    pc = eng_on.stats()["prefix_cache"]
+    # bitwise parity gate on a deterministic replay of the same prompts
+    eng_dc, toks_cold = det_tokens(False)
+    eng_dw, toks_warm = det_tokens(True)
+    # hit requests' first token can land inside the arrival-poll
+    # iteration (TTFT records as 0.0); floor at 1 ms so the speedup
+    # stays a finite, conservative number
+    p50_up = st_off["ttft_p50_s"] / max(st_on["ttft_p50_s"], 1e-3)
+    out = {
+        "requests": n_req,
+        "shared_prefix_tokens": sys_len,
+        "hit_rate": pc["hit_rate"],
+        "hit_tokens": pc["hit_tokens"],
+        "cached_blocks": pc["cached_blocks"],
+        "cow_copies": pc["cow_copies"],
+        "ttft_p50_s_off": round(st_off["ttft_p50_s"], 4),
+        "ttft_p50_s_on": round(st_on["ttft_p50_s"], 4),
+        "ttft_p99_s_off": round(st_off["ttft_p99_s"], 4),
+        "ttft_p99_s_on": round(st_on["ttft_p99_s"], 4),
+        "ttft_p50_speedup": round(p50_up, 2),
+        "tokens_per_sec_off":
+            round(st_off["generated_tokens"] / wall_off, 2),
+        "tokens_per_sec_on":
+            round(st_on["generated_tokens"] / wall_on, 2),
+        "cached_tokens_identical": toks_warm == toks_cold,
+        "pool_leak_free": all(e.pool.used_blocks == 0 for e in
+                              (eng_off, eng_on, eng_dc, eng_dw)),
+        "det_hits": eng_dw.stats()["prefix_cache"]["hits"],
+    }
+    if not on_tpu:
+        out["note"] = ("tiny config in pallas interpret mode on CPU — "
+                       "functional rung; flagship trace lands with the "
+                       "TPU bench round")
+    return out
+
+
+def bench_serve_kv_int8(dev, config, on_tpu):
+    """PR-16 rung: int8 paged KV capacity. At a FIXED pool byte budget,
+    how many sequences are concurrently resident with int8 blocks
+    (bytes + per-column fp32 scale sidecars) vs fp16 blocks — measured
+    by actually serving that many one-block sequences with zero
+    preemptions — plus decode wall per token for each dtype. Uses a
+    head_dim=64 config: the ratio 2*hd/(hd+4) needs hd >= 36 to clear
+    the 1.8x target (at hd=64 the analytic ceiling is 1.88x)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference import InferenceEngine, Request, ServeConfig
+    from paddle_tpu.models.llama import init_llama_params, llama_tiny
+
+    if on_tpu:
+        cfg = llama_tiny(vocab=2048, hidden=1024, layers=4, heads=16,
+                         kv_heads=8, seq=256)
+        budget_blocks, max_new, plen = 64, 8, 100
+    else:
+        cfg = llama_tiny(vocab=96, hidden=256, layers=1, heads=4,
+                         kv_heads=2, seq=256)
+        budget_blocks, max_new, plen = 8, 2, 100
+    bs = 128
+    kvd = cfg.num_key_value_heads * (
+        cfg.hidden_size // cfg.num_attention_heads)
+    nkv = cfg.num_key_value_heads
+    # per-block bytes across k+v (per layer): fp16/fp32 model dtype vs
+    # int8 bytes + one fp32 scale per (kv-head, column)
+    fp_item = jnp.dtype(cfg.dtype).itemsize
+    bytes_fp = 2 * kvd * bs * fp_item
+    bytes_i8 = 2 * (kvd * bs * 1 + nkv * bs * 4)
+    budget = budget_blocks * bytes_fp
+    blocks_i8 = int(budget // bytes_i8)
+    params = init_llama_params(cfg, seed=0)
+    rng = np.random.RandomState(8)
+
+    def peak_concurrency(kv_dtype, usable):
+        serve = ServeConfig(block_size=bs, num_blocks=usable + 1,
+                            max_batch=usable, prefill_chunk=128,
+                            max_seq_len=128, kv_dtype=kv_dtype)
+        eng = InferenceEngine(params, cfg, serve, record_events=True)
+        reqs = [Request(rng.randint(1, cfg.vocab_size,
+                                    size=plen).tolist(),
+                        max_new_tokens=max_new, arrival=0.0)
+                for _ in range(usable)]
+        t0 = time.perf_counter()
+        stats = eng.run(reqs)
+        wall = time.perf_counter() - t0
+        live = peak = 0
+        for ev in eng.events:
+            kind = ev[1]
+            if kind == "admit":
+                live += 1
+                peak = max(peak, live)
+            elif kind in ("finish", "evict", "shed", "failed"):
+                live -= 1
+        assert stats["preemptions"] == 0 and eng.pool.used_blocks == 0
+        return peak, stats, wall
+
+    peak_concurrency("auto", budget_blocks)      # warm jit caches
+    peak_fp, st_fp, wall_fp = peak_concurrency("auto", budget_blocks)
+    peak_i8, st_i8, wall_i8 = peak_concurrency("int8", blocks_i8)
+    dec_fp = wall_fp / max(st_fp["generated_tokens"], 1)
+    dec_i8 = wall_i8 / max(st_i8["generated_tokens"], 1)
+    out = {
+        "head_dim": cfg.hidden_size // cfg.num_attention_heads,
+        "pool_budget_bytes_per_layer": int(budget),
+        "block_bytes_fp": int(bytes_fp),
+        "block_bytes_int8": int(bytes_i8),
+        "blocks_fp": budget_blocks,
+        "blocks_int8": blocks_i8,
+        "max_concurrent_fp": peak_fp,
+        "max_concurrent_int8": peak_i8,
+        "concurrency_ratio": round(peak_i8 / max(peak_fp, 1), 2),
+        # the 1.8x contract pinned against fp16 block bytes, independent
+        # of the platform model dtype (fp32 on CPU inflates the measured
+        # ratio above this)
+        "model_kv_itemsize": int(fp_item),
+        "fp16_equivalent_ratio": round(2 * kvd * bs * 2 / bytes_i8, 2),
+        "decode_ms_per_tok_fp": round(dec_fp * 1e3, 3),
+        "decode_ms_per_tok_int8": round(dec_i8 * 1e3, 3),
+        "decode_ms_ratio": round(dec_i8 / max(dec_fp, 1e-9), 2),
+    }
+    if not on_tpu:
+        out["note"] = ("tiny hd=64 config in pallas interpret mode on "
+                       "CPU — capacity ratio is exact (byte arithmetic "
+                       "+ real concurrent serving); decode timing is "
+                       "interpret-mode, honest only relatively")
+    return out
+
+
 def _static_analysis_record():
     """Per-rule finding counts from paddle_tpu.analysis — the bench
     record carries the lint posture of the tree the numbers came from
@@ -1462,6 +1647,12 @@ def main():
     # overload-hardened serving (PR 14): deterministic shedding, goodput
     # under a 2x burst, admission+journal cost — runs on both backends
     detail["serve_overload"] = bench_serve_overload(dev, config, on_tpu)
+
+    # prefix-cached serving + int8 paged KV (PR 16): TTFT under shared
+    # system prompts, capacity at fixed pool bytes — both backends
+    detail["serve_prefix_cache"] = bench_serve_prefix_cache(
+        dev, config, on_tpu)
+    detail["serve_kv_int8"] = bench_serve_kv_int8(dev, config, on_tpu)
 
     # fleet observability (PR 15): attributed FleetMonitor cost + loss
     # parity monitored vs bare — runs on both backends
@@ -1725,6 +1916,17 @@ def main():
             and so["no_silent_drops"] and so["pool_leak_free"])
         rungs["serve_admission_journal_pct"] = \
             so["admission_journal_overhead_pct"]
+    if "serve_prefix_cache" in detail:
+        sp = detail["serve_prefix_cache"]
+        rungs["serve_prefix_hit_rate"] = sp["hit_rate"]
+        rungs["serve_prefix_ttft_p50_speedup"] = sp["ttft_p50_speedup"]
+        rungs["serve_prefix_clean"] = bool(
+            sp["cached_tokens_identical"] and sp["pool_leak_free"])
+    if "serve_kv_int8" in detail:
+        si = detail["serve_kv_int8"]
+        rungs["serve_kv_int8_concurrency_x"] = si["concurrency_ratio"]
+        rungs["serve_kv_int8_vs_fp16_x"] = si["fp16_equivalent_ratio"]
+        rungs["serve_kv_int8_decode_ms_ratio"] = si["decode_ms_ratio"]
     if "fleet_observability" in detail:
         fo = detail["fleet_observability"]
         rungs["fleet_observability_pct"] = fo["fleet_overhead_pct"]

@@ -18,7 +18,7 @@ from .._astutil import call_ident, keyword
 # flash fwd/bwd (resident, streaming, fused flat, split pair), varlen
 # fwd/bwd (streaming + stacked + fused + split), decode slabs, rms_norm,
 # grouped matmul x3, paged attention read + fused update
-MIN_SITES = 12
+MIN_SITES = 14
 
 
 @register

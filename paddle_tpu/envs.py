@@ -427,6 +427,32 @@ _register(
     parse=_strict_bool("PADDLE_TPU_SERVE_JOURNAL_FSYNC"))
 
 _register(
+    "PADDLE_TPU_SERVE_PREFIX_CACHE", "bool", False,
+    doc="Copy-on-write prefix caching for the serving engine (PR 16): "
+        "prefilled prompts' full KV blocks stay indexed by their exact "
+        "token prefix after release, and a later request whose prompt "
+        "starts identically shares those blocks (ref-counted, COW) and "
+        "skips prefill for the hit span — TTFT becomes a cache hit for "
+        "shared system prompts. Parked cache blocks are reclaimed "
+        "LRU-last, so caching never steals capacity from live "
+        "sequences. Hit output is bitwise-identical to a cold run "
+        "(PARITY.md). Default OFF; ServeConfig(prefix_cache=) wins.",
+    parse=_strict_bool("PADDLE_TPU_SERVE_PREFIX_CACHE"))
+
+_register(
+    "PADDLE_TPU_SERVE_KV_DTYPE", "enum", "auto",
+    doc="Paged KV cache storage dtype for the serving engine (PR 16). "
+        "'auto' stores the model dtype — the pre-PR-16 path, "
+        "bit-identical. 'int8' stores per-block/per-kv-head/per-column "
+        "absmax-quantized bytes (quantization/ conventions: qmax 127, "
+        "scale floor 1e-8) with fused dequant inside the paged "
+        "kernels — half the pool bytes per cached token, the one "
+        "documented numeric deviation (PARITY.md). "
+        "ServeConfig(kv_dtype=) wins.",
+    parse=_enum("PADDLE_TPU_SERVE_KV_DTYPE", ("auto", "int8"), "auto"),
+    choices=("auto", "int8"))
+
+_register(
     "PADDLE_TPU_FLEET", "bool", False,
     doc="Wire a FleetMonitor (PR 15) into jit.TrainStep: per-rank step "
         "times, per-site comm_span hop stats and all-device memory are "

@@ -253,11 +253,12 @@ __all__ = ["Config", "Predictor", "PredictorPool", "create_predictor",
 
 
 # --- continuous-batching serving engine (paged KV cache) -------------------
-from .kv_cache import BlockPool, BlockPoolError, pad_table  # noqa: E402
+from .kv_cache import BlockPool, BlockPoolError, PrefixCache, pad_table  # noqa: E402
 from .engine import (Admission, AdmissionController, InferenceEngine,  # noqa: E402
                      PoisonError, Request, ServeConfig)
 from .journal import EngineJournal, read_journal  # noqa: E402
 
-__all__ += ["BlockPool", "BlockPoolError", "pad_table", "InferenceEngine",
-            "Request", "ServeConfig", "Admission", "AdmissionController",
-            "PoisonError", "EngineJournal", "read_journal"]
+__all__ += ["BlockPool", "BlockPoolError", "PrefixCache", "pad_table",
+            "InferenceEngine", "Request", "ServeConfig", "Admission",
+            "AdmissionController", "PoisonError", "EngineJournal",
+            "read_journal"]

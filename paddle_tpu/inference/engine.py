@@ -74,7 +74,10 @@ import jax.numpy as jnp
 from .. import envs
 from ..testing import faults
 from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
-                            _jitted_paged_prefill, init_paged_kv_pool)
+                            _jitted_paged_decode_quant,
+                            _jitted_paged_prefill,
+                            _jitted_paged_prefill_quant, init_paged_kv_pool,
+                            init_paged_kv_scales)
 from ..observability.flight_recorder import (FlightRecorder,
                                              flight_recorder_enabled)
 from ..observability.histogram import LogHistogram
@@ -83,7 +86,7 @@ from ..observability.metrics import StepMetrics
 from ..observability.request_trace import RequestTracer
 from ..observability.trace import comm_span, record_counter
 from .journal import EngineJournal, read_journal
-from .kv_cache import BlockPool, pad_table
+from .kv_cache import BlockPool, PrefixCache, pad_table
 
 ENV_TRACE_REQUESTS = "PADDLE_TPU_TRACE_REQUESTS"
 ENV_SERVE_MAX_QUEUE = "PADDLE_TPU_SERVE_MAX_QUEUE"
@@ -93,6 +96,8 @@ ENV_SERVE_OVERCOMMIT = "PADDLE_TPU_SERVE_OVERCOMMIT"
 ENV_SERVE_NAN_CHECK = "PADDLE_TPU_SERVE_NAN_CHECK"
 ENV_SERVE_JOURNAL = "PADDLE_TPU_SERVE_JOURNAL"
 ENV_SERVE_JOURNAL_FSYNC = "PADDLE_TPU_SERVE_JOURNAL_FSYNC"
+ENV_SERVE_PREFIX_CACHE = "PADDLE_TPU_SERVE_PREFIX_CACHE"
+ENV_SERVE_KV_DTYPE = "PADDLE_TPU_SERVE_KV_DTYPE"
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
@@ -206,6 +211,10 @@ class ServeConfig:
     burst: Optional[int] = None           # default max(2, max_batch)
     overcommit: Optional[float] = None    # default 4.0 x usable blocks
     nan_check: Optional[bool] = None      # default True
+    # PR 16 capacity features; None defers to the knob (both default
+    # to the legacy behavior: no sharing, model-dtype fp KV)
+    prefix_cache: Optional[bool] = None   # COW shared prefix blocks
+    kv_dtype: Optional[str] = None        # "auto" (model dtype) | "int8"
 
     def __post_init__(self):
         if self.decode_buckets is None:
@@ -281,8 +290,31 @@ class InferenceEngine:
         self.config = config
         self.serve = serve or ServeConfig()
         self.pool = BlockPool(self.serve.num_blocks, self.serve.block_size)
+        # KV storage dtype: "auto" keeps the model dtype (the pre-PR-16
+        # path, bit-identical); "int8" halves pool bytes with per-column
+        # scale pools dequantized inside the paged kernels
+        self.kv_dtype = (self.serve.kv_dtype
+                         if self.serve.kv_dtype is not None
+                         else envs.get(ENV_SERVE_KV_DTYPE))
+        if self.kv_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"ServeConfig.kv_dtype must be 'auto' or 'int8', "
+                f"got {self.kv_dtype!r}")
         self.k_pool, self.v_pool = init_paged_kv_pool(
-            config, self.serve.num_blocks, self.serve.block_size)
+            config, self.serve.num_blocks, self.serve.block_size,
+            kv_dtype=self.kv_dtype)
+        self.k_scale = self.v_scale = None
+        if self.kv_dtype == "int8":
+            self.k_scale, self.v_scale = init_paged_kv_scales(
+                config, self.serve.num_blocks, self.serve.block_size)
+        # COW prefix cache: full prompt blocks stay indexed after
+        # release and later identical prompts share them ref-counted
+        prefix_on = (self.serve.prefix_cache
+                     if self.serve.prefix_cache is not None
+                     else envs.get(ENV_SERVE_PREFIX_CACHE))
+        self.cache: Optional[PrefixCache] = \
+            PrefixCache(self.pool) if prefix_on else None
+        self._cow_copies = 0
         self.metrics = telemetry
         self.record_events = record_events
         # request-lifecycle tracing is measurement-only: spans are recorded
@@ -341,7 +373,8 @@ class InferenceEngine:
         if self.journal_path:
             self._journal = EngineJournal(
                 self.journal_path,
-                fsync=envs.get(ENV_SERVE_JOURNAL_FSYNC))
+                fsync=envs.get(ENV_SERVE_JOURNAL_FSYNC),
+                meta=self._journal_meta())
         self._rid = itertools.count()
         self._seqno = itertools.count()
         self._frozen = _freeze_config(config)
@@ -398,8 +431,32 @@ class InferenceEngine:
         r.gauge("generated_tokens",
                 fn=lambda: sum(len(s.generated) for s in self.finished),
                 help="tokens generated by finished requests")
+        # PR 16 capacity gauges, only when the cache is live: the
+        # default exposition stays byte-compatible with the pre-PR-15
+        # legacy dict (pinned by the metrics-registry golden test)
+        if self.cache is not None:
+            r.gauge("prefix_cache_hits", fn=lambda: self.cache.hits,
+                    help="admissions served a shared prefix from the "
+                         "cache")
+            r.gauge("prefix_cache_hit_tokens",
+                    fn=lambda: self.cache.hit_tokens,
+                    help="prompt tokens whose prefill was skipped via "
+                         "cache")
+            r.gauge("prefix_cached_blocks",
+                    fn=lambda: self.pool.cached_blocks,
+                    help="parked prefix-cache blocks (zero refs, "
+                         "reclaimable)")
+            r.gauge("cow_copies", fn=lambda: self._cow_copies,
+                    help="shared blocks copied on write")
 
     # -- bookkeeping --------------------------------------------------------
+
+    def _journal_meta(self) -> Dict[str, Any]:
+        """Audit-only open-record fields: which capacity features were
+        live. Cache STATE is derived (bytes are a pure function of the
+        token prefix), so recovery never needs it journaled."""
+        return {"kv_dtype": self.kv_dtype,
+                "prefix_cache": self.cache is not None}
 
     def _event(self, *ev):
         if self.record_events:
@@ -424,6 +481,50 @@ class InferenceEngine:
             self.pool.free(seq.blocks)
             seq.blocks = []
 
+    def _cow_span(self, seq: _Seq, start: int, n_tokens: int) -> bool:
+        """Copy-on-write guard: make every block covering positions
+        [start, start+n) privately writable before the device writes.
+        With sharing on, scheduler writes land past the hit span by
+        construction (hits are block-aligned and capped below
+        prefill_target; registration covers only full immutable
+        blocks), so this is a defensive invariant — but it is THE
+        contract that keeps shared bytes immutable: a block with other
+        readers is copied (device blit + table swap), a registered
+        ref-1 block has its index entry invalidated instead. False if
+        the pool cannot supply a copy block (caller evicts/stalls)."""
+        if self.cache is None or n_tokens < 1:
+            return True
+        bs = self.pool.block_size
+        for bi in range(start // bs, (start + n_tokens - 1) // bs + 1):
+            if bi >= len(seq.blocks):
+                continue
+            b = seq.blocks[bi]
+            if self.pool.ref_count(b) > 1:
+                got = self.pool.alloc(1)
+                if got is None:
+                    return False
+                nb = got[0]
+                # device-side blit of the shared block's slabs (host
+                # decision, one copy — never a cache reshape/compact)
+                self.k_pool = self.k_pool.at[:, nb].set(self.k_pool[:, b])
+                self.v_pool = self.v_pool.at[:, nb].set(self.v_pool[:, b])
+                if self.k_scale is not None:
+                    self.k_scale = self.k_scale.at[:, nb].set(
+                        self.k_scale[:, b])
+                    self.v_scale = self.v_scale.at[:, nb].set(
+                        self.v_scale[:, b])
+                self.pool.free([b])
+                seq.blocks[bi] = nb
+                self._cow_copies += 1
+                record_counter("serve.cow_copy")
+                self._event("cow_copy", seq.req.request_id, b, nb)
+            elif self.pool.is_registered(b):
+                # sole owner, but the index still maps a prefix to this
+                # block: writing would corrupt future hits' bytes —
+                # forget the entry, keep the block private
+                self.cache.invalidate_block(b)
+        return True
+
     def _evict_one(self, protect: Optional[_Seq] = None) -> bool:
         """Preempt the lowest-priority, then YOUNGEST running sequence:
         free its blocks and push it to the FRONT of the waiting queue for
@@ -433,11 +534,24 @@ class InferenceEngine:
                    if s.state == RUNNING and s is not protect]
         if not victims:
             return False
-        # lowest priority goes first; within a priority, ties on arrival
-        # (e.g. a burst submitted at the same instant) break toward the
-        # latest-submitted sequence, deterministically
+
+        def restorable(s: _Seq) -> int:
+            # ref-count-aware tiebreak (PR 16): blocks that back prefix-
+            # cache entries survive this sequence's eviction (they park
+            # or stay shared), so readmission re-hits them — evicting
+            # the most-cached victim costs the least recompute. Zero
+            # for every sequence when the cache is off.
+            if self.cache is None:
+                return 0
+            return sum(1 for b in s.blocks if self.pool.is_registered(b))
+
+        # lowest priority goes first; then the victim whose prefix is
+        # best covered by the cache (cheapest to restore); within that,
+        # ties on arrival (e.g. a burst submitted at the same instant)
+        # break toward the latest-submitted sequence, deterministically
         victim = max(victims,
-                     key=lambda s: (-s.req.priority, s.arrival, s.order))
+                     key=lambda s: (-s.req.priority, restorable(s),
+                                    s.arrival, s.order))
         self.active.remove(victim)
         self._release(victim)
         victim.state = WAITING
@@ -528,7 +642,10 @@ class InferenceEngine:
         """False when an exception killed a kernel AFTER its donated
         k/v pool buffers were invalidated — unrecoverable in-process
         (the journal recovery path owns that failure mode)."""
-        for pool in (self.k_pool, self.v_pool):
+        pools = [self.k_pool, self.v_pool]
+        if self.k_scale is not None:
+            pools += [self.k_scale, self.v_scale]
+        for pool in pools:
             deleted = getattr(pool, "is_deleted", None)
             if deleted is not None and deleted():
                 return False
@@ -545,11 +662,41 @@ class InferenceEngine:
 
     # -- public API ---------------------------------------------------------
 
+    def _demand_and_shared(self, req: Optional[Request]
+                           ) -> Tuple[int, int]:
+        """Worst-case block demand of everything queued + active, and
+        the new request's estimated prefix-shared blocks.
+
+        With the prefix cache on (PR 16), shared prefix blocks are
+        free-by-construction — N requests over one cached prefix cost
+        its blocks ONCE — so each request's worst case shrinks by its
+        expected hit length. Queued-but-unprefilled prompts count too
+        (``pending`` keys), so a same-instant burst of identical
+        prompts is admitted against one copy of the shared span, which
+        is exactly the ROADMAP's "admission estimate could subtract
+        shared blocks" item. Cache off: identical to the PR-14 sum."""
+        demand = 0
+        cache = self.cache
+        pending: set = set()
+        for s in itertools.chain(self.waiting, self.active):
+            worst = self.pool.blocks_for(
+                len(s.req.prompt) + s.req.max_new_tokens)
+            if cache is not None:
+                limit = (len(s.req.prompt) - 1) // self.pool.block_size
+                shared = cache.match_len(s.req.prompt, limit, pending)
+                worst -= min(shared, worst - 1)
+                pending.update(cache.prospective_keys(s.req.prompt,
+                                                      limit))
+            demand += worst
+        new_shared = 0
+        if req is not None and cache is not None:
+            limit = (len(req.prompt) - 1) // self.pool.block_size
+            new_shared = cache.match_len(req.prompt, limit, pending)
+        return demand, new_shared
+
     def _demand_blocks(self) -> int:
         """Worst-case block demand of everything queued + active."""
-        return sum(
-            self.pool.blocks_for(len(s.req.prompt) + s.req.max_new_tokens)
-            for s in itertools.chain(self.waiting, self.active))
+        return self._demand_and_shared(None)[0]
 
     def submit(self, req: Request) -> Admission:
         """Admit ``req`` into the bounded queue or reject it with a
@@ -570,10 +717,12 @@ class InferenceEngine:
         if not len(req.prompt):
             raise ValueError(f"request {req.request_id}: empty prompt")
         faults.inject("serve.admit.before", rid=req.request_id)
+        demand, new_shared = self._demand_and_shared(req)
+        worst_blocks = max(self.pool.blocks_for(worst) - new_shared, 1)
         cause = self.admission.decide(
             queue_len=len(self.waiting),
-            demand_blocks=self._demand_blocks(),
-            worst_blocks=self.pool.blocks_for(worst),
+            demand_blocks=demand,
+            worst_blocks=worst_blocks,
             usable_blocks=self.serve.num_blocks - 1,
             now=self._clock)
         if cause is not None:
@@ -677,12 +826,29 @@ class InferenceEngine:
     def _admit(self):
         while self.waiting and len(self.active) < self.serve.max_batch:
             seq = self.waiting[0]
-            need = self.pool.blocks_for(seq.prefill_target) + 1
+            # prefix-cache hit (PR 16): the longest chain of cached
+            # full blocks prefixing this prompt, capped one token short
+            # of prefill_target so the final chunk always has a live
+            # token to produce the sampling logits. Hit blocks are
+            # shared ref-counted (COW), never re-prefilled.
+            hit: List[int] = []
+            if self.cache is not None and not seq.blocks:
+                limit = (seq.prefill_target - 1) // self.pool.block_size
+                hit = self.cache.match(seq.tokens, limit)
+            need = self.pool.blocks_for(seq.prefill_target) + 1 - len(hit)
             if not self.pool.can_alloc(need):
                 break
             self.waiting.pop(0)
             seq.state = PREFILL
-            seq.n_cached = 0
+            if hit:
+                self.pool.acquire(hit)
+                seq.blocks = list(hit)
+                seq.n_cached = len(hit) * self.pool.block_size
+                record_counter("serve.prefix_hit")
+                record_counter("serve.prefix_hit_tokens", seq.n_cached)
+                self._event("prefix_hit", seq.req.request_id, len(hit))
+            else:
+                seq.n_cached = 0
             self.active.append(seq)
             record_counter("serve.admit")
             self._event("admit", seq.req.request_id)
@@ -705,23 +871,27 @@ class InferenceEngine:
         n_live = min(c, seq.prefill_target - seq.n_cached)
         # graceful degradation: under pool pressure, shrink this chunk's
         # LIVE span to the headroom the pool still has (n_live is data,
-        # not shape — same compiled step) before resorting to eviction
-        headroom = ((len(seq.blocks) + self.pool.free_blocks)
+        # not shape — same compiled step) before resorting to eviction.
+        # available_blocks counts parked cache blocks: alloc() reclaims
+        # them LRU-oldest after the free list, so caching never shrinks
+        # a chunk a cache-off engine could run whole
+        headroom = ((len(seq.blocks) + self.pool.available_blocks)
                     * self.pool.block_size - seq.n_cached)
         if 1 <= headroom < n_live:
             n_live = headroom
             record_counter("serve.prefill_shrink")
             self._event("prefill_shrink", rid, n_live)
-        if not self._alloc_for(seq, seq.n_cached + n_live):
+        if not (self._alloc_for(seq, seq.n_cached + n_live)
+                and self._cow_span(seq, seq.n_cached, n_live)):
             # pool dry mid-prompt: steal from the youngest decoder; if
             # there is none, stall — decode progress will free blocks
             if not (self._evict_one(protect=seq)
-                    and self._alloc_for(seq, seq.n_cached + n_live)):
+                    and self._alloc_for(seq, seq.n_cached + n_live)
+                    and self._cow_span(seq, seq.n_cached, n_live)):
                 return False
         ids = np.zeros((c,), np.int32)
         ids[:n_live] = seq.tokens[seq.n_cached:seq.n_cached + n_live]
         table = pad_table(seq.blocks, self.serve.max_nb)
-        fn = _jitted_paged_prefill(self._frozen)
         key = ("prefill", c)
         t0 = time.perf_counter()
         try:
@@ -729,10 +899,20 @@ class InferenceEngine:
             with comm_span("serve.prefill",
                            nbytes=int(n_live) * 4,
                            site="serve.prefill"):
-                logits, self.k_pool, self.v_pool = fn(
-                    self.params, self.k_pool, self.v_pool,
-                    jnp.asarray(table), np.int32(seq.n_cached),
-                    jnp.asarray(ids), np.int32(n_live))
+                if self.k_scale is None:
+                    fn = _jitted_paged_prefill(self._frozen)
+                    logits, self.k_pool, self.v_pool = fn(
+                        self.params, self.k_pool, self.v_pool,
+                        jnp.asarray(table), np.int32(seq.n_cached),
+                        jnp.asarray(ids), np.int32(n_live))
+                else:
+                    fn = _jitted_paged_prefill_quant(self._frozen)
+                    (logits, self.k_pool, self.v_pool, self.k_scale,
+                     self.v_scale) = fn(
+                        self.params, self.k_pool, self.v_pool,
+                        self.k_scale, self.v_scale,
+                        jnp.asarray(table), np.int32(seq.n_cached),
+                        jnp.asarray(ids), np.int32(n_live))
                 logits = np.asarray(logits)  # noqa: PTA006 -- deliberate sync so prefill phase timing is honest
             faults.inject("serve.prefill.logits", rid=rid, logits=logits)
             if self._nan_check and not bool(np.isfinite(logits).all()):
@@ -754,6 +934,17 @@ class InferenceEngine:
                 recompute=bool(seq.generated))
         seq.n_cached += n_live
         if seq.n_cached == seq.prefill_target:
+            if self.cache is not None:
+                # register the prompt's FULL blocks — wholly below
+                # prefill_target, so their bytes are immutable from here
+                # on (decode writes land at >= prefill_target). A
+                # quarantined prefill never reaches this line.
+                n_reg = seq.prefill_target // self.pool.block_size
+                if n_reg:
+                    added = self.cache.register(seq.tokens, seq.blocks,
+                                                n_reg)
+                    if added:
+                        self._event("prefix_register", rid, added)
             if not seq.generated:
                 # fresh prompt: the final chunk's logits sample the
                 # first new token (greedy)
@@ -784,9 +975,11 @@ class InferenceEngine:
         for seq in [s for s in self.active if s.state == RUNNING]:
             if seq.state != RUNNING:
                 continue
-            ok = self._alloc_for(seq, seq.n_cached + 1)
+            ok = (self._alloc_for(seq, seq.n_cached + 1)
+                  and self._cow_span(seq, seq.n_cached, 1))
             while not ok and self._evict_one(protect=seq):
-                ok = self._alloc_for(seq, seq.n_cached + 1)
+                ok = (self._alloc_for(seq, seq.n_cached + 1)
+                      and self._cow_span(seq, seq.n_cached, 1))
             if ok:
                 ready.append(seq)
             else:
@@ -796,7 +989,6 @@ class InferenceEngine:
             return []
         faults.inject("serve.decode.before",
                       rids=[s.req.request_id for s in rows])
-        fn = _jitted_paged_decode(self._frozen)
         logits = None
         # re-drive loop: a PoisonError attributable to one row drops that
         # row (quarantined) and re-runs the batch without it; rows are
@@ -819,10 +1011,20 @@ class InferenceEngine:
                 faults.inject("serve.decode.poison", rids=rids)
                 with comm_span("serve.decode", nbytes=bucket * 4,
                                site="serve.decode"):
-                    logits, self.k_pool, self.v_pool = fn(
-                        self.params, self.k_pool, self.v_pool,
-                        jnp.asarray(tables), jnp.asarray(positions),
-                        jnp.asarray(toks))
+                    if self.k_scale is None:
+                        fn = _jitted_paged_decode(self._frozen)
+                        logits, self.k_pool, self.v_pool = fn(
+                            self.params, self.k_pool, self.v_pool,
+                            jnp.asarray(tables), jnp.asarray(positions),
+                            jnp.asarray(toks))
+                    else:
+                        fn = _jitted_paged_decode_quant(self._frozen)
+                        (logits, self.k_pool, self.v_pool, self.k_scale,
+                         self.v_scale) = fn(
+                            self.params, self.k_pool, self.v_pool,
+                            self.k_scale, self.v_scale,
+                            jnp.asarray(tables), jnp.asarray(positions),
+                            jnp.asarray(toks))
                     logits = np.asarray(logits)  # noqa: PTA006 -- step boundary: sampled tokens must reach the scheduler
                 faults.inject("serve.decode.logits", rids=rids,
                               logits=logits)
@@ -1175,7 +1377,7 @@ class InferenceEngine:
         if self._journal is None:
             self._journal = EngineJournal(
                 path, fsync=envs.get(ENV_SERVE_JOURNAL_FSYNC),
-                resume=True)
+                resume=True, meta=self._journal_meta())
             self.journal_path = path
         else:
             # in-place recovery after run() raised: the writer may hold
@@ -1247,6 +1449,11 @@ class InferenceEngine:
             "failed": len(self.failed),
             "decode_redrives": self._redrives,
             "recovered": self._recovered,
+            "kv_dtype": self.kv_dtype,
+            "prefix_cache": (dict(self.cache.stats(),
+                                  cached_blocks=self.pool.cached_blocks,
+                                  cow_copies=self._cow_copies)
+                             if self.cache is not None else None),
             "outcomes": self.outcomes(),
         }
 

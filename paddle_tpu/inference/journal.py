@@ -68,15 +68,21 @@ class EngineJournal:
     MAX_PENDING = 4096
 
     def __init__(self, path: str, fsync: bool = False,
-                 resume: bool = False):
+                 resume: bool = False,
+                 meta: Optional[Dict[str, Any]] = None):
         self.path = path
         self.fsync = bool(fsync)
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
         self._buf: List[Dict[str, Any]] = []
+        # meta is AUDIT-ONLY engine configuration (e.g. kv_dtype,
+        # prefix_cache — PR 16). Cache state itself is derived, never
+        # journaled: recovery re-derives identical bytes from the token
+        # record, so replay needs no cache snapshot. read_journal
+        # ignores unknown open-record fields by construction.
         self._append({"ev": "open", "version": _VERSION,
-                      "resume": bool(resume)})
+                      "resume": bool(resume), **(meta or {})})
 
     def _write_buf(self) -> None:
         if self._buf:

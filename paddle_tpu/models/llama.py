@@ -879,21 +879,42 @@ def llama_decode_step(params, cache, ids, config: LlamaConfig):
 
 
 def init_paged_kv_pool(config: LlamaConfig, num_blocks: int,
-                       block_size: int):
+                       block_size: int, kv_dtype: str = "auto"):
     """Paged KV pool for the serving engine: k and v
     [L, num_blocks, KV*HD, block_size] — each block is a time-in-lanes
     slab fragment, so the paged kernel's per-block dots are the same
     [KVD, bs] shapes the contiguous slab kernel tiles into. Block 0 is
     reserved as the null block (see inference/kv_cache.py): padding
-    rows scribble there and live tables never reference it."""
+    rows scribble there and live tables never reference it.
+
+    ``kv_dtype='auto'`` stores the model dtype (the pre-PR-16 path,
+    bit-identical); ``'int8'`` stores quantized bytes — pair with
+    :func:`init_paged_kv_scales`."""
     c = config
+    if kv_dtype not in ("auto", "int8"):
+        raise ValueError(f"kv_dtype must be 'auto' or 'int8', "
+                         f"got {kv_dtype!r}")
+    dt = jnp.int8 if kv_dtype == "int8" else c.dtype
     kvd = c.num_key_value_heads * c.head_dim
     shape = (c.num_hidden_layers, num_blocks, kvd, block_size)
-    return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def init_paged_kv_scales(config: LlamaConfig, num_blocks: int,
+                         block_size: int):
+    """f32 scale pools [L, num_blocks, NKV, block_size] for an int8
+    paged KV pool: one symmetric absmax scale per block / kv head /
+    COLUMN (ops/paged_attention.kv_quant_columns). Zero-initialized so
+    never-written columns (incl. null-block scribbles) dequantize to
+    exactly 0."""
+    c = config
+    shape = (c.num_hidden_layers, num_blocks, c.num_key_value_heads,
+             block_size)
+    return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
 
 def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
-                            ids, config: LlamaConfig):
+                            ids, config: LlamaConfig, kv_scales=None):
     """One decode step over a PAGED cache: ids [B] i32, tables
     [B, max_nb] i32 block tables, positions [B] i32 = the slot each
     row's new token occupies (== its cached length; the block holding
@@ -906,8 +927,16 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
     the layer scan as carries and the Pallas kernel updates them
     in-place through input_output_aliases, so no per-layer cache copy
     exists (the conservative-aliasing trap documented in
-    ops/decode_attention.py STATUS)."""
-    from ..ops.paged_attention import _LOG2E, paged_attend_update
+    ops/decode_attention.py STATUS).
+
+    With ``kv_scales=(k_scale, v_scale)`` the pools are int8: each new
+    column is quantized per-kv-head OUTSIDE the kernel (the same
+    kv_quant_columns bytes a prefill of the same tokens writes) and
+    the fused update merges bytes + scales in place. Returns
+    (logits, k_pool, v_pool, k_scale, v_scale) in that mode."""
+    from ..ops.paged_attention import (_LOG2E, kv_quant_columns,
+                                       paged_attend_update,
+                                       paged_attend_update_quant)
     c = config
     b = ids.shape[0]
     hd = c.head_dim
@@ -916,7 +945,10 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
                                 position_ids=positions[:, None])  # [B,1,·]
 
     def layer_step(carry, xs):
-        h, kp, vp = carry
+        if kv_scales is None:
+            h, kp, vp = carry
+        else:
+            h, kp, vp, ksc, vsc = carry
         p, layer = xs
         x = fused_rms_norm(h[:, None], p["input_norm"], c.rms_norm_eps)
         if "qkv_proj" in p:
@@ -946,10 +978,17 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
         q_bd = jnp.einsum("bgrd,ge->bgred", qg, eye).reshape(b, nh, kvd)
         qs = (q_bd.astype(jnp.float32)
               * (_LOG2E / (hd ** 0.5))).astype(q_bd.dtype)
-        attn_full, kp, vp = paged_attend_update(
-            qs, k.reshape(b, kvd).astype(kp.dtype),
-            v.reshape(b, kvd).astype(vp.dtype), kp, vp,
-            tables, positions, layer_i)
+        if kv_scales is None:
+            attn_full, kp, vp = paged_attend_update(
+                qs, k.reshape(b, kvd).astype(kp.dtype),
+                v.reshape(b, kvd).astype(vp.dtype), kp, vp,
+                tables, positions, layer_i)
+        else:
+            nk_q, nk_s = kv_quant_columns(k.reshape(b, kvd), nkv)
+            nv_q, nv_s = kv_quant_columns(v.reshape(b, kvd), nkv)
+            attn_full, kp, vp, ksc, vsc = paged_attend_update_quant(
+                qs, nk_q, nv_q, nk_s, nv_s, kp, vp, ksc, vsc,
+                tables, positions, layer_i)
         attn = jnp.einsum("bgred,ge->bgrd",
                           attn_full.reshape(b, nkv, rep, nkv, hd),
                           eye.astype(attn_full.dtype)).astype(c.dtype)
@@ -958,18 +997,27 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
         x2 = fused_rms_norm(h[:, None], p["post_norm"], c.rms_norm_eps)[:, 0]
         gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
         h = h + _mat(gated, p["down_proj"])
-        return (h, kp, vp), None
+        if kv_scales is None:
+            return (h, kp, vp), None
+        return (h, kp, vp, ksc, vsc), None
 
     n_layers = k_pool.shape[0]
-    (h, k_pool, v_pool), _ = lax.scan(
-        layer_step, (h, k_pool, v_pool),
-        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)))
+    xs = (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
+    if kv_scales is None:
+        (h, k_pool, v_pool), _ = lax.scan(
+            layer_step, (h, k_pool, v_pool), xs)
+        logits = llama_logits(params, h[:, None], config)[:, 0]
+        return logits.astype(jnp.float32), k_pool, v_pool
+    k_scale, v_scale = kv_scales
+    (h, k_pool, v_pool, k_scale, v_scale), _ = lax.scan(
+        layer_step, (h, k_pool, v_pool, k_scale, v_scale), xs)
     logits = llama_logits(params, h[:, None], config)[:, 0]
-    return logits.astype(jnp.float32), k_pool, v_pool
+    return logits.astype(jnp.float32), k_pool, v_pool, k_scale, v_scale
 
 
 def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
-                              ids, n_live, config: LlamaConfig):
+                              ids, n_live, config: LlamaConfig,
+                              kv_scales=None):
     """One chunked-prefill slice for ONE sequence: ids [C] i32 padded
     to the chunk bucket, n_live (traced) real tokens, start (traced) =
     tokens already cached from earlier chunks. Scatters the chunk's KV
@@ -977,7 +1025,14 @@ def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
     attends each chunk token over cached-prefix + chunk causally via
     the gathered-context XLA path, and returns the logits of the LAST
     REAL token ([vocab] f32 — only meaningful on the final chunk) plus
-    the updated pools."""
+    the updated pools.
+
+    With ``kv_scales=(k_scale, v_scale)`` the pools are int8: each
+    column quantizes per-kv-head via kv_quant_columns before the
+    scatter (one scale per column — bytes independent of chunk
+    boundaries) and the context gather dequantizes. Returns
+    (logits, k_pool, v_pool, k_scale, v_scale) in that mode."""
+    from ..ops.paged_attention import kv_quant_columns
     c = config
     C = ids.shape[0]
     hd = c.head_dim
@@ -994,7 +1049,10 @@ def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
     col = pidx % bs
 
     def layer_step(carry, xs):
-        h, kp, vp = carry
+        if kv_scales is None:
+            h, kp, vp = carry
+        else:
+            h, kp, vp, ksc, vsc = carry
         p, layer = xs
         x = fused_rms_norm(h, p["input_norm"], c.rms_norm_eps)
         if "qkv_proj" in p:
@@ -1017,17 +1075,40 @@ def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
         k = apply_rope(k, cos, sin)
         # scatter the chunk's KV columns into their blocks ([C]-indexed
         # rows over the [NP, KVD, bs] pool slab: one scatter per layer)
-        kp = kp.at[layer, bid, :, col].set(
-            k.reshape(C, kvd).astype(kp.dtype))
-        vp = vp.at[layer, bid, :, col].set(
-            v.reshape(C, kvd).astype(vp.dtype))
-        # gather the sequence's context (prefix + this chunk) back to a
-        # contiguous slab; dead table slots read null-block garbage that
-        # the causal mask kills
-        kctx = jnp.transpose(kp[layer][table_row], (1, 0, 2)) \
-            .reshape(kvd, T)
-        vctx = jnp.transpose(vp[layer][table_row], (1, 0, 2)) \
-            .reshape(kvd, T)
+        if kv_scales is None:
+            kp = kp.at[layer, bid, :, col].set(
+                k.reshape(C, kvd).astype(kp.dtype))
+            vp = vp.at[layer, bid, :, col].set(
+                v.reshape(C, kvd).astype(vp.dtype))
+            # gather the sequence's context (prefix + this chunk) back
+            # to a contiguous slab; dead table slots read null-block
+            # garbage that the causal mask kills
+            kctx = jnp.transpose(kp[layer][table_row], (1, 0, 2)) \
+                .reshape(kvd, T)
+            vctx = jnp.transpose(vp[layer][table_row], (1, 0, 2)) \
+                .reshape(kvd, T)
+        else:
+            nkv_ = kvd // hd
+            kq, ksq = kv_quant_columns(k.reshape(C, kvd), nkv_)
+            vq, vsq = kv_quant_columns(v.reshape(C, kvd), nkv_)
+            kp = kp.at[layer, bid, :, col].set(kq)
+            vp = vp.at[layer, bid, :, col].set(vq)
+            ksc = ksc.at[layer, bid, :, col].set(ksq)
+            vsc = vsc.at[layer, bid, :, col].set(vsq)
+            max_nb_ = table_row.shape[0]
+            bs_ = kp.shape[-1]
+            kdeq = (kp[layer][table_row].astype(jnp.float32)
+                    .reshape(max_nb_, nkv_, hd, bs_)
+                    * ksc[layer][table_row][:, :, None, :]) \
+                .reshape(max_nb_, kvd, bs_)
+            vdeq = (vp[layer][table_row].astype(jnp.float32)
+                    .reshape(max_nb_, nkv_, hd, bs_)
+                    * vsc[layer][table_row][:, :, None, :]) \
+                .reshape(max_nb_, kvd, bs_)
+            kctx = jnp.transpose(kdeq, (1, 0, 2)).reshape(kvd, T) \
+                .astype(c.dtype)
+            vctx = jnp.transpose(vdeq, (1, 0, 2)).reshape(kvd, T) \
+                .astype(c.dtype)
         rep = nh // nkv
         qg = q[0].reshape(C, nkv, rep, hd)
         kg = kctx.reshape(nkv, hd, T)
@@ -1045,15 +1126,25 @@ def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
         x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
         gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
         h = h + _mat(gated, p["down_proj"])
-        return (h, kp, vp), None
+        if kv_scales is None:
+            return (h, kp, vp), None
+        return (h, kp, vp, ksc, vsc), None
 
     n_layers = k_pool.shape[0]
-    (h, k_pool, v_pool), _ = lax.scan(
-        layer_step, (h, k_pool, v_pool),
-        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)))
+    xs = (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
+    if kv_scales is None:
+        (h, k_pool, v_pool), _ = lax.scan(
+            layer_step, (h, k_pool, v_pool), xs)
+        h_last = lax.dynamic_slice_in_dim(h[0], n_live - 1, 1, 0)[None]
+        logits = llama_logits(params, h_last, config)[0, 0]
+        return logits.astype(jnp.float32), k_pool, v_pool
+    k_scale, v_scale = kv_scales
+    (h, k_pool, v_pool, k_scale, v_scale), _ = lax.scan(
+        layer_step, (h, k_pool, v_pool, k_scale, v_scale), xs)
     h_last = lax.dynamic_slice_in_dim(h[0], n_live - 1, 1, 0)[None]
     logits = llama_logits(params, h_last, config)[0, 0]
-    return logits.astype(jnp.float32), k_pool, v_pool
+    return (logits.astype(jnp.float32), k_pool, v_pool, k_scale,
+            v_scale)
 
 
 @functools.lru_cache(maxsize=32)
@@ -1076,6 +1167,31 @@ def _jitted_paged_prefill(frozen):
                                          start, ids, n_live, config)
     paged_prefill_fn.__name__ = "paged_prefill_chunk"
     return jax.jit(paged_prefill_fn, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_decode_quant(frozen):
+    config = LlamaConfig(*frozen)
+
+    def paged_decode_quant_fn(params, kp, vp, ks, vs, tables, positions,
+                              ids):
+        return llama_paged_decode_step(params, kp, vp, tables, positions,
+                                       ids, config, kv_scales=(ks, vs))
+    paged_decode_quant_fn.__name__ = "paged_decode_step_int8"
+    return jax.jit(paged_decode_quant_fn, donate_argnums=(1, 2, 3, 4))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_prefill_quant(frozen):
+    config = LlamaConfig(*frozen)
+
+    def paged_prefill_quant_fn(params, kp, vp, ks, vs, table_row, start,
+                               ids, n_live):
+        return llama_paged_prefill_chunk(params, kp, vp, table_row,
+                                         start, ids, n_live, config,
+                                         kv_scales=(ks, vs))
+    paged_prefill_quant_fn.__name__ = "paged_prefill_chunk_int8"
+    return jax.jit(paged_prefill_quant_fn, donate_argnums=(1, 2, 3, 4))
 
 
 def generate_scan(params, cache, first_token, num_tokens,

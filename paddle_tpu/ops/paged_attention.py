@@ -381,6 +381,380 @@ def paged_attend_update(q_bd, new_k, new_v, k_pool, v_pool, tables,
     return out, kp, vp
 
 
+# -- int8 paged KV (PR 16) ----------------------------------------------------
+#
+# Storage halves to one byte per cached element, with f32 scales at
+# per-block / per-kv-head / per-COLUMN granularity ([L, NP, NKV, bs]).
+# Per-column scales are the load-bearing choice: every column is
+# quantized exactly once, from its own fp values, by the same helper on
+# both the prefill-scatter and decode-update paths — so the cache BYTES
+# are a pure function of the token prefix, independent of chunk
+# grouping or prefill-vs-decode history. That is what keeps prefix-hit
+# reuse and journal recovery bit-identical with int8 on (PARITY.md).
+# Conventions follow quantization/quanters.py: qmax = 2^(b-1)-1 = 127,
+# scale floor 1e-8.
+
+KV_QMAX = 127.0
+KV_SCALE_FLOOR = 1e-8
+
+# double-buffered window budget for the paged kernels' fitter: one
+# TPU core's scoped VMEM (pallas guide) — far under PTA002's 64 MiB
+# static ceiling, because these windows must ALSO leave room for the
+# decode batch's other kernels resident in the same step
+PAGED_VMEM_BUDGET = 16 * 1024 * 1024
+
+
+def kv_quant_columns(x, nkv):
+    """Symmetric per-column-per-kv-head int8 quantization of KV columns.
+
+    x [N, KVD] fp values (KVD = nkv * hd) -> (q int8 [N, KVD],
+    scales f32 [N, NKV]) with scale = max(absmax/127, 1e-8) over each
+    column's hd-slice — the quantization/ absmax convention. The ONLY
+    quantizer for paged KV bytes: prefill scatter and decode update
+    both route through it, so identical fp columns always produce
+    identical int8 bytes + scales."""
+    n, kvd = x.shape
+    hd = kvd // int(nkv)
+    xf = x.astype(jnp.float32).reshape(n, int(nkv), hd)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / KV_QMAX,
+                    KV_SCALE_FLOOR)                        # [N, nkv]
+    q = jnp.clip(jnp.round(xf / s[:, :, None]), -KV_QMAX, KV_QMAX)
+    return q.astype(jnp.int8).reshape(n, kvd), s
+
+
+def _fit_paged_kv_blocks(nh, kvd, nkv, bs, itemsize):
+    """Window fitter for the quantized paged kernels (PTA002 contract).
+
+    Block geometry is pinned by the pool layout — block_size IS the
+    allocator's unit and KVD the model's — so unlike _fit_block_t this
+    fitter sizes nothing; it PRICES the per-step double-buffered
+    windows (q + int8 k/v tiles + f32 scale tiles + outputs + scratch)
+    and fails at trace time if a configuration could not fit, instead
+    of compile-failing only on hardware. Returns (kvd, bs, nkv)
+    unchanged."""
+    win = (2 * nh * kvd * 4                 # q window (f32-priced)
+           + 2 * 2 * kvd * bs * itemsize    # k/v tiles
+           + 2 * 2 * nkv * bs * 4           # scale tiles
+           + 2 * nh * kvd * 4               # attn out
+           + 2 * 2 * (kvd * bs * itemsize + nkv * bs * 4)  # aliased outs
+           + 2 * nh * 128 * 4 + nh * kvd * 4)              # scratch
+    if win > PAGED_VMEM_BUDGET:
+        raise ValueError(
+            f"paged int8 kernel windows need {win} B VMEM "
+            f"(> {PAGED_VMEM_BUDGET} B): shrink block_size or heads")
+    return kvd, bs, nkv
+
+
+def _dequant_tile(tile, scale, nkv):
+    """Fused in-kernel dequant of one [KVD, bs] int8 tile with its
+    [NKV, bs] f32 per-column scales: expand scales across each head's
+    hd rows. Reshape-based broadcast (per-head row grouping); runs in
+    interpret mode and lowers to a relayout+mul on Mosaic."""
+    kvd, bs = tile.shape
+    hd = kvd // nkv
+    return (tile.astype(jnp.float32).reshape(nkv, hd, bs)
+            * scale[:, None, :]).reshape(kvd, bs)
+
+
+def _paged_quant_kernel(lp_ref, sc_ref, q_ref, k_ref, v_ref, ks_ref,
+                        vs_ref, o_ref, l_s, b_s, acc_s, *, block_size,
+                        nkv, online=False):
+    """_paged_kernel with int8 tiles: identical op chain, except k/v
+    dequantize in-register before the dots (p stays f32 — there is no
+    low-precision v to cast to)."""
+    j = pl.program_id(0)
+    pos = sc_ref[_POS, j]
+    start = sc_ref[_START, j]
+
+    def scores():
+        k_deq = _dequant_tile(k_ref[0, 0], ks_ref[0, 0], nkv)
+        s = jax.lax.dot_general(
+            q_ref[0].astype(jnp.float32), k_deq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [NH, bs]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(t <= pos, s, jnp.float32(-1e30))
+
+    def pv(p):
+        v_deq = _dequant_tile(v_ref[0, 0], vs_ref[0, 0], nkv)
+        return jax.lax.dot_general(
+            p, v_deq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [NH, KVD]
+
+    @pl.when(sc_ref[_FIRST, j] == np.int32(1))
+    def _first():
+        s = scores()
+        base = s.max(axis=-1, keepdims=True)
+        p = jnp.exp2(s - base)
+        b_s[...] = jnp.broadcast_to(base, b_s.shape)
+        l_s[...] = jnp.broadcast_to(p.sum(axis=-1, keepdims=True),
+                                    l_s.shape)
+        acc_s[...] = pv(p)
+
+    @pl.when(jnp.logical_and(sc_ref[_LIVE, j] == np.int32(1),
+                             sc_ref[_FIRST, j] == np.int32(0)))
+    def _more():
+        s = scores()
+        if online:
+            m_prev = b_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            b_s[...] = jnp.broadcast_to(m_new, b_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + pv(p)
+        else:
+            p = jnp.exp2(s - b_s[:, :1])
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + pv(p)
+
+    @pl.when(sc_ref[_LAST, j] == np.int32(1))
+    def _fin():
+        o_ref[0] = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
+
+
+def paged_attention_quant(q_bd, k_pool, v_pool, k_scale, v_scale,
+                          tables, lengths, layer, *, n_steps=None):
+    """Read-only paged decode attention over an int8 pool with fused
+    per-column dequant. Same contract as :func:`paged_attention`, plus
+    scale pools [L, NP, NKV, bs] f32 riding their own (tiny) windows
+    down the same flat schedule."""
+    b, nh, kvd = q_bd.shape
+    L, NP, _, bs = k_pool.shape
+    nkv = k_scale.shape[2]
+    B, max_nb = tables.shape
+    if n_steps is None:
+        n_steps = B * max_nb
+    it = jnp.dtype(k_pool.dtype).itemsize
+    kvd_b, bs_b, nkv_b = _fit_paged_kv_blocks(nh, kvd, nkv, bs, it)
+    sched = paged_schedule(lengths, tables, n_steps, bs)
+    lp = jnp.asarray([layer], jnp.int32)
+
+    def kv_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_BLK, j], 0, 0)
+
+    def q_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0, 0)
+
+    kernel = functools.partial(_paged_quant_kernel, block_size=bs,
+                               nkv=nkv, online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_steps,),
+                in_specs=[
+                    pl.BlockSpec((1, nh, kvd_b), q_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), kv_map),
+                ],
+                out_specs=pl.BlockSpec((1, nh, kvd_b), q_map),
+                scratch_shapes=[
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+            cost_estimate=_cost_estimate(
+                flops=(4 * nh * kvd * bs + 2 * kvd * bs) * n_steps,
+                transcendentals=nh * bs * n_steps,
+                bytes_accessed=(2 * kvd * bs * it
+                                + 2 * nkv * bs * 4) * n_steps),
+            interpret=_interpret(),
+        )(lp, sched, q_bd, k_pool, v_pool, k_scale, v_scale)
+    return out
+
+
+def _paged_update_quant_kernel(lp_ref, sc_ref, q_ref, nk_ref, nv_ref,
+                               nks_ref, nvs_ref, k_ref, v_ref, ks_ref,
+                               vs_ref, o_ref, ko_ref, vo_ref, kso_ref,
+                               vso_ref, l_s, b_s, acc_s, *, block_size,
+                               nkv, online=False):
+    """_paged_update_kernel over int8 tiles + f32 scale tiles. The new
+    column arrives ALREADY quantized (kv_quant_columns outside the
+    call, so decode writes the same bytes a prefill of the same tokens
+    would); the kernel merges bytes + scale into the update tile and
+    dequantizes whichever tile each step reads."""
+    j = pl.program_id(0)
+    pos = sc_ref[_POS, j]
+    start = sc_ref[_START, j]
+    col = sc_ref[_COL, j]
+    first = sc_ref[_FIRST, j] == np.int32(1)
+    upd = sc_ref[_LAST, j] == np.int32(1)
+    kvd = q_ref.shape[2]
+    lane = lax.broadcasted_iota(jnp.int32, (kvd, block_size), 1)
+    lane_s = lax.broadcasted_iota(jnp.int32, (nkv, block_size), 1)
+
+    @pl.when(upd)
+    def _write_cache():
+        # full tiles written every update step (the aliased out windows
+        # start uninitialized); the int8 insert routes through f32 like
+        # the fp16 kernel's minor-dim insert — exact for int8 values
+        ko_ref[0, 0] = jnp.where(
+            lane == col, nk_ref[0].astype(jnp.float32)[:, None],
+            k_ref[0, 0].astype(jnp.float32)).astype(jnp.int8)
+        vo_ref[0, 0] = jnp.where(
+            lane == col, nv_ref[0].astype(jnp.float32)[:, None],
+            v_ref[0, 0].astype(jnp.float32)).astype(jnp.int8)
+        kso_ref[0, 0] = jnp.where(lane_s == col, nks_ref[0][:, None],
+                                  ks_ref[0, 0])
+        vso_ref[0, 0] = jnp.where(lane_s == col, nvs_ref[0][:, None],
+                                  vs_ref[0, 0])
+
+    def chain(k_at, v_at, ks_at, vs_at, is_first):
+        k_deq = _dequant_tile(k_at, ks_at, nkv)
+        v_deq = _dequant_tile(v_at, vs_at, nkv)
+        s = jax.lax.dot_general(
+            q_ref[0].astype(jnp.float32), k_deq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [NH, bs]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(t <= pos, s, jnp.float32(-1e30))
+        alpha = None
+        if is_first:
+            bvec = s.max(axis=-1, keepdims=True)
+            b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
+        elif online:
+            m_prev = b_s[:, :1]
+            bvec = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - bvec)
+            b_s[...] = jnp.broadcast_to(bvec, b_s.shape)
+        else:
+            bvec = b_s[:, :1]
+        p = jnp.exp2(s - bvec)
+        psum = jnp.broadcast_to(p.sum(axis=-1, keepdims=True), l_s.shape)
+        d = jax.lax.dot_general(
+            p, v_deq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if is_first:
+            l_s[...] = psum
+            acc_s[...] = d
+        elif online:
+            l_s[...] = l_s[...] * alpha + psum
+            acc_s[...] = acc_s[...] * alpha + d
+        else:
+            l_s[...] = l_s[...] + psum
+            acc_s[...] = acc_s[...] + d
+
+    @pl.when(jnp.logical_and(first, upd))
+    def _first_updated():
+        chain(ko_ref[0, 0], vo_ref[0, 0], kso_ref[0, 0], vso_ref[0, 0],
+              True)
+
+    @pl.when(jnp.logical_and(first, jnp.logical_not(upd)))
+    def _first_raw():
+        chain(k_ref[0, 0], v_ref[0, 0], ks_ref[0, 0], vs_ref[0, 0], True)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(first), upd))
+    def _more_updated():
+        chain(ko_ref[0, 0], vo_ref[0, 0], kso_ref[0, 0], vso_ref[0, 0],
+              False)
+
+    @pl.when(jnp.logical_and(
+            jnp.logical_not(first),
+            jnp.logical_and(sc_ref[_LIVE, j] == np.int32(1),
+                            jnp.logical_not(upd))))
+    def _more_raw():
+        chain(k_ref[0, 0], v_ref[0, 0], ks_ref[0, 0], vs_ref[0, 0], False)
+
+    @pl.when(sc_ref[_LAST, j] == np.int32(1))
+    def _fin():
+        o_ref[0] = acc_s[...] / jnp.maximum(l_s[:, :1], jnp.float32(1e-30))
+
+
+def paged_attend_update_quant(q_bd, new_k, new_v, new_ks, new_vs,
+                              k_pool, v_pool, k_scale, v_scale, tables,
+                              positions, layer, *, n_steps=None):
+    """Fused int8 pool-update + paged attention for one decode layer.
+
+    Same contract as :func:`paged_attend_update`, except the pools are
+    int8 with [L, NP, NKV, bs] f32 scale pools, and the new columns
+    arrive pre-quantized: new_k/new_v int8 [B, KVD], new_ks/new_vs f32
+    [B, NKV] from :func:`kv_quant_columns`. All four pools alias
+    through the custom call. Returns (attn [B, NH, KVD] f32, k_pool,
+    v_pool, k_scale, v_scale)."""
+    b, nh, kvd = q_bd.shape
+    L, NP, _, bs = k_pool.shape
+    nkv = k_scale.shape[2]
+    B, max_nb = tables.shape
+    if n_steps is None:
+        n_steps = B * max_nb
+    it = jnp.dtype(k_pool.dtype).itemsize
+    kvd_b, bs_b, nkv_b = _fit_paged_kv_blocks(nh, kvd, nkv, bs, it)
+    sched = paged_schedule(positions + 1, tables, n_steps, bs)
+    lp = jnp.asarray([layer], jnp.int32)
+
+    def kv_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_BLK, j], 0, 0)
+
+    def q_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0, 0)
+
+    def new_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0)
+
+    def upd_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_UBLK, j], 0, 0)
+
+    kernel = functools.partial(_paged_update_quant_kernel, block_size=bs,
+                               nkv=nkv, online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        out, kp, vp, ks, vs = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_steps,),
+                in_specs=[
+                    pl.BlockSpec((1, nh, kvd_b), q_map),
+                    pl.BlockSpec((1, kvd_b), new_map),
+                    pl.BlockSpec((1, kvd_b), new_map),
+                    pl.BlockSpec((1, nkv_b), new_map),
+                    pl.BlockSpec((1, nkv_b), new_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), kv_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, nh, kvd_b), q_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), upd_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), upd_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), upd_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), upd_map),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, 128), jnp.float32),
+                    pltpu.VMEM((nh, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((b, nh, kvd), jnp.float32),
+                jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ],
+            # operand indices count scalar-prefetch first: 0=lp,
+            # 1=sched, 2=q, 3=new_k, 4=new_v, 5=new_ks, 6=new_vs,
+            # 7=k_pool, 8=v_pool, 9=k_scale, 10=v_scale
+            input_output_aliases={7: 1, 8: 2, 9: 3, 10: 4},
+            cost_estimate=_cost_estimate(
+                flops=(4 * nh * kvd * bs + 2 * kvd * bs) * n_steps,
+                transcendentals=nh * bs * n_steps,
+                bytes_accessed=((2 * kvd * bs * it + 2 * nkv * bs * 4)
+                                * n_steps
+                                + 4 * b * (kvd + nkv) * bs * it)),
+            interpret=_interpret(),
+        )(lp, sched, q_bd, new_k, new_v, new_ks, new_vs,
+          k_pool, v_pool, k_scale, v_scale)
+    return out, kp, vp, ks, vs
+
+
 def paged_attention_xla(q, k_pool, v_pool, tables, lengths, layer,
                         scale):
     """Plain-XLA reference: q [B, NH, KVD] UNSCALED, standard e-base

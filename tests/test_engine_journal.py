@@ -240,5 +240,68 @@ def test_torn_tail_recovery_rederives_lost_tokens(model, tmp_path):
     assert read_journal(path).torn_lines == 1  # resume never rewrites
 
 
+# -- crash matrix with prefix caching + int8 KV (PR 16) -----------------------
+
+
+def _shared_requests(n=3, max_new=6, seed=7):
+    """Identical 150-token prompts: one full shared block, so the
+    prefix cache registers + hits across the trace."""
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(1, 96, size=150).tolist()
+    return [Request(list(prompt), max_new_tokens=max_new,
+                    arrival=float(i), request_id=i) for i in range(n)]
+
+
+@pytest.mark.parametrize("point,nth", MATRIX,
+                         ids=[f"{p}-cached-int8" for p, _ in MATRIX])
+def test_crash_matrix_with_prefix_cache_and_int8(model, tmp_path, point,
+                                                 nth):
+    """The full fault matrix re-run with prefix caching AND int8 KV on,
+    over identical prompts that actually share a cached block. Cache
+    state is derived, never journaled; the per-column quantizer makes
+    cache bytes a pure function of the token prefix — so recovery is
+    bit-identical and leak-free with both features enabled."""
+    cfg, params = model
+    kw = dict(prefix_cache=True, kv_dtype="int8")
+
+    ref_eng = _engine(model, str(tmp_path / "ref16.jsonl"), **kw)
+    ref_eng.swap_weights(params, at_iteration=4)
+    ref_eng.run(_shared_requests(), deterministic=True)
+    ref = {s.req.request_id: s.generated for s in ref_eng.finished}
+    assert len(ref) == 3
+    # identical prompts -> identical greedy streams, via cache hits
+    assert len({tuple(t) for t in ref.values()}) == 1
+    assert ref_eng.stats()["prefix_cache"]["hits"] >= 1
+
+    path = str(tmp_path / "kill16.jsonl")
+    reqs = _shared_requests()
+    eng = _engine(model, path, **kw)
+    eng.swap_weights(params, at_iteration=4)
+    with faults.scope(point, "raise", nth=nth) as plan:
+        with pytest.raises(faults.FaultError):
+            eng.run(reqs, deterministic=True)
+        assert plan.fired == 1
+        # crash path released every live block (shared counted once;
+        # parked cache blocks are refs-0 by definition, not leaks)
+        assert eng.pool.used_blocks == 0
+
+        eng2 = _engine(model, path, **kw)
+        rec = eng2.recover()
+        assert rec["torn_lines"] == 0
+        journaled = ({s.req.request_id for s in eng2.waiting}
+                     | {s.req.request_id for s in eng2.finished})
+        resubmit = [Request(r.prompt, max_new_tokens=r.max_new_tokens,
+                            request_id=r.request_id)
+                    for r in reqs if r.request_id not in journaled]
+        eng2.run(resubmit, deterministic=True)
+
+    got = {s.req.request_id: s.generated for s in eng2.finished}
+    assert got == ref, f"streams diverged after crash at {point}"
+    assert eng2.pool.used_blocks == 0
+    st = read_journal(path)
+    assert st.finished == set(ref)
+    assert st.torn_lines == 0
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -25,6 +25,7 @@ def test_train_llama_single():
     assert "step 2: loss" in out
 
 
+@pytest.mark.slow   # hybrid-parallel math is pinned by test_llama_parallel; the single-device example smoke stays
 def test_train_llama_hybrid():
     out = _run("train_llama.py", "--steps", "2", "--dp", "2", "--mp", "2")
     assert "step 1: loss" in out

@@ -109,6 +109,7 @@ def test_remat_matches(ref_run):
     np.testing.assert_allclose(a1, l1, rtol=1e-4)
 
 
+@pytest.mark.slow   # 8-device flagship compile alone is ~1 min on the tier-1 CPU box
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)

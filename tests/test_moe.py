@@ -238,7 +238,7 @@ def test_ragged_shard_map_ep2_matches_serial():
     assert st["moe_expert_rows"].shape == (E,)
 
 
-@pytest.mark.slow   # covered in tier-1 by the multichip dryrun ragged step
+@pytest.mark.slow   # covered in tier-1 by the ep2 ragged shard_map parity test
 def test_ernie_moe_ragged_ep_dp_matches_serial():
     """ERNIE-MoE with dispatch_mode='ragged' on an ep=2 x dp=2 virtual
     mesh matches the serial ragged run, and serial ragged trains."""

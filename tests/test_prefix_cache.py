@@ -1,0 +1,328 @@
+"""Prefix-cached serving: COW shared KV blocks (PR 16).
+
+Contracts pinned here (PARITY.md "Prefix cache semantics"):
+
+  * BlockPool hardening: ``free()`` on a block with refs > 1
+    decrements; a double-decrement raises BlockPoolError BEFORE
+    mutating anything; the leak audit (``used_blocks``) counts a
+    shared block once and a parked cache block zero times.
+  * COW invariants: a scheduler write into a block with other readers
+    copies it first (readers keep the old bytes); a write into a
+    registered ref-1 block invalidates the index entry instead.
+  * cached-vs-cold parity: a prefix hit produces BITWISE identical
+    greedy tokens to the cold prefill of the same prompt.
+  * eviction under pressure reclaims only unreferenced (parked) cache
+    blocks, LRU-oldest first — caching never steals live capacity.
+  * sharpened admission: an identical-prompt burst admits MORE
+    requests with the cache on than off at the same pool size.
+  * deterministic replay is unchanged by caching (same trace ->
+    identical events and tokens).
+
+Tiny model, pallas interpret mode on CPU.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.inference import (BlockPool, BlockPoolError, InferenceEngine,
+                                  PrefixCache, Request, ServeConfig)
+import paddle_tpu.inference.engine as engine_mod
+from paddle_tpu.models.llama import (greedy_generate, init_llama_params,
+                                     llama_tiny)
+from paddle_tpu.ops import _common
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    with _common.interpret_mode(True):
+        yield
+
+
+# -- BlockPool ref counts + cached parking -----------------------------------
+
+
+def test_shared_free_decrements_then_releases():
+    pool = BlockPool(6, 128)
+    (b,) = pool.alloc(1)
+    pool.acquire([b])                       # second reader
+    assert pool.ref_count(b) == 2
+    assert pool.used_blocks == 1            # shared counts ONCE
+    pool.free([b])
+    assert pool.ref_count(b) == 1           # decrement, not release
+    assert pool.used_blocks == 1
+    pool.free([b])
+    assert pool.ref_count(b) == 0
+    assert pool.used_blocks == 0 and pool.free_blocks == 5
+
+
+def test_double_free_raises_before_mutating():
+    pool = BlockPool(6, 128)
+    a, b = pool.alloc(2)
+    pool.free([a])
+    snapshot = (pool.free_blocks, pool.ref_count(b))
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free([a])                      # stale id
+    with pytest.raises(BlockPoolError, match="double free"):
+        pool.free([b, b])                   # duplicate WITHIN one call
+    # the rejected frees left the pool untouched
+    assert (pool.free_blocks, pool.ref_count(b)) == snapshot
+    pool.free([b])
+    assert pool.used_blocks == 0
+
+
+def test_free_validates_null_and_range():
+    pool = BlockPool(4, 128)
+    with pytest.raises(BlockPoolError, match="null"):
+        pool.free([0])
+    with pytest.raises(BlockPoolError, match="out-of-range"):
+        pool.free([4])
+    with pytest.raises(BlockPoolError):
+        pool.acquire([9])
+
+
+def test_cached_parking_and_lru_reclaim():
+    """Registered blocks park on last free; alloc drains the true free
+    list FIRST, then reclaims parked blocks oldest-first with the
+    reclaim callback."""
+    pool = BlockPool(5, 128)                # 4 usable
+    reclaimed = []
+    pool.reclaim_cb = reclaimed.append
+    a, b = pool.alloc(2)
+    pool.mark_cached(a)
+    pool.mark_cached(b)
+    pool.free([a])                          # parks (LRU-oldest)
+    pool.free([b])                          # parks (MRU)
+    assert pool.cached_blocks == 2 and pool.used_blocks == 0
+    assert pool.free_blocks == 2 and pool.available_blocks == 4
+    got = pool.alloc(3)                     # 2 free + 1 reclaim
+    assert len(got) == 3
+    assert reclaimed == [a]                 # LRU-oldest reclaimed first
+    assert not pool.is_registered(a)
+    assert pool.is_registered(b) and pool.cached_blocks == 1
+    pool.free(got)
+    assert pool.used_blocks == 0
+
+
+def test_acquire_revives_parked_block():
+    pool = BlockPool(4, 128)
+    (b,) = pool.alloc(1)
+    pool.mark_cached(b)
+    pool.free([b])
+    assert pool.cached_blocks == 1
+    pool.acquire([b])                       # prefix hit
+    assert pool.ref_count(b) == 1 and pool.cached_blocks == 0
+    assert pool.is_registered(b)            # still index-backed
+    pool.free([b])                          # parks again
+    assert pool.cached_blocks == 1
+    pool.unmark_cached(b)                   # index invalidation
+    assert pool.free_blocks == 3 and pool.cached_blocks == 0
+
+
+# -- PrefixCache index --------------------------------------------------------
+
+
+def test_prefix_cache_register_match_exact_tokens():
+    pool = BlockPool(8, 128)
+    cache = PrefixCache(pool)
+    toks = list(range(1, 300))              # 2 full blocks + tail
+    blocks = pool.alloc(3)
+    assert cache.register(toks, blocks, 2) == 2
+    assert cache.match(toks, 2) == blocks[:2]
+    # one differing token inside block 0 -> no hit (exact tuples,
+    # no hash collisions by construction)
+    other = list(toks)
+    other[5] += 1
+    assert cache.match(other, 2) == []
+    # shorter prefix that shares block 0 hits exactly one block
+    assert cache.match(toks[:200], 1) == blocks[:1]
+    st = cache.stats()
+    assert st["entries"] == 2 and st["hits"] == 2 and st["lookups"] == 3
+
+
+def test_prefix_cache_first_writer_wins():
+    pool = BlockPool(8, 128)
+    cache = PrefixCache(pool)
+    toks = list(range(1, 200))
+    first = pool.alloc(1)
+    second = pool.alloc(1)
+    assert cache.register(toks, first, 1) == 1
+    assert cache.register(toks, second, 1) == 0   # duplicate key skipped
+    assert cache.match(toks, 1) == first
+    assert not pool.is_registered(second[0])
+
+
+def test_prefix_cache_reclaim_drops_entry():
+    pool = BlockPool(3, 128)                # 2 usable
+    cache = PrefixCache(pool)
+    toks = list(range(1, 150))
+    blocks = pool.alloc(1)
+    cache.register(toks, blocks, 1)
+    pool.free(blocks)                       # parks
+    got = pool.alloc(2)                     # must reclaim the parked block
+    assert set(got) >= set(blocks)
+    assert cache.match(toks, 1) == []       # entry died with the block
+    assert cache.stats()["reclaimed"] == 1
+    pool.free(got)
+
+
+# -- engine: COW, parity, eviction, admission, replay -------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny(vocab=96, hidden=64, layers=1, heads=4, kv_heads=2,
+                     seq=512)
+    return cfg, init_llama_params(cfg, seed=3)
+
+
+def _serve(**kw):
+    base = dict(block_size=128, num_blocks=12, max_batch=2,
+                prefill_chunk=64, max_seq_len=512)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _run(model, reqs, **kw):
+    cfg, params = model
+    eng = InferenceEngine(params, cfg, _serve(**kw), record_events=True)
+    eng.run([Request(list(p), max_new_tokens=m, arrival=a)
+             for p, m, a in reqs], deterministic=True)
+    return eng, {s.req.request_id: s.generated for s in eng.finished}
+
+
+def test_cached_hit_bitwise_equals_cold(model):
+    """The tentpole parity pin: request 1 re-sends request 0's prompt
+    after registration; it HITS (2 full blocks skipped) and its greedy
+    tokens are bitwise identical to the cold run AND to the contiguous
+    greedy_generate reference."""
+    cfg, params = model
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, 96, size=300).tolist()
+    trace = [(prompt, 4, 0.0), (prompt, 4, 50.0)]
+    eng_cold, cold = _run(model, trace)
+    eng_warm, warm = _run(model, trace, prefix_cache=True)
+    pc = eng_warm.stats()["prefix_cache"]
+    assert pc["hits"] == 1 and pc["hit_tokens"] == 256
+    assert warm == cold
+    ref = greedy_generate(params, jnp.asarray([prompt], jnp.int32), cfg, 4)
+    assert warm[1] == np.asarray(ref)[0].tolist()
+    assert any(e[1:] == ("prefix_hit", 1, 2) for e in eng_warm.events)
+    # no leaks; registered blocks sit parked, not lost
+    assert eng_warm.pool.used_blocks == 0
+    assert eng_warm.pool.cached_blocks == pc["entries"] > 0
+
+
+def test_cow_copy_preserves_reader_bytes(model):
+    """Drive _cow_span directly on a genuinely shared block: the writer
+    gets a private copy (table swap), the other reader's block keeps
+    its exact bytes, and the copy starts bitwise identical."""
+    cfg, params = model
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(1, 96, size=300).tolist()
+    eng, _ = _run(model, [(prompt, 3, 0.0)], prefix_cache=True)
+    hit = eng.cache.match(prompt, 2)
+    assert len(hit) == 2
+    eng.pool.acquire(hit)                   # reader A
+    eng.pool.acquire(hit)                   # reader B
+    b = hit[0]
+    assert eng.pool.ref_count(b) == 2
+    before = np.asarray(eng.k_pool[:, b]).copy()
+    writer = engine_mod._Seq(Request(prompt, max_new_tokens=1,
+                                     request_id=99), 0.0)
+    writer.blocks = list(hit)
+    assert eng._cow_span(writer, 0, 1)      # write lands in block 0
+    nb = writer.blocks[0]
+    assert nb != b                          # writer swapped to a copy
+    assert eng.pool.ref_count(b) == 1       # reader count decremented
+    assert (np.asarray(eng.k_pool[:, b]) == before).all()
+    assert (np.asarray(eng.k_pool[:, nb]) == before).all()
+    assert eng.stats()["prefix_cache"]["cow_copies"] == 1
+    eng.pool.free(writer.blocks)
+    eng.pool.free(hit[1:])
+    eng.pool.free([b])
+
+
+def test_cow_sole_owner_invalidates_index_entry(model):
+    """ref-1 + registered: no copy, but the index forgets the entry so
+    future lookups can't hit mutated bytes."""
+    cfg, params = model
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(1, 96, size=200).tolist()
+    eng, _ = _run(model, [(prompt, 3, 0.0)], prefix_cache=True)
+    hit = eng.cache.match(prompt, 1)
+    assert len(hit) == 1
+    eng.pool.acquire(hit)                   # sole live owner
+    writer = engine_mod._Seq(Request(prompt, max_new_tokens=1,
+                                     request_id=98), 0.0)
+    writer.blocks = list(hit)
+    assert eng._cow_span(writer, 0, 1)
+    assert writer.blocks == hit             # no copy made
+    assert not eng.pool.is_registered(hit[0])
+    assert eng.cache.match(prompt, 1) == []
+    assert eng.stats()["prefix_cache"]["invalidated"] == 1
+    eng.pool.free(hit)
+
+
+def test_eviction_reclaims_only_unreferenced_cache_blocks(model):
+    """Pool sized so later admissions must reclaim parked cache blocks:
+    the run completes leak-free, reclaims happened, and every stream
+    still matches its cold reference (live shared bytes were never
+    stolen)."""
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(1, 96, size=260).tolist() for _ in range(4)]
+    trace = [(p, 3, float(10 * i)) for i, p in enumerate(prompts)]
+    eng_cold, cold = _run(model, trace, num_blocks=8)
+    eng, warm = _run(model, trace, num_blocks=8, prefix_cache=True)
+    assert warm == cold
+    assert eng.pool.used_blocks == 0
+    pc = eng.stats()["prefix_cache"]
+    assert pc["reclaimed"] > 0              # pressure actually reclaimed
+    # whatever remains parked is still coherent with the index
+    assert eng.pool.cached_blocks == pc["entries"]
+
+
+def test_burst_admission_admits_more_with_cache(model):
+    """Satellite pin: at the same pool size and overcommit, a burst of
+    identical prompts admits MORE requests with the cache on — shared
+    prefix blocks are free-by-construction in the demand estimate."""
+    cfg, params = model
+
+    def admitted(prefix_cache):
+        serve = _serve(num_blocks=5, overcommit=1.0, max_queue=16,
+                       prefix_cache=prefix_cache)
+        eng = InferenceEngine(params, cfg, serve)
+        rng = np.random.RandomState(4)
+        prompt = rng.randint(1, 96, size=300).tolist()
+        outs = [eng.submit(Request(list(prompt), max_new_tokens=4,
+                                   arrival=0.0))
+                for _ in range(4)]
+        assert all(a.cause in (None, "overcommit") for a in outs)
+        return sum(a.accepted for a in outs)
+
+    n_off, n_on = admitted(False), admitted(True)
+    assert n_on > n_off, (n_on, n_off)
+
+
+def test_deterministic_replay_with_cache(model):
+    """Same arrival trace twice with caching on: identical event logs
+    and identical tokens (the cache introduces no nondeterminism)."""
+    rng = np.random.RandomState(5)
+    shared = rng.randint(1, 96, size=280).tolist()
+    other = rng.randint(1, 96, size=40).tolist()
+    trace = [(shared, 3, 0.0), (other, 3, 1.0), (shared, 3, 40.0)]
+    eng1, t1 = _run(model, trace, prefix_cache=True)
+    eng2, t2 = _run(model, trace, prefix_cache=True)
+    assert eng1.events == eng2.events
+    assert t1 == t2
+    assert eng1.stats()["prefix_cache"]["hits"] >= 1
+
+
+def test_env_knob_enables_prefix_cache(model, monkeypatch):
+    cfg, params = model
+    monkeypatch.setenv("PADDLE_TPU_SERVE_PREFIX_CACHE", "1")
+    eng = InferenceEngine(params, cfg, _serve())
+    assert eng.cache is not None
+    # explicit config wins over the knob
+    eng2 = InferenceEngine(params, cfg, _serve(prefix_cache=False))
+    assert eng2.cache is None

@@ -86,6 +86,7 @@ def test_ring_flash_grads_match(causal):
                                    atol=2e-4, err_msg=f"d{name}")
 
 
+@pytest.mark.slow   # GQA kv routing stays covered in tier-1 by the ulysses gqa tests
 def test_ring_flash_gqa():
     n = 4
     B, S, H, D = 1, 4 * 128, 4, 64

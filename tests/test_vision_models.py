@@ -16,12 +16,14 @@ def _img(n=1, s=64):
     return paddle.to_tensor(R.rand(n, 3, s, s).astype(np.float32))
 
 
+# deep-stack XLA compiles dominate the tier-1 CPU budget: one forward per
+# model family stays in tier-1, the redundant/deepest variants run as slow
 @pytest.mark.parametrize("builder,classes", [
-    (models.alexnet, 10),
-    (models.squeezenet1_0, 10),
+    pytest.param(models.alexnet, 10, marks=pytest.mark.slow),
+    pytest.param(models.squeezenet1_0, 10, marks=pytest.mark.slow),
     (models.squeezenet1_1, 10),
-    (models.mobilenet_v1, 10),
-    (models.mobilenet_v3_small, 10),
+    pytest.param(models.mobilenet_v1, 10, marks=pytest.mark.slow),
+    pytest.param(models.mobilenet_v3_small, 10, marks=pytest.mark.slow),
     (models.shufflenet_v2_x0_25, 10),
 ])
 def test_small_model_forward(builder, classes):
@@ -33,8 +35,8 @@ def test_small_model_forward(builder, classes):
 
 
 @pytest.mark.parametrize("builder", [
-    models.densenet121,
-    models.googlenet,
+    pytest.param(models.densenet121, marks=pytest.mark.slow),
+    pytest.param(models.googlenet, marks=pytest.mark.slow),
     models.shufflenet_v2_x1_0,
 ])
 def test_medium_model_forward(builder):
@@ -45,6 +47,7 @@ def test_medium_model_forward(builder):
     assert np.isfinite(out.numpy()).all()
 
 
+@pytest.mark.slow
 def test_inception_v3_forward():
     # stem requires >= 75px input
     m = models.inception_v3(num_classes=5)
@@ -53,6 +56,7 @@ def test_inception_v3_forward():
     assert list(out.shape) == [1, 5]
 
 
+@pytest.mark.slow   # deep conv backward compile ~12s on the tier-1 CPU box
 def test_zoo_model_trains():
     m = models.squeezenet1_1(num_classes=4)
     m.train()
