@@ -211,6 +211,32 @@ _register(
     parse=lambda value: value or None)
 
 _register(
+    "PADDLE_TPU_LEDGER", "bool", False,
+    doc="Always-on roofline step ledger (PR 17): TrainStep captures each "
+        "compiled program's per-kernel cost_estimate totals at trace time "
+        "and itemizes step time into named roofline-classified lines with "
+        "an explicit unattributed remainder. Measurement-only (losses "
+        "bit-identical). An explicit ledger= argument to jit.TrainStep "
+        "wins over the env.",
+    parse=_truthy(("1", "true", "on", "yes")))
+
+_register(
+    "PADDLE_TPU_LEDGER_DIR", "str", None,
+    doc="Directory for RooflineLedger JSONL report output (PR 17); "
+        "unset/empty falls back to PADDLE_TPU_TELEMETRY_DIR, and with "
+        "neither set no ledger file is written.",
+    parse=lambda value: value or None)
+
+_register(
+    "PADDLE_TPU_REGRESS_BAND", "float", 0.15,
+    doc="Default fractional noise band for the bench regression ratchet "
+        "(PR 17, observability.regress): a rung worse than its "
+        "PERF_BASELINE.json value by more than the band fails --check. "
+        "Per-entry bands in the baseline and the --band flag win over "
+        "the env.",
+    parse=_positive_float("PADDLE_TPU_REGRESS_BAND", 0.15))
+
+_register(
     "PADDLE_TPU_PEAK_FLOPS", "float", None,
     doc="Per-chip peak FLOP/s override for MFU attribution (PR 2); unset "
         "falls back to the PJRT device_kind table in observability."

@@ -47,7 +47,7 @@ class TrainStep:
                  telemetry_dir: Optional[str] = None,
                  tokens_per_step: Optional[int] = None,
                  flight_recorder: Optional[bool] = None,
-                 fleet=None, checkpoint=None):
+                 fleet=None, ledger=None, checkpoint=None):
         # rolling-checkpoint + preemption orchestration (PR 13): a
         # CheckpointManager instance or a root directory string. on_step
         # fires after every completed step; interval pacing and the
@@ -326,6 +326,18 @@ class TrainStep:
                           if logdir else None))
         else:
             self.fleet = None
+        # roofline ledger (PR 17): itemizes step time into named kernel
+        # component lines from the cost_estimate FLOPs/bytes captured while
+        # tracing. Accepts a shared RooflineLedger instance, True/False, or
+        # None -> PADDLE_TPU_LEDGER. Measurement-only: the compiled program
+        # is untouched, the only hot-path cost is one perf_counter read.
+        if isinstance(ledger, observability.RooflineLedger):
+            self.ledger = ledger
+        elif observability.ledger_enabled(ledger if isinstance(ledger, bool)
+                                          else None):
+            self.ledger = observability.RooflineLedger(name="train_step")
+        else:
+            self.ledger = None
         if self.fleet is not None and self.telemetry is not None:
             try:
                 self.telemetry.register_into(self.fleet.registry)
@@ -462,16 +474,20 @@ class TrainStep:
         batch, train_params, frozen, lr = self._prepare(list(inputs), labels)
         self._rng, sub = jax.random.split(self._rng)
         m = self.telemetry
+        led = self.ledger
         captured = False
-        if m is not None and self._flops_stale:
+        if (m is not None or led is not None) and self._flops_stale:
             # once per (re)compile, BEFORE dispatch (donation hasn't consumed
             # the buffers yet): lower the step for this batch and read the
-            # program's cost analysis — trace-time work, nothing per step
+            # program's cost analysis — trace-time work, nothing per step.
+            # The trace also fires every pallas_call cost_estimate= site, so
+            # the ledger ingests exact per-kernel FLOPs/bytes for free.
             self._capture_cost(train_params, frozen, batch, sub, lr)
             captured = True
         rec = self.recorder
         fl = self.fleet
-        timed = m is not None or rec is not None or fl is not None
+        timed = (m is not None or rec is not None or fl is not None
+                 or led is not None)
         t0 = time.perf_counter() if timed else 0.0
         try:
             new_p, new_s, new_b, loss = self._compiled(
@@ -485,16 +501,17 @@ class TrainStep:
             raise
         if timed:
             dt = time.perf_counter() - t0
-            is_compile = (self._note_compile() if m is not None
+            is_compile = (self._note_compile()
+                          if (m is not None or led is not None)
                           else self._step_count == 0)
+            if is_compile and captured:
+                # this dispatch paid trace+compile. A recompile marks FLOPs
+                # stale (the program changed) — unless they were captured
+                # for exactly this program a few lines up.
+                self._flops_stale = False
             if m is not None:
                 if is_compile:
-                    # this dispatch paid trace+compile: account it as compile
-                    # time, not a step sample. A recompile marks FLOPs stale
-                    # (the program changed) — unless they were captured for
-                    # exactly this program a few lines up.
-                    if captured:
-                        self._flops_stale = False
+                    # account it as compile time, not a step sample
                     m.record_compile(compile_s=dt, flops=m.flops_per_step)
                 else:
                     m.step(tokens=self._batch_tokens(batch),
@@ -514,6 +531,11 @@ class TrainStep:
                 # host float only — the monitor must never pull a device
                 # value (that would be the sync this path avoids)
                 fl.on_step(dt)
+            if led is not None and not is_compile:
+                led.on_step(dt)
+                if observability.ledger_dir() \
+                        and self._step_count % 64 == 0:
+                    led.write()
         self.params.update(new_p)
         self.opt_states = new_s
         self.buffers = new_b
@@ -559,21 +581,30 @@ class TrainStep:
 
     def _capture_cost(self, train_params, frozen, batch, sub, lr):
         """FLOPs-per-step from the lowered program's cost analysis (client-
-        side HLO analysis; no extra XLA compile, no device work)."""
+        side HLO analysis; no extra XLA compile, no device work). Tracing
+        also fires every pallas_call ``cost_estimate=`` site exactly as
+        many times as the program calls it, so the window delta over the
+        kernel-cost totals is this program's exact per-kernel cost — the
+        roofline ledger's model-mode feed."""
         self._flops_stale = False
         try:
+            from ..ops import _common as _opsc
+            snap = _opsc.snapshot_kernel_costs()
             t0 = time.perf_counter()
             lowered = self._compiled.lower(train_params, self.opt_states,
                                            self.buffers, frozen, batch, sub,
                                            lr)
             trace_s = time.perf_counter() - t0
+            if self.ledger is not None:
+                self.ledger.ingest(_opsc.kernel_costs_since(snap))
             cost = lowered.cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}
             flops = float((cost or {}).get("flops", 0.0))
-            self.telemetry.trace_time_s += trace_s
-            if flops > 0:
-                self.telemetry.flops_per_step = flops
+            if self.telemetry is not None:
+                self.telemetry.trace_time_s += trace_s
+                if flops > 0:
+                    self.telemetry.flops_per_step = flops
         except Exception:
             pass
 
@@ -583,6 +614,8 @@ class TrainStep:
         try:
             size = self._compiled._cache_size()
         except Exception:
+            if self.telemetry is None:
+                return not self._step_count
             return self.telemetry.compiles == 0 and not self._step_count
         if size != self._seen_cache_size:
             self._seen_cache_size = size

@@ -344,57 +344,64 @@ def decoder_layer(p, h_in, cos, sin, config: LlamaConfig,
     nh = _mat_out_dim(p["q_proj"]) // hd  # local head count (sliced under TP)
     nkv = _mat_out_dim(p["k_proj"]) // hd
 
-    x = fused_rms_norm(h_in, p["input_norm"], c.rms_norm_eps)
-    q = _mat(x, p["q_proj"]).reshape(b, s, nh, hd)
-    k = _mat(x, p["k_proj"]).reshape(b, s, nkv, hd)
-    v = _mat(x, p["v_proj"]).reshape(b, s, nkv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    # jax.named_scope boundaries (measurement-only): the scope names land
+    # in the lowered ops' metadata, so device traces and merge_device_trace
+    # can attribute kernel time back to step components by name.
+    with jax.named_scope("decoder.qkv"):
+        x = fused_rms_norm(h_in, p["input_norm"], c.rms_norm_eps)
+        q = _mat(x, p["q_proj"]).reshape(b, s, nh, hd)
+        k = _mat(x, p["k_proj"]).reshape(b, s, nkv, hd)
+        v = _mat(x, p["v_proj"]).reshape(b, s, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
 
-    if parallel.sep > 1 and in_shard_map:
-        from ..parallel.ring_attention import ring_attention
-        from ..parallel.ulysses_attention import (resolve_sep_strategy,
-                                                  ulysses_attention)
-        if resolve_sep_strategy(parallel.sep_strategy) == "ulysses":
-            if use_flash:
-                attn = ulysses_attention(q, k, v, axis_name="sep",
-                                         causal=True)
+    with jax.named_scope("decoder.attn"):
+        if parallel.sep > 1 and in_shard_map:
+            from ..parallel.ring_attention import ring_attention
+            from ..parallel.ulysses_attention import (resolve_sep_strategy,
+                                                      ulysses_attention)
+            if resolve_sep_strategy(parallel.sep_strategy) == "ulysses":
+                if use_flash:
+                    attn = ulysses_attention(q, k, v, axis_name="sep",
+                                             causal=True)
+                else:
+                    from ..nn.functional.attention import _xla_sdpa
+                    attn = ulysses_attention(
+                        q, k, v, axis_name="sep", causal=True,
+                        attn_fn=lambda qg, kg, vg: _xla_sdpa(
+                            qg, kg, vg, is_causal=True))
             else:
-                from ..nn.functional.attention import _xla_sdpa
-                attn = ulysses_attention(
-                    q, k, v, axis_name="sep", causal=True,
-                    attn_fn=lambda qg, kg, vg: _xla_sdpa(
-                        qg, kg, vg, is_causal=True))
+                attn = ring_attention(q, k, v, axis_name="sep", causal=True,
+                                      impl="flash" if use_flash else "xla")
+        elif use_flash:
+            attn = flash_attention_bshd(q, k, v, causal=True)
         else:
-            attn = ring_attention(q, k, v, axis_name="sep", causal=True,
-                                  impl="flash" if use_flash else "xla")
-    elif use_flash:
-        attn = flash_attention_bshd(q, k, v, causal=True)
-    else:
-        from ..nn.functional.attention import _xla_sdpa
-        attn = _xla_sdpa(q, k, v, is_causal=True)
-    attn = attn.reshape(b, s, nh * hd)
-    # named so the 'save_attn' remat policy can keep it (skips recomputing
-    # the flash kernel in backward at the cost of one [B,S,H*D] residual)
-    attn = _ckpt_name(attn, "attn_out")
-    attn_out = _mat(attn, p["o_proj"])
-    if tp_axis is not None:
-        attn_out = lax.psum(attn_out, tp_axis)
+            from ..nn.functional.attention import _xla_sdpa
+            attn = _xla_sdpa(q, k, v, is_causal=True)
+        attn = attn.reshape(b, s, nh * hd)
+        # named so the 'save_attn' remat policy can keep it (skips
+        # recomputing the flash kernel in backward at the cost of one
+        # [B,S,H*D] residual)
+        attn = _ckpt_name(attn, "attn_out")
+        attn_out = _mat(attn, p["o_proj"])
+        if tp_axis is not None:
+            attn_out = lax.psum(attn_out, tp_axis)
     h = h_in + _maybe_hint(attn_out, mesh, _act_spec(parallel))
 
-    x = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
-    mlp_out = _fused_ffn_overlap(x, p, parallel, mesh, tp_axis)
-    if mlp_out is None:
-        # named so 'save_mlp' can keep the gate/up matmul outputs across the
-        # remat boundary — gate+up are HALF the forward matmul FLOPs, so
-        # saving them halves the backward recompute at the cost of two
-        # [B, S, I] residuals per layer
-        g = _ckpt_name(_mat(x, p["gate_proj"]), "mlp_gate")
-        u = _ckpt_name(_mat(x, p["up_proj"]), "mlp_up")
-        gated = jax.nn.silu(g) * u
-        mlp_out = _mat(gated, p["down_proj"])
-        if tp_axis is not None:
-            mlp_out = lax.psum(mlp_out, tp_axis)
+    with jax.named_scope("decoder.ffn"):
+        x = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
+        mlp_out = _fused_ffn_overlap(x, p, parallel, mesh, tp_axis)
+        if mlp_out is None:
+            # named so 'save_mlp' can keep the gate/up matmul outputs across
+            # the remat boundary — gate+up are HALF the forward matmul
+            # FLOPs, so saving them halves the backward recompute at the
+            # cost of two [B, S, I] residuals per layer
+            g = _ckpt_name(_mat(x, p["gate_proj"]), "mlp_gate")
+            u = _ckpt_name(_mat(x, p["up_proj"]), "mlp_up")
+            gated = jax.nn.silu(g) * u
+            mlp_out = _mat(gated, p["down_proj"])
+            if tp_axis is not None:
+                mlp_out = lax.psum(mlp_out, tp_axis)
     out = h + _maybe_hint(mlp_out, mesh, _act_spec(parallel))
     return out
 
@@ -520,10 +527,11 @@ def llama_hidden(params, ids, config, parallel, mesh=None, use_flash=True,
 
 
 def llama_logits(params, h, config):
-    x = fused_rms_norm(h, params["final_norm"], config.rms_norm_eps)
-    if config.tie_word_embeddings:
-        return x @ params["embed"].T
-    return _mat(x, params["lm_head"])
+    with jax.named_scope("lm_head"):
+        x = fused_rms_norm(h, params["final_norm"], config.rms_norm_eps)
+        if config.tie_word_embeddings:
+            return x @ params["embed"].T
+        return _mat(x, params["lm_head"])
 
 
 def masked_ce_loss(logits, labels, sep_psum: bool = False, psum_axes=None):
@@ -533,16 +541,17 @@ def masked_ce_loss(logits, labels, sep_psum: bool = False, psum_axes=None):
     valid tokens don't deflate the denominator."""
     if psum_axes is None and sep_psum:
         psum_axes = ("sep",)
-    mask = labels != -100
-    safe = jnp.where(mask, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
-    count = jnp.sum(mask)
-    if psum_axes:
-        loss_sum = lax.psum(loss_sum, psum_axes)
-        count = lax.psum(count, psum_axes)
-    return loss_sum / jnp.maximum(count, 1)
+    with jax.named_scope("ce_loss"):
+        mask = labels != -100
+        safe = jnp.where(mask, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        loss_sum = jnp.sum(jnp.where(mask, -picked, 0.0))
+        count = jnp.sum(mask)
+        if psum_axes:
+            loss_sum = lax.psum(loss_sum, psum_axes)
+            count = lax.psum(count, psum_axes)
+        return loss_sum / jnp.maximum(count, 1)
 
 
 def chunked_ce_loss(x, head, labels, sep_psum: bool = False, n_chunks=8):

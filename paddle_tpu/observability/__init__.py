@@ -23,10 +23,18 @@ pipeline, in three layers (PR 2 + PR 12):
    worst/median rank + straggler attribution + desync, and hooks
    non-finite-loss / grad-norm-spike / HBM-watermark anomalies into the
    shared flight-recorder ring.
+5. **Performance attribution** (PR 17) — ``RooflineLedger`` itemizes
+   step time into named kernel/component lines from the ``cost_estimate``
+   FLOPs/bytes every pallas_call site declares, classifies each as
+   compute- or memory-bound against the per-platform peak/HBM tables
+   with an explicit unattributed remainder; ``merge_device_trace`` joins
+   jax.profiler device events with host spans into one Perfetto view;
+   ``regress`` ratchets bench rungs against ``PERF_BASELINE.json``.
 
 Switched by ``PADDLE_TPU_TELEMETRY`` / ``PADDLE_TPU_TRACE_REQUESTS`` /
-``PADDLE_TPU_FLIGHT_RECORDER`` / ``PADDLE_TPU_FLEET`` (+
-``PADDLE_TPU_TELEMETRY_DIR`` for file output).
+``PADDLE_TPU_FLIGHT_RECORDER`` / ``PADDLE_TPU_FLEET`` /
+``PADDLE_TPU_LEDGER`` (+ ``PADDLE_TPU_TELEMETRY_DIR`` /
+``PADDLE_TPU_LEDGER_DIR`` for file output).
 """
 from .exporters import (JsonlWriter, TensorBoardWriter, get_logger,  # noqa: F401
                         load_jsonl, log_event, process_rank,
@@ -37,8 +45,12 @@ from .flight_recorder import (FlightRecorder, flight_recorder_enabled,  # noqa: 
                               load_dump)
 from .histogram import (LogHistogram, histogram_sample_lines,  # noqa: F401
                         render_prometheus)
+from .ledger import (HBM_BW_TABLE, RooflineLedger,  # noqa: F401
+                     flagship_component_specs, hbm_bw_per_device,
+                     ledger_dir, ledger_enabled, load_device_trace_events,
+                     merge_device_trace)
 from .metrics import (PEAK_FLOPS_TABLE, StepMetrics, active,  # noqa: F401
-                      peak_flops_per_device, set_active)
+                      peak_flops_info, peak_flops_per_device, set_active)
 from .registry import MetricsRegistry  # noqa: F401
 from .request_trace import RequestTracer  # noqa: F401
 from .trace import (ENV_TELEMETRY, ENV_TELEMETRY_DIR, comm_span,  # noqa: F401

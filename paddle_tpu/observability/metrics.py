@@ -40,22 +40,45 @@ PEAK_FLOPS_TABLE = (
 )
 
 
-def peak_flops_per_device(device=None) -> Optional[float]:
-    """Peak FLOP/s for one device, from ``PADDLE_TPU_PEAK_FLOPS`` (wins) or
-    the device_kind table; None when the kind is unknown."""
+# device_kinds already warned about this process: an unknown platform must
+# not fall back SILENTLY (roofline/MFU fractions would be quietly wrong or
+# quietly absent), but it must also not spam one warning per StepMetrics
+_PEAK_WARNED: set = set()
+
+
+def peak_flops_info(device=None):
+    """(per-device peak FLOP/s, source) — source is ``"env"`` (the
+    PADDLE_TPU_PEAK_FLOPS override), ``"table:<key>"`` (the device_kind
+    row that matched), or ``"unknown:<kind>"`` with a once-per-run warning
+    NAMING the platform so an MFU/roofline gap is never a silent None."""
     env = envs.get(ENV_PEAK_FLOPS)
     if env is not None:
-        return env
+        return env, "env"
     if device is None:
         devs = jax.devices()
         if not devs:
-            return None
+            return None, "unknown:no-devices"
         device = devs[0]
     kind = (getattr(device, "device_kind", "") or "").lower()
     for key, flops in PEAK_FLOPS_TABLE:
         if key in kind:
-            return flops
-    return None
+            return flops, f"table:{key}"
+    if kind not in _PEAK_WARNED:
+        _PEAK_WARNED.add(kind)
+        import warnings
+        warnings.warn(
+            f"no peak-FLOPs table entry for device_kind {kind!r}: MFU and "
+            f"roofline fractions will be unavailable for this platform — "
+            f"set PADDLE_TPU_PEAK_FLOPS or extend "
+            f"observability.metrics.PEAK_FLOPS_TABLE", stacklevel=2)
+    return None, f"unknown:{kind or '?'}"
+
+
+def peak_flops_per_device(device=None) -> Optional[float]:
+    """Peak FLOP/s for one device, from ``PADDLE_TPU_PEAK_FLOPS`` (wins) or
+    the device_kind table; None (with a once-per-run warning via
+    :func:`peak_flops_info`) when the kind is unknown."""
+    return peak_flops_info(device)[0]
 
 
 class StepMetrics:
@@ -80,8 +103,10 @@ class StepMetrics:
         self.name = name
         self.tokens_per_step = tokens_per_step
         self.n_devices = n_devices if n_devices is not None else jax.device_count()
-        per_dev = (peak_flops if peak_flops is not None
-                   else peak_flops_per_device())
+        if peak_flops is not None:
+            per_dev, self.mfu_peak_source = peak_flops, "arg"
+        else:
+            per_dev, self.mfu_peak_source = peak_flops_info()
         self.peak_flops_total = (per_dev * self.n_devices
                                  if per_dev is not None else None)
         self.flops_per_step: Optional[float] = None
@@ -227,6 +252,10 @@ class StepMetrics:
             "tokens_per_sec": (tokens / step_time_s
                                if tokens and step_time_s else None),
             "mfu": self.mfu(step_time_s),
+            # provenance of the MFU denominator, so a reader of the JSONL
+            # can tell a table-backed fraction from an env override or an
+            # unknown-platform None at a glance
+            "mfu_peak_source": self.mfu_peak_source,
         }
         rec.update(self.device_memory())
         rec.update(extra)
@@ -255,6 +284,7 @@ class StepMetrics:
             "trace_time_s": self.trace_time_s,
             "flops_per_step": self.flops_per_step,
             "peak_flops_total": self.peak_flops_total,
+            "mfu_peak_source": self.mfu_peak_source,
             "n_devices": self.n_devices,
             "step_time_ms_best": best,
             "step_time_ms_mean": mean,

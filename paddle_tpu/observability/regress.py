@@ -1,0 +1,376 @@
+"""Bench regression ratchet: ``python -m paddle_tpu.observability.regress``.
+
+The PR-11 finding-ratchet pattern applied to performance: a checked-in
+baseline (``PERF_BASELINE.json``, seeded from ``BENCH_DETAIL.json``)
+freezes one value per bench rung with a per-rung noise band, and
+``--check`` compares a fresh bench record against it —
+
+* a rung WORSE than baseline by more than its band **fails**;
+* an improvement **passes without moving the baseline** (records only
+  ratchet forward deliberately, so a lucky run can't raise the bar);
+* a STALE baseline entry (rung missing from the record) **fails** — a
+  silently-vanished rung is a lost regression guard, exactly like a
+  stale lint-baseline fingerprint;
+* a TORN baseline (unparseable, or entries without values) **fails**
+  with the defect named;
+* moving the baseline requires an explicit ``--accept``.
+
+New rungs in the record are reported but do not fail: new coverage is
+not debt. Directions (higher- vs lower-is-better) are derived from the
+rung name at seed time and frozen into the baseline entries, so a later
+rename cannot silently flip a comparison.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+from .. import envs
+
+ENV_REGRESS_BAND = "PADDLE_TPU_REGRESS_BAND"
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "PERF_BASELINE.json")
+DEFAULT_RECORD = os.path.join(_REPO_ROOT, "BENCH_DETAIL.json")
+
+# Rung-name patterns whose value gets BETTER as it goes DOWN. Everything
+# else defaults to higher-is-better; booleans are pinned-true gates.
+_LOWER_SUFFIXES = ("_ms", "_s", "_pct", "_x_floor")
+_LOWER_SUBSTRINGS = ("pad_waste", "overhead", "wire_ratio",
+                     "decode_ms_ratio", "unattributed")
+
+
+def direction(rung: str, value=None) -> str:
+    """'bool' | 'lower' | 'higher' for one rung name/value."""
+    if isinstance(value, bool):
+        return "bool"
+    if rung.endswith(_LOWER_SUFFIXES):
+        return "lower"
+    if any(s in rung for s in _LOWER_SUBSTRINGS):
+        return "lower"
+    return "higher"
+
+
+def default_band(rung: str, band: float) -> float:
+    """Per-rung noise band at seed time: raw timing/throughput rungs are
+    far noisier (host scheduling, CPU-interpret paths) than efficiency
+    fractions or attributed-overhead gates, so they seed with a wide
+    band; everything else takes the configured default."""
+    noisy = ("tokens_per_sec", "_tps", "_ms", "_s", "speedup", "x_floor",
+             "hit_rate")
+    if rung.endswith(noisy) or any(s in rung for s in ("tpot", "ttft")):
+        return max(band, 0.5)
+    return band
+
+
+def band_default() -> float:
+    """The fractional noise band when neither the baseline entry nor the
+    CLI provides one: PADDLE_TPU_REGRESS_BAND."""
+    return float(envs.get(ENV_REGRESS_BAND))
+
+
+# ---------------------------------------------------------------------------
+# rung extraction — the ONE mapping from a bench detail dict to the flat
+# {rung: value} record (bench.py's summary line calls this too)
+# ---------------------------------------------------------------------------
+
+def rungs_from_bench_detail(doc: Dict) -> Dict:
+    """Flat ``{rung_name: value}`` from a bench record — either the short
+    summary line (has ``rungs``) or the full ``BENCH_DETAIL.json`` shape
+    (has ``detail``; the per-section rung mapping lives here so the bench
+    and the ratchet can never disagree about what a rung is)."""
+    rungs: Dict = {}
+    if doc.get("metric") and doc.get("value") is not None:
+        rungs[doc["metric"]] = doc["value"]
+    if isinstance(doc.get("rungs"), dict):
+        rungs.update(doc["rungs"])
+        return rungs
+    detail = doc.get("detail") or {}
+    if "7b_shape" in detail:
+        rungs["7b_mfu"] = detail["7b_shape"]["mfu"]
+    if "13b_layer" in detail:
+        rungs["13b_mfu"] = detail["13b_layer"]["mfu"]
+    if "hd64_shape" in detail:
+        rungs["hd64_mfu"] = detail["hd64_shape"]["mfu"]
+    if "moe" in detail:
+        rungs["moe_active_mfu"] = detail["moe"]["active_mfu"]
+    if "moe_dropless" in detail:
+        rungs["moe_dropless_active_mfu"] = \
+            detail["moe_dropless"]["active_mfu"]
+        rungs["moe_dropless_pad_waste"] = \
+            detail["moe_dropless"]["pad_waste_frac"]
+    if "moe_skew_sweep" in detail:
+        mss = detail["moe_skew_sweep"]
+        rungs["moe_active_mfu"] = max(rungs.get("moe_active_mfu", 0.0),
+                                      mss["active_mfu"])
+        rungs["moe_skew_wire_ratio_zipf"] = \
+            mss["sweep"]["zipf"]["wire_vs_dense_ratio"]
+        if mss.get("overlap_fraction") is not None:
+            rungs["moe_a2a_overlap_fraction"] = mss["overlap_fraction"]
+    decode = detail.get("decode") or {}
+    if "hd64_pair_stack_ab" in decode:
+        rungs["decode_hd64_pair_stack_speedup"] = \
+            decode["hd64_pair_stack_ab"]["pair_stack_speedup"]
+    if "flagship_b8" in decode:
+        rungs["decode_flagship_b8_x_floor"] = \
+            decode["flagship_b8"]["x_of_floor"]
+        if "hd64_b8" in decode:
+            rungs["decode_hd64_b8_x_floor"] = \
+                decode["hd64_b8"]["x_of_floor"]
+    if "long_seq_flash_fwd" in detail:
+        ls = detail["long_seq_flash_fwd"]
+        for s_key, tag in (("S16384", "16k"), ("S32768", "32k"),
+                           ("S131072", "128k")):
+            if s_key in ls:
+                rungs[f"flash_fwd_eff_{tag}"] = ls[s_key]["attn_eff"]
+                rungs[f"flash_bwd_eff_{tag}"] = ls[s_key]["bwd_eff"]
+    if "packed_varlen_16seq_16k" in detail:
+        pv = detail["packed_varlen_16seq_16k"]
+        rungs["varlen_fwd_eff"] = pv["varlen_fwd_eff"]
+        rungs["varlen_bwd_eff"] = pv["varlen_bwd_eff"]
+        ca = pv.get("ceiling_ablation")
+        if ca:
+            rungs["varlen_fwd_eff_ceiling"] = ca["varlen_fwd_eff_ceiling"]
+            rungs["varlen_bwd_eff_ceiling"] = ca["varlen_bwd_eff_ceiling"]
+    if "serve_continuous" in detail:
+        sc = detail["serve_continuous"]
+        rungs["serve_tokens_per_sec"] = sc["tokens_per_sec"]
+        rungs["serve_tpot_p99_s"] = sc["tpot_p99_s"]
+    if "serve_overload" in detail:
+        so = detail["serve_overload"]
+        rungs["serve_overload_goodput_tps"] = so["goodput_tokens_per_sec"]
+        rungs["serve_overload_deterministic"] = bool(
+            so["shed_deterministic"] and so["streams_identical"]
+            and so["no_silent_drops"] and so["pool_leak_free"])
+        rungs["serve_admission_journal_pct"] = \
+            so["admission_journal_overhead_pct"]
+    if "serve_prefix_cache" in detail:
+        sp = detail["serve_prefix_cache"]
+        rungs["serve_prefix_hit_rate"] = sp["hit_rate"]
+        rungs["serve_prefix_ttft_p50_speedup"] = sp["ttft_p50_speedup"]
+        rungs["serve_prefix_clean"] = bool(
+            sp["cached_tokens_identical"] and sp["pool_leak_free"])
+    if "serve_kv_int8" in detail:
+        si = detail["serve_kv_int8"]
+        rungs["serve_kv_int8_concurrency_x"] = si["concurrency_ratio"]
+        rungs["serve_kv_int8_vs_fp16_x"] = si["fp16_equivalent_ratio"]
+        rungs["serve_kv_int8_decode_ms_ratio"] = si["decode_ms_ratio"]
+    if "fleet_observability" in detail:
+        fo = detail["fleet_observability"]
+        rungs["fleet_observability_pct"] = fo["fleet_overhead_pct"]
+        rungs["fleet_observability_clean"] = bool(
+            fo["monitored_losses_identical"] and fo["health_check_ok"])
+    if "ledger_roofline" in detail:
+        lr = detail["ledger_roofline"]
+        rungs["ledger_unattributed_frac"] = lr["unattributed_frac"]
+        rungs["ledger_overhead_pct"] = lr["ledger_overhead_pct"]
+        rungs["ledger_clean"] = bool(lr["ledger_losses_identical"])
+    return rungs
+
+
+# ---------------------------------------------------------------------------
+# baseline I/O
+# ---------------------------------------------------------------------------
+
+class TornBaseline(ValueError):
+    """The baseline file exists but is not a usable ratchet."""
+
+
+def load_baseline(path: Optional[str] = None) -> Dict:
+    """Parsed baseline, or {} when the file does not exist yet. Raises
+    :class:`TornBaseline` naming the defect when the file is torn
+    (unparseable JSON, wrong top-level shape, entries missing values)."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except ValueError as e:
+        raise TornBaseline(f"{path}: unparseable JSON ({e})")
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        raise TornBaseline(f"{path}: no 'entries' mapping")
+    for rung, entry in entries.items():
+        if not isinstance(entry, dict) or "value" not in entry:
+            raise TornBaseline(f"{path}: entry {rung!r} has no value")
+        if entry.get("direction") not in ("higher", "lower", "bool"):
+            raise TornBaseline(f"{path}: entry {rung!r} has no direction")
+    return data
+
+
+def write_baseline(rungs: Dict, path: Optional[str] = None,
+                   band: Optional[float] = None,
+                   prev: Optional[Dict] = None,
+                   source: str = "BENCH_DETAIL.json") -> Dict:
+    """Freeze ``rungs`` as the new baseline. Per-entry ``band`` /
+    ``direction`` overrides from a previous baseline survive for rungs
+    that persist (an operator-tuned band is deliberate state)."""
+    path = path or DEFAULT_BASELINE
+    band = band if band is not None else band_default()
+    prev_entries = (prev or {}).get("entries") or {}
+    entries = {}
+    for rung in sorted(rungs):
+        value = rungs[rung]
+        if value is None:
+            continue
+        old = prev_entries.get(rung) or {}
+        d = old.get("direction") or direction(rung, value)
+        entry = {"value": value, "direction": d}
+        if d != "bool":
+            entry["band"] = old.get("band", default_band(rung, band))
+        entries[rung] = entry
+    data = {
+        "_comment": ("perf ratchet baseline (regress --accept); --check "
+                     "fails on rungs worse than value by more than band "
+                     "and on stale entries; improvements pass without "
+                     "moving this file"),
+        "source": source,
+        "band_default": band,
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# the check
+# ---------------------------------------------------------------------------
+
+def check(rungs: Dict, baseline: Dict,
+          band: Optional[float] = None) -> Dict:
+    """Compare one rung record against a loaded baseline.
+
+    Returns ``{ok, regressed, stale, improved, unchanged, new, lines}``;
+    ``ok`` is False exactly when ``regressed`` or ``stale`` is non-empty.
+    """
+    fallback = band if band is not None else \
+        baseline.get("band_default", band_default())
+    entries = baseline.get("entries") or {}
+    regressed, stale, improved, unchanged, new = [], [], [], [], []
+    lines = []
+    for rung in sorted(entries):
+        entry = entries[rung]
+        base, d = entry["value"], entry["direction"]
+        if rung not in rungs or rungs[rung] is None:
+            stale.append(rung)
+            lines.append(f"STALE      {rung}: baseline {base} but the "
+                         f"record has no such rung (lost guard — re-run "
+                         f"the bench or --accept the removal)")
+            continue
+        val = rungs[rung]
+        if d == "bool":
+            if bool(base) and not bool(val):
+                regressed.append(rung)
+                lines.append(f"REGRESSED  {rung}: {base} -> {val}")
+            else:
+                (unchanged if bool(val) == bool(base)
+                 else improved).append(rung)
+                lines.append(f"ok         {rung}: {val}")
+            continue
+        b = entry.get("band", fallback)
+        if d == "lower":
+            worse = val > base * (1.0 + b)
+            better = val < base
+        else:
+            worse = val < base * (1.0 - b)
+            better = val > base
+        if worse:
+            regressed.append(rung)
+            lines.append(f"REGRESSED  {rung}: {base} -> {val} "
+                         f"({d} is better, band {b:.0%})")
+        elif better:
+            improved.append(rung)
+            lines.append(f"improved   {rung}: {base} -> {val} "
+                         f"(baseline unmoved)")
+        else:
+            unchanged.append(rung)
+            lines.append(f"ok         {rung}: {base} -> {val} "
+                         f"(within band {b:.0%})")
+    for rung in sorted(set(rungs) - set(entries)):
+        if rungs[rung] is None:
+            continue
+        new.append(rung)
+        lines.append(f"new        {rung}: {rungs[rung]} (not in baseline; "
+                     f"--accept to start guarding it)")
+    return {"ok": not regressed and not stale, "regressed": regressed,
+            "stale": stale, "improved": improved, "unchanged": unchanged,
+            "new": new, "lines": lines}
+
+
+def load_record(path: str) -> Dict:
+    """Flat rung record from a bench output file: the full
+    BENCH_DETAIL.json shape, the short summary-line shape, or an
+    already-flat {rung: value} mapping."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if "detail" in doc or "rungs" in doc or "metric" in doc:
+        return rungs_from_bench_detail(doc)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability.regress",
+        description="bench perf regression ratchet")
+    ap.add_argument("--check", action="store_true",
+                    help="compare the record against the baseline; exit 1 "
+                         "on regressions beyond band or stale entries")
+    ap.add_argument("--accept", action="store_true",
+                    help="move the baseline to the record's values "
+                         "(the ONLY way the baseline moves)")
+    ap.add_argument("--record", default=DEFAULT_RECORD,
+                    help="bench record (default: BENCH_DETAIL.json)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: PERF_BASELINE.json)")
+    ap.add_argument("--band", type=float, default=None,
+                    help="fractional noise band override (default: "
+                         "per-entry band, else PADDLE_TPU_REGRESS_BAND)")
+    args = ap.parse_args(argv)
+    if not args.check and not args.accept:
+        ap.error("one of --check / --accept is required")
+    try:
+        rungs = load_record(args.record)
+    except (OSError, ValueError) as e:
+        print(f"regress: cannot read record {args.record}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.accept:
+        try:
+            prev = load_baseline(args.baseline)
+        except TornBaseline:
+            prev = {}  # --accept is the repair path for a torn baseline
+        data = write_baseline(rungs, args.baseline, band=args.band,
+                              prev=prev, source=os.path.basename(
+                                  args.record))
+        print(f"regress: baseline {args.baseline} <- "
+              f"{len(data['entries'])} rungs from {args.record}")
+        if not args.check:
+            return 0
+    try:
+        baseline = load_baseline(args.baseline)
+    except TornBaseline as e:
+        print(f"regress: TORN baseline — {e}", file=sys.stderr)
+        return 1
+    if not baseline:
+        print(f"regress: no baseline at {args.baseline}; seed one with "
+              f"--accept", file=sys.stderr)
+        return 1
+    res = check(rungs, baseline, band=args.band)
+    for line in res["lines"]:
+        print(line)
+    print(f"regress: {len(res['unchanged'])} ok, "
+          f"{len(res['improved'])} improved, {len(res['new'])} new, "
+          f"{len(res['stale'])} stale, {len(res['regressed'])} regressed "
+          f"-> {'PASS' if res['ok'] else 'FAIL'}")
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

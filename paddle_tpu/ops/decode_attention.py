@@ -469,7 +469,8 @@ def decode_attend_update_slab(q_bd, new_k, new_v, k_cache, v_cache,
             cost_estimate=_cost_estimate(
                 flops=4 * b * nh * kvd * T,
                 transcendentals=b * nh * T,
-                bytes_accessed=2 * b * kvd * (T + block_t) * it),
+                bytes_accessed=2 * b * kvd * (T + block_t) * it,
+                name="decode.attend_update_slab"),
             interpret=_interpret(),
         )(lp, q_bd, new_k, new_v, k_cache, v_cache)
     return out, kc, vc
@@ -521,7 +522,8 @@ def decode_attention_slab(q_bd, k_cache, v_cache, layer, pos):
             cost_estimate=_cost_estimate(
                 flops=4 * b * b * nh * kvd * T,
                 transcendentals=b * nh * T,
-                bytes_accessed=2 * b * kvd * T * it),
+                bytes_accessed=2 * b * kvd * T * it,
+                name="decode.attention_slab"),
             interpret=_interpret(),
         )(lp, q_bd, k_cache, v_cache)
     return out
@@ -588,7 +590,8 @@ def _decode_attention_slab_pair(q_bd, k_cache, v_cache, layer, pos):
             cost_estimate=_cost_estimate(
                 flops=8 * b * b * kvd * T,
                 transcendentals=b * nh * T,
-                bytes_accessed=2 * b * kvd * T * it),
+                bytes_accessed=2 * b * kvd * T * it,
+                name="decode.slab_pair"),
             interpret=_interpret(),
         )(lp, q_bd, k_cache, v_cache)
     return out

@@ -54,15 +54,18 @@ def _fit_block(block, n):
     return min(block, -(-n // 128) * 128)
 
 
-def _attn_cost(bh, sp, skp, d, itemsize, causal, matmuls, extra_bytes=0):
+def _attn_cost(bh, sp, skp, d, itemsize, causal, matmuls, extra_bytes=0,
+               name=None):
     """pl.CostEstimate for a dense-attention kernel: `matmuls` [Sq, Sk]·D
     contractions over the (clamped-to-half under causal) score area, one
-    exp per score, and the q/k/v/o-sized HBM traffic."""
+    exp per score, and the q/k/v/o-sized HBM traffic. ``name`` is the
+    site's stable kernel name for ``kernel_cost_table`` attribution."""
     cf = 0.5 if causal else 1.0
     return _cost_estimate(
         flops=matmuls * 2 * bh * sp * skp * d * cf,
         transcendentals=bh * sp * skp * cf,
-        bytes_accessed=bh * (2 * sp + 2 * skp) * d * itemsize + extra_bytes)
+        bytes_accessed=bh * (2 * sp + 2 * skp) * d * itemsize + extra_bytes,
+        name=name)
 
 
 def _pad_rows(x, multiple):
@@ -510,7 +513,8 @@ def _flash_fwd_stream(qp, kp, vp, causal, block_q, block_k, sk,
                 pltpu.VMEM((block_q, d), jnp.float32),
             ],
             cost_estimate=_attn_cost(bh, sp, skp, d, qp.dtype.itemsize,
-                                     causal, matmuls=2),
+                                     causal, matmuls=2,
+                                     name="flash.fwd_stream"),
             interpret=_interpret(),
         )(*args)
 
@@ -580,7 +584,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
                 jax.ShapeDtypeStruct((bh, 1, sp), jnp.float32),
             ],
             cost_estimate=_attn_cost(bh, sp, skp, d, q.dtype.itemsize,
-                                     causal, matmuls=2),
+                                     causal, matmuls=2,
+                                     name="flash.fwd"),
             interpret=_interpret(),
         )(*args)
     return o[:, :s], lse.reshape(bh, sp)[:, :s]
@@ -955,7 +960,8 @@ def _bwd_fused_stream_chunk(qp, kp, vp, dop, lse3, delta3, causal,
                 vmem_limit_bytes=48 * 1024 * 1024),
             cost_estimate=_attn_cost(
                 bh, sp, skp, d, qp.dtype.itemsize, causal, matmuls=5,
-                extra_bytes=n_k * bh * sp * d * qp.dtype.itemsize),
+                extra_bytes=n_k * bh * sp * d * qp.dtype.itemsize,
+                name="flash.bwd_fused_stream"),
             interpret=_interpret(),
         )(*args)
     # Σ_j ds̃·K (scale applied by the caller after cross-chunk
@@ -1222,7 +1228,8 @@ def _bwd_fused_flat_call(qp, kp, vp, dop, lse3, delta3, causal, scale,
                 transcendentals=bh * n_flat * block_q * block_k,
                 bytes_accessed=(bh * n_flat
                                 * (2 * block_q + 2 * block_k) * d * it
-                                + bh * (sp + 2 * skp) * d * it)),
+                                + bh * (sp + 2 * skp) * d * it),
+                name="flash.bwd_fused_flat"),
             interpret=_interpret(),
         )(ki_a, qi_a, first_a, last_a, qp, kp, vp, dop, lse3, delta3)
     return dq, dk, dv
@@ -1342,7 +1349,8 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                     jax.ShapeDtypeStruct(vp.shape, vp.dtype),
                 ],
                 cost_estimate=_attn_cost(bh, sp, skp, d, item, causal,
-                                         matmuls=4),
+                                         matmuls=4,
+                                         name="flash.bwd_dkv"),
                 interpret=_interpret(),
             )(*args)
 
@@ -1372,7 +1380,8 @@ def _bwd_pallas_calls(qp, kp, vp, dop, lse3, delta3, causal, scale, block_q,
                                        lambda b, i: (b, i, 0)),
                 out_shape=jax.ShapeDtypeStruct(qp.shape, qp.dtype),
                 cost_estimate=_attn_cost(bh, sp, skp, d, item, causal,
-                                         matmuls=3),
+                                         matmuls=3,
+                                         name="flash.bwd_dq"),
                 interpret=_interpret(),
             )(*args)
     return dq, dk, dv

@@ -558,7 +558,8 @@ def _flash_varlen_fwd_stacked(q, k, v, cu_q, causal, scale, block_q,
                 flops=4 * h * n_flat * block_q * block_k * d,
                 transcendentals=h * n_flat * block_q * block_k,
                 bytes_accessed=(h * n_flat * (block_q + 2 * block_k) * d
-                                * it + h * tp * d * it)),
+                                * it + h * tp * d * it),
+                name="varlen.fwd_stacked"),
             interpret=_interpret(),
         )(qi_a, ki_a, first_a, last_a, live_a, qp, kp, vp, cq2d, ck2d)
     return o[:, :t], lse.reshape(h, tp)[:, :t]
@@ -708,7 +709,8 @@ def _flash_varlen_fwd_impl(q, k, v, cu_q, cu_k, causal, scale, block_q,
                 flops=4 * h * n_flat * block_q * block_k * d,
                 transcendentals=h * n_flat * block_q * block_k,
                 bytes_accessed=(h * n_flat * (block_q + 2 * block_k) * d
-                                * it + h * tp * d * it)),
+                                * it + h * tp * d * it),
+                name="varlen.fwd"),
             interpret=_interpret(),
         )(qi_a, ki_a, first_a, last_a, live_a, qp, kp, vp, cq2d, ck2d)
     return o[:, :t], lse.reshape(h, tp)[:, :t]
@@ -783,7 +785,8 @@ def _bwd_fused_call(qp, kp, vp, dop, lse3, delta3, cq2d, ck2d, ki_a, qi_a,
             flops=10 * h * n_flat * block_q * block_k * d,
             transcendentals=h * n_flat * block_q * block_k,
             bytes_accessed=(2 * h * n_flat * (block_q + block_k) * d * it
-                            + h * (tp + 2 * tkp) * d * it)),
+                            + h * (tp + 2 * tkp) * d * it),
+            name="varlen.bwd_fused"),
         interpret=_interpret(),
     )(ki_a, qi_a, first_a, last_a, live_a, qp, kp, vp, dop, lse3, delta3,
       cq2d, ck2d)
@@ -913,7 +916,8 @@ def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
                     flops=8 * h * n_flat * block_q * block_k * d,
                     transcendentals=h * n_flat * block_q * block_k,
                     bytes_accessed=(2 * h * n_flat * (block_q + block_k)
-                                    * d * it + 2 * h * tkp * d * it)),
+                                    * d * it + 2 * h * tkp * d * it),
+                    name="varlen.bwd_dkv"),
                 interpret=_interpret(),
             )(ki_a, qi_a, first_a, last_a, live_a, qp, kp, vp, dop, lse3,
               delta3, cq2d, ck2d)
@@ -970,7 +974,8 @@ def _flash_varlen_bwd(causal, scale, block_q, block_k, self_attn,
                     flops=6 * h * n_flat_q * block_q * block_k * d,
                     transcendentals=h * n_flat_q * block_q * block_k,
                     bytes_accessed=(2 * h * n_flat_q * (block_q + block_k)
-                                    * d * it + h * tp * d * it)),
+                                    * d * it + h * tp * d * it),
+                    name="varlen.bwd_dq"),
                 interpret=_interpret(),
             )(qi_b, ki_b, first_b, last_b, live_b, qp, kp, vp, dop, lse3,
               delta3, cq2d, ck2d)

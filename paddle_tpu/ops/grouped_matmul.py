@@ -198,7 +198,8 @@ def _gmm_fwd_call(lhs, rhs, sched, tile_rows):
             cost_estimate=_cost_estimate(
                 flops=2 * m * k * n,
                 bytes_accessed=(m * k + E * k * n) * it
-                + m * n * jnp.dtype(out_dtype).itemsize),
+                + m * n * jnp.dtype(out_dtype).itemsize,
+                name="gmm.fwd"),
             interpret=_interpret(),
         )(*_sched_i32(sched), lhs, rhs)
 
@@ -230,7 +231,8 @@ def _gmm_dx_call(dout, rhs, sched, tile_rows, dx_dtype):
             cost_estimate=_cost_estimate(
                 flops=2 * m * k * n,
                 bytes_accessed=(m * n + E * k * n) * it
-                + m * k * jnp.dtype(dx_dtype).itemsize),
+                + m * k * jnp.dtype(dx_dtype).itemsize,
+                name="gmm.dx"),
             interpret=_interpret(),
         )(*_sched_i32(sched), dout, rhs)
 
@@ -277,7 +279,8 @@ def _gmm_dw_call(lhs, dout, sched, tile_rows, E, dw_dtype):
             cost_estimate=_cost_estimate(
                 flops=2 * m * k * n,
                 bytes_accessed=m * (k + n) * it
-                + E * k * n * jnp.dtype(dw_dtype).itemsize),
+                + E * k * n * jnp.dtype(dw_dtype).itemsize,
+                name="gmm.dw"),
             interpret=_interpret(),
         )(*_sched_i32(sched), lhs, dout)
 

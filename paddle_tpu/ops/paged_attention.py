@@ -209,7 +209,8 @@ def paged_attention(q_bd, k_pool, v_pool, tables, lengths, layer, *,
             cost_estimate=_cost_estimate(
                 flops=4 * nh * kvd * bs * n_steps,
                 transcendentals=nh * bs * n_steps,
-                bytes_accessed=2 * kvd * bs * it * n_steps),
+                bytes_accessed=2 * kvd * bs * it * n_steps,
+                name="paged.attention"),
             interpret=_interpret(),
         )(lp, sched, q_bd, k_pool, v_pool)
     return out
@@ -375,7 +376,8 @@ def paged_attend_update(q_bd, new_k, new_v, k_pool, v_pool, tables,
                 flops=4 * nh * kvd * bs * n_steps,
                 transcendentals=nh * bs * n_steps,
                 bytes_accessed=(2 * kvd * bs * it * n_steps
-                                + 4 * b * kvd * bs * it)),
+                                + 4 * b * kvd * bs * it),
+                name="paged.attend_update"),
             interpret=_interpret(),
         )(lp, sched, q_bd, new_k, new_v, k_pool, v_pool)
     return out, kp, vp
@@ -564,7 +566,8 @@ def paged_attention_quant(q_bd, k_pool, v_pool, k_scale, v_scale,
                 flops=(4 * nh * kvd * bs + 2 * kvd * bs) * n_steps,
                 transcendentals=nh * bs * n_steps,
                 bytes_accessed=(2 * kvd * bs * it
-                                + 2 * nkv * bs * 4) * n_steps),
+                                + 2 * nkv * bs * 4) * n_steps,
+                name="paged.attention_quant"),
             interpret=_interpret(),
         )(lp, sched, q_bd, k_pool, v_pool, k_scale, v_scale)
     return out
@@ -748,7 +751,8 @@ def paged_attend_update_quant(q_bd, new_k, new_v, new_ks, new_vs,
                 transcendentals=nh * bs * n_steps,
                 bytes_accessed=((2 * kvd * bs * it + 2 * nkv * bs * 4)
                                 * n_steps
-                                + 4 * b * (kvd + nkv) * bs * it)),
+                                + 4 * b * (kvd + nkv) * bs * it),
+                name="paged.attend_update_quant"),
             interpret=_interpret(),
         )(lp, sched, q_bd, new_k, new_v, new_ks, new_vs,
           k_pool, v_pool, k_scale, v_scale)

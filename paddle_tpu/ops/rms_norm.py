@@ -44,7 +44,8 @@ def _rms_fwd_impl(x2d, w, eps, block_rows):
             cost_estimate=_cost_estimate(
                 flops=4 * n * h,
                 transcendentals=n,
-                bytes_accessed=2 * n * h * jnp.dtype(x2d.dtype).itemsize),
+                bytes_accessed=2 * n * h * jnp.dtype(x2d.dtype).itemsize,
+                name="rms_norm.fwd"),
             interpret=_interpret(),
         )(x2d, w.reshape(1, h))
 

@@ -109,6 +109,65 @@ def test_flash_dense_decode_and_rmsnorm_report_costs():
     assert all(ce.transcendentals > 0 for ce in rec)
 
 
+def test_kernel_cost_table_covers_every_lint_floored_site():
+    """kernel_cost_table() keys every ops/ pallas_call cost site by a
+    STABLE kernel name: at least the PTA003 floor of sites, every one
+    carrying a name literal (an unnamed site would key as
+    '<module>:<line>' and silently churn on any edit)."""
+    from paddle_tpu.analysis.rules.pta003_cost_estimate import MIN_SITES
+    from paddle_tpu.ops._common import kernel_cost_table
+    table = kernel_cost_table()
+    static = {k: v for k, v in table.items() if v["module"] is not None}
+    assert len(static) >= MIN_SITES, (len(static), MIN_SITES)
+    unnamed = [k for k, v in static.items() if not v["named"]]
+    assert not unnamed, f"cost sites without name=: {unnamed}"
+    # names are the ledger join key — they must be unique by construction
+    # (dict keys) AND follow the '<module-ish>.<kernel>' convention
+    assert all("." in k for k in static), sorted(static)
+
+
+def test_kernel_cost_table_observes_traced_values():
+    from paddle_tpu.ops import _common
+    from paddle_tpu.ops.rms_norm import fused_rms_norm
+    _common.reset_kernel_costs()
+    before = _common.kernel_cost_table()["rms_norm.fwd"]
+    assert before["calls"] == 0 and before["flops"] is None
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 128), jnp.float32)
+    fused_rms_norm(x, jnp.ones((128,), jnp.float32)).block_until_ready()
+    after = _common.kernel_cost_table()["rms_norm.fwd"]
+    assert after["calls"] >= 1
+    assert after["flops"] > 0 and after["bytes_accessed"] > 0
+    assert after["transcendentals"] > 0
+    _common.reset_kernel_costs()
+
+
+def test_kernel_costs_window_delta():
+    """snapshot/since: the window delta over the cumulative totals is the
+    exact per-program kernel cost — a site fired L times inside the
+    window reports L calls and L-fold summed FLOPs."""
+    from paddle_tpu.ops import _common
+    from paddle_tpu.ops.rms_norm import fused_rms_norm
+    _common.reset_kernel_costs()
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 128), jnp.float32)
+    w = jnp.ones((128,), jnp.float32)
+    fused_rms_norm(x, w).block_until_ready()  # outside the window
+    snap = _common.snapshot_kernel_costs()
+    one = _common.kernel_cost_table()["rms_norm.fwd"]["flops"]
+
+    def three(x, w):
+        return (fused_rms_norm(x, w) + fused_rms_norm(x + 1, w)
+                + fused_rms_norm(x + 2, w))
+
+    jax.jit(three).lower(x, w)  # trace fires the site 3x; no execution
+    delta = _common.kernel_costs_since(snap)
+    assert delta["rms_norm.fwd"]["calls"] == 3
+    assert delta["rms_norm.fwd"]["flops"] == 3 * one
+    # an empty window reports nothing
+    assert _common.kernel_costs_since(
+        _common.snapshot_kernel_costs()) == {}
+    _common.reset_kernel_costs()
+
+
 def test_mfu_rises_when_kernel_flops_are_counted():
     """End-to-end attribution: a step whose cost analysis sees only the
     non-kernel FLOPs (what an estimate-less custom call yields) must

@@ -1,0 +1,224 @@
+"""Regression-ratchet tests: baseline round-trip, band semantics (worse
+beyond band fails, improvements pass WITHOUT moving the baseline), the
+--accept-only baseline move, torn/stale detection, the direction/band
+heuristics, the one detail->rungs mapping, and the CLI exit codes."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.observability import regress as rg
+
+
+def _write(path, doc):
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    return str(path)
+
+
+def _seed(tmp_path, rungs, **kw):
+    base = str(tmp_path / "baseline.json")
+    rg.write_baseline(rungs, path=base, **kw)
+    return base
+
+
+# -- direction / band heuristics ---------------------------------------------
+
+def test_direction_heuristics():
+    assert rg.direction("llama_train_mfu") == "higher"
+    assert rg.direction("serve_tpot_p99_s") == "lower"
+    assert rg.direction("ledger_overhead_pct") == "lower"
+    assert rg.direction("ledger_unattributed_frac") == "lower"
+    assert rg.direction("decode_flagship_b8_x_floor") == "lower"
+    assert rg.direction("serve_kv_int8_decode_ms_ratio") == "lower"
+    # an actual bool value pins the gate regardless of name
+    assert rg.direction("serve_tpot_p99_s", value=True) == "bool"
+
+
+def test_default_band_widens_noisy_rungs():
+    assert rg.default_band("serve_tokens_per_sec", 0.15) == 0.5
+    assert rg.default_band("serve_tpot_p99_s", 0.15) == 0.5
+    assert rg.default_band("ledger_unattributed_frac", 0.15) == 0.15
+    assert rg.default_band("7b_mfu", 0.15) == 0.15
+    # an operator-widened default is never narrowed
+    assert rg.default_band("serve_tokens_per_sec", 0.8) == 0.8
+
+
+def test_band_env_knob(monkeypatch):
+    monkeypatch.delenv(rg.ENV_REGRESS_BAND, raising=False)
+    assert rg.band_default() == 0.15
+    monkeypatch.setenv(rg.ENV_REGRESS_BAND, "0.25")
+    assert rg.band_default() == 0.25
+
+
+# -- baseline I/O -------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    rungs = {"7b_mfu": 0.41, "serve_tpot_p99_s": 0.02,
+             "ledger_clean": True, "skipped": None}
+    base = _seed(tmp_path, rungs, band=0.15)
+    data = rg.load_baseline(base)
+    e = data["entries"]
+    assert set(e) == {"7b_mfu", "serve_tpot_p99_s", "ledger_clean"}
+    assert e["7b_mfu"] == {"value": 0.41, "direction": "higher",
+                           "band": 0.15}
+    assert e["serve_tpot_p99_s"]["direction"] == "lower"
+    assert e["serve_tpot_p99_s"]["band"] == 0.5  # noisy timing rung
+    # bool gates carry no band
+    assert e["ledger_clean"] == {"value": True, "direction": "bool"}
+
+
+def test_write_baseline_preserves_operator_band_and_direction(tmp_path):
+    base = _seed(tmp_path, {"7b_mfu": 0.41}, band=0.15)
+    prev = rg.load_baseline(base)
+    prev["entries"]["7b_mfu"]["band"] = 0.33  # operator-tuned
+    rg.write_baseline({"7b_mfu": 0.44, "new_rung": 1.0}, path=base,
+                      band=0.15, prev=prev)
+    e = rg.load_baseline(base)["entries"]
+    assert e["7b_mfu"] == {"value": 0.44, "direction": "higher",
+                           "band": 0.33}
+    assert e["new_rung"]["band"] == 0.15
+
+
+def test_load_baseline_missing_is_empty(tmp_path):
+    assert rg.load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+@pytest.mark.parametrize("doc, defect", [
+    ("{not json", "unparseable"),
+    ({"entries": [1, 2]}, "no 'entries'"),
+    ({"entries": {"r": {"direction": "higher"}}}, "no value"),
+    ({"entries": {"r": {"value": 1.0}}}, "no direction"),
+    ({"entries": {"r": {"value": 1.0, "direction": "sideways"}}},
+     "no direction"),
+])
+def test_torn_baseline_named(tmp_path, doc, defect):
+    path = tmp_path / "torn.json"
+    if isinstance(doc, str):
+        path.write_text(doc)
+    else:
+        _write(path, doc)
+    with pytest.raises(rg.TornBaseline, match=defect):
+        rg.load_baseline(str(path))
+
+
+# -- check semantics ----------------------------------------------------------
+
+def test_regression_beyond_band_fails_within_band_passes(tmp_path):
+    base = rg.load_baseline(_seed(tmp_path, {"7b_mfu": 0.40}, band=0.10))
+    ok = rg.check({"7b_mfu": 0.37}, base)          # -7.5%: inside band
+    assert ok["ok"] and ok["unchanged"] == ["7b_mfu"]
+    bad = rg.check({"7b_mfu": 0.30}, base)         # -25%: beyond band
+    assert not bad["ok"] and bad["regressed"] == ["7b_mfu"]
+
+
+def test_lower_is_better_band_is_one_sided(tmp_path):
+    base = rg.load_baseline(_seed(
+        tmp_path, {"ledger_overhead_pct": 1.0}, band=0.10))
+    assert rg.check({"ledger_overhead_pct": 1.05}, base)["ok"]
+    res = rg.check({"ledger_overhead_pct": 1.5}, base)
+    assert not res["ok"] and res["regressed"] == ["ledger_overhead_pct"]
+
+
+def test_improvement_passes_without_moving_baseline(tmp_path):
+    path = _seed(tmp_path, {"7b_mfu": 0.40}, band=0.10)
+    before = open(path).read()
+    res = rg.check({"7b_mfu": 0.55}, rg.load_baseline(path))
+    assert res["ok"] and res["improved"] == ["7b_mfu"]
+    assert any("baseline unmoved" in l for l in res["lines"])
+    assert open(path).read() == before  # a lucky run can't raise the bar
+
+
+def test_bool_gate_regression_and_repair(tmp_path):
+    base = rg.load_baseline(_seed(tmp_path, {"clean": True,
+                                             "was_broken": False}))
+    res = rg.check({"clean": False, "was_broken": True}, base)
+    # true->false regresses; false->true is an improvement, not a trip
+    assert res["regressed"] == ["clean"]
+    assert res["improved"] == ["was_broken"]
+
+
+def test_stale_entry_fails_new_rung_does_not(tmp_path):
+    base = rg.load_baseline(_seed(tmp_path, {"7b_mfu": 0.40}, band=0.10))
+    res = rg.check({"fresh_rung": 9.0}, base)
+    assert not res["ok"]
+    assert res["stale"] == ["7b_mfu"] and res["new"] == ["fresh_rung"]
+    assert any("lost guard" in l for l in res["lines"])
+    ok = rg.check({"7b_mfu": 0.40, "fresh_rung": 9.0}, base)
+    assert ok["ok"] and ok["new"] == ["fresh_rung"]
+
+
+# -- the one detail->rungs mapping --------------------------------------------
+
+def test_rungs_from_bench_detail_ledger_section():
+    doc = {"metric": "llama_train_mfu", "value": 0.42,
+           "detail": {"ledger_roofline": {
+               "unattributed_frac": 0.31, "ledger_overhead_pct": 0.12,
+               "ledger_losses_identical": True, "steps": 8}}}
+    rungs = rg.rungs_from_bench_detail(doc)
+    assert rungs["llama_train_mfu"] == 0.42
+    assert rungs["ledger_unattributed_frac"] == 0.31
+    assert rungs["ledger_overhead_pct"] == 0.12
+    assert rungs["ledger_clean"] is True
+
+
+def test_rungs_from_summary_line_shape():
+    doc = {"metric": "llama_train_mfu", "value": 0.42,
+           "rungs": {"7b_mfu": 0.4}}
+    assert rg.rungs_from_bench_detail(doc) == {"llama_train_mfu": 0.42,
+                                               "7b_mfu": 0.4}
+
+
+def test_load_record_flat_mapping(tmp_path):
+    path = _write(tmp_path / "flat.json", {"7b_mfu": 0.4})
+    assert rg.load_record(path) == {"7b_mfu": 0.4}
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_accept_then_check_then_injected_regression(tmp_path, capsys):
+    rec = _write(tmp_path / "rec.json", {"7b_mfu": 0.40,
+                                         "ledger_clean": True})
+    base = str(tmp_path / "baseline.json")
+    # no baseline yet: --check refuses, --accept is the only seed path
+    assert rg.main(["--check", "--record", rec, "--baseline", base]) == 1
+    assert rg.main(["--accept", "--record", rec, "--baseline", base]) == 0
+    assert rg.main(["--check", "--record", rec, "--baseline", base]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out
+    # an injected synthetic regression trips the gate
+    bad = _write(tmp_path / "bad.json", {"7b_mfu": 0.10,
+                                         "ledger_clean": False})
+    assert rg.main(["--check", "--record", bad, "--baseline", base]) == 1
+    assert "FAIL" in capsys.readouterr().out
+    # --accept (and only --accept) moves the baseline down
+    assert rg.main(["--accept", "--record", bad, "--baseline", base]) == 0
+    assert rg.main(["--check", "--record", bad, "--baseline", base]) == 0
+
+
+def test_cli_unreadable_record_exit_2(tmp_path):
+    assert rg.main(["--check", "--record", str(tmp_path / "nope.json"),
+                    "--baseline", str(tmp_path / "b.json")]) == 2
+
+
+def test_cli_torn_baseline_exit_1(tmp_path, capsys):
+    rec = _write(tmp_path / "rec.json", {"7b_mfu": 0.4})
+    torn = tmp_path / "torn.json"
+    torn.write_text("{not json")
+    assert rg.main(["--check", "--record", rec,
+                    "--baseline", str(torn)]) == 1
+    assert "TORN" in capsys.readouterr().err
+    # --accept repairs a torn baseline
+    assert rg.main(["--accept", "--record", rec,
+                    "--baseline", str(torn)]) == 0
+    assert rg.main(["--check", "--record", rec,
+                    "--baseline", str(torn)]) == 0
+
+
+def test_checked_in_baseline_is_loadable_and_covers_ledger_rungs():
+    data = rg.load_baseline()  # repo PERF_BASELINE.json; raises if torn
+    entries = data["entries"]
+    assert {"ledger_unattributed_frac", "ledger_overhead_pct",
+            "ledger_clean"} <= set(entries)
+    assert entries["ledger_clean"]["direction"] == "bool"
+    assert entries["ledger_overhead_pct"]["direction"] == "lower"

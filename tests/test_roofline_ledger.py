@@ -1,0 +1,333 @@
+"""RooflineLedger tests: classification math, the explicit unattributed
+remainder line, model- vs measured-mode feeds, the TrainStep integration
+(bit-identical losses with the ledger on), peak-FLOPs provenance, env
+gating, the device-trace merge, and the flagship component specs."""
+import gzip
+import json
+import os
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.observability import ledger as led
+from paddle_tpu.observability import metrics as met
+from paddle_tpu.observability.ledger import (RooflineLedger, ledger_dir,
+                                             ledger_enabled,
+                                             merge_device_trace)
+from paddle_tpu.optimizer import AdamW
+
+
+def _ledger(**kw):
+    kw.setdefault("peak_flops", 1e12)   # 1 TFLOP/s -> 1e9 flops = 1 ms
+    kw.setdefault("hbm_bw", 1e9)        # 1 GB/s    -> 1e6 bytes = 1 ms
+    return RooflineLedger(name="t", **kw)
+
+
+# -- classification -----------------------------------------------------------
+
+def test_classify_compute_vs_memory_bound():
+    lg = _ledger()
+    c = lg.classify(flops=1e9, bytes_accessed=10)
+    assert c["bound"] == "compute"
+    np.testing.assert_allclose(c["compute_ms"], 1.0)
+    np.testing.assert_allclose(c["roofline_ms"], 1.0)
+    m = lg.classify(flops=10, bytes_accessed=1e6)
+    assert m["bound"] == "memory"
+    np.testing.assert_allclose(m["memory_ms"], 1.0)
+    np.testing.assert_allclose(m["roofline_ms"], 1.0)
+    # roofline time is the MAX of the two — never the sum
+    both = lg.classify(flops=2e9, bytes_accessed=1e6)
+    np.testing.assert_allclose(both["roofline_ms"], 2.0)
+    assert both["bound"] == "compute"
+
+
+def test_classify_unknown_platform_degrades_to_unknown():
+    lg = _ledger()
+    lg.peak_flops = lg.hbm_bw = None
+    c = lg.classify(1e9, 1e6)
+    assert c == {"compute_ms": None, "memory_ms": None,
+                 "bound": "unknown", "roofline_ms": None}
+
+
+def test_hbm_bw_table_and_unknown_kind():
+    class Dev:
+        device_kind = "TPU v4"
+    bw, src = led.hbm_bw_per_device(Dev())
+    assert bw == 1228e9 and src == "table:v4"
+
+    class Weird:
+        device_kind = "quantum-abacus"
+    bw, src = led.hbm_bw_per_device(Weird())
+    assert bw is None and src == "unknown:quantum-abacus"
+
+
+# -- report shape + the explicit remainder line -------------------------------
+
+def test_report_has_explicit_unattributed_remainder_line():
+    lg = _ledger()
+    lg.add("matmul", flops=4e9, bytes_accessed=100, time_ms=6.0)
+    rep = lg.report(step_time_ms=10.0)
+    assert rep["step_ms"] == 10.0
+    assert rep["attributed_ms"] == 6.0
+    np.testing.assert_allclose(rep["unattributed_ms"], 4.0)
+    np.testing.assert_allclose(rep["unattributed_frac"], 0.4)
+    rem = rep["lines"][-1]
+    assert rem["name"] == "unattributed"
+    assert rem["bound"] == "remainder"
+    np.testing.assert_allclose(rem["attributed_ms"], 4.0)
+    np.testing.assert_allclose(rem["frac_of_step"], 0.4)
+    # ... and it renders in report_lines like any other row
+    text = "\n".join(lg.report_lines(10.0))
+    assert "unattributed" in text and "[remainder]" in text
+
+
+def test_report_remainder_clamps_at_zero():
+    lg = _ledger()
+    lg.add("matmul", flops=1.0, time_ms=12.0)  # attributes MORE than step
+    rep = lg.report(step_time_ms=10.0)
+    assert rep["unattributed_ms"] == 0.0
+    assert rep["unattributed_frac"] == 0.0
+
+
+def test_measured_mode_achieved_frac():
+    lg = _ledger()
+    lg.add("matmul", flops=1e9, time_ms=2.0)   # roofline 1 ms, ran in 2 ms
+    line = lg.report(step_time_ms=4.0)["lines"][0]
+    assert line["measured"] is True
+    np.testing.assert_allclose(line["achieved_frac"], 0.5)
+    np.testing.assert_allclose(line["frac_of_step"], 0.5)
+
+
+def test_model_mode_ingest_uses_roofline_time():
+    lg = _ledger()
+    n = lg.ingest({"rms_norm.fwd": {"calls": 3, "flops": 1e9,
+                                    "bytes_accessed": 10,
+                                    "transcendentals": 5.0},
+                   "never_ran": {"calls": 0, "flops": 1e12}})
+    assert n == 1  # zero-call entries are not lines
+    line = lg.report(step_time_ms=2.0)["lines"][0]
+    assert line["name"] == "rms_norm.fwd" and line["calls"] == 3
+    assert line["measured"] is False and line["time_ms"] is None
+    # attribution falls back to the roofline (optimistic-floor) time
+    np.testing.assert_allclose(line["attributed_ms"], 1.0)
+    np.testing.assert_allclose(line["frac_of_step"], 0.5)
+
+
+def test_on_step_window_and_best_of():
+    lg = _ledger()
+    for s in (0.004, 0.002, 0.003, 0.0, -1.0):  # non-positive ignored
+        lg.on_step(s)
+    assert lg.steps == 5
+    np.testing.assert_allclose(lg.step_time_ms(), 2.0)
+    # report with no explicit step time uses the recorded best
+    np.testing.assert_allclose(lg.report()["step_ms"], 2.0)
+
+
+def test_write_appends_jsonl(tmp_path):
+    lg = _ledger()
+    lg.add("k", flops=1e9, time_ms=1.5)
+    path = str(tmp_path / "sub" / "ledger.jsonl")
+    assert lg.write(path=path, step_time_ms=3.0) == path
+    lg.write(path=path, step_time_ms=3.0)
+    recs = [json.loads(l) for l in open(path)]
+    assert len(recs) == 2
+    assert recs[0]["lines"][-1]["name"] == "unattributed"
+
+
+# -- env gating ---------------------------------------------------------------
+
+def test_ledger_env_gating(tmp_path, monkeypatch):
+    monkeypatch.delenv(led.ENV_LEDGER, raising=False)
+    assert ledger_enabled() is False
+    monkeypatch.setenv(led.ENV_LEDGER, "1")
+    assert ledger_enabled() is True
+    assert ledger_enabled(explicit=False) is False  # explicit arg wins
+    monkeypatch.setenv(led.ENV_LEDGER, "0")
+    assert ledger_enabled() is False
+    assert ledger_enabled(explicit=True) is True
+    monkeypatch.setenv(led.ENV_LEDGER_DIR, str(tmp_path))
+    assert ledger_dir() == str(tmp_path)
+
+
+# -- peak-FLOPs provenance (StepMetrics satellite) ----------------------------
+
+def test_peak_flops_unknown_platform_warns_once_naming_it(monkeypatch):
+    monkeypatch.delenv(met.ENV_PEAK_FLOPS, raising=False)
+
+    class Dev:
+        device_kind = "quantum-abacus"
+    met._PEAK_WARNED.discard("quantum-abacus")
+    with pytest.warns(UserWarning, match="quantum-abacus"):
+        flops, src = met.peak_flops_info(Dev())
+    assert flops is None and src == "unknown:quantum-abacus"
+    # once per run: the second lookup is silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        flops, src = met.peak_flops_info(Dev())
+    assert flops is None and src == "unknown:quantum-abacus"
+    met._PEAK_WARNED.discard("quantum-abacus")
+
+
+def test_step_metrics_records_carry_mfu_peak_source():
+    m = met.StepMetrics("t", n_devices=1, peak_flops=1e12)
+    assert m.mfu_peak_source == "arg"
+    rec = m.step(step_time_s=1e-3, tokens=4)
+    assert rec["mfu_peak_source"] == "arg"
+    assert m.summary()["mfu_peak_source"] == "arg"
+
+
+def test_peak_flops_env_override_wins(monkeypatch):
+    monkeypatch.setenv(met.ENV_PEAK_FLOPS, "2.5e12")
+    flops, src = met.peak_flops_info()
+    assert flops == 2.5e12 and src == "env"
+
+
+# -- TrainStep integration: measurement-only ----------------------------------
+
+def _run_tiny(n_calls=6, **kw):
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    opt = AdamW(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, lambda o, l: paddle.mean((o - l) ** 2), opt,
+                     **kw)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+    losses = [float(step(x, labels=y)) for _ in range(n_calls)]
+    return step, losses
+
+
+def test_train_step_ledger_losses_bit_identical(monkeypatch):
+    monkeypatch.delenv(led.ENV_LEDGER, raising=False)
+    step_off, losses_off = _run_tiny()
+    assert step_off.ledger is None  # off by default
+    step_on, losses_on = _run_tiny(ledger=True)
+    assert isinstance(step_on.ledger, RooflineLedger)
+    # the measurement-only contract: EXACT equality, not allclose
+    assert losses_on == losses_off
+    # and the ledger actually observed the run
+    assert step_on.ledger.steps >= 1
+    rep = step_on.ledger.report()
+    assert rep["step_ms"] and rep["lines"][-1]["name"] == "unattributed"
+
+
+def test_train_step_ledger_instance_arg_wins(monkeypatch):
+    monkeypatch.delenv(led.ENV_LEDGER, raising=False)
+    mine = RooflineLedger(name="mine")
+    step, _ = _run_tiny(n_calls=3, ledger=mine)
+    assert step.ledger is mine and mine.steps >= 1
+
+
+# -- device-trace merge -------------------------------------------------------
+
+def _fake_profile_dir(tmp_path, events):
+    d = tmp_path / "prof" / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "host.trace.json.gz", "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return str(tmp_path / "prof")
+
+
+def test_merge_device_trace_min_ts_alignment(tmp_path):
+    dev = [{"name": "fusion.1", "ph": "X", "pid": 1, "tid": 0,
+            "ts": 1000, "dur": 50},
+           {"name": "fusion.2", "ph": "X", "pid": 1, "tid": 0,
+            "ts": 1500, "dur": 20},
+           {"name": "process_name", "ph": "M", "pid": 1,
+            "args": {"name": "device"}}]
+    host = [{"name": "step", "ph": "X", "pid": 0, "tid": 0,
+             "ts": 500000, "dur": 900}]
+    out_path = str(tmp_path / "merged.json")
+    res = merge_device_trace(_fake_profile_dir(tmp_path, dev),
+                             host_events=host, out_path=out_path)
+    assert res["device_events"] == 3 and res["host_events"] == 1
+    assert res["aligned_on"] is None and res["out_path"] == out_path
+    tr = json.load(open(out_path))["traceEvents"]
+    by_name = {e["name"]: e for e in tr if e.get("ph") != "M"}
+    # both streams re-zeroed on their own earliest event
+    assert by_name["fusion.1"]["ts"] == 0
+    assert by_name["fusion.2"]["ts"] == 500
+    assert by_name["step"]["ts"] == 0
+    # host spans live on the dedicated host pid row
+    assert by_name["step"]["pid"] == led._HOST_PID
+    meta = [e for e in tr if e.get("ph") == "M"
+            and e.get("pid") == led._HOST_PID]
+    assert meta and meta[0]["args"]["name"].startswith("host")
+
+
+def test_merge_device_trace_align_on_shared_span(tmp_path):
+    dev = [{"name": "warmup", "ph": "X", "pid": 1, "ts": 100, "dur": 5},
+           {"name": "jit_step7/decoder.attn", "ph": "X", "pid": 1,
+            "ts": 1500, "dur": 80}]
+    host = [{"name": "setup", "ph": "X", "pid": 0, "ts": 7000, "dur": 10},
+            {"name": "step7", "ph": "X", "pid": 0, "ts": 9000, "dur": 100}]
+    res = merge_device_trace(_fake_profile_dir(tmp_path, dev),
+                             host_events=host, align_on="step7")
+    assert res["aligned_on"] == "step7"
+    by_name = {e["name"]: e for e in res["events"] if e.get("ph") != "M"}
+    # the shared span's first occurrence is pinned to t=0 on BOTH sides
+    assert by_name["jit_step7/decoder.attn"]["ts"] == 0
+    assert by_name["step7"]["ts"] == 0
+    assert by_name["warmup"]["ts"] == -1400
+    assert by_name["setup"]["ts"] == -2000
+
+
+def test_merge_device_trace_missing_align_falls_back(tmp_path):
+    dev = [{"name": "fusion.1", "ph": "X", "pid": 1, "ts": 300, "dur": 5}]
+    res = merge_device_trace(_fake_profile_dir(tmp_path, dev),
+                             host_events=[], align_on="nowhere")
+    assert res["aligned_on"] is None
+    by_name = {e["name"]: e for e in res["events"] if e.get("ph") != "M"}
+    assert by_name["fusion.1"]["ts"] == 0
+
+
+# -- flagship component specs -------------------------------------------------
+
+def test_flagship_component_specs_shape_and_runnable():
+    from paddle_tpu.models.llama import llama_tiny
+    config = llama_tiny(vocab=64, hidden=32, layers=2, heads=2, kv_heads=2,
+                        inter=64, seq=32)
+    specs = led.flagship_component_specs(config, batch=2, seq=32,
+                                         use_flash=False)
+    names = [s["name"] for s in specs]
+    assert names == ["attention_fwd", "attention_bwd", "ffn_fwd",
+                     "ffn_bwd", "qkvo_proj_fwd", "qkvo_proj_bwd",
+                     "lm_head_loss_fwd", "lm_head_loss_bwd", "optimizer"]
+    for s in specs:
+        assert set(s) == {"name", "build", "mult", "flops",
+                          "bytes_accessed", "transcendentals"}
+        assert s["flops"] > 0 and s["bytes_accessed"] > 0
+        assert s["mult"] >= 1
+    # per-layer components scale by L; bwd costs exceed fwd
+    assert specs[0]["mult"] == config.num_hidden_layers
+    assert specs[1]["flops"] > specs[0]["flops"]
+    # a build() hands back (fn, args) the caller's timer can run
+    fn, args = specs[2]["build"]()  # ffn_fwd
+    out = jax.jit(fn)(*args)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    fn, args = specs[8]["build"]()  # optimizer
+    p2, m2, v2 = jax.jit(fn)(*args)
+    assert p2.shape == args[0].shape
+
+
+def test_flagship_specs_feed_measured_ledger():
+    from paddle_tpu.models.llama import llama_tiny
+    config = llama_tiny(vocab=64, hidden=32, layers=2, heads=2, kv_heads=2,
+                        inter=64, seq=32)
+    lg = _ledger()
+    for s in led.flagship_component_specs(config, 2, 32, use_flash=False):
+        lg.add(s["name"], flops=s["mult"] * s["flops"],
+               bytes_accessed=s["mult"] * s["bytes_accessed"],
+               transcendentals=s["mult"] * s["transcendentals"],
+               time_ms=s["mult"] * 0.1, calls=s["mult"])
+    rep = lg.report(step_time_ms=2.0)
+    assert len(rep["lines"]) == 9 + 1  # components + remainder
+    assert all(l["achieved_frac"] is not None
+               for l in rep["lines"][:-1])
+    assert all(l["bound"] in ("compute", "memory")
+               for l in rep["lines"][:-1])
