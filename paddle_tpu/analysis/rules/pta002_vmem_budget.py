@@ -46,7 +46,8 @@ _DTYPE_SIZES = {
 # names a block-shape element may come from to mark the site fitter-sized
 FITTER_PREFIX = "_fit"
 REGISTERED_FITTERS = frozenset({"_fit_block_t", "_fit_bwd_flat_blocks",
-                               "_fit_paged_kv_blocks"})
+                               "_fit_paged_kv_blocks",
+                               "_fit_paged_verify_blocks"})
 
 
 def _is_fitter(name):

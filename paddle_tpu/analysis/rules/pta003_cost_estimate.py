@@ -17,8 +17,9 @@ from .._astutil import call_ident, keyword
 
 # flash fwd/bwd (resident, streaming, fused flat, split pair), varlen
 # fwd/bwd (streaming + stacked + fused + split), decode slabs, rms_norm,
-# grouped matmul x3, paged attention read + fused update
-MIN_SITES = 14
+# grouped matmul x3, paged attention read + fused update + the PR-18
+# speculative family (verify read fp/int8, verify commit fp/int8)
+MIN_SITES = 18
 
 
 @register

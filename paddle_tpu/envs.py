@@ -479,6 +479,30 @@ _register(
     choices=("auto", "int8"))
 
 _register(
+    "PADDLE_TPU_SERVE_SPEC", "bool", False,
+    doc="Greedy speculative decoding in the serving engine (PR 18): a "
+        "small draft model (default: the base truncated to its first "
+        "layer, embedding shared) proposes up to K tokens per sequence "
+        "per iteration and ONE batched multi-token verification pass "
+        "scores all K+1 positions, committing only the accepted "
+        "prefix's KV. Every emitted token is the BASE model's greedy "
+        "argmax, so streams are bit-identical to sequential decode "
+        "(PARITY.md) — speculation only moves latency. Default OFF; "
+        "ServeConfig(speculative=) wins.",
+    parse=_strict_bool("PADDLE_TPU_SERVE_SPEC"))
+
+_register(
+    "PADDLE_TPU_SERVE_SPEC_K", "int", 4,
+    doc="Draft proposal depth K for speculative serving (PR 18): up to "
+        "K lookahead tokens are proposed and K+1 positions verified "
+        "per sequence per iteration. Higher K amortizes more scheduler "
+        "iterations per verified span at the cost of wasted draft work "
+        "when acceptance is low. The verify program's token width is "
+        "pinned at K+1, so K is part of the bounded compiled-shape "
+        "family. ServeConfig(draft_k=) wins.",
+    parse=_positive_int("PADDLE_TPU_SERVE_SPEC_K", 4))
+
+_register(
     "PADDLE_TPU_FLEET", "bool", False,
     doc="Wire a FleetMonitor (PR 15) into jit.TrainStep: per-rank step "
         "times, per-site comm_span hop stats and all-device memory are "

@@ -76,8 +76,10 @@ from ..testing import faults
 from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
                             _jitted_paged_decode_quant,
                             _jitted_paged_prefill,
-                            _jitted_paged_prefill_quant, init_paged_kv_pool,
-                            init_paged_kv_scales)
+                            _jitted_paged_prefill_quant,
+                            _jitted_paged_verify,
+                            _jitted_paged_verify_quant, init_paged_kv_pool,
+                            init_paged_kv_scales, make_draft_model)
 from ..observability.flight_recorder import (FlightRecorder,
                                              flight_recorder_enabled)
 from ..observability.histogram import LogHistogram
@@ -98,6 +100,8 @@ ENV_SERVE_JOURNAL = "PADDLE_TPU_SERVE_JOURNAL"
 ENV_SERVE_JOURNAL_FSYNC = "PADDLE_TPU_SERVE_JOURNAL_FSYNC"
 ENV_SERVE_PREFIX_CACHE = "PADDLE_TPU_SERVE_PREFIX_CACHE"
 ENV_SERVE_KV_DTYPE = "PADDLE_TPU_SERVE_KV_DTYPE"
+ENV_SERVE_SPEC = "PADDLE_TPU_SERVE_SPEC"
+ENV_SERVE_SPEC_K = "PADDLE_TPU_SERVE_SPEC_K"
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
@@ -215,6 +219,8 @@ class ServeConfig:
     # to the legacy behavior: no sharing, model-dtype fp KV)
     prefix_cache: Optional[bool] = None   # COW shared prefix blocks
     kv_dtype: Optional[str] = None        # "auto" (model dtype) | "int8"
+    speculative: Optional[bool] = None    # draft + batched verification
+    draft_k: Optional[int] = None         # proposals/seq/iteration (>=1)
 
     def __post_init__(self):
         if self.decode_buckets is None:
@@ -251,6 +257,9 @@ class _Seq:
         self.n_preempted = 0
         self.fail_cause: Optional[str] = None   # shed/quarantine cause
         self.recovered = False                  # rebuilt from a journal
+        # tokens whose KV the DRAFT pools hold; always <= n_cached after
+        # a verify (rejected lookahead KV is simply re-proposed over)
+        self.draft_pos = 0
 
     @property
     def generated(self) -> List[int]:
@@ -285,7 +294,9 @@ class InferenceEngine:
                  record_events: bool = False,
                  trace_requests: Optional[bool] = None,
                  flight_recorder: Optional[bool] = None,
-                 journal: Optional[str] = None):
+                 journal: Optional[str] = None,
+                 draft_params: Optional[Dict[str, Any]] = None,
+                 draft_config: Optional[LlamaConfig] = None):
         self.params = params
         self.config = config
         self.serve = serve or ServeConfig()
@@ -315,6 +326,44 @@ class InferenceEngine:
         self.cache: Optional[PrefixCache] = \
             PrefixCache(self.pool) if prefix_on else None
         self._cow_copies = 0
+        # speculative decoding (PR 18): a draft model proposes up to K
+        # tokens per sequence per iteration and ONE batched verify pass
+        # scores all K+1 positions. Emitted tokens are always the BASE
+        # model's greedy argmax, so streams are bit-identical to
+        # sequential decode regardless of draft quality (PARITY.md) —
+        # the draft only moves latency.
+        spec = (self.serve.speculative
+                if self.serve.speculative is not None
+                else envs.get(ENV_SERVE_SPEC))
+        self.speculative = bool(spec)
+        self.draft_k = int(self.serve.draft_k
+                           if self.serve.draft_k is not None
+                           else envs.get(ENV_SERVE_SPEC_K))
+        if self.draft_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {self.draft_k}")
+        self.draft_params: Optional[Dict[str, Any]] = None
+        self.draft_config: Optional[LlamaConfig] = None
+        self._draft_frozen: Optional[Tuple] = None
+        self.k_draft = self.v_draft = None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        if self.speculative:
+            if draft_params is None:
+                # default draft: the base model truncated to its first
+                # layer, sharing embedding/head weights by reference
+                draft_params, draft_config = make_draft_model(params,
+                                                              config)
+            elif draft_config is None:
+                raise ValueError("draft_params given without draft_config")
+            self.draft_params = draft_params
+            self.draft_config = draft_config
+            self._draft_frozen = _freeze_config(draft_config)
+            # the draft pools mirror the base pool's block geometry (one
+            # shared block table per sequence) but always store the
+            # model dtype: draft KV only shapes proposals, never output
+            # bytes, so int8 buys nothing there
+            self.k_draft, self.v_draft = init_paged_kv_pool(
+                draft_config, self.serve.num_blocks, self.serve.block_size)
         self.metrics = telemetry
         self.record_events = record_events
         # request-lifecycle tracing is measurement-only: spans are recorded
@@ -448,6 +497,16 @@ class InferenceEngine:
                          "reclaimable)")
             r.gauge("cow_copies", fn=lambda: self._cow_copies,
                     help="shared blocks copied on write")
+        # PR 18 speculative-decode gauges, only when speculation is live
+        if self.speculative:
+            r.gauge("spec_proposed_tokens", fn=lambda: self._spec_proposed,
+                    help="draft tokens proposed for verification")
+            r.gauge("spec_accepted_tokens", fn=lambda: self._spec_accepted,
+                    help="draft tokens the base model accepted")
+            r.gauge("spec_accept_rate",
+                    fn=lambda: (self._spec_accepted / self._spec_proposed
+                                if self._spec_proposed else 0.0),
+                    help="accepted / proposed draft tokens")
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -456,7 +515,8 @@ class InferenceEngine:
         live. Cache STATE is derived (bytes are a pure function of the
         token prefix), so recovery never needs it journaled."""
         return {"kv_dtype": self.kv_dtype,
-                "prefix_cache": self.cache is not None}
+                "prefix_cache": self.cache is not None,
+                "speculative": self.speculative}
 
     def _event(self, *ev):
         if self.record_events:
@@ -513,6 +573,13 @@ class InferenceEngine:
                         self.k_scale[:, b])
                     self.v_scale = self.v_scale.at[:, nb].set(
                         self.v_scale[:, b])
+                if self.k_draft is not None:
+                    # draft pools share the block table, so the draft's
+                    # slab must move with the base's copy
+                    self.k_draft = self.k_draft.at[:, nb].set(
+                        self.k_draft[:, b])
+                    self.v_draft = self.v_draft.at[:, nb].set(
+                        self.v_draft[:, b])
                 self.pool.free([b])
                 seq.blocks[bi] = nb
                 self._cow_copies += 1
@@ -556,6 +623,7 @@ class InferenceEngine:
         self._release(victim)
         victim.state = WAITING
         victim.n_cached = 0
+        victim.draft_pos = 0
         victim.n_preempted += 1
         self.waiting.insert(0, victim)
         self.preemptions += 1
@@ -645,6 +713,8 @@ class InferenceEngine:
         pools = [self.k_pool, self.v_pool]
         if self.k_scale is not None:
             pools += [self.k_scale, self.v_scale]
+        if self.k_draft is not None:
+            pools += [self.k_draft, self.v_draft]
         for pool in pools:
             deleted = getattr(pool, "is_deleted", None)
             if deleted is not None and deleted():
@@ -679,6 +749,10 @@ class InferenceEngine:
         cache = self.cache
         pending: set = set()
         for s in itertools.chain(self.waiting, self.active):
+            # speculative lookahead needs no extra headroom here: the
+            # per-iteration cap t_cap <= max_new - generated keeps every
+            # allocation within blocks_for(prompt + max_new), the same
+            # worst case sequential decode plans for
             worst = self.pool.blocks_for(
                 len(s.req.prompt) + s.req.max_new_tokens)
             if cache is not None:
@@ -963,10 +1037,45 @@ class InferenceEngine:
                     done_out.append(seq)
             else:
                 seq.state = RUNNING
+                if self.speculative:
+                    # bring the draft's cache up to n_cached before the
+                    # first decode iteration touches this row; covers
+                    # fresh, readmitted, recovered and prefix-hit
+                    # sequences uniformly (the draft re-prefills shared
+                    # blocks with identical bytes — pure function of
+                    # the token prefix)
+                    self._draft_prefill(seq)
         faults.inject("serve.prefill.after", rid=rid)
         return True
 
+    def _draft_prefill(self, seq: _Seq):
+        """Chunked prefill of ``seq``'s prompt through the DRAFT model
+        into the draft pools (shared block table). Draft state is fully
+        derived — never journaled, never recovered — so a crash here
+        costs nothing but the re-prefill on readmission."""
+        c = self.serve.prefill_chunk
+        fn = _jitted_paged_prefill(self._draft_frozen)
+        table = jnp.asarray(pad_table(seq.blocks, self.serve.max_nb))
+        start, target = 0, seq.n_cached
+        t0 = time.perf_counter()
+        while start < target:
+            n_live = min(c, target - start)
+            ids = np.zeros((c,), np.int32)
+            ids[:n_live] = seq.tokens[start:start + n_live]
+            _, self.k_draft, self.v_draft = fn(
+                self.draft_params, self.k_draft, self.v_draft,
+                table, np.int32(start), jnp.asarray(ids),
+                np.int32(n_live))
+            start += n_live
+        t1 = time.perf_counter()
+        self._mark_compiled("draft_prefill", c, t1 - t0)
+        seq.draft_pos = target
+        if self.tracer is not None:
+            self.tracer.phase("draft", t0, t1, self.iteration)
+
     def _decode_batch(self) -> List[_Seq]:
+        if self.speculative:
+            return self._decode_spec_batch()
         # grow each row across its block boundary, evicting youngest-
         # first when the pool runs dry (an evicted row drops out of the
         # batch by losing RUNNING state); with nothing evictable the row
@@ -1073,6 +1182,218 @@ class InferenceEngine:
             elif seq.token_times:
                 self.slo["tpot"].record(now - seq.token_times[-1])
             seq.token_times.append(now)
+            if seq.done():
+                self._finish_seq(seq, t1)
+                done.append(seq)
+        faults.inject("serve.decode.after",
+                      rids=[s.req.request_id for _, s in live])
+        return done
+
+    def _decode_spec_batch(self) -> List[_Seq]:
+        """Speculative decode iteration: up to K host-chained DRAFT
+        steps propose lookahead tokens per RUNNING row, then ONE batched
+        base-model verification pass scores all K+1 positions through
+        the multi-token paged read and commits only the accepted
+        prefix's KV (ops/paged_attention paged_verify_commit*).
+
+        Determinism contract: every emitted token is the BASE model's
+        own greedy argmax at its position — the draft only chooses how
+        many positions one iteration can confirm — so the stream is
+        bit-identical to sequential decode (PARITY.md) and the journal
+        only ever sees verified tokens."""
+        K = self.draft_k
+        # per-row lookahead cap: never past max_new (admission's worst-
+        # case bound) or the table width; floor 1 means the degenerate
+        # row still advances one token — the verify path IS the decode
+        # path, one uniform program family
+        ready: List[_Seq] = []
+        caps: Dict[int, int] = {}
+        for seq in [s for s in self.active if s.state == RUNNING]:
+            if seq.state != RUNNING:
+                continue
+            remaining = seq.req.max_new_tokens - len(seq.generated)
+            t_cap = max(1, min(K + 1, remaining,
+                               self.serve.max_seq_len - seq.n_cached))
+            ok = (self._alloc_for(seq, seq.n_cached + t_cap)
+                  and self._cow_span(seq, seq.n_cached, t_cap))
+            # shrink the lookahead before evicting anyone: in-flight
+            # draft tokens are free to drop (they cost accept-rate,
+            # never correctness)
+            while not ok and t_cap > 1:
+                t_cap -= 1
+                record_counter("serve.spec_shrink")
+                ok = (self._alloc_for(seq, seq.n_cached + t_cap)
+                      and self._cow_span(seq, seq.n_cached, t_cap))
+            while not ok and self._evict_one(protect=seq):
+                t_cap = 1
+                ok = (self._alloc_for(seq, seq.n_cached + 1)
+                      and self._cow_span(seq, seq.n_cached, 1))
+            if ok:
+                ready.append(seq)
+                caps[seq.req.request_id] = t_cap
+            else:
+                record_counter("serve.decode_stall")
+        rows = [s for s in ready if s.state == RUNNING]
+        if not rows:
+            return []
+        faults.inject("serve.decode.before",
+                      rids=[s.req.request_id for s in rows])
+        # -- draft phase: K batched single-token steps, host-chained.
+        # Each step feeds one token per still-proposing row; rows past
+        # their window become padding rows (null table -> block-0
+        # scribble, the established convention). The first proposing
+        # step for a row feeds tokens[-1] — identical to what verify
+        # feeds as fed[:, 0] — so catch-up and proposal steps are the
+        # same compiled program.
+        t0d = time.perf_counter()
+        proposals: Dict[int, List[int]] = {}
+        last_out: Dict[int, int] = {}
+        drafted = False
+        bucket = next(b for b in self.serve.decode_buckets
+                      if b >= len(rows))
+        for _ in range(K):
+            toks = np.zeros((bucket,), np.int32)
+            positions = np.zeros((bucket,), np.int32)
+            tables = np.zeros((bucket, self.serve.max_nb), np.int32)
+            stepping = []
+            for i, seq in enumerate(rows):
+                rid = seq.req.request_id
+                if seq.draft_pos >= seq.n_cached + caps[rid] - 1:
+                    continue  # window proposed through: padding row
+                p = seq.draft_pos
+                toks[i] = (seq.tokens[p] if p < len(seq.tokens)
+                           else last_out[rid])
+                positions[i] = p
+                tables[i] = pad_table(seq.blocks, self.serve.max_nb)
+                stepping.append((i, seq))
+            if not stepping:
+                break
+            td0 = time.perf_counter()
+            fn = _jitted_paged_decode(self._draft_frozen)
+            dl, self.k_draft, self.v_draft = fn(
+                self.draft_params, self.k_draft, self.v_draft,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(toks))
+            dl = np.asarray(dl)  # noqa: PTA006 -- host-chained: each draft argmax feeds the next draft step
+            self._mark_compiled("draft", bucket,
+                                time.perf_counter() - td0)
+            drafted = True
+            nxt = dl.argmax(-1)
+            for i, seq in stepping:
+                rid = seq.req.request_id
+                seq.draft_pos += 1
+                last_out[rid] = int(nxt[i])
+                if seq.draft_pos > seq.n_cached:
+                    proposals.setdefault(rid, []).append(int(nxt[i]))
+        t1d = time.perf_counter()
+        if drafted and self.tracer is not None:
+            self.tracer.phase("draft", t0d, t1d, self.iteration)
+        # -- verify phase: one batched K+1-position base pass; the
+        # re-drive loop mirrors sequential decode's (rows independent)
+        T = K + 1
+        out = clen = fin = None
+        key = None
+        while rows:
+            rids = [s.req.request_id for s in rows]
+            bucket = next(b for b in self.serve.decode_buckets
+                          if b >= len(rows))
+            fed = np.zeros((bucket, T), np.int32)
+            qstart = np.zeros((bucket,), np.int32)
+            t_live = np.zeros((bucket,), np.int32)
+            tables = np.zeros((bucket, self.serve.max_nb), np.int32)
+            for i, seq in enumerate(rows):
+                rid = seq.req.request_id
+                props = proposals.get(rid, [])[:caps[rid] - 1]
+                fed[i, 0] = seq.tokens[-1]
+                fed[i, 1:1 + len(props)] = props
+                qstart[i] = seq.n_cached
+                t_live[i] = 1 + len(props)
+                tables[i] = pad_table(seq.blocks, self.serve.max_nb)
+            key = ("verify", bucket)
+            t0 = time.perf_counter()
+            try:
+                faults.inject("serve.decode.poison", rids=rids)
+                with comm_span("serve.verify", nbytes=bucket * T * 4,
+                               site="serve.verify"):
+                    if self.k_scale is None:
+                        fn = _jitted_paged_verify(self._frozen)
+                        (out, clen, fin, self.k_pool,
+                         self.v_pool) = fn(
+                            self.params, self.k_pool, self.v_pool,
+                            jnp.asarray(tables), jnp.asarray(qstart),
+                            jnp.asarray(t_live), jnp.asarray(fed))
+                    else:
+                        fn = _jitted_paged_verify_quant(self._frozen)
+                        (out, clen, fin, self.k_pool, self.v_pool,
+                         self.k_scale, self.v_scale) = fn(
+                            self.params, self.k_pool, self.v_pool,
+                            self.k_scale, self.v_scale,
+                            jnp.asarray(tables), jnp.asarray(qstart),
+                            jnp.asarray(t_live), jnp.asarray(fed))
+                    out = np.asarray(out)  # noqa: PTA006 -- step boundary: verified tokens must reach the scheduler
+                    clen = np.asarray(clen)  # noqa: PTA006 -- accept lengths gate the host-side commit loop
+                    fin = np.asarray(fin)  # noqa: PTA006 -- per-row finite screen read at the step boundary
+                faults.inject("serve.decode.logits", rids=rids,
+                              logits=out)
+            except PoisonError as e:
+                if not self._pools_alive():
+                    raise  # donated pools died mid-kernel: journal path
+                bad = next((s for s in rows
+                            if s.req.request_id == e.rid), None)
+                if bad is None:
+                    raise  # not attributable to this batch
+                self._quarantine(bad, e.cause)
+                rows = [s for s in rows if s is not bad]
+                self._redrives += 1
+                record_counter("serve.decode_redrive")
+                continue
+            break
+        if not rows:
+            return []
+        t1 = time.perf_counter()
+        self._mark_compiled(*key, t1 - t0)
+        live = list(enumerate(rows))
+        if self._nan_check:
+            # the verify step returns tokens, not logits, so the finite
+            # screen is computed inside the jit and surfaced per row
+            finite = fin[:len(rows)]
+            if not bool(finite.all()):
+                for i, seq in [p for p in live if not finite[p[0]]]:
+                    self._quarantine(seq, "non-finite decode logits")
+                live = [p for p in live if finite[p[0]]]
+        if self.tracer is not None:
+            self.tracer.decode([s.req.request_id for _, s in live],
+                               t0, t1, self.iteration)
+            self.tracer.phase("verify", t0, t1, self.iteration)
+        done: List[_Seq] = []
+        now = self._now()
+        for i, seq in live:
+            rid = seq.req.request_id
+            self._spec_proposed += int(t_live[i]) - 1
+            # accepted draft credit = commit_len - 1: the +1 is the
+            # base's own correction/next token, not the draft's
+            self._spec_accepted += max(0, int(clen[i]) - 1)
+            emitted = 0
+            for j in range(int(clen[i])):
+                seq.n_cached += 1
+                seq.tokens.append(int(out[i, j]))
+                self._jtoks.append((rid, seq.tokens[-1]))
+                emitted += 1
+                if seq.first_token_t is None:
+                    seq.first_token_t = now
+                    self.slo["ttft"].record(now - seq.arrival)
+                elif seq.token_times:
+                    self.slo["tpot"].record(now - seq.token_times[-1])
+                seq.token_times.append(now)
+                if seq.done():
+                    # eos/max_new inside the window: later verified
+                    # tokens are exactly what sequential decode would
+                    # have produced AFTER stopping — discard them
+                    break
+            self._last_tokens += emitted
+            # roll the draft back to the last verified position: its
+            # cache past the accepted prefix reflects rejected tokens
+            seq.draft_pos = min(seq.draft_pos, seq.n_cached)
             if seq.done():
                 self._finish_seq(seq, t1)
                 done.append(seq)
@@ -1300,6 +1621,7 @@ class InferenceEngine:
                 self._release(seq)
                 seq.state = WAITING
                 seq.n_cached = 0
+                seq.draft_pos = 0
                 self.waiting.insert(0, seq)
             # crash post-mortem: dump the last N iteration records before
             # the exception leaves the engine (no-op without a recorder
@@ -1454,6 +1776,14 @@ class InferenceEngine:
                                   cached_blocks=self.pool.cached_blocks,
                                   cow_copies=self._cow_copies)
                              if self.cache is not None else None),
+            "speculative": ({
+                "draft_k": self.draft_k,
+                "draft_layers": self.draft_config.num_hidden_layers,
+                "proposed": self._spec_proposed,
+                "accepted": self._spec_accepted,
+                "accept_rate": (self._spec_accepted / self._spec_proposed
+                                if self._spec_proposed else None),
+            } if self.speculative else None),
             "outcomes": self.outcomes(),
         }
 

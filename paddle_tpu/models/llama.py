@@ -1203,6 +1203,225 @@ def _jitted_paged_prefill_quant(frozen):
     return jax.jit(paged_prefill_quant_fn, donate_argnums=(1, 2, 3, 4))
 
 
+# ---------------------------------------------------------------------------
+# speculative decoding (PR 18): draft model + batched paged verification
+# ---------------------------------------------------------------------------
+
+def make_draft_model(params, config: LlamaConfig, num_layers: int = 1):
+    """Default draft model for speculative decoding: the base model's
+    FIRST ``num_layers`` decoder layers, sharing the embedding, final
+    norm and lm head by reference (no copy — the stacked-leaf layout
+    makes the truncation a view-style slice per leaf).
+
+    A truncated self-draft needs no extra training to correlate with
+    the base argmax, and the PARITY contract makes its quality a pure
+    latency knob: verification re-derives every emitted token from the
+    base model, so ANY draft — this one, separately trained weights, or
+    garbage — yields bit-identical streams. Returns (draft_params,
+    draft_config)."""
+    dl = max(1, min(int(num_layers), config.num_hidden_layers))
+    dcfg = dataclasses.replace(config, num_hidden_layers=dl)
+    dparams = {
+        "embed": params["embed"],
+        "layers": {k: v[:dl] for k, v in params["layers"].items()},
+        "final_norm": params["final_norm"],
+    }
+    if "lm_head" in params:
+        dparams["lm_head"] = params["lm_head"]
+    return dparams, dcfg
+
+
+def llama_paged_verify_step(params, k_pool, v_pool, tables, qstart,
+                            t_live, fed, config: LlamaConfig,
+                            kv_scales=None):
+    """Score T fed tokens per sequence in ONE base-model pass over a
+    paged cache, greedily accept/reject, and commit only accepted KV.
+
+    fed [B, T] i32 — fed[:, 0] is each row's last emitted token (its KV
+    is NOT yet cached), fed[:, 1:] the draft's proposals; qstart [B]
+    i32 cached token counts (fed[:, j] sits at position qstart + j);
+    t_live [B] i32 live fed counts (1 = plain decode through this
+    path, 0 = padding row: tables at null block 0, qstart 0).
+
+    Attention splits into the cached prefix — the multi-token paged
+    kernel returns online-softmax partials — and the tiny [T, T] causal
+    fed block computed here in XLA, merged exactly
+    (ops/paged_attention.merge_verify_partials). The greedy accept rule
+    takes the longest prefix where the base argmax equals the draft
+    proposal, then the base's correction token: out[:, j] is the base's
+    next-token argmax after position qstart + j, and
+    commit_len = accepted proposals + 1 counts the fed tokens whose KV
+    is committed (the correction token's KV is NOT cached — it is the
+    next iteration's fed[:, 0], exactly like sequential decode).
+
+    Returns (out [B, T] i32, commit_len [B] i32, fin_ok [B] bool,
+    k_pool, v_pool) — the emitted tokens for row b are
+    out[b, :commit_len[b]]; fin_ok flags rows whose logits were all
+    finite (the engine's poison screen — it never sees logits). With
+    ``kv_scales=(k_scale, v_scale)`` the pools are int8: fed columns
+    quantize OUTSIDE the kernels via kv_quant_columns and the fed-block
+    attention reads the DEQUANTIZED values, so both the committed bytes
+    and the numerics each token sees match sequential int8 decode.
+    Returns (out, commit_len, fin_ok, k_pool, v_pool, k_scale,
+    v_scale) in that mode."""
+    from ..ops.paged_attention import (_LOG2E, kv_quant_columns,
+                                       merge_verify_partials,
+                                       paged_attention_verify,
+                                       paged_attention_verify_quant,
+                                       paged_verify_commit,
+                                       paged_verify_commit_quant)
+    c = config
+    B, T = fed.shape
+    hd = c.head_dim
+    h = jnp.take(params["embed"], fed, axis=0).astype(c.dtype)  # [B,T,H]
+    pos2d = qstart[:, None] + jnp.arange(T, dtype=jnp.int32)    # [B,T]
+    cos, sin = build_rope_cache(T, hd, base=c.rope_theta,
+                                position_ids=pos2d)             # [B,T,hd/2]
+    # dead-row guard: a padding row's kernel outputs are unwritten, so
+    # zero its cached-side partials (anchor -1e30 rescales to exactly 0)
+    live3 = (qstart > 0)[:, None, None]
+
+    def layer_step(carry, xs):
+        # pools are closure-captured read-only here (the commit below is
+        # the single writer), so the carry holds just the hidden state
+        h, = carry
+        p, layer = xs
+        x = fused_rms_norm(h, p["input_norm"], c.rms_norm_eps)
+        if "qkv_proj" in p:
+            ratio = c.num_attention_heads // c.num_key_value_heads
+            nkv = _mat_out_dim(p["qkv_proj"]) // hd // (ratio + 2)
+            nh = nkv * ratio
+            qkv = _mat(x, p["qkv_proj"])
+            q, k, v = jnp.split(qkv, [nh * hd, (nh + nkv) * hd], axis=-1)
+            q = q.reshape(B, T, nh, hd)
+            k = k.reshape(B, T, nkv, hd)
+            v = v.reshape(B, T, nkv, hd)
+        else:
+            nh = _mat_out_dim(p["q_proj"]) // hd
+            nkv = _mat_out_dim(p["k_proj"]) // hd
+            q = _mat(x, p["q_proj"]).reshape(B, T, nh, hd)
+            k = _mat(x, p["k_proj"]).reshape(B, T, nkv, hd)
+            v = _mat(x, p["v_proj"]).reshape(B, T, nkv, hd)
+        kvd = nkv * hd
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        layer_i = jnp.asarray(layer, jnp.int32)
+        rep = nh // nkv
+        # t-major block-diagonal rows: row t*NH + i is fed token t's
+        # head-i query against whole [KVD, bs] slab fragments
+        qg = q.reshape(B, T, nkv, rep, hd)
+        eye = jnp.eye(nkv, dtype=qg.dtype)
+        q_bd = jnp.einsum("btgrd,ge->btgred", qg, eye) \
+            .reshape(B, T * nh, kvd)
+        qs = (q_bd.astype(jnp.float32)
+              * (_LOG2E / (hd ** 0.5))).astype(q_bd.dtype)
+        if kv_scales is None:
+            acc_c, m_c, l_c = paged_attention_verify(
+                qs, k_pool, v_pool, tables, qstart, layer_i)
+            # fed columns AS STORED (pool dtype round-trip): the exact
+            # values sequential decode would read back from the cache
+            k_st = k.reshape(B, T, kvd).astype(k_pool.dtype)
+            v_st = v.reshape(B, T, kvd).astype(v_pool.dtype)
+            kf = k_st.astype(jnp.float32)
+            vf = v_st.astype(jnp.float32)
+            ys = (k_st, v_st)
+        else:
+            ksc, vsc = kv_scales
+            kq, ksq = kv_quant_columns(k.reshape(B * T, kvd), nkv)
+            vq, vsq = kv_quant_columns(v.reshape(B * T, kvd), nkv)
+            kq = kq.reshape(B, T, kvd)
+            vq = vq.reshape(B, T, kvd)
+            ksq = ksq.reshape(B, T, nkv)
+            vsq = vsq.reshape(B, T, nkv)
+            acc_c, m_c, l_c = paged_attention_verify_quant(
+                qs, k_pool, v_pool, ksc, vsc, tables, qstart, layer_i)
+            kf = (kq.astype(jnp.float32).reshape(B, T, nkv, hd)
+                  * ksq[..., None]).reshape(B, T, kvd)
+            vf = (vq.astype(jnp.float32).reshape(B, T, nkv, hd)
+                  * vsq[..., None]).reshape(B, T, kvd)
+            ys = (kq, vq, ksq, vsq)
+        # fed-token causal attention in XLA: block-diagonal q rows make
+        # the GQA head selection automatic in the [KVD] dot
+        s_f = jnp.einsum("brk,buk->bru", qs.astype(jnp.float32), kf)
+        t_row = jnp.arange(T * nh, dtype=jnp.int32) // nh      # [R]
+        causal = (jnp.arange(T, dtype=jnp.int32)[None, :]
+                  <= t_row[:, None])                           # [R,T]
+        s_f = jnp.where(causal[None], s_f, jnp.float32(-1e30))
+        m_f = s_f.max(axis=-1, keepdims=True)
+        p_f = jnp.exp2(s_f - m_f)
+        l_f = p_f.sum(axis=-1, keepdims=True)
+        acc_f = jnp.einsum("bru,buk->brk", p_f, vf)
+        attn_rows = merge_verify_partials(
+            jnp.where(live3, acc_c, 0.0),
+            jnp.where(live3, m_c[:, :, :1], jnp.float32(-1e30)),
+            jnp.where(live3, l_c[:, :, :1], 0.0),
+            acc_f, m_f, l_f)                                   # [B,R,KVD]
+        attn = jnp.einsum("btgred,ge->btgrd",
+                          attn_rows.reshape(B, T, nkv, rep, nkv, hd),
+                          eye.astype(attn_rows.dtype)).astype(c.dtype)
+        attn_out = _mat(attn.reshape(B, T, nh * hd), p["o_proj"])
+        h = h + attn_out
+        x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
+        gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
+        h = h + _mat(gated, p["down_proj"])
+        return (h,), ys
+
+    n_layers = k_pool.shape[0]
+    xs = (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
+    (h,), cols = lax.scan(layer_step, (h,), xs)
+    logits = llama_logits(params, h, config).astype(jnp.float32)
+    # per-row finite screen: the engine sees tokens, not logits, so the
+    # poison/quarantine contract needs the flag computed here
+    fin_ok = jnp.isfinite(logits).all(axis=(1, 2))             # [B]
+    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # [B,T]
+    # longest prefix where base argmax == draft proposal (both within
+    # the live window), then the base's correction token
+    if T > 1:
+        match = ((out[:, :-1] == fed[:, 1:])
+                 & (jnp.arange(1, T, dtype=jnp.int32)[None, :]
+                    < t_live[:, None]))
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                           axis=1)
+    else:
+        accepted = jnp.zeros((B,), jnp.int32)
+    commit_len = jnp.where(t_live > 0, accepted + 1, 0).astype(jnp.int32)
+    if kv_scales is None:
+        k_cols, v_cols = cols
+        kp, vp = paged_verify_commit(k_cols, v_cols, k_pool, v_pool,
+                                     tables, qstart, commit_len)
+        return out, commit_len, fin_ok, kp, vp
+    kq_cols, vq_cols, ks_cols, vs_cols = cols
+    k_scale, v_scale = kv_scales
+    kp, vp, ks, vs = paged_verify_commit_quant(
+        kq_cols, vq_cols, ks_cols, vs_cols, k_pool, v_pool,
+        k_scale, v_scale, tables, qstart, commit_len)
+    return out, commit_len, fin_ok, kp, vp, ks, vs
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_verify(frozen):
+    config = LlamaConfig(*frozen)
+
+    def paged_verify_fn(params, kp, vp, tables, qstart, t_live, fed):
+        return llama_paged_verify_step(params, kp, vp, tables, qstart,
+                                       t_live, fed, config)
+    paged_verify_fn.__name__ = "paged_verify_step"
+    return jax.jit(paged_verify_fn, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_verify_quant(frozen):
+    config = LlamaConfig(*frozen)
+
+    def paged_verify_quant_fn(params, kp, vp, ks, vs, tables, qstart,
+                              t_live, fed):
+        return llama_paged_verify_step(params, kp, vp, tables, qstart,
+                                       t_live, fed, config,
+                                       kv_scales=(ks, vs))
+    paged_verify_quant_fn.__name__ = "paged_verify_step_int8"
+    return jax.jit(paged_verify_quant_fn, donate_argnums=(1, 2, 3, 4))
+
+
 def generate_scan(params, cache, first_token, num_tokens,
                   config: LlamaConfig):
     """Generate ``num_tokens`` greedily INSIDE one jit: lax.scan over decode

@@ -62,7 +62,9 @@ def default_band(rung: str, band: float) -> float:
     band; everything else takes the configured default."""
     noisy = ("tokens_per_sec", "_tps", "_ms", "_s", "speedup", "x_floor",
              "hit_rate")
-    if rung.endswith(noisy) or any(s in rung for s in ("tpot", "ttft")):
+    if rung.endswith(noisy) or any(s in rung
+                                   for s in ("tpot", "ttft",
+                                             "eff_ceiling")):
         return max(band, 0.5)
     return band
 
@@ -159,6 +161,21 @@ def rungs_from_bench_detail(doc: Dict) -> Dict:
         rungs["serve_kv_int8_concurrency_x"] = si["concurrency_ratio"]
         rungs["serve_kv_int8_vs_fp16_x"] = si["fp16_equivalent_ratio"]
         rungs["serve_kv_int8_decode_ms_ratio"] = si["decode_ms_ratio"]
+    if "serve_speculative" in detail:
+        ss = detail["serve_speculative"]
+        rungs["serve_spec_accept_rate"] = ss["accept_rate"]
+        # iteration-clock speedup vs the sequential engine on the same
+        # trace (deterministic mode: both runs replay bit-identically,
+        # so the ratio is noise-free by construction)
+        rungs["serve_spec_speedup"] = ss["speedup"]
+        rungs["serve_spec_parity"] = bool(
+            ss["streams_identical"] and ss["pool_leak_free"])
+    if "varlen_ceiling_ablation" in detail:
+        # standalone (off-TPU) run of the ceiling rung; on TPU the same
+        # rung names come from packed_varlen's ceiling_ablation above
+        ca = detail["varlen_ceiling_ablation"]
+        rungs["varlen_fwd_eff_ceiling"] = ca["varlen_fwd_eff_ceiling"]
+        rungs["varlen_bwd_eff_ceiling"] = ca["varlen_bwd_eff_ceiling"]
     if "fleet_observability" in detail:
         fo = detail["fleet_observability"]
         rungs["fleet_observability_pct"] = fo["fleet_overhead_pct"]

@@ -777,3 +777,536 @@ def paged_attention_xla(q, k_pool, v_pool, tables, lengths, layer,
     s = jnp.where(t < lengths[:, None, None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bht,bct->bhc", p, vc.astype(jnp.float32))
+
+
+# -- speculative verification (PR 18) -----------------------------------------
+#
+# Greedy speculative decoding scores K+1 fed tokens per sequence in ONE
+# pass: the kernel below attends every fed token's query rows over the
+# CACHED prefix only (the unchanged flat schedule — fed tokens are not
+# in the pool yet), and returns UNFINALIZED online-softmax partials
+# (acc, m, l) so the caller can merge the fed-token attention — computed
+# outside in XLA, where the tiny [T, T] causal block is cheap — exactly:
+# rescale both partial sums to a common max and finalize once. The merge
+# identity holds for the tile-0-anchored m just as for a true running
+# max, so both PADDLE_TPU_FLASH_SOFTMAX modes verify bit-stably.
+#
+# Commit is a second fused kernel: scalar-prefetched per-sequence accept
+# lengths redirect every rejected or dead column to the reserved null
+# block 0 (the engine's scribble target), so ONLY accepted tokens' KV
+# lands in live blocks — int8 columns arrive pre-quantized by
+# kv_quant_columns, keeping committed bytes equal to what sequential
+# decode would have written (PARITY.md).
+
+# commit sched row indices ([N_COMMIT_FIELDS, L*B*T] i32)
+_CL, _CB, _CCOL, _CFIRST, _CSEQ, _CT = range(6)
+N_COMMIT_FIELDS = 6
+
+
+def _fit_paged_verify_blocks(r, kvd, nkv, bs, itemsize):
+    """Window fitter for the verification kernels (PTA002 contract).
+
+    Like _fit_paged_kv_blocks the geometry is pinned by the pool layout;
+    this prices the verify read's double-buffered windows — r = T*NH
+    query rows instead of NH, plus the three partial outputs — and
+    fails at trace time if they could not fit. Returns (kvd, bs, nkv)
+    unchanged."""
+    win = (2 * r * kvd * 4                  # q window
+           + 2 * 2 * kvd * bs * itemsize    # k/v tiles
+           + 2 * 2 * nkv * bs * 4           # scale tiles (quant path)
+           + 2 * r * (kvd + 2 * 128) * 4    # acc/m/l partial outs
+           + 2 * r * 128 * 4 + r * kvd * 4)  # scratch
+    if win > PAGED_VMEM_BUDGET:
+        raise ValueError(
+            f"paged verify kernel windows need {win} B VMEM "
+            f"(> {PAGED_VMEM_BUDGET} B): shrink draft_k, block_size or "
+            f"heads")
+    return kvd, bs, nkv
+
+
+def _paged_verify_kernel(lp_ref, sc_ref, q_ref, k_ref, v_ref,
+                         acc_ref, m_ref, l_ref, l_s, b_s, acc_s, *,
+                         block_size, online=False):
+    """_paged_kernel over R = T*NH query rows, finalization deferred:
+    the last live step stores raw (acc, m, l) instead of acc/l."""
+    j = pl.program_id(0)
+    pos = sc_ref[_POS, j]
+    start = sc_ref[_START, j]
+
+    def scores():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [R, bs]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(t <= pos, s, jnp.float32(-1e30))
+
+    def pv(p):
+        return jax.lax.dot_general(
+            p, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [R, KVD]
+
+    @pl.when(sc_ref[_FIRST, j] == np.int32(1))
+    def _first():
+        s = scores()
+        base = s.max(axis=-1, keepdims=True)
+        p = jnp.exp2(s - base)
+        b_s[...] = jnp.broadcast_to(base, b_s.shape)
+        l_s[...] = jnp.broadcast_to(p.sum(axis=-1, keepdims=True),
+                                    l_s.shape)
+        acc_s[...] = pv(p.astype(v_ref.dtype))
+
+    @pl.when(jnp.logical_and(sc_ref[_LIVE, j] == np.int32(1),
+                             sc_ref[_FIRST, j] == np.int32(0)))
+    def _more():
+        s = scores()
+        if online:
+            m_prev = b_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            b_s[...] = jnp.broadcast_to(m_new, b_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + pv(p.astype(v_ref.dtype))
+        else:
+            p = jnp.exp2(s - b_s[:, :1])
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + pv(p.astype(v_ref.dtype))
+
+    @pl.when(sc_ref[_LAST, j] == np.int32(1))
+    def _fin():
+        acc_ref[0] = acc_s[...]
+        m_ref[0] = b_s[...]
+        l_ref[0] = l_s[...]
+
+
+def paged_attention_verify(q_bd, k_pool, v_pool, tables, qstart, layer,
+                           *, n_steps=None):
+    """Multi-token verification read over the CACHED prefix of each
+    sequence.
+
+    q_bd [B, R, KVD] with R = T*NH t-major block-diagonal rows (row
+    r = t*NH + h is fed token t's head-h query), PRE-SCALED by
+    scale*log2(e); qstart [B] i32 cached token counts (a 0 row is
+    skipped and its outputs left unwritten — every live row must have
+    qstart >= 1). All R rows of a sequence share the prefix mask
+    t < qstart; the caller merges fed-token attention outside. Returns
+    UNFINALIZED f32 partials (acc [B, R, KVD], m [B, R, 128],
+    l [B, R, 128]) — only column 0 of m/l is meaningful."""
+    b, r, kvd = q_bd.shape
+    L, NP, _, bs = k_pool.shape
+    B, max_nb = tables.shape
+    if n_steps is None:
+        n_steps = B * max_nb
+    it = jnp.dtype(k_pool.dtype).itemsize
+    sched = paged_schedule(qstart, tables, n_steps, bs)
+    lp = jnp.asarray([layer], jnp.int32)
+
+    def kv_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_BLK, j], 0, 0)
+
+    def q_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0, 0)
+
+    kernel = functools.partial(_paged_verify_kernel, block_size=bs,
+                               online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_steps,),
+                in_specs=[
+                    pl.BlockSpec((1, r, kvd), q_map),
+                    pl.BlockSpec((1, 1, kvd, bs), kv_map),
+                    pl.BlockSpec((1, 1, kvd, bs), kv_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, r, kvd), q_map),
+                    pl.BlockSpec((1, r, 128), q_map),
+                    pl.BlockSpec((1, r, 128), q_map),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((r, 128), jnp.float32),
+                    pltpu.VMEM((r, 128), jnp.float32),
+                    pltpu.VMEM((r, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((b, r, kvd), jnp.float32),
+                jax.ShapeDtypeStruct((b, r, 128), jnp.float32),
+                jax.ShapeDtypeStruct((b, r, 128), jnp.float32),
+            ],
+            cost_estimate=_cost_estimate(
+                flops=4 * r * kvd * bs * n_steps,
+                transcendentals=r * bs * n_steps,
+                bytes_accessed=2 * kvd * bs * it * n_steps,
+                name="paged.attention_verify"),
+            interpret=_interpret(),
+        )(lp, sched, q_bd, k_pool, v_pool)
+    return acc, m, l
+
+
+def _paged_verify_quant_kernel(lp_ref, sc_ref, q_ref, k_ref, v_ref,
+                               ks_ref, vs_ref, acc_ref, m_ref, l_ref,
+                               l_s, b_s, acc_s, *, block_size, nkv,
+                               online=False):
+    """_paged_verify_kernel over int8 tiles (fused per-column dequant,
+    same op chain as _paged_quant_kernel, finalization deferred)."""
+    j = pl.program_id(0)
+    pos = sc_ref[_POS, j]
+    start = sc_ref[_START, j]
+
+    def scores():
+        k_deq = _dequant_tile(k_ref[0, 0], ks_ref[0, 0], nkv)
+        s = jax.lax.dot_general(
+            q_ref[0].astype(jnp.float32), k_deq, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [R, bs]
+        t = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        return jnp.where(t <= pos, s, jnp.float32(-1e30))
+
+    def pv(p):
+        v_deq = _dequant_tile(v_ref[0, 0], vs_ref[0, 0], nkv)
+        return jax.lax.dot_general(
+            p, v_deq, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [R, KVD]
+
+    @pl.when(sc_ref[_FIRST, j] == np.int32(1))
+    def _first():
+        s = scores()
+        base = s.max(axis=-1, keepdims=True)
+        p = jnp.exp2(s - base)
+        b_s[...] = jnp.broadcast_to(base, b_s.shape)
+        l_s[...] = jnp.broadcast_to(p.sum(axis=-1, keepdims=True),
+                                    l_s.shape)
+        acc_s[...] = pv(p)
+
+    @pl.when(jnp.logical_and(sc_ref[_LIVE, j] == np.int32(1),
+                             sc_ref[_FIRST, j] == np.int32(0)))
+    def _more():
+        s = scores()
+        if online:
+            m_prev = b_s[:, :1]
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            alpha = jnp.exp2(m_prev - m_new)
+            p = jnp.exp2(s - m_new)
+            b_s[...] = jnp.broadcast_to(m_new, b_s.shape)
+            l_s[...] = l_s[...] * alpha + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] * alpha + pv(p)
+        else:
+            p = jnp.exp2(s - b_s[:, :1])
+            l_s[...] = l_s[...] + jnp.broadcast_to(
+                p.sum(axis=-1, keepdims=True), l_s.shape)
+            acc_s[...] = acc_s[...] + pv(p)
+
+    @pl.when(sc_ref[_LAST, j] == np.int32(1))
+    def _fin():
+        acc_ref[0] = acc_s[...]
+        m_ref[0] = b_s[...]
+        l_ref[0] = l_s[...]
+
+
+def paged_attention_verify_quant(q_bd, k_pool, v_pool, k_scale, v_scale,
+                                 tables, qstart, layer, *, n_steps=None):
+    """Multi-token verification read over an int8 pool with fused
+    per-column dequant. Same contract as
+    :func:`paged_attention_verify`, plus the [L, NP, NKV, bs] f32 scale
+    pools riding the flat schedule."""
+    b, r, kvd = q_bd.shape
+    L, NP, _, bs = k_pool.shape
+    nkv = k_scale.shape[2]
+    B, max_nb = tables.shape
+    if n_steps is None:
+        n_steps = B * max_nb
+    it = jnp.dtype(k_pool.dtype).itemsize
+    kvd_b, bs_b, nkv_b = _fit_paged_verify_blocks(r, kvd, nkv, bs, it)
+    sched = paged_schedule(qstart, tables, n_steps, bs)
+    lp = jnp.asarray([layer], jnp.int32)
+
+    def kv_map(j, lp_ref, sc_ref):
+        return (lp_ref[0], sc_ref[_BLK, j], 0, 0)
+
+    def q_map(j, lp_ref, sc_ref):
+        return (sc_ref[_SEQ, j], 0, 0)
+
+    kernel = functools.partial(_paged_verify_quant_kernel, block_size=bs,
+                               nkv=nkv, online=softmax_mode() == "online")
+    with _mosaic_ctx():
+        acc, m, l = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_steps,),
+                in_specs=[
+                    pl.BlockSpec((1, r, kvd_b), q_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), kv_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), kv_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, r, kvd_b), q_map),
+                    pl.BlockSpec((1, r, 128), q_map),
+                    pl.BlockSpec((1, r, 128), q_map),
+                ],
+                scratch_shapes=[
+                    pltpu.VMEM((r, 128), jnp.float32),
+                    pltpu.VMEM((r, 128), jnp.float32),
+                    pltpu.VMEM((r, kvd), jnp.float32),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((b, r, kvd), jnp.float32),
+                jax.ShapeDtypeStruct((b, r, 128), jnp.float32),
+                jax.ShapeDtypeStruct((b, r, 128), jnp.float32),
+            ],
+            cost_estimate=_cost_estimate(
+                flops=(4 * r * kvd * bs + 2 * kvd * bs) * n_steps,
+                transcendentals=r * bs * n_steps,
+                bytes_accessed=(2 * kvd * bs * it
+                                + 2 * nkv * bs * 4) * n_steps,
+                name="paged.attention_verify_quant"),
+            interpret=_interpret(),
+        )(lp, sched, q_bd, k_pool, v_pool, k_scale, v_scale)
+    return acc, m, l
+
+
+def merge_verify_partials(acc_c, m_c, l_c, acc_f, m_f, l_f):
+    """Exact online-softmax merge of the kernel's cached-prefix partials
+    with the caller's fed-token partials: rescale both exp2 sums to the
+    common max and finalize once. Exact for ANY anchor m (tile-0 or
+    running max): acc = sum_i exp2(s_i - m) * v_i rescales by
+    exp2(m - m_tot) regardless of how m was chosen. Shapes: acc
+    [B, R, KVD]; m/l [B, R, 1]. Returns attn [B, R, KVD] f32."""
+    m_tot = jnp.maximum(m_c, m_f)
+    a_c = jnp.exp2(m_c - m_tot)
+    a_f = jnp.exp2(m_f - m_tot)
+    num = acc_c * a_c + acc_f * a_f
+    den = l_c * a_c + l_f * a_f
+    return num / jnp.maximum(den, jnp.float32(1e-30))
+
+
+def paged_commit_schedule(qstart, commit_len, tables, n_layers,
+                          n_tokens, block_size):
+    """Flat commit walk for the verification cache update:
+    [N_COMMIT_FIELDS, L*B*T] i32, layer-major then sequence then token.
+
+    Fed token t of sequence b commits at position qstart[b] + t iff
+    t < commit_len[b]; rejected and dead columns redirect to the
+    reserved null block 0 (the engine's scribble target), so the kernel
+    writes every step and live blocks only ever receive accepted
+    columns. Within one (layer, seq) the walk's block ids are
+    non-decreasing and each block is visited consecutively, so the
+    FIRST flag (out-window change) is computable by shifted comparison.
+    Works on traced values (pure jnp)."""
+    B, max_nb = tables.shape
+    bs = jnp.int32(block_size)
+    n = int(n_layers) * B * int(n_tokens)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    li = idx // (B * int(n_tokens))
+    bi = (idx // int(n_tokens)) % B
+    ti = idx % int(n_tokens)
+    pos = qstart[bi].astype(jnp.int32) + ti
+    commit = ti < commit_len[bi].astype(jnp.int32)
+    slot = jnp.clip(pos // bs, 0, max_nb - 1)
+    bid = jnp.where(commit, tables[bi, slot].astype(jnp.int32),
+                    jnp.int32(0))
+    col = pos % bs
+    prev_l = jnp.concatenate([jnp.full((1,), -1, jnp.int32), li[:-1]])
+    prev_b = jnp.concatenate([jnp.full((1,), -1, jnp.int32), bid[:-1]])
+    first = ((li != prev_l) | (bid != prev_b)).astype(jnp.int32)
+    return jnp.stack([li, bid, col, first, bi, ti])
+
+
+def _paged_commit_kernel(sc_ref, nk_ref, nv_ref, k_ref, v_ref,
+                         ko_ref, vo_ref, *, block_size):
+    """One fed token's column merged into its block tile per step. The
+    first visit to an out window seeds it from the input pool tile;
+    revisits (further columns of the same block) read the aliased out
+    refs back — the paged_attend_update revisit-buffer semantics. The
+    minor-dim insert routes through f32 (Mosaic bf16 limitation), exact
+    for f32 and int8 values alike."""
+    j = pl.program_id(0)
+    col = sc_ref[_CCOL, j]
+    first = sc_ref[_CFIRST, j] == np.int32(1)
+    kvd = nk_ref.shape[3]
+    lane = lax.broadcasted_iota(jnp.int32, (kvd, block_size), 1)
+
+    def merged(base, new_ref):
+        new32 = new_ref[0, 0, 0].astype(jnp.float32)[:, None]
+        return jnp.where(lane == col, new32, base.astype(jnp.float32)) \
+            .astype(ko_ref.dtype)
+
+    @pl.when(first)
+    def _fresh():
+        ko_ref[0, 0] = merged(k_ref[0, 0], nk_ref)
+        vo_ref[0, 0] = merged(v_ref[0, 0], nv_ref)
+
+    @pl.when(jnp.logical_not(first))
+    def _revisit():
+        ko_ref[0, 0] = merged(ko_ref[0, 0], nk_ref)
+        vo_ref[0, 0] = merged(vo_ref[0, 0], nv_ref)
+
+
+def paged_verify_commit(new_k, new_v, k_pool, v_pool, tables, qstart,
+                        commit_len):
+    """Fused post-verification cache commit: writes fed token t's KV
+    column at position qstart[b] + t for every t < commit_len[b],
+    across all layers in one call. new_k/new_v [L, B, T, KVD] in pool
+    dtype; rejected/dead columns scribble the reserved null block 0.
+    The pools alias through the custom call. Returns (k_pool,
+    v_pool)."""
+    L, B, T, kvd = new_k.shape
+    _, NP, _, bs = k_pool.shape
+    n = L * B * T
+    it = jnp.dtype(k_pool.dtype).itemsize
+    sched = paged_commit_schedule(qstart, commit_len, tables, L, T, bs)
+
+    def new_map(j, sc_ref):
+        return (sc_ref[_CL, j], sc_ref[_CSEQ, j], sc_ref[_CT, j], 0)
+
+    def pool_map(j, sc_ref):
+        return (sc_ref[_CL, j], sc_ref[_CB, j], 0, 0)
+
+    with _mosaic_ctx():
+        kp, vp = pl.pallas_call(
+            functools.partial(_paged_commit_kernel, block_size=bs),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n,),
+                in_specs=[
+                    pl.BlockSpec((1, 1, 1, kvd), new_map),
+                    pl.BlockSpec((1, 1, 1, kvd), new_map),
+                    pl.BlockSpec((1, 1, kvd, bs), pool_map),
+                    pl.BlockSpec((1, 1, kvd, bs), pool_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, 1, kvd, bs), pool_map),
+                    pl.BlockSpec((1, 1, kvd, bs), pool_map),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+            ],
+            # operand indices count scalar-prefetch first: 0=sched,
+            # 1=new_k, 2=new_v, 3=k_pool, 4=v_pool
+            input_output_aliases={3: 0, 4: 1},
+            cost_estimate=_cost_estimate(
+                flops=2 * kvd * bs * n,
+                transcendentals=0,
+                bytes_accessed=(2 * kvd * bs * it + 2 * kvd * it) * n,
+                name="paged.verify_commit"),
+            interpret=_interpret(),
+        )(sched, new_k, new_v, k_pool, v_pool)
+    return kp, vp
+
+
+def _paged_commit_quant_kernel(sc_ref, nk_ref, nv_ref, nks_ref, nvs_ref,
+                               k_ref, v_ref, ks_ref, vs_ref,
+                               ko_ref, vo_ref, kso_ref, vso_ref, *,
+                               block_size, nkv):
+    """_paged_commit_kernel over int8 byte tiles + f32 scale tiles. The
+    fed columns arrive ALREADY quantized (kv_quant_columns outside the
+    call), so committed bytes equal what sequential decode would have
+    written; the int8 insert routes through f32 — exact for int8
+    values."""
+    j = pl.program_id(0)
+    col = sc_ref[_CCOL, j]
+    first = sc_ref[_CFIRST, j] == np.int32(1)
+    kvd = nk_ref.shape[3]
+    lane = lax.broadcasted_iota(jnp.int32, (kvd, block_size), 1)
+    lane_s = lax.broadcasted_iota(jnp.int32, (nkv, block_size), 1)
+
+    def merged(base, new_ref):
+        new32 = new_ref[0, 0, 0].astype(jnp.float32)[:, None]
+        return jnp.where(lane == col, new32, base.astype(jnp.float32)) \
+            .astype(jnp.int8)
+
+    def merged_s(base, new_ref):
+        return jnp.where(lane_s == col, new_ref[0, 0, 0][:, None], base)
+
+    @pl.when(first)
+    def _fresh():
+        ko_ref[0, 0] = merged(k_ref[0, 0], nk_ref)
+        vo_ref[0, 0] = merged(v_ref[0, 0], nv_ref)
+        kso_ref[0, 0] = merged_s(ks_ref[0, 0], nks_ref)
+        vso_ref[0, 0] = merged_s(vs_ref[0, 0], nvs_ref)
+
+    @pl.when(jnp.logical_not(first))
+    def _revisit():
+        ko_ref[0, 0] = merged(ko_ref[0, 0], nk_ref)
+        vo_ref[0, 0] = merged(vo_ref[0, 0], nv_ref)
+        kso_ref[0, 0] = merged_s(kso_ref[0, 0], nks_ref)
+        vso_ref[0, 0] = merged_s(vso_ref[0, 0], nvs_ref)
+
+
+def paged_verify_commit_quant(new_k, new_v, new_ks, new_vs, k_pool,
+                              v_pool, k_scale, v_scale, tables, qstart,
+                              commit_len):
+    """Fused int8 post-verification cache commit. Same contract as
+    :func:`paged_verify_commit`, except the fed columns arrive
+    pre-quantized — new_k/new_v int8 [L, B, T, KVD] with new_ks/new_vs
+    f32 [L, B, T, NKV] from :func:`kv_quant_columns` — and all four
+    pools alias through the custom call. Returns (k_pool, v_pool,
+    k_scale, v_scale)."""
+    L, B, T, kvd = new_k.shape
+    _, NP, _, bs = k_pool.shape
+    nkv = k_scale.shape[2]
+    n = L * B * T
+    it = jnp.dtype(k_pool.dtype).itemsize
+    kvd_b, bs_b, nkv_b = _fit_paged_kv_blocks(1, kvd, nkv, bs, it)
+    sched = paged_commit_schedule(qstart, commit_len, tables, L, T, bs)
+
+    def new_map(j, sc_ref):
+        return (sc_ref[_CL, j], sc_ref[_CSEQ, j], sc_ref[_CT, j], 0)
+
+    def pool_map(j, sc_ref):
+        return (sc_ref[_CL, j], sc_ref[_CB, j], 0, 0)
+
+    with _mosaic_ctx():
+        kp, vp, ks, vs = pl.pallas_call(
+            functools.partial(_paged_commit_quant_kernel, block_size=bs,
+                              nkv=nkv),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n,),
+                in_specs=[
+                    pl.BlockSpec((1, 1, 1, kvd_b), new_map),
+                    pl.BlockSpec((1, 1, 1, kvd_b), new_map),
+                    pl.BlockSpec((1, 1, 1, nkv_b), new_map),
+                    pl.BlockSpec((1, 1, 1, nkv_b), new_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), pool_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), pool_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), pool_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), pool_map),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), pool_map),
+                    pl.BlockSpec((1, 1, kvd_b, bs_b), pool_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), pool_map),
+                    pl.BlockSpec((1, 1, nkv_b, bs_b), pool_map),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ],
+            # operand indices count scalar-prefetch first: 0=sched,
+            # 1=new_k, 2=new_v, 3=new_ks, 4=new_vs, 5=k_pool, 6=v_pool,
+            # 7=k_scale, 8=v_scale
+            input_output_aliases={5: 0, 6: 1, 7: 2, 8: 3},
+            cost_estimate=_cost_estimate(
+                flops=2 * kvd * bs * n,
+                transcendentals=0,
+                bytes_accessed=((2 * kvd * bs + 2 * nkv * bs * 4) * it
+                                + 2 * (kvd + 4 * nkv) * it) * n,
+                name="paged.verify_commit_quant"),
+            interpret=_interpret(),
+        )(sched, new_k, new_v, new_ks, new_vs,
+          k_pool, v_pool, k_scale, v_scale)
+    return kp, vp, ks, vs

@@ -303,5 +303,71 @@ def test_crash_matrix_with_prefix_cache_and_int8(model, tmp_path, point,
     assert st.torn_lines == 0
 
 
+@pytest.fixture(scope="module")
+def spec_ref(model, tmp_path_factory):
+    """Unkilled speculative+int8+cache reference streams (computed once
+    for the whole spec matrix)."""
+    tmp = tmp_path_factory.mktemp("specref")
+    kw = dict(prefix_cache=True, kv_dtype="int8", speculative=True,
+              draft_k=3)
+    eng = _engine(model, str(tmp / "ref18.jsonl"), **kw)
+    eng.swap_weights(model[1], at_iteration=4)
+    eng.run(_shared_requests(), deterministic=True)
+    ref = {s.req.request_id: s.generated for s in eng.finished}
+    assert len(ref) == 3
+    assert eng.pool.used_blocks == 0
+    return ref
+
+
+@pytest.mark.parametrize("point,nth", MATRIX,
+                         ids=[f"{p}-spec-int8" for p, _ in MATRIX])
+def test_crash_matrix_with_speculation_and_int8(model, tmp_path, spec_ref,
+                                                point, nth):
+    """The full fault matrix with SPECULATIVE decoding, prefix caching
+    and int8 KV all on (PR 18). Speculation changes how many tokens an
+    iteration emits, but every journaled token is base-verified — an
+    unverified draft token can never reach the journal because draft
+    state lives only in the derived draft pools and proposals die with
+    the iteration. So recovery is still bit-identical and leak-free at
+    every fault point, and the mid-crash journal holds a strict prefix
+    of the reference stream per request."""
+    kw = dict(prefix_cache=True, kv_dtype="int8", speculative=True,
+              draft_k=3)
+    path = str(tmp_path / "kill18.jsonl")
+    reqs = _shared_requests()
+    eng = _engine(model, path, **kw)
+    eng.swap_weights(model[1], at_iteration=4)
+    with faults.scope(point, "raise", nth=nth) as plan:
+        with pytest.raises(faults.FaultError):
+            eng.run(reqs, deterministic=True)
+        assert plan.fired == 1
+        assert eng.pool.used_blocks == 0
+
+        # journal discipline: every token on disk at crash time is a
+        # verified prefix of the reference stream (zero draft leakage)
+        mid = read_journal(path)
+        assert mid.torn_lines == 0
+        for rid, toks in mid.tokens.items():
+            assert list(toks) == spec_ref[rid][:len(toks)], \
+                f"unverified token journaled for rid {rid} at {point}"
+
+        eng2 = _engine(model, path, **kw)
+        rec = eng2.recover()
+        assert rec["torn_lines"] == 0
+        journaled = ({s.req.request_id for s in eng2.waiting}
+                     | {s.req.request_id for s in eng2.finished})
+        resubmit = [Request(r.prompt, max_new_tokens=r.max_new_tokens,
+                            request_id=r.request_id)
+                    for r in reqs if r.request_id not in journaled]
+        eng2.run(resubmit, deterministic=True)
+
+    got = {s.req.request_id: s.generated for s in eng2.finished}
+    assert got == spec_ref, f"streams diverged after crash at {point}"
+    assert eng2.pool.used_blocks == 0
+    st = read_journal(path)
+    assert st.finished == set(spec_ref)
+    assert st.torn_lines == 0
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])

@@ -99,6 +99,32 @@ def test_bounded_compiles(basic_run):
     assert set(stats["compiles"]) <= {"prefill_32", "decode_1", "decode_2"}
 
 
+def test_bounded_compiles_speculative(model):
+    """With speculation on (PR 18) the family stays counted/bounded:
+    the draft's prefill + per-bucket decode programs and the base's
+    per-bucket K+1-wide verify program replace plain decode — no
+    program keyed on data (accept length, proposal count) ever
+    compiles."""
+    cfg, params = model
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 96, size=n).tolist() for n in (7, 40)]
+    serve = ServeConfig(block_size=128, num_blocks=10, max_batch=2,
+                        prefill_chunk=32, max_seq_len=512,
+                        speculative=True, draft_k=2)
+    eng = InferenceEngine(params, cfg, serve)
+    reqs = [Request(p, max_new_tokens=5, arrival=float(i))
+            for i, p in enumerate(prompts)]
+    stats = eng.run(reqs, deterministic=True)
+    assert set(stats["compiles"]) <= {"prefill_32", "draft_prefill_32",
+                                      "draft_1", "draft_2",
+                                      "verify_1", "verify_2"}
+    assert any(k.startswith("verify_") for k in stats["compiles"])
+    for i, p in enumerate(prompts):
+        got = [s for s in eng.finished
+               if s.req.request_id == i][0].generated
+        assert got == _greedy_ref(model, p, 5), f"request {i}"
+
+
 @pytest.fixture(scope="module")
 def evict_run(model):
     """Pool sized so three one-block sequences admit, then starve when

@@ -1,0 +1,163 @@
+"""Speculative decoding in the serving engine (PR 18).
+
+The contract under test is PARITY.md's: every token a speculative
+engine emits is the BASE model's own greedy argmax at its position —
+the draft model only decides how many positions one iteration can
+confirm — so streams are token-bitwise-identical to sequential decode
+with speculation off, for any draft (including a garbage one), with
+int8 KV on, through prefix-cache sharing and through eviction. The
+compiled-shape family stays bounded: one draft-prefill program, one
+draft-decode and one verify program per decode bucket (token width
+pinned at K+1).
+"""
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import InferenceEngine, Request, ServeConfig
+from paddle_tpu.models.llama import (init_llama_params, llama_tiny,
+                                     make_draft_model)
+from paddle_tpu.ops import _common
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    with _common.interpret_mode(True):
+        yield
+
+
+@pytest.fixture(scope="module")
+def model():
+    # two layers so the default draft (first layer only) genuinely
+    # disagrees with the base model and rejection paths are exercised
+    cfg = llama_tiny(vocab=96, hidden=64, layers=2, heads=4, kv_heads=2,
+                     seq=512)
+    return cfg, init_llama_params(cfg, seed=0)
+
+
+def _requests(max_new=8):
+    rng = np.random.RandomState(7)
+    # one multi-block prompt (130 > block_size) to cross block
+    # boundaries inside the verify window
+    return [Request(rng.randint(1, 90, size=n).tolist(),
+                    max_new_tokens=max_new, arrival=float(i),
+                    request_id=i)
+            for i, n in enumerate([9, 40, 130])]
+
+
+def _run(model, **kw):
+    cfg, params = model
+    eng_kw = {k: kw.pop(k) for k in ("draft_params", "draft_config")
+              if k in kw}
+    serve = ServeConfig(block_size=128, num_blocks=kw.pop("num_blocks", 10),
+                        max_batch=2, prefill_chunk=32, max_seq_len=256,
+                        **kw)
+    eng = InferenceEngine(params, cfg, serve, **eng_kw)
+    eng.run(_requests(), deterministic=True)
+    return {s.req.request_id: s.generated for s in eng.finished}, eng
+
+
+@pytest.fixture(scope="module")
+def reference(model):
+    streams, _ = _run(model, speculative=False)
+    assert len(streams) == 3
+    return streams
+
+
+@pytest.fixture(scope="module")
+def spec_run(model):
+    return _run(model, speculative=True, draft_k=3)
+
+
+def test_spec_streams_bit_identical(spec_run, reference):
+    streams, eng = spec_run
+    assert streams == reference
+    sp = eng.stats()["speculative"]
+    assert sp["draft_k"] == 3 and sp["draft_layers"] == 1
+    assert sp["proposed"] > 0
+    assert 0.0 <= sp["accept_rate"] <= 1.0
+
+
+def test_spec_parity_int8_and_prefix_cache(model):
+    ref, _ = _run(model, speculative=False, kv_dtype="int8")
+    got, eng = _run(model, speculative=True, draft_k=3, kv_dtype="int8",
+                    prefix_cache=True)
+    assert got == ref
+    assert eng.pool.used_blocks == 0
+
+
+def test_spec_parity_under_eviction(model, reference):
+    # pool sized to starve: lookahead shrinks, then eviction fires;
+    # dropped draft tokens must cost only latency, never tokens
+    got, eng = _run(model, speculative=True, draft_k=4, num_blocks=5)
+    assert got == reference
+    assert eng.pool.used_blocks == 0
+
+
+def test_garbage_draft_never_affects_outputs(model, reference):
+    # a draft with unrelated random weights proposes mostly-rejected
+    # tokens; outputs must be the base model's stream regardless
+    cfg, params = model
+    _, dcfg = make_draft_model(params, cfg)
+    dparams = init_llama_params(dcfg, seed=99)
+    got, eng = _run(model, speculative=True, draft_k=2,
+                    draft_params=dparams, draft_config=dcfg)
+    assert got == reference
+    sp = eng.stats()["speculative"]
+    assert sp["accept_rate"] < 1.0
+
+
+def test_spec_bounded_compiles_and_metrics(spec_run):
+    _, eng = spec_run
+    compiles = set(eng.stats()["compiles"])
+    # draft and verify programs are each counted per decode bucket;
+    # no plain-decode program ever compiles with speculation on
+    assert compiles <= {"prefill_32", "draft_prefill_32",
+                        "draft_1", "draft_2", "verify_1", "verify_2"}
+    assert any(k.startswith("verify_") for k in compiles)
+    assert any(k.startswith("draft_") for k in compiles)
+    snap = eng.registry.snapshot()
+    assert "spec_accept_rate" in snap
+    rendered = eng.registry.render_prometheus()
+    assert "paddle_tpu_serve_spec_accept_rate" in rendered
+
+
+def test_commit_schedule_pure():
+    # host-visible oracle for the commit schedule: layer-major order,
+    # rejected columns redirected to the null block, first-visit flags
+    # exactly at (layer, block) transitions
+    import jax.numpy as jnp
+    from paddle_tpu.ops.paged_attention import (N_COMMIT_FIELDS, _CB,
+                                                _CCOL, _CFIRST, _CL,
+                                                _CSEQ, _CT,
+                                                paged_commit_schedule)
+    tables = jnp.asarray([[2, 3, 0, 0], [5, 0, 0, 0]], jnp.int32)
+    qstart = jnp.asarray([126, 4], jnp.int32)
+    clen = jnp.asarray([3, 0], jnp.int32)
+    sc = np.asarray(paged_commit_schedule(qstart, clen, tables,
+                                          n_layers=2, n_tokens=4,
+                                          block_size=128))
+    assert sc.shape == (N_COMMIT_FIELDS, 2 * 2 * 4)
+    # seq 0 commits positions 126,127 (block 2) and 128 (block 3);
+    # its 4th slot and all of seq 1 scribble the null block
+    j0 = [j for j in range(sc.shape[1])
+          if sc[_CL, j] == 0 and sc[_CSEQ, j] == 0]
+    assert [int(sc[_CB, j]) for j in j0] == [2, 2, 3, 0]
+    assert [int(sc[_CCOL, j]) for j in j0] == [126, 127, 0, 1]
+    assert [int(sc[_CT, j]) for j in j0] == [0, 1, 2, 3]
+    j1 = [j for j in range(sc.shape[1])
+          if sc[_CL, j] == 0 and sc[_CSEQ, j] == 1]
+    assert all(int(sc[_CB, j]) == 0 for j in j1)
+    # first flags: one per (layer, block) run over consecutive columns
+    runs = []
+    for j in range(sc.shape[1]):
+        key = (int(sc[_CL, j]), int(sc[_CB, j]))
+        if sc[_CFIRST, j]:
+            runs.append(key)
+        else:
+            assert runs and runs[-1] == key
+    assert all(a != b for a, b in zip(runs, runs[1:]))
+    assert len(runs) >= 4
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
