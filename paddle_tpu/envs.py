@@ -503,6 +503,17 @@ _register(
     parse=_positive_int("PADDLE_TPU_SERVE_SPEC_K", 4))
 
 _register(
+    "PADDLE_TPU_SERVE_MP", "int", 1,
+    doc="Tensor-parallel degree of the serving engine (PR 19): mp > 1 "
+        "runs prefill/decode/speculative-verify inside an ('mp',)-"
+        "sharded mesh — weights sliced per param_pspecs, KV/scale/draft "
+        "pools sharded by kv-head — with token streams identical to "
+        "mp=1 (greedy argmax; PARITY.md). Needs num_attention_heads, "
+        "num_key_value_heads, vocab_size and intermediate_size all "
+        "divisible by mp, and mp local devices. ServeConfig(mp=) wins.",
+    parse=_positive_int("PADDLE_TPU_SERVE_MP", 1))
+
+_register(
     "PADDLE_TPU_FLEET", "bool", False,
     doc="Wire a FleetMonitor (PR 15) into jit.TrainStep: per-rank step "
         "times, per-site comm_span hop stats and all-device memory are "

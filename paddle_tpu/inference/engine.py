@@ -70,16 +70,25 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import envs
 from ..testing import faults
-from ..models.llama import (LlamaConfig, _freeze_config, _jitted_paged_decode,
+from ..models.llama import (LlamaConfig, ParallelConfig, _freeze_config,
+                            _jitted_paged_decode,
                             _jitted_paged_decode_quant,
+                            _jitted_paged_decode_quant_tp,
+                            _jitted_paged_decode_tp,
                             _jitted_paged_prefill,
                             _jitted_paged_prefill_quant,
+                            _jitted_paged_prefill_quant_tp,
+                            _jitted_paged_prefill_tp,
                             _jitted_paged_verify,
-                            _jitted_paged_verify_quant, init_paged_kv_pool,
-                            init_paged_kv_scales, make_draft_model)
+                            _jitted_paged_verify_quant,
+                            _jitted_paged_verify_quant_tp,
+                            _jitted_paged_verify_tp, init_paged_kv_pool,
+                            init_paged_kv_scales, make_draft_model,
+                            make_mesh, param_pspecs)
 from ..observability.flight_recorder import (FlightRecorder,
                                              flight_recorder_enabled)
 from ..observability.histogram import LogHistogram
@@ -88,7 +97,8 @@ from ..observability.metrics import StepMetrics
 from ..observability.request_trace import RequestTracer
 from ..observability.trace import comm_span, record_counter
 from .journal import EngineJournal, read_journal
-from .kv_cache import BlockPool, PrefixCache, pad_table
+from .kv_cache import (BlockPool, PrefixCache, pad_table,
+                       pool_bytes_per_rank)
 
 ENV_TRACE_REQUESTS = "PADDLE_TPU_TRACE_REQUESTS"
 ENV_SERVE_MAX_QUEUE = "PADDLE_TPU_SERVE_MAX_QUEUE"
@@ -102,6 +112,7 @@ ENV_SERVE_PREFIX_CACHE = "PADDLE_TPU_SERVE_PREFIX_CACHE"
 ENV_SERVE_KV_DTYPE = "PADDLE_TPU_SERVE_KV_DTYPE"
 ENV_SERVE_SPEC = "PADDLE_TPU_SERVE_SPEC"
 ENV_SERVE_SPEC_K = "PADDLE_TPU_SERVE_SPEC_K"
+ENV_SERVE_MP = "PADDLE_TPU_SERVE_MP"
 
 WAITING, PREFILL, RUNNING, FINISHED = "waiting", "prefill", "running", \
     "finished"
@@ -221,6 +232,11 @@ class ServeConfig:
     kv_dtype: Optional[str] = None        # "auto" (model dtype) | "int8"
     speculative: Optional[bool] = None    # draft + batched verification
     draft_k: Optional[int] = None         # proposals/seq/iteration (>=1)
+    # tensor-parallel serving (PR 19): model-parallel degree of the
+    # engine's mesh; weights slice per param_pspecs, KV pools shard by
+    # kv-head. None defers to PADDLE_TPU_SERVE_MP (default 1 = the
+    # single-device path, bit-identical to pre-PR-19).
+    mp: Optional[int] = None
 
     def __post_init__(self):
         if self.decode_buckets is None:
@@ -300,6 +316,18 @@ class InferenceEngine:
         self.params = params
         self.config = config
         self.serve = serve or ServeConfig()
+        # tensor-parallel serving (PR 19): mp > 1 runs every jitted step
+        # family inside an ('mp',)-sharded mesh — weights sliced per
+        # param_pspecs, KV/scale pools sharded by kv-head — while the
+        # host-side scheduler (admission, shedding, quarantine, journal,
+        # BlockPool, PrefixCache) stays rank-agnostic: one process
+        # drives all ranks with rank-replicated block tables. Streams
+        # stay token-identical to mp=1 (PARITY.md PR 19).
+        self.mp = int(self.serve.mp if self.serve.mp is not None
+                      else envs.get(ENV_SERVE_MP))
+        if self.mp < 1:
+            raise ValueError(f"ServeConfig.mp must be >= 1, got {self.mp}")
+        self.mesh = None
         self.pool = BlockPool(self.serve.num_blocks, self.serve.block_size)
         # KV storage dtype: "auto" keeps the model dtype (the pre-PR-16
         # path, bit-identical); "int8" halves pool bytes with per-column
@@ -364,6 +392,8 @@ class InferenceEngine:
             # bytes, so int8 buys nothing there
             self.k_draft, self.v_draft = init_paged_kv_pool(
                 draft_config, self.serve.num_blocks, self.serve.block_size)
+        if self.mp > 1:
+            self._shard_tp()
         self.metrics = telemetry
         self.record_events = record_events
         # request-lifecycle tracing is measurement-only: spans are recorded
@@ -437,6 +467,84 @@ class InferenceEngine:
         self._pending_swap: Optional[Tuple[Any, int]] = None
         self.swaps = 0
         self.last_swap: Optional[Dict[str, Any]] = None
+
+    # jitted step families, keyed (kind, quant): the mp-sharded twins
+    # are drop-in — same argument lists, same output tuples — so every
+    # scheduler call site dispatches through _step_fn and nothing else
+    # about the engine changes with mp.
+    _STEP_BUILDERS = {
+        ("prefill", False): (_jitted_paged_prefill,
+                             _jitted_paged_prefill_tp),
+        ("prefill", True): (_jitted_paged_prefill_quant,
+                            _jitted_paged_prefill_quant_tp),
+        ("decode", False): (_jitted_paged_decode, _jitted_paged_decode_tp),
+        ("decode", True): (_jitted_paged_decode_quant,
+                           _jitted_paged_decode_quant_tp),
+        ("verify", False): (_jitted_paged_verify, _jitted_paged_verify_tp),
+        ("verify", True): (_jitted_paged_verify_quant,
+                           _jitted_paged_verify_quant_tp),
+    }
+
+    def _step_fn(self, kind: str, frozen, quant: bool = False):
+        plain, tp = self._STEP_BUILDERS[(kind, bool(quant))]
+        return tp(frozen, self.mesh) if self.mp > 1 else plain(frozen)
+
+    def _shard_tp(self) -> None:
+        """Build the serving mesh and place weights + pools for mp > 1.
+
+        Weight slicing follows ``param_pspecs`` over 'mp' alone
+        (column-parallel q/k/v/gate/up, row-parallel o/down, vocab-
+        parallel embed + lm_head); every KV/scale pool — fp16, int8 and
+        draft — shards its kv-head-major axis 2. Rejects geometries the
+        contiguous-head slicing cannot express (see PARITY.md PR 19)."""
+        c, mp = self.config, self.mp
+        for dim, name in ((c.num_attention_heads, "num_attention_heads"),
+                          (c.num_key_value_heads, "num_key_value_heads"),
+                          (c.vocab_size, "vocab_size"),
+                          (c.intermediate_size, "intermediate_size")):
+            if dim % mp:
+                raise ValueError(
+                    f"ServeConfig.mp={mp} needs {name} % mp == 0 "
+                    f"(got {dim}): heads/vocab/ffn slice contiguously "
+                    f"across ranks")
+        ndev = len(jax.devices())
+        if ndev < mp:
+            raise ValueError(f"ServeConfig.mp={mp} needs {mp} devices, "
+                             f"have {ndev}")
+        if "qkv_proj" in self.params.get("layers", {}):
+            raise ValueError(
+                "tensor-parallel serving needs split q/k/v projections; "
+                "fused qkv_proj weights interleave heads and cannot "
+                "slice contiguously over 'mp'")
+        for tree in (self.params, self.draft_params or {}):
+            for leaf in jax.tree_util.tree_leaves(
+                    tree, is_leaf=lambda x: isinstance(x, dict) and
+                    ("w" in x or "wT" in x)):
+                if isinstance(leaf, dict):
+                    raise ValueError(
+                        "tensor-parallel serving takes plain weight "
+                        "arrays; int8/transposed weight dicts don't "
+                        "carry the param_pspecs tree")
+        self.mesh = make_mesh(ParallelConfig(mp=mp))
+
+        def put(tree, cfg):
+            specs = param_pspecs(cfg, ParallelConfig(mp=mp))
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P))
+            return jax.device_put(tree, shardings)
+
+        self.params = put(self.params, c)
+        pool_sh = NamedSharding(self.mesh, P(None, None, "mp", None))
+        self.k_pool = jax.device_put(self.k_pool, pool_sh)
+        self.v_pool = jax.device_put(self.v_pool, pool_sh)
+        if self.k_scale is not None:
+            self.k_scale = jax.device_put(self.k_scale, pool_sh)
+            self.v_scale = jax.device_put(self.v_scale, pool_sh)
+        if self.speculative:
+            self.draft_params = put(self.draft_params, self.draft_config)
+            self.k_draft = jax.device_put(self.k_draft, pool_sh)
+            self.v_draft = jax.device_put(self.v_draft, pool_sh)
 
     def _register_metrics(self) -> None:
         """Register every engine metric into the unified registry: the
@@ -516,7 +624,8 @@ class InferenceEngine:
         token prefix), so recovery never needs it journaled."""
         return {"kv_dtype": self.kv_dtype,
                 "prefix_cache": self.cache is not None,
-                "speculative": self.speculative}
+                "speculative": self.speculative,
+                "mp": self.mp}
 
     def _event(self, *ev):
         if self.record_events:
@@ -974,13 +1083,13 @@ class InferenceEngine:
                            nbytes=int(n_live) * 4,
                            site="serve.prefill"):
                 if self.k_scale is None:
-                    fn = _jitted_paged_prefill(self._frozen)
+                    fn = self._step_fn("prefill", self._frozen)
                     logits, self.k_pool, self.v_pool = fn(
                         self.params, self.k_pool, self.v_pool,
                         jnp.asarray(table), np.int32(seq.n_cached),
                         jnp.asarray(ids), np.int32(n_live))
                 else:
-                    fn = _jitted_paged_prefill_quant(self._frozen)
+                    fn = self._step_fn("prefill", self._frozen, quant=True)
                     (logits, self.k_pool, self.v_pool, self.k_scale,
                      self.v_scale) = fn(
                         self.params, self.k_pool, self.v_pool,
@@ -1054,7 +1163,7 @@ class InferenceEngine:
         derived — never journaled, never recovered — so a crash here
         costs nothing but the re-prefill on readmission."""
         c = self.serve.prefill_chunk
-        fn = _jitted_paged_prefill(self._draft_frozen)
+        fn = self._step_fn("prefill", self._draft_frozen)
         table = jnp.asarray(pad_table(seq.blocks, self.serve.max_nb))
         start, target = 0, seq.n_cached
         t0 = time.perf_counter()
@@ -1121,13 +1230,14 @@ class InferenceEngine:
                 with comm_span("serve.decode", nbytes=bucket * 4,
                                site="serve.decode"):
                     if self.k_scale is None:
-                        fn = _jitted_paged_decode(self._frozen)
+                        fn = self._step_fn("decode", self._frozen)
                         logits, self.k_pool, self.v_pool = fn(
                             self.params, self.k_pool, self.v_pool,
                             jnp.asarray(tables), jnp.asarray(positions),
                             jnp.asarray(toks))
                     else:
-                        fn = _jitted_paged_decode_quant(self._frozen)
+                        fn = self._step_fn("decode", self._frozen,
+                                           quant=True)
                         (logits, self.k_pool, self.v_pool, self.k_scale,
                          self.v_scale) = fn(
                             self.params, self.k_pool, self.v_pool,
@@ -1269,7 +1379,7 @@ class InferenceEngine:
             if not stepping:
                 break
             td0 = time.perf_counter()
-            fn = _jitted_paged_decode(self._draft_frozen)
+            fn = self._step_fn("decode", self._draft_frozen)
             dl, self.k_draft, self.v_draft = fn(
                 self.draft_params, self.k_draft, self.v_draft,
                 jnp.asarray(tables), jnp.asarray(positions),
@@ -1316,14 +1426,15 @@ class InferenceEngine:
                 with comm_span("serve.verify", nbytes=bucket * T * 4,
                                site="serve.verify"):
                     if self.k_scale is None:
-                        fn = _jitted_paged_verify(self._frozen)
+                        fn = self._step_fn("verify", self._frozen)
                         (out, clen, fin, self.k_pool,
                          self.v_pool) = fn(
                             self.params, self.k_pool, self.v_pool,
                             jnp.asarray(tables), jnp.asarray(qstart),
                             jnp.asarray(t_live), jnp.asarray(fed))
                     else:
-                        fn = _jitted_paged_verify_quant(self._frozen)
+                        fn = self._step_fn("verify", self._frozen,
+                                           quant=True)
                         (out, clen, fin, self.k_pool, self.v_pool,
                          self.k_scale, self.v_scale) = fn(
                             self.params, self.k_pool, self.v_pool,
@@ -1766,6 +1877,10 @@ class InferenceEngine:
             "compiles": {f"{k}_{v}": round(t, 3)
                          for (k, v), t in sorted(self._compiled.items())},
             "pool_blocks": self.serve.num_blocks - 1,
+            "mp": self.mp,
+            "pool_bytes_per_rank": pool_bytes_per_rank(
+                (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                 self.k_draft, self.v_draft), self.mp),
             "rejected": len(self.rejected),
             "shed": len(self.shed),
             "failed": len(self.failed),

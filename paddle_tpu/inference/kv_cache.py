@@ -337,3 +337,19 @@ def pad_table(blocks: List[int], max_nb: int) -> np.ndarray:
     row = np.zeros((max_nb,), np.int32)
     row[:len(blocks)] = blocks
     return row
+
+
+def pool_bytes_per_rank(pools: Sequence, mp: int = 1) -> int:
+    """Device bytes ONE rank holds for the given KV/scale pools.
+
+    Under tensor-parallel serving (PR 19) every pool shards its
+    kv-head-major axis evenly across ``mp`` ranks — the engine
+    validates ``num_key_value_heads % mp == 0`` at init, so the split
+    is exact and per-rank bytes are total/mp. ``None`` entries (absent
+    scale/draft pools) are skipped; ``mp=1`` is just the total."""
+    total = 0
+    for p in pools:
+        if p is None:
+            continue
+        total += int(p.size) * int(np.dtype(p.dtype).itemsize)
+    return total // max(1, int(mp))

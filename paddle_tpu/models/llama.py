@@ -29,6 +29,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .._compat import shard_map
 from ..observability import trace as _obs
 from ..ops.flash_attention import flash_attention_bshd
 from ..ops.rms_norm import fused_rms_norm
@@ -922,8 +923,95 @@ def init_paged_kv_scales(config: LlamaConfig, num_blocks: int,
     return jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32)
 
 
+# ---------------------------------------------------------------------------
+# tensor-parallel serving (PR 19): paged steps inside an mp shard_map
+# ---------------------------------------------------------------------------
+#
+# The serving TP path threads ``tp=(axis_name, n)`` through the three
+# paged step functions below. Weights arrive PRE-SLICED by the island's
+# in_specs (param_pspecs over 'mp'), so the column-parallel projections
+# need no code change at all — nh/nkv are derived from weight shapes and
+# become local head counts, the paged kernels and their _fit_* fitters
+# price the per-shard [KVD/n, bs] geometry from argument shapes, and the
+# block-diagonal-q attention is exact per kv-head. Only three collectives
+# exist: the vocab-parallel embed psum (exact — each id is non-zero on
+# one rank), the o_proj/down_proj row-parallel reduce (the ONLY
+# re-associated sums vs mp=1; greedy argmax keeps token streams
+# identical), and the verify logits all-gather (exact vocab concat so
+# accept/commit logic is rank-identical). See PARITY.md (PR 19).
+
+def _tp_vocab_embed(embed, ids, tp):
+    """Masked vocab-parallel lookup INSIDE the serving island: ``embed``
+    is this rank's [V/n, H] vocab slice; every id row is non-zero on
+    exactly one rank, so the psum is EXACT in any dtype (same contract
+    as vocab_parallel_embed, manual-collective form)."""
+    axis, _ = tp
+    vs = embed.shape[0]
+    local = ids - lax.axis_index(axis) * vs
+    ok = (local >= 0) & (local < vs)
+    rows = jnp.take(embed, jnp.clip(local, 0, vs - 1), axis=0)
+    rows = jnp.where(ok[..., None], rows, 0).astype(embed.dtype)
+    with _obs.comm_span("serve.tp_ring.embed",
+                        nbytes=rows.size * rows.dtype.itemsize,
+                        site="serve.tp_ring.embed"):
+        return lax.psum(rows, axis)
+
+
+def _tp_row_matmul(x, w, tp):
+    """Row-parallel ``x @ w_local`` + cross-rank reduce for the serving
+    TP path: x [..., k/n] holds this rank's slice of the contracted dim
+    (its attention heads / FFN columns), w [k/n, out] the matching row
+    shard. Routes through the overlapped reduce-scatter ring
+    (ring_allreduce_matmul) when PADDLE_TPU_TP_OVERLAP is on and the
+    row count divides the ring, else the blocking psum — the mp=2 ring
+    is pinned bitwise-vs-blocking (parallel/collective_matmul), so the
+    knob never changes mp=2 streams."""
+    from ..parallel.collective_matmul import (overlap_enabled,
+                                              resolve_chunks,
+                                              ring_allreduce_matmul)
+    axis, n = tp
+    lead = x.shape[:-1]
+    t = x.size // x.shape[-1]
+    x2 = x.reshape(t, x.shape[-1])
+    if overlap_enabled() and t % n == 0 and not isinstance(w, dict):
+        out = ring_allreduce_matmul(x2, w, n, axis, resolve_chunks(n, t // n))
+    else:
+        out = lax.psum(_mat(x2, w), axis)
+    return out.reshape(lead + out.shape[-1:])
+
+
+def _tp_o_proj(a, w, tp):
+    t = a.size // a.shape[-1]
+    with _obs.comm_span("serve.tp_ring.o_proj",
+                        nbytes=t * _mat_out_dim(w) * a.dtype.itemsize,
+                        site="serve.tp_ring.o_proj"):
+        return _tp_row_matmul(a, w, tp)
+
+
+def _tp_down_proj(a, w, tp):
+    t = a.size // a.shape[-1]
+    with _obs.comm_span("serve.tp_ring.down_proj",
+                        nbytes=t * _mat_out_dim(w) * a.dtype.itemsize,
+                        site="serve.tp_ring.down_proj"):
+        return _tp_row_matmul(a, w, tp)
+
+
+def _tp_gather_logits(logits, tp):
+    """All-gather vocab-sliced logits to the full vocab axis INSIDE the
+    island (tiled concat in rank order — exact, no arithmetic), so the
+    verify accept/commit logic computes from identical full logits on
+    every rank."""
+    axis, n = tp
+    with _obs.comm_span("serve.tp_ring.logits",
+                        nbytes=logits.size * (n - 1) * logits.dtype.itemsize,
+                        site="serve.tp_ring.logits"):
+        return lax.all_gather(logits, axis, axis=logits.ndim - 1,
+                              tiled=True)
+
+
 def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
-                            ids, config: LlamaConfig, kv_scales=None):
+                            ids, config: LlamaConfig, kv_scales=None,
+                            tp=None):
     """One decode step over a PAGED cache: ids [B] i32, tables
     [B, max_nb] i32 block tables, positions [B] i32 = the slot each
     row's new token occupies (== its cached length; the block holding
@@ -949,7 +1037,10 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
     c = config
     b = ids.shape[0]
     hd = c.head_dim
-    h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)  # [B, H]
+    if tp is None:
+        h = jnp.take(params["embed"], ids, axis=0).astype(c.dtype)  # [B, H]
+    else:
+        h = _tp_vocab_embed(params["embed"], ids, tp).astype(c.dtype)
     cos, sin = build_rope_cache(b, hd, base=c.rope_theta,
                                 position_ids=positions[:, None])  # [B,1,·]
 
@@ -1001,11 +1092,14 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
         attn = jnp.einsum("bgred,ge->bgrd",
                           attn_full.reshape(b, nkv, rep, nkv, hd),
                           eye.astype(attn_full.dtype)).astype(c.dtype)
-        attn_out = _mat(attn.reshape(b, nh * hd), p["o_proj"])
+        ao = attn.reshape(b, nh * hd)
+        attn_out = (_mat(ao, p["o_proj"]) if tp is None
+                    else _tp_o_proj(ao, p["o_proj"], tp))
         h = h + attn_out
         x2 = fused_rms_norm(h[:, None], p["post_norm"], c.rms_norm_eps)[:, 0]
         gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
-        h = h + _mat(gated, p["down_proj"])
+        h = h + (_mat(gated, p["down_proj"]) if tp is None
+                 else _tp_down_proj(gated, p["down_proj"], tp))
         if kv_scales is None:
             return (h, kp, vp), None
         return (h, kp, vp, ksc, vsc), None
@@ -1026,7 +1120,7 @@ def llama_paged_decode_step(params, k_pool, v_pool, tables, positions,
 
 def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
                               ids, n_live, config: LlamaConfig,
-                              kv_scales=None):
+                              kv_scales=None, tp=None):
     """One chunked-prefill slice for ONE sequence: ids [C] i32 padded
     to the chunk bucket, n_live (traced) real tokens, start (traced) =
     tokens already cached from earlier chunks. Scatters the chunk's KV
@@ -1048,7 +1142,10 @@ def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
     bs = k_pool.shape[-1]
     max_nb = table_row.shape[0]
     T = max_nb * bs
-    h = jnp.take(params["embed"], ids, axis=0)[None].astype(c.dtype)
+    if tp is None:
+        h = jnp.take(params["embed"], ids, axis=0)[None].astype(c.dtype)
+    else:
+        h = _tp_vocab_embed(params["embed"], ids, tp)[None].astype(c.dtype)
     pidx = start + jnp.arange(C, dtype=jnp.int32)          # [C] positions
     cos, sin = build_rope_cache(C, hd, base=c.rope_theta,
                                 position_ids=pidx)         # [C, hd/2]
@@ -1130,11 +1227,14 @@ def llama_paged_prefill_chunk(params, k_pool, v_pool, table_row, start,
         probs = jax.nn.softmax(s, axis=-1).astype(vg.dtype)
         attn = jnp.einsum("cgrt,gdt->cgrd", probs, vg,
                           preferred_element_type=jnp.float32).astype(c.dtype)
-        attn_out = _mat(attn.reshape(1, C, nh * hd), p["o_proj"])
+        ao = attn.reshape(1, C, nh * hd)
+        attn_out = (_mat(ao, p["o_proj"]) if tp is None
+                    else _tp_o_proj(ao, p["o_proj"], tp))
         h = h + attn_out
         x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
         gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
-        h = h + _mat(gated, p["down_proj"])
+        h = h + (_mat(gated, p["down_proj"]) if tp is None
+                 else _tp_down_proj(gated, p["down_proj"], tp))
         if kv_scales is None:
             return (h, kp, vp), None
         return (h, kp, vp, ksc, vsc), None
@@ -1203,6 +1303,127 @@ def _jitted_paged_prefill_quant(frozen):
     return jax.jit(paged_prefill_quant_fn, donate_argnums=(1, 2, 3, 4))
 
 
+# KV/scale pools [L, NP, NKV*HD|NKV, bs] shard their kv-head-major axis
+# 2 across 'mp' — each rank runs the unchanged paged kernels (and their
+# shape-priced _fit_* fitters) on its head shard with the SAME
+# rank-replicated block tables, so BlockPool / PrefixCache / the commit
+# schedule stay host-side and rank-agnostic.
+_TP_POOL_SPEC = P(None, None, "mp", None)
+
+
+def _tp_specs(config: LlamaConfig, mesh: Mesh):
+    """(param pspec tree, ``tp`` tuple) for a serving island: weights
+    sliced per param_pspecs over 'mp' alone (no fsdp inside the serving
+    mesh). The trees only match PLAIN param arrays — the engine rejects
+    fused/int8 weight dicts under TP at init."""
+    n = int(mesh.shape["mp"])
+    return param_pspecs(config, ParallelConfig(mp=n)), ("mp", n)
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_decode_tp(frozen, mesh):
+    """mp-sharded twin of _jitted_paged_decode: one fully-manual
+    shard_map island per decode step (the paged Pallas kernels cannot be
+    auto-partitioned under GSPMD). Logits leave vocab-sharded
+    P(None, 'mp') — the engine's host argmax reads the exact concat."""
+    config = LlamaConfig(*frozen)
+    pspecs, tp = _tp_specs(config, mesh)
+    rep = P()
+
+    def step(params, kp, vp, tables, positions, ids):
+        return llama_paged_decode_step(params, kp, vp, tables, positions,
+                                       ids, config, tp=tp)
+
+    body = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, _TP_POOL_SPEC, _TP_POOL_SPEC, rep, rep, rep),
+        out_specs=(P(None, "mp"), _TP_POOL_SPEC, _TP_POOL_SPEC),
+        check_vma=False)
+
+    def paged_decode_tp_fn(params, kp, vp, tables, positions, ids):
+        return body(params, kp, vp, tables, positions, ids)
+    paged_decode_tp_fn.__name__ = "paged_decode_step_tp"
+    return jax.jit(paged_decode_tp_fn, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_decode_quant_tp(frozen, mesh):
+    config = LlamaConfig(*frozen)
+    pspecs, tp = _tp_specs(config, mesh)
+    rep = P()
+
+    def step(params, kp, vp, ks, vs, tables, positions, ids):
+        return llama_paged_decode_step(params, kp, vp, tables, positions,
+                                       ids, config, kv_scales=(ks, vs),
+                                       tp=tp)
+
+    body = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, _TP_POOL_SPEC, _TP_POOL_SPEC, _TP_POOL_SPEC,
+                  _TP_POOL_SPEC, rep, rep, rep),
+        out_specs=(P(None, "mp"), _TP_POOL_SPEC, _TP_POOL_SPEC,
+                   _TP_POOL_SPEC, _TP_POOL_SPEC),
+        check_vma=False)
+
+    def paged_decode_quant_tp_fn(params, kp, vp, ks, vs, tables,
+                                 positions, ids):
+        return body(params, kp, vp, ks, vs, tables, positions, ids)
+    paged_decode_quant_tp_fn.__name__ = "paged_decode_step_int8_tp"
+    return jax.jit(paged_decode_quant_tp_fn, donate_argnums=(1, 2, 3, 4))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_prefill_tp(frozen, mesh):
+    config = LlamaConfig(*frozen)
+    pspecs, tp = _tp_specs(config, mesh)
+    rep = P()
+
+    def step(params, kp, vp, table_row, start, ids, n_live):
+        return llama_paged_prefill_chunk(params, kp, vp, table_row,
+                                         start, ids, n_live, config,
+                                         tp=tp)
+
+    body = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, _TP_POOL_SPEC, _TP_POOL_SPEC, rep, rep, rep,
+                  rep),
+        out_specs=(P("mp"), _TP_POOL_SPEC, _TP_POOL_SPEC),
+        check_vma=False)
+
+    def paged_prefill_tp_fn(params, kp, vp, table_row, start, ids,
+                            n_live):
+        return body(params, kp, vp, table_row, start, ids, n_live)
+    paged_prefill_tp_fn.__name__ = "paged_prefill_chunk_tp"
+    return jax.jit(paged_prefill_tp_fn, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_prefill_quant_tp(frozen, mesh):
+    config = LlamaConfig(*frozen)
+    pspecs, tp = _tp_specs(config, mesh)
+    rep = P()
+
+    def step(params, kp, vp, ks, vs, table_row, start, ids, n_live):
+        return llama_paged_prefill_chunk(params, kp, vp, table_row,
+                                         start, ids, n_live, config,
+                                         kv_scales=(ks, vs), tp=tp)
+
+    body = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, _TP_POOL_SPEC, _TP_POOL_SPEC, _TP_POOL_SPEC,
+                  _TP_POOL_SPEC, rep, rep, rep, rep),
+        out_specs=(P("mp"), _TP_POOL_SPEC, _TP_POOL_SPEC, _TP_POOL_SPEC,
+                   _TP_POOL_SPEC),
+        check_vma=False)
+
+    def paged_prefill_quant_tp_fn(params, kp, vp, ks, vs, table_row,
+                                  start, ids, n_live):
+        return body(params, kp, vp, ks, vs, table_row, start, ids,
+                    n_live)
+    paged_prefill_quant_tp_fn.__name__ = "paged_prefill_chunk_int8_tp"
+    return jax.jit(paged_prefill_quant_tp_fn, donate_argnums=(1, 2, 3, 4))
+
+
 # ---------------------------------------------------------------------------
 # speculative decoding (PR 18): draft model + batched paged verification
 # ---------------------------------------------------------------------------
@@ -1233,7 +1454,7 @@ def make_draft_model(params, config: LlamaConfig, num_layers: int = 1):
 
 def llama_paged_verify_step(params, k_pool, v_pool, tables, qstart,
                             t_live, fed, config: LlamaConfig,
-                            kv_scales=None):
+                            kv_scales=None, tp=None):
     """Score T fed tokens per sequence in ONE base-model pass over a
     paged cache, greedily accept/reject, and commit only accepted KV.
 
@@ -1273,7 +1494,10 @@ def llama_paged_verify_step(params, k_pool, v_pool, tables, qstart,
     c = config
     B, T = fed.shape
     hd = c.head_dim
-    h = jnp.take(params["embed"], fed, axis=0).astype(c.dtype)  # [B,T,H]
+    if tp is None:
+        h = jnp.take(params["embed"], fed, axis=0).astype(c.dtype)  # [B,T,H]
+    else:
+        h = _tp_vocab_embed(params["embed"], fed, tp).astype(c.dtype)
     pos2d = qstart[:, None] + jnp.arange(T, dtype=jnp.int32)    # [B,T]
     cos, sin = build_rope_cache(T, hd, base=c.rope_theta,
                                 position_ids=pos2d)             # [B,T,hd/2]
@@ -1359,17 +1583,25 @@ def llama_paged_verify_step(params, k_pool, v_pool, tables, qstart,
         attn = jnp.einsum("btgred,ge->btgrd",
                           attn_rows.reshape(B, T, nkv, rep, nkv, hd),
                           eye.astype(attn_rows.dtype)).astype(c.dtype)
-        attn_out = _mat(attn.reshape(B, T, nh * hd), p["o_proj"])
+        ao = attn.reshape(B, T, nh * hd)
+        attn_out = (_mat(ao, p["o_proj"]) if tp is None
+                    else _tp_o_proj(ao, p["o_proj"], tp))
         h = h + attn_out
         x2 = fused_rms_norm(h, p["post_norm"], c.rms_norm_eps)
         gated = jax.nn.silu(_mat(x2, p["gate_proj"])) * _mat(x2, p["up_proj"])
-        h = h + _mat(gated, p["down_proj"])
+        h = h + (_mat(gated, p["down_proj"]) if tp is None
+                 else _tp_down_proj(gated, p["down_proj"], tp))
         return (h,), ys
 
     n_layers = k_pool.shape[0]
     xs = (params["layers"], jnp.arange(n_layers, dtype=jnp.int32))
     (h,), cols = lax.scan(layer_step, (h,), xs)
     logits = llama_logits(params, h, config).astype(jnp.float32)
+    if tp is not None:
+        # full-vocab logits on every rank (exact concat) so the argmax /
+        # accept / commit_len below — and hence the commit kernel each
+        # rank drives on its pool shard — are rank-identical
+        logits = _tp_gather_logits(logits, tp)
     # per-row finite screen: the engine sees tokens, not logits, so the
     # poison/quarantine contract needs the flag computed here
     fin_ok = jnp.isfinite(logits).all(axis=(1, 2))             # [B]
@@ -1420,6 +1652,59 @@ def _jitted_paged_verify_quant(frozen):
                                        kv_scales=(ks, vs))
     paged_verify_quant_fn.__name__ = "paged_verify_step_int8"
     return jax.jit(paged_verify_quant_fn, donate_argnums=(1, 2, 3, 4))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_verify_tp(frozen, mesh):
+    """mp-sharded verify: logits all-gather in-island (exact vocab
+    concat) so out/commit_len/fin_ok are computed rank-identically and
+    each rank drives the commit kernel on its pool shard with the same
+    schedule — they leave the island replicated."""
+    config = LlamaConfig(*frozen)
+    pspecs, tp = _tp_specs(config, mesh)
+    rep = P()
+
+    def step(params, kp, vp, tables, qstart, t_live, fed):
+        return llama_paged_verify_step(params, kp, vp, tables, qstart,
+                                       t_live, fed, config, tp=tp)
+
+    body = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, _TP_POOL_SPEC, _TP_POOL_SPEC, rep, rep, rep,
+                  rep),
+        out_specs=(rep, rep, rep, _TP_POOL_SPEC, _TP_POOL_SPEC),
+        check_vma=False)
+
+    def paged_verify_tp_fn(params, kp, vp, tables, qstart, t_live, fed):
+        return body(params, kp, vp, tables, qstart, t_live, fed)
+    paged_verify_tp_fn.__name__ = "paged_verify_step_tp"
+    return jax.jit(paged_verify_tp_fn, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_paged_verify_quant_tp(frozen, mesh):
+    config = LlamaConfig(*frozen)
+    pspecs, tp = _tp_specs(config, mesh)
+    rep = P()
+
+    def step(params, kp, vp, ks, vs, tables, qstart, t_live, fed):
+        return llama_paged_verify_step(params, kp, vp, tables, qstart,
+                                       t_live, fed, config,
+                                       kv_scales=(ks, vs), tp=tp)
+
+    body = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspecs, _TP_POOL_SPEC, _TP_POOL_SPEC, _TP_POOL_SPEC,
+                  _TP_POOL_SPEC, rep, rep, rep, rep),
+        out_specs=(rep, rep, rep, _TP_POOL_SPEC, _TP_POOL_SPEC,
+                   _TP_POOL_SPEC, _TP_POOL_SPEC),
+        check_vma=False)
+
+    def paged_verify_quant_tp_fn(params, kp, vp, ks, vs, tables, qstart,
+                                 t_live, fed):
+        return body(params, kp, vp, ks, vs, tables, qstart, t_live, fed)
+    paged_verify_quant_tp_fn.__name__ = "paged_verify_step_int8_tp"
+    return jax.jit(paged_verify_quant_tp_fn, donate_argnums=(1, 2, 3, 4))
 
 
 def generate_scan(params, cache, first_token, num_tokens,

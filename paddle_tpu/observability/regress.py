@@ -170,6 +170,15 @@ def rungs_from_bench_detail(doc: Dict) -> Dict:
         rungs["serve_spec_speedup"] = ss["speedup"]
         rungs["serve_spec_parity"] = bool(
             ss["streams_identical"] and ss["pool_leak_free"])
+    if "serve_tp" in detail and "streams_identical" in detail["serve_tp"]:
+        st = detail["serve_tp"]
+        # token-bitwise parity at every sharded degree plus leak-free
+        # pools is the gate the feature ships under (PARITY.md)
+        rungs["serve_tp_parity"] = bool(
+            st["streams_identical"] and st["pool_leak_free"])
+        # off-TPU this measures sharding overhead on a time-sliced host
+        # (expected < 1); on TPU it is the real mp scaling number
+        rungs["serve_tp_speedup"] = st["wall_speedup_top"]
     if "varlen_ceiling_ablation" in detail:
         # standalone (off-TPU) run of the ceiling rung; on TPU the same
         # rung names come from packed_varlen's ceiling_ablation above

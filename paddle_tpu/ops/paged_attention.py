@@ -433,7 +433,14 @@ def _fit_paged_kv_blocks(nh, kvd, nkv, bs, itemsize):
     windows (q + int8 k/v tiles + f32 scale tiles + outputs + scratch)
     and fails at trace time if a configuration could not fit, instead
     of compile-failing only on hardware. Returns (kvd, bs, nkv)
-    unchanged."""
+    unchanged.
+
+    Under tensor-parallel serving (PR 19) this fitter runs INSIDE the
+    shard_map island, so nh/nkv here are the per-rank head counts
+    (NH/mp, NKV/mp) read off the rank's pool slice — per-shard window
+    budgets fall out of the argument shapes with no TP-specific fitter
+    code, and a geometry that only fits when sharded is accepted
+    exactly when the sharded kernel actually runs."""
     win = (2 * nh * kvd * 4                 # q window (f32-priced)
            + 2 * 2 * kvd * bs * itemsize    # k/v tiles
            + 2 * 2 * nkv * bs * 4           # scale tiles
@@ -810,7 +817,9 @@ def _fit_paged_verify_blocks(r, kvd, nkv, bs, itemsize):
     this prices the verify read's double-buffered windows — r = T*NH
     query rows instead of NH, plus the three partial outputs — and
     fails at trace time if they could not fit. Returns (kvd, bs, nkv)
-    unchanged."""
+    unchanged. Under tensor-parallel serving (PR 19) r and nkv are the
+    per-rank values seen inside the shard_map island, so verify
+    windows are priced per shard automatically."""
     win = (2 * r * kvd * 4                  # q window
            + 2 * 2 * kvd * bs * itemsize    # k/v tiles
            + 2 * 2 * nkv * bs * 4           # scale tiles (quant path)
