@@ -1763,6 +1763,175 @@ def bench_serve_tp(dev, config, on_tpu):
     return out
 
 
+def bench_serve_fleet(dev, config, on_tpu):
+    """PR-20 tentpole rung: the multi-replica serving fleet. One
+    shared-prefix Poisson trace served by N in {1, 2, 4} FleetRouter
+    replicas (prefix caching on, per-replica journals), reporting
+    per-N tokens/s and the router's affinity hit rate, plus the gates
+    the feature ships under: every fleet's streams token-bitwise-
+    identical to the lone engine's (greedy decode is a pure function
+    of prompt + weights — replica count cannot change tokens), an A/B
+    of affinity vs seeded-random dispatch on fleet-wide prefix-cache
+    reuse, a chaos cell (kill one replica mid-burst: zero lost
+    accepted requests, migrated streams bit-identical), and a rolling
+    fleet-wide weight swap (every replica swaps at its idle boundary,
+    zero drops).
+
+    Off-TPU the replicas time-slice one host, so wall-clock "speedup"
+    measures router + duplication overhead, not parallel speedup — the
+    honest wins there are the affinity hit-rate delta and the chaos /
+    rolling-swap gates; the TPU round lands real scaling numbers."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.inference import (FleetRouter, InferenceEngine,
+                                      Request, ServeConfig)
+    from paddle_tpu.models.llama import init_llama_params, llama_tiny
+
+    rng = np.random.RandomState(20)
+    if on_tpu:
+        cfg = config
+        serve_kw = dict(block_size=128, num_blocks=257, max_batch=8,
+                        prefill_chunk=256, max_seq_len=2048,
+                        prefix_cache=True)
+        n_req, rate, max_new, sys_len, tail = 24, 12.0, 32, 512, (16, 96)
+    else:
+        cfg = llama_tiny(vocab=96, hidden=64, layers=1, heads=4,
+                         kv_heads=2, seq=512)
+        serve_kw = dict(block_size=128, num_blocks=10, max_batch=2,
+                        prefill_chunk=32, max_seq_len=256,
+                        prefix_cache=True)
+        n_req, rate, max_new, sys_len, tail = 10, 4.0, 6, 140, (6, 16)
+    params = init_llama_params(cfg, seed=0)
+    system = rng.randint(1, cfg.vocab_size, size=sys_len).tolist()
+    prompts = [system + rng.randint(1, cfg.vocab_size,
+                                    size=rng.randint(*tail)).tolist()
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+
+    def det_reqs():
+        # iteration-clock arrivals, spaced so the shared prefix is
+        # derived before later submits probe for it
+        return [Request(list(p), max_new_tokens=max_new,
+                        arrival=float(2 * i))
+                for i, p in enumerate(prompts)]
+
+    def det_run(n, policy="affinity", **runkw):
+        d = tempfile.mkdtemp(prefix="fleet_bench_")
+        try:
+            fleet = FleetRouter(params, cfg, ServeConfig(**serve_kw),
+                                n_replicas=n, journal_dir=d,
+                                policy=policy)
+            stats = fleet.run(det_reqs(), deterministic=True, **runkw)
+            return fleet, stats, fleet.streams()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    def wall_run(n):
+        fleet = FleetRouter(params, cfg, ServeConfig(**serve_kw),
+                            n_replicas=n)
+        reqs = [Request(list(p), max_new_tokens=max_new,
+                        arrival=float(t))
+                for p, t in zip(prompts, arrivals)]
+        t0 = time.perf_counter()
+        stats = fleet.run(reqs)
+        return fleet, stats, time.perf_counter() - t0
+
+    # lone-engine reference: the bit-identity oracle for every fleet
+    ref_eng = InferenceEngine(params, cfg, ServeConfig(**serve_kw))
+    reqs = det_reqs()
+    for i, r in enumerate(reqs):
+        r.request_id = i
+    ref_eng.run(reqs, deterministic=True)
+    ref = {s.req.request_id: list(s.generated) for s in ref_eng.finished}
+
+    per_n, parity, leak_free, zero_lost = {}, True, True, True
+    for n in (1, 2, 4):
+        det_run(n)  # warm the jit caches outside timing
+        fleet_d, st_d, toks = det_run(n)
+        fleet_w, st_w, wall = wall_run(n)
+        parity = parity and (toks == ref)
+        zero_lost = zero_lost and st_d["lost"] == 0 == st_w["lost"]
+        leak_free = leak_free and all(
+            fleet_d.engines[i].pool.used_blocks == 0
+            for i in fleet_d._live())
+        per_n[f"n{n}"] = {
+            "tokens_per_iteration": round(
+                st_d["generated_tokens"] / max(st_d["iterations"], 1),
+                3),
+            "wall_tokens_per_sec": round(
+                st_w["generated_tokens"] / wall, 2),
+            # worst live replica's streaming TTFT p99 (the fleet's
+            # client-visible tail)
+            "ttft_p99_s": round(max(
+                fleet_w.engines[i].slo["ttft"].percentile(99) or 0.0
+                for i in fleet_w._live()), 4),
+            "affinity_hit_rate": (round(st_d["affinity_hit_rate"], 3)
+                                  if st_d["affinity_hit_rate"]
+                                  is not None else None),
+            "spills": st_d["spills"],
+            "routed_per_replica": st_d["routed_per_replica"],
+        }
+
+    # A/B: affinity vs seeded-random dispatch, fleet-wide cache reuse
+    fleet_a, st_a, _ = det_run(4)
+    fleet_r, st_r, toks_r = det_run(4, policy="random")
+    aff_tokens = sum(e.cache.hit_tokens for e in fleet_a.engines)
+    rnd_tokens = sum(e.cache.hit_tokens for e in fleet_r.engines)
+
+    # chaos: kill replica 0 mid-burst, journal migration onto survivors
+    fleet_c, st_c, toks_c = det_run(3, kill_at=(n_req, 0))
+
+    # rolling fleet-wide weight swap under traffic (same weights, so
+    # bit-identity doubles as the zero-drop check)
+    fleet_s, st_s, toks_s = det_run(3, rolling_swap_at=3,
+                                    swap_source=params)
+
+    base = per_n["n1"]["wall_tokens_per_sec"]
+    top = per_n["n4"]["wall_tokens_per_sec"]
+    out = {
+        "requests": n_req,
+        "replica_counts": [1, 2, 4],
+        **per_n,
+        "wall_speedup_top": round(top / max(base, 1e-9), 2),
+        "streams_identical": parity,
+        "zero_lost": zero_lost,
+        "pool_leak_free": leak_free,
+        "affinity_ab": {
+            "affinity_hit_tokens": aff_tokens,
+            "random_hit_tokens": rnd_tokens,
+            "affinity_wins": bool(aff_tokens >= rnd_tokens),
+            "random_streams_identical": toks_r == ref,
+        },
+        "chaos_kill": {
+            "migrations": st_c["migrations"],
+            "lost": st_c["lost"],
+            "streams_identical": toks_c == ref,
+            "survivors_leak_free": all(
+                fleet_c.engines[i].pool.used_blocks == 0
+                for i in fleet_c._live()),
+        },
+        "rolling_swap": {
+            "swapped": st_s["rolling_swaps"],
+            "lost": st_s["lost"],
+            "streams_identical": toks_s == ref,
+            "drops": sum(e.last_swap["in_flight_running"]
+                         + e.last_swap["in_flight_prefill"]
+                         for e in fleet_s.engines
+                         if e.last_swap is not None),
+        },
+        "arrival_trace": {"process": "poisson", "rate_per_s": rate,
+                          "shared_prefix_tokens": sys_len},
+    }
+    if not on_tpu:
+        out["note"] = ("tiny config with replicas time-slicing one "
+                       "host — parity, zero-lost and hit-rate gates "
+                       "are exact; wall-clock speedup measures router "
+                       "overhead, not parallel scaling; TPU round "
+                       "lands real numbers")
+    return out
+
+
 def _static_analysis_record():
     """Per-rule finding counts from paddle_tpu.analysis — the bench
     record carries the lint posture of the tree the numbers came from
@@ -1923,6 +2092,10 @@ def main():
     # plans, sharded KV pools, bitwise parity vs mp=1 — both backends
     # (off-TPU needs the virtual CPU mesh: XLA_FLAGS device count >= 2)
     detail["serve_tp"] = bench_serve_tp(dev, config, on_tpu)
+
+    # multi-replica fleet serving (PR 20): prefix-affinity router over
+    # N engines, chaos kill + journal migration, rolling weight swap
+    detail["serve_fleet"] = bench_serve_fleet(dev, config, on_tpu)
 
     # fleet observability (PR 15): attributed FleetMonitor cost + loss
     # parity monitored vs bare — runs on both backends

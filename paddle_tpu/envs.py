@@ -514,6 +514,32 @@ _register(
     parse=_positive_int("PADDLE_TPU_SERVE_MP", 1))
 
 _register(
+    "PADDLE_TPU_FLEET_SERVE_REPLICAS", "int", 2,
+    doc="Replica count of the serving FleetRouter (PR 20): N "
+        "InferenceEngine replicas behind one prefix-affinity router. "
+        "Positive integer; FleetRouter(n_replicas=) wins.",
+    parse=_positive_int("PADDLE_TPU_FLEET_SERVE_REPLICAS", 2))
+
+_register(
+    "PADDLE_TPU_FLEET_SERVE_SPILL", "int", 4,
+    doc="Queue-depth spill threshold of the FleetRouter's prefix-"
+        "affinity dispatch (PR 20): when the affinity replica's queue "
+        "depth + in-flight count reaches this, the request spills to "
+        "the least-loaded live replica instead (counted as a "
+        "rebalance), so adversarial prefix skew never starves N-1 "
+        "replicas. Positive integer; FleetRouter(spill=) wins.",
+    parse=_positive_int("PADDLE_TPU_FLEET_SERVE_SPILL", 4))
+
+_register(
+    "PADDLE_TPU_FLEET_SERVE_JOURNAL_DIR", "str", None,
+    doc="Directory for per-replica FleetRouter journals (PR 20): each "
+        "replica writes replica_<i>.jsonl there, and kill_replica() "
+        "re-drives a dead replica's unfinished journal entries onto "
+        "survivors bit-identically. Unset/empty disables fleet "
+        "journaling; FleetRouter(journal_dir=) wins.",
+    parse=lambda value: value or None)
+
+_register(
     "PADDLE_TPU_FLEET", "bool", False,
     doc="Wire a FleetMonitor (PR 15) into jit.TrainStep: per-rank step "
         "times, per-site comm_span hop stats and all-device memory are "

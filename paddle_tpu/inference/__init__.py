@@ -256,9 +256,11 @@ __all__ = ["Config", "Predictor", "PredictorPool", "create_predictor",
 from .kv_cache import BlockPool, BlockPoolError, PrefixCache, pad_table  # noqa: E402
 from .engine import (Admission, AdmissionController, InferenceEngine,  # noqa: E402
                      PoisonError, Request, ServeConfig)
-from .journal import EngineJournal, read_journal  # noqa: E402
+from .journal import (EngineJournal, JournalCompatError,  # noqa: E402
+                      read_journal)
+from .fleet import FleetRouter  # noqa: E402
 
 __all__ += ["BlockPool", "BlockPoolError", "PrefixCache", "pad_table",
             "InferenceEngine", "Request", "ServeConfig", "Admission",
             "AdmissionController", "PoisonError", "EngineJournal",
-            "read_journal"]
+            "JournalCompatError", "read_journal", "FleetRouter"]

@@ -96,7 +96,7 @@ from ..observability.registry import MetricsRegistry
 from ..observability.metrics import StepMetrics
 from ..observability.request_trace import RequestTracer
 from ..observability.trace import comm_span, record_counter
-from .journal import EngineJournal, read_journal
+from .journal import EngineJournal, JournalCompatError, read_journal
 from .kv_cache import (BlockPool, PrefixCache, pad_table,
                        pool_bytes_per_rank)
 
@@ -467,6 +467,10 @@ class InferenceEngine:
         self._pending_swap: Optional[Tuple[Any, int]] = None
         self.swaps = 0
         self.last_swap: Optional[Dict[str, Any]] = None
+        # drain mode (PR 20): submit() rejects with cause 'draining'
+        # while existing work runs to completion (drain() / the fleet
+        # router's rolling swap both flip this)
+        self._draining = False
 
     # jitted step families, keyed (kind, quant): the mp-sharded twins
     # are drop-in — same argument lists, same output tuples — so every
@@ -900,14 +904,19 @@ class InferenceEngine:
         if not len(req.prompt):
             raise ValueError(f"request {req.request_id}: empty prompt")
         faults.inject("serve.admit.before", rid=req.request_id)
-        demand, new_shared = self._demand_and_shared(req)
-        worst_blocks = max(self.pool.blocks_for(worst) - new_shared, 1)
-        cause = self.admission.decide(
-            queue_len=len(self.waiting),
-            demand_blocks=demand,
-            worst_blocks=worst_blocks,
-            usable_blocks=self.serve.num_blocks - 1,
-            now=self._clock)
+        if self._draining:
+            # a draining engine admits nothing new — checked before the
+            # admission valves so draining never spends bucket tokens
+            cause = "draining"
+        else:
+            demand, new_shared = self._demand_and_shared(req)
+            worst_blocks = max(self.pool.blocks_for(worst) - new_shared, 1)
+            cause = self.admission.decide(
+                queue_len=len(self.waiting),
+                demand_blocks=demand,
+                worst_blocks=worst_blocks,
+                usable_blocks=self.serve.num_blocks - 1,
+                now=self._clock)
         if cause is not None:
             self.rejected.append((req, cause))
             record_counter("serve.reject")
@@ -933,6 +942,57 @@ class InferenceEngine:
             self._journal.submit(req)
         faults.inject("serve.admit.after", rid=req.request_id)
         return Admission(True, req.request_id)
+
+    def adopt(self, req: Request,
+              generated: Sequence[int] = ()) -> None:
+        """Adopt an already-ACCEPTED request migrated from another
+        engine (fleet journal migration, PR 20): enqueue it BYPASSING
+        the admission valves — accepted work is never re-rejected —
+        with its already-emitted tokens attached, exactly as
+        ``recover()`` rebuilds an unfinished rid. Greedy decode is
+        deterministic in (prompt + history), so the continuation stream
+        is bit-identical to the donor's would-have-been stream. The
+        request is re-journaled on THIS engine (submit + inherited
+        tokens), so a second crash recovers from this journal alone,
+        without the dead donor's file."""
+        if req.request_id is None:
+            req.request_id = next(self._rid)
+        seq = _Seq(req, self._clock)
+        seq.order = next(self._seqno)
+        seq.tokens.extend(int(t) for t in generated)
+        seq.recovered = True
+        if seq.generated:
+            # its first token predates this engine: never re-measure TTFT
+            seq.first_token_t = seq.arrival
+        if self._journal is not None:
+            self._journal.submit(req)
+            if seq.generated:
+                self._journal.tokens(
+                    self.iteration,
+                    [(req.request_id, t) for t in seq.generated])
+        if seq.done():
+            # the donor emitted its last token but never journaled the
+            # finish mark: already complete, no re-drive
+            seq.state = FINISHED
+            self.finished.append(seq)
+            if self._journal is not None:
+                self._journal.finish(req.request_id)
+        else:
+            self.waiting.append(seq)
+        self._recovered += 1
+        record_counter("serve.adopt")
+        self._event("adopt", req.request_id, len(seq.generated))
+
+    def load_signal(self) -> Tuple[float, float, float]:
+        """Composite load for fleet routing (PR 20), host-side and
+        cheap: (queue depth + in-flight, -available blocks, streaming
+        TTFT p99). Every component is a pure function of scheduler
+        state and the engine clock, so identical replays expose
+        identical load and routing stays deterministic."""
+        p99 = self.slo["ttft"].percentile(99)
+        return (float(len(self.waiting) + len(self.active)),
+                -float(self.pool.available_blocks),
+                float(p99 if p99 is not None else 0.0))
 
     def step(self) -> List[_Seq]:
         """One scheduler iteration: admit, one prefill chunk, one decode
@@ -1746,6 +1806,30 @@ class InferenceEngine:
             self._journal.flush()
         return self.stats()
 
+    def drain(self, deterministic: bool = False,
+              max_iterations: int = 100000
+              ) -> Dict[int, Tuple[str, Optional[str]]]:
+        """Graceful wind-down: stop admitting (every later ``submit()``
+        rejects with cause ``draining``), run the already-accepted work
+        to completion, and return the total :meth:`outcomes` map. The
+        overload contract holds throughout — ``outcomes()`` stays total
+        during and after the drain, with drained-away submissions
+        showing as ``("rejected", "draining")``. The engine stays
+        usable: :meth:`undrain` re-opens admissions (the fleet's
+        rolling weight swap drains, swaps, then undrains each replica
+        in turn)."""
+        self._draining = True
+        record_counter("serve.drain")
+        self._event("drain")
+        self.run([], deterministic=deterministic,
+                 max_iterations=max_iterations)
+        return self.outcomes()
+
+    def undrain(self) -> None:
+        """Re-open admissions after :meth:`drain`."""
+        self._draining = False
+        self._event("undrain")
+
     def recover(self, journal_path: Optional[str] = None
                 ) -> Dict[str, Any]:
         """Rebuild scheduler state from an engine journal after a crash.
@@ -1768,6 +1852,33 @@ class InferenceEngine:
                 "recover() needs a journal: pass journal_path= or build "
                 "the engine with journal=/PADDLE_TPU_SERVE_JOURNAL")
         st = read_journal(path)
+        # up-front portability screen (PR 20): either this engine can
+        # re-drive the journal bit-identically, or refuse before any
+        # state is touched. kv_dtype is the one stream-changing axis
+        # (int8 quantization is the documented numeric deviation);
+        # mp / prefix_cache / speculative differences recover freely —
+        # PARITY.md pins their streams as bit-identical.
+        j_dtype = st.meta.get("kv_dtype")
+        if j_dtype is not None and j_dtype != self.kv_dtype:
+            raise JournalCompatError(
+                f"recover(): journal {path!r} was written with "
+                f"kv_dtype={j_dtype!r} but this engine stores "
+                f"{self.kv_dtype!r}; crossing the int8 quantization "
+                f"boundary changes token streams, so the re-drive "
+                f"would not be bit-identical")
+        for rid in st.unfinished_rids():
+            rec = st.requests[rid]
+            worst = len(rec["prompt"]) + int(rec["max_new_tokens"])
+            if worst > self.serve.max_seq_len:
+                raise JournalCompatError(
+                    f"recover(): journaled request {rid} needs {worst} "
+                    f"tokens but this engine's max_seq_len is "
+                    f"{self.serve.max_seq_len}")
+            if self.pool.blocks_for(worst) > self.serve.num_blocks - 1:
+                raise JournalCompatError(
+                    f"recover(): journaled request {rid} can never fit "
+                    f"this engine's pool ({worst} tokens > "
+                    f"{self.serve.num_blocks - 1} usable blocks)")
         for seq in itertools.chain(self.active, self.waiting):
             self._release(seq)
         self.active, self.waiting = [], []

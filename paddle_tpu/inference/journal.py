@@ -46,9 +46,22 @@ import json
 import os
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
-__all__ = ["EngineJournal", "JournalState", "read_journal"]
+__all__ = ["EngineJournal", "JournalCompatError", "JournalState",
+           "read_journal"]
 
 _VERSION = 1
+
+
+class JournalCompatError(ValueError):
+    """A journal cannot be recovered onto THIS engine configuration.
+
+    Raised up front by ``InferenceEngine.recover()`` — before any state
+    is touched — when the successor's ``ServeConfig`` breaks the bit-
+    identical re-drive contract: a different ``kv_dtype`` (int8 is the
+    documented numeric deviation, so crossing it changes streams), or a
+    journaled request that can never fit the successor's ``max_seq_len``
+    / block pool. Config differences that PARITY.md pins as bit-identical
+    (mp degree, prefix caching, speculation) recover freely."""
 
 
 class EngineJournal:
@@ -180,6 +193,17 @@ class EngineJournal:
             self.flush()
             self._f.close()
 
+    def abandon(self) -> None:
+        """Crash-simulation close: drop the buffered records and close
+        the fd WITHOUT flushing — exactly what the OS does to a killed
+        process. What dies with the buffer (tokens, finish marks) is
+        re-derived by recovery; durable appends already hit the OS.
+        Used by the fleet's ``kill_replica`` so a killed replica's
+        journal looks like a real crash, torn tail and all."""
+        self._buf = []
+        if not self._f.closed:
+            self._f.close()
+
 
 @dataclasses.dataclass
 class JournalState:
@@ -192,6 +216,10 @@ class JournalState:
     failed: Dict[int, str]
     swaps: int = 0
     torn_lines: int = 0
+    # the FIRST open record's audit fields (kv_dtype, prefix_cache,
+    # speculative, mp) — the configuration that produced the journaled
+    # tokens; recover() checks successor compatibility against it
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def terminal_rids(self) -> Set[int]:
         return (self.finished | set(self.shed) | set(self.failed)
@@ -237,5 +265,10 @@ def read_journal(path: str) -> JournalState:
                 st.failed[int(rec["rid"])] = rec.get("cause", "")
             elif ev == "swap":
                 st.swaps += 1
-            # open/recover records carry no replay state
+            elif ev == "open" and not st.meta:
+                # the ORIGINAL writer's configuration; resume reopens
+                # append later open records but never shadow the first
+                st.meta = {k: v for k, v in rec.items()
+                           if k not in ("ev", "version", "resume")}
+            # recover records carry no replay state
     return st

@@ -179,6 +179,23 @@ def rungs_from_bench_detail(doc: Dict) -> Dict:
         # off-TPU this measures sharding overhead on a time-sliced host
         # (expected < 1); on TPU it is the real mp scaling number
         rungs["serve_tp_speedup"] = st["wall_speedup_top"]
+    if "serve_fleet" in detail and "streams_identical" in detail[
+            "serve_fleet"]:
+        sf = detail["serve_fleet"]
+        # the PR-20 ship gate: every fleet size bit-identical to the
+        # lone engine, zero lost accepted requests (incl. the chaos
+        # kill), leak-free pools, and a rolling swap with zero drops
+        rungs["serve_fleet_parity"] = bool(
+            sf["streams_identical"] and sf["zero_lost"]
+            and sf["pool_leak_free"]
+            and sf["chaos_kill"]["lost"] == 0
+            and sf["chaos_kill"]["streams_identical"]
+            and sf["rolling_swap"]["lost"] == 0
+            and sf["rolling_swap"]["drops"] == 0
+            and sf["rolling_swap"]["streams_identical"])
+        # off-TPU this measures router + replica duplication overhead
+        # on a time-sliced host (~1.0); on TPU it is real fleet scaling
+        rungs["serve_fleet_speedup"] = sf["wall_speedup_top"]
     if "varlen_ceiling_ablation" in detail:
         # standalone (off-TPU) run of the ceiling rung; on TPU the same
         # rung names come from packed_varlen's ceiling_ablation above

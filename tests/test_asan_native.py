@@ -54,6 +54,7 @@ print("ASAN_DRIVER_OK")
 """
 
 
+@pytest.mark.slow   # sanitizer sweep: functional native-runtime coverage stays tier-1 in test_native_runtime; the ASAN rebuild + subprocess drive is the slow-tier deep check
 def test_native_runtime_clean_under_asan(tmp_path):
     if shutil.which("g++") is None:
         pytest.skip("no g++")

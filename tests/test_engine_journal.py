@@ -15,10 +15,13 @@ stream against an unkilled reference run.
 import json
 import os
 
+import shutil
+
 import numpy as np
 import pytest
 
-from paddle_tpu.inference import (EngineJournal, InferenceEngine, Request,
+from paddle_tpu.inference import (EngineJournal, InferenceEngine,
+                                  JournalCompatError, Request,
                                   ServeConfig, read_journal)
 from paddle_tpu.models.llama import init_llama_params, llama_tiny
 from paddle_tpu.ops import _common
@@ -367,6 +370,116 @@ def test_crash_matrix_with_speculation_and_int8(model, tmp_path, spec_ref,
     st = read_journal(path)
     assert st.finished == set(spec_ref)
     assert st.torn_lines == 0
+
+
+# -- cross-config recovery (PR 20) --------------------------------------------
+#
+# Journal portability: recover() onto a DIFFERENT ServeConfig either
+# re-drives bit-identically (differences PARITY pins as bit-identical:
+# mp sharding, pool size, prefix caching, speculation) or refuses up
+# front with JournalCompatError before touching engine state (kv_dtype
+# crossings — int8 is a documented numeric deviation — and capacity
+# misfits the successor can never serve).
+
+
+def _crashed_journal(model, tmp_path, reqs=None, **kw):
+    """Run a trace into a decode-point crash; the journal is the only
+    survivor. Each successor gets its OWN COPY — recover() reopens the
+    journal for append, so a shared file would accrete the first
+    successor's finish records."""
+    path = str(tmp_path / "cross.jsonl")
+    eng = _engine2(model, path, **kw)
+    with faults.scope("serve.decode.before", "raise", nth=3) as plan:
+        with pytest.raises(faults.FaultError):
+            eng.run(reqs if reqs is not None else _requests(),
+                    deterministic=True)
+        assert plan.fired == 1
+    return path
+
+
+def _engine2(model, journal, **kw):
+    cfg, params = model
+    serve = ServeConfig(block_size=128,
+                        num_blocks=kw.pop("num_blocks", 10),
+                        max_batch=2, prefill_chunk=32,
+                        max_seq_len=kw.pop("max_seq_len", 256), **kw)
+    return InferenceEngine(params, cfg, serve, record_events=True,
+                           journal=journal)
+
+
+def _recover_and_finish(model, path, reqs, **kw):
+    eng2 = _engine2(model, path, **kw)
+    eng2.recover()
+    journaled = ({s.req.request_id for s in eng2.waiting}
+                 | {s.req.request_id for s in eng2.finished})
+    resubmit = [Request(r.prompt, max_new_tokens=r.max_new_tokens,
+                        request_id=r.request_id)
+                for r in reqs if r.request_id not in journaled]
+    eng2.run(resubmit, deterministic=True)
+    assert eng2.pool.used_blocks == 0
+    return {s.req.request_id: s.generated for s in eng2.finished}
+
+
+@pytest.mark.parametrize("succ_kw", [
+    pytest.param(dict(mp=2), id="mp1-to-mp2"),
+    pytest.param(dict(num_blocks=24), id="bigger-pool"),
+    pytest.param(dict(prefix_cache=True), id="prefix-cache-on"),
+    pytest.param(dict(speculative=True, draft_k=3), id="speculative-on"),
+], )
+def test_cross_config_recovery_bit_identical(model, tmp_path, succ_kw):
+    """A journal written at one config recovers onto a config that
+    differs along a PARITY-pinned bit-identical axis: streams match
+    the unkilled baseline exactly."""
+    cfg, params = model
+    ref_eng = _engine2(model, str(tmp_path / "ref20.jsonl"))
+    ref_eng.run(_requests(), deterministic=True)
+    ref = {s.req.request_id: s.generated for s in ref_eng.finished}
+
+    path = _crashed_journal(model, tmp_path)
+    p = str(tmp_path / "succ.jsonl")
+    shutil.copy(path, p)
+    got = _recover_and_finish(model, p, _requests(), **succ_kw)
+    assert got == ref, f"cross-config recovery diverged at {succ_kw}"
+
+
+def test_cross_kv_dtype_recovery_refuses_up_front(model, tmp_path):
+    """int8 KV is the one documented numeric deviation: crossing it in
+    EITHER direction breaks bit-identical re-drive, so recover() must
+    raise the named error before touching any engine state."""
+    path = _crashed_journal(model, tmp_path)
+    eng2 = _engine2(model, path, kv_dtype="int8")
+    with pytest.raises(JournalCompatError, match="kv_dtype"):
+        eng2.recover()
+    # refused up front: nothing was adopted, nothing allocated
+    assert eng2.idle() and eng2.pool.used_blocks == 0
+
+    # and the reverse crossing (int8 journal -> full-precision engine)
+    (tmp_path / "r").mkdir()
+    path8 = _crashed_journal(model, tmp_path / "r", kv_dtype="int8")
+    eng3 = _engine2(model, path8)
+    with pytest.raises(JournalCompatError, match="kv_dtype"):
+        eng3.recover()
+
+
+def test_cross_capacity_recovery_refuses_up_front(model, tmp_path):
+    """A successor that can NEVER serve a journaled request (seq-len
+    cap or pool too small for even one sequence) refuses by name
+    instead of failing deep inside the scheduler."""
+    reqs = _shared_requests()   # 150-token prompts: worst case 156
+    path = _crashed_journal(model, tmp_path, reqs=reqs)
+
+    p1 = str(tmp_path / "seqlen.jsonl")
+    shutil.copy(path, p1)
+    eng = _engine2(model, p1, max_seq_len=128)
+    with pytest.raises(JournalCompatError, match="max_seq_len"):
+        eng.recover()
+
+    p2 = str(tmp_path / "pool.jsonl")
+    shutil.copy(path, p2)
+    eng2 = _engine2(model, p2, num_blocks=2)   # 1 usable < 2 needed
+    with pytest.raises(JournalCompatError, match="never fit"):
+        eng2.recover()
+    assert eng2.idle() and eng2.pool.used_blocks == 0
 
 
 if __name__ == "__main__":

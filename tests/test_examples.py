@@ -31,14 +31,24 @@ def test_train_llama_hybrid():
     assert "step 1: loss" in out
 
 
+@pytest.mark.slow   # int8 decode parity is pinned by test_llama_decode/test_kv_int8/test_quantization; this subprocess smoke is compile-dominated
 def test_serve_int8():
     assert "continuation:" in _run("serve_int8.py")
 
 
+@pytest.mark.slow   # continuous-batching behavior is pinned by test_serving/test_fleet_serving; this subprocess smoke (fresh jax init + full serve run) is compile-dominated
 def test_serve_continuous():
     out = _run("serve_continuous.py")
     assert "throughput:" in out
     assert "pool leak-free: True" in out
+
+
+@pytest.mark.slow   # fleet routing/migration/swap are pinned by test_fleet_serving; this subprocess smoke (fresh jax init + 4 fleet runs) is compile-dominated
+def test_serve_fleet():
+    out = _run("serve_fleet.py")
+    assert "bit-identical to lone engine: True" in out
+    assert "0 lost" in out
+    assert "bit-identical to no-failure run: True" in out
 
 
 def test_dygraph_train():

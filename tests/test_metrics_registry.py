@@ -302,6 +302,81 @@ def test_engine_registry_rejects_shadowing(served_engine):
         served_engine.registry.gauge("iterations")
 
 
+# -- replica merge (PR 20) ---------------------------------------------------
+
+def test_merge_label_splits_replicas():
+    regs = []
+    for i in range(3):
+        r = MetricsRegistry(prefix="p")
+        r.gauge("depth", help="queue depth").set(i)
+        s = r.summary("lat_seconds", help="latency")
+        s.observe(0.01 * (i + 1))
+        regs.append((str(i), r))
+    text = MetricsRegistry.merge(regs, label="replica")
+    kinds = check_exposition(text)
+    assert kinds == {"p_depth": "gauge", "p_lat_seconds": "histogram"}
+    assert 'p_depth{replica="0"} 0.0' in text
+    assert 'p_depth{replica="2"} 2.0' in text
+    assert 'p_lat_seconds_bucket{replica="1",le="+Inf"} 1' in text
+    # one HELP/TYPE declaration per family, not one per replica
+    assert text.count("# TYPE p_depth gauge") == 1
+    assert text.count("# HELP p_depth queue depth") == 1
+
+
+def test_merge_appends_replica_to_family_labels():
+    a = MetricsRegistry(prefix="p")
+    a.family("hop_ms", "gauge", labelnames=("site",)) \
+        .labels(site="x").set(1)
+    b = MetricsRegistry(prefix="p")
+    b.family("hop_ms", "gauge", labelnames=("site",)) \
+        .labels(site="x").set(2)
+    text = MetricsRegistry.merge([("0", a), ("1", b)])
+    check_exposition(text)
+    assert 'p_hop_ms{replica="0",site="x"} 1.0' in text
+    assert 'p_hop_ms{replica="1",site="x"} 2.0' in text
+
+
+def test_merge_rejects_non_label_split_collisions():
+    a = MetricsRegistry(prefix="p")
+    a.gauge("x", help="h")
+    b = MetricsRegistry(prefix="p")
+    b.counter("x", help="h")
+    with pytest.raises(ValueError, match="collides"):
+        MetricsRegistry.merge([("0", a), ("1", b)])
+    c = MetricsRegistry(prefix="p")
+    c.gauge("x", help="a DIFFERENT help")
+    with pytest.raises(ValueError, match="collides"):
+        MetricsRegistry.merge([("0", a), ("1", c)])
+
+
+def test_merge_rejects_duplicate_label_values_and_label_shadowing():
+    a = MetricsRegistry(prefix="p")
+    a.gauge("x").set(1)
+    with pytest.raises(ValueError, match="duplicate replica"):
+        MetricsRegistry.merge([("0", a), ("0", a)])
+    d = MetricsRegistry(prefix="p")
+    d.family("y", "gauge", labelnames=("replica",)) \
+        .labels(replica="z").set(1)
+    with pytest.raises(ValueError, match="already carries"):
+        MetricsRegistry.merge([("0", d)])
+    with pytest.raises(ValueError, match="invalid label"):
+        MetricsRegistry.merge([("0", a)], label="not-a-label")
+
+
+def test_engine_registries_merge_compliant(served_engine):
+    """Two copies of a LIVE engine registry merge into one compliant
+    scrape with every sample label-split by replica — the fleet
+    exposition's building block."""
+    eng = served_engine
+    text = MetricsRegistry.merge([("0", eng.registry),
+                                  ("1", eng.registry)])
+    check_exposition(text)
+    assert 'paddle_tpu_serve_finished_requests{replica="0"} 2.0' in text
+    assert 'paddle_tpu_serve_finished_requests{replica="1"} 2.0' in text
+    assert ('paddle_tpu_serve_ttft_seconds_bucket{replica="0",le='
+            in text)
+
+
 def test_fleet_exposition_is_compliant():
     mon = FleetMonitor(rank=0, world=1, interval=2, out_path=None)
     for t in (0.010, 0.012, 0.011, 0.013):

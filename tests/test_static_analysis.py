@@ -35,6 +35,7 @@ def test_every_suppression_and_grant_carries_a_reason(repo_report):
         assert f.reason, f"{f.format()} suppressed without a reason"
 
 
+@pytest.mark.slow   # same full-repo strict pass as the baseline-check gate below, which stays tier-1; keeping one CLI sweep per run as the repo grows
 def test_cli_strict_exits_zero_and_emits_json(capsys):
     rc = cli_main(["--strict", "--json"])
     out = capsys.readouterr().out
